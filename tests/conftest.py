@@ -1,0 +1,40 @@
+"""Test session setup: force JAX onto an 8-device virtual CPU mesh.
+
+This is the JAX-idiomatic "multi-chip without a cluster" (SURVEY.md §4):
+tensor-parallel and data-parallel tests shard over 8 host-platform devices,
+numerics tests run on CPU, and nothing here ever needs a real TPU.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests with asyncio.run (pytest-asyncio not available)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    from fasttalk_tpu.utils.metrics import reset_metrics
+
+    reset_metrics()
+    yield
+    reset_metrics()
