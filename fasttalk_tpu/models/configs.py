@@ -1,0 +1,123 @@
+"""Model architecture configs for the Llama family the reference serves.
+
+The reference serves these models by name through external engines
+(reference: README.md model tables, app/utils/config.py:86 LLM_MODEL
+defaults to "llama3.2:1b"); here the architecture lives in-tree so the
+JAX engine can build and shard the real thing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3 style rope frequency scaling (as in HF config rope_scaling)."""
+
+    factor: float = 32.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = True
+    max_position: int = 131072
+    rope_scaling: RopeScaling | None = None
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.hidden_size
+        attn = self.hidden_size * self.q_dim + 2 * self.hidden_size * self.kv_dim \
+            + self.q_dim * self.hidden_size
+        mlp = 3 * self.hidden_size * self.intermediate_size
+        norms = 2 * self.hidden_size
+        per_layer = attn + mlp + norms
+        head = 0 if self.tie_embeddings else embed
+        return embed + self.num_layers * per_layer + self.hidden_size + head
+
+
+_LLAMA32_SCALING = RopeScaling(factor=32.0, low_freq_factor=1.0,
+                               high_freq_factor=4.0, original_max_position=8192)
+
+_REGISTRY: dict[str, ModelConfig] = {}
+
+
+def _register(cfg: ModelConfig, *aliases: str) -> None:
+    _REGISTRY[cfg.name] = cfg
+    for a in aliases:
+        _REGISTRY[a] = cfg
+
+
+_register(ModelConfig(
+    name="llama3.2:1b", vocab_size=128256, hidden_size=2048,
+    intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+    head_dim=64, tie_embeddings=True, rope_scaling=_LLAMA32_SCALING),
+    "meta-llama/Llama-3.2-1B", "meta-llama/Llama-3.2-1B-Instruct")
+
+_register(ModelConfig(
+    name="llama3.2:3b", vocab_size=128256, hidden_size=3072,
+    intermediate_size=8192, num_layers=28, num_heads=24, num_kv_heads=8,
+    head_dim=128, tie_embeddings=True, rope_scaling=_LLAMA32_SCALING),
+    "meta-llama/Llama-3.2-3B", "meta-llama/Llama-3.2-3B-Instruct")
+
+_register(ModelConfig(
+    name="llama3:8b", vocab_size=128256, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    head_dim=128, tie_embeddings=False, max_position=8192),
+    "llama3.1:8b", "meta-llama/Meta-Llama-3-8B-Instruct",
+    "meta-llama/Llama-3.1-8B-Instruct",
+    "hugging-quants/Meta-Llama-3.1-8B-Instruct-AWQ-INT4")
+
+_register(ModelConfig(
+    name="llama3:70b", vocab_size=128256, hidden_size=8192,
+    intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+    head_dim=128, tie_embeddings=False, max_position=8192),
+    "llama3.1:70b", "meta-llama/Meta-Llama-3-70B-Instruct")
+
+# Tiny config for tests and CI: runs everywhere in milliseconds. Vocab is
+# sized for the byte-level fallback tokenizer (256 bytes + specials).
+_register(ModelConfig(
+    name="test-tiny", vocab_size=384, hidden_size=64, intermediate_size=256,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+    tie_embeddings=True, max_position=2048, rope_theta=10000.0))
+
+# Small-but-real config for on-TPU smoke benchmarks without weights.
+_register(ModelConfig(
+    name="test-small", vocab_size=8192, hidden_size=512,
+    intermediate_size=2048, num_layers=8, num_heads=8, num_kv_heads=4,
+    head_dim=64, tie_embeddings=True, max_position=8192))
+
+
+def get_model_config(name: str) -> ModelConfig:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    raise KeyError(
+        f"Unknown model {name!r}. Known: {sorted(set(c.name for c in _REGISTRY.values()))}")
+
+
+def list_models() -> list[str]:
+    return sorted({c.name for c in _REGISTRY.values()})
+
+
+def with_overrides(cfg: ModelConfig, **kw) -> ModelConfig:
+    return replace(cfg, **kw)

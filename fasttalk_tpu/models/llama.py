@@ -1,0 +1,146 @@
+"""Pure-functional Llama forward pass with in-forward KV cache update.
+
+TPU-first design notes:
+- Per-layer weights are *stacked* along a leading layer axis and the
+  transformer body is a single ``lax.scan`` — one traced layer instead of
+  N, so a 70B/80-layer model compiles as fast as the 1B.
+- The KV cache is threaded through the scan as scan inputs/outputs with
+  matching shapes, so under ``jit(..., donate_argnums=...)`` XLA aliases
+  the buffers and decode updates the cache in place in HBM.
+- All norms/softmax/rope run in float32; matmuls stay in bfloat16 on the
+  MXU (``preferred_element_type`` on the attention contraction).
+- Writes use vmapped ``dynamic_update_slice`` so each batch row (slot)
+  can write at its own position — the primitive continuous batching needs.
+
+This module replaces the model execution that the reference delegated to
+external vLLM/Ollama containers (SURVEY.md §2: in-tree native components
+NONE; engine capability lived in the containers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value cache: k, v each [L, B, S, num_kv_heads, head_dim]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int,
+               dtype: jnp.dtype = jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array,
+                dtype: jnp.dtype = jnp.bfloat16) -> Params:
+    """Random init with GPT-style scaled normals (for tests and weight-free
+    benchmarking; real checkpoints come from models/loader.py)."""
+    keys = iter(jax.random.split(rng, 16))
+    d, f, l = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    scale = d ** -0.5
+    params: Params = {
+        "embed": normal(next(keys), (cfg.vocab_size, d), scale),
+        "layers": {
+            "attn_norm": jnp.ones((l, d), dtype),
+            "wq": normal(next(keys), (l, d, cfg.q_dim), scale),
+            "wk": normal(next(keys), (l, d, cfg.kv_dim), scale),
+            "wv": normal(next(keys), (l, d, cfg.kv_dim), scale),
+            "wo": normal(next(keys), (l, cfg.q_dim, d), scale / np.sqrt(2 * l)),
+            "mlp_norm": jnp.ones((l, d), dtype),
+            "w_gate": normal(next(keys), (l, d, f), scale),
+            "w_up": normal(next(keys), (l, d, f), scale),
+            "w_down": normal(next(keys), (l, f, d), f ** -0.5 / np.sqrt(2 * l)),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(keys), (d, cfg.vocab_size), scale)
+    return params
+
+
+def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (normed * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _write_kv(cache_layer: jnp.ndarray, new: jnp.ndarray,
+              write_start: jnp.ndarray) -> jnp.ndarray:
+    """Write new [B, T, K, H] into cache [B, S, K, H] at per-row offsets."""
+    def row(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+    return jax.vmap(row)(cache_layer, new, write_start)
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, cache: KVCache, write_start: jnp.ndarray,
+            *, blockwise: bool = False) -> tuple[jnp.ndarray, KVCache]:
+    """Run the transformer over ``tokens`` [B, T], updating the cache.
+
+    positions [B, T]: absolute position of each token (also its RoPE phase
+    and attention horizon). write_start [B]: cache index where this chunk's
+    K/V are written per row. Works for prefill (T=chunk) and decode (T=1)
+    alike; ``blockwise`` picks the flash-style attention for long chunks.
+
+    Returns (logits [B, T, vocab], updated cache).
+    """
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                            cfg.rope_scaling))
+    x = jnp.take(params["embed"], tokens, axis=0)
+    b, t = tokens.shape
+
+    def layer(x, scanned):
+        lp, ck, cv = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = (h @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        ck = _write_kv(ck, k, write_start)
+        cv = _write_kv(cv, v, write_start)
+        attn_fn = attend_blockwise if blockwise else attend
+        o = attn_fn(q, ck, cv, positions)
+        x = x + o.reshape(b, t, cfg.q_dim) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+        up = (h @ lp["w_up"]).astype(jnp.float32)
+        x = x + (gate * up).astype(x.dtype) @ lp["w_down"]
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+def param_count(params: Params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

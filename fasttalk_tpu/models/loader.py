@@ -1,0 +1,148 @@
+"""Weight loading: HF safetensors checkpoints → stacked JAX pytrees.
+
+The reference never loads weights in-tree — its external engines pull
+them into docker volumes (SURVEY.md §5 checkpoint/resume: none in-tree;
+config MODEL_PATH existed at reference config.py:157 but nothing read
+it). Here MODEL_PATH points at a HF-format checkpoint directory and the
+loader builds the stacked-layer pytree the scan-based forward expects,
+optionally placing shards straight onto a device mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.models.llama import Params, init_params
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("models.loader")
+
+# HF parameter name templates → (our pytree path, needs_transpose).
+# HF Linear stores [out, in]; our forward uses x @ w so we keep [in, out].
+_LAYER_MAP = {
+    "model.layers.{i}.input_layernorm.weight": ("attn_norm", False),
+    "model.layers.{i}.self_attn.q_proj.weight": ("wq", True),
+    "model.layers.{i}.self_attn.k_proj.weight": ("wk", True),
+    "model.layers.{i}.self_attn.v_proj.weight": ("wv", True),
+    "model.layers.{i}.self_attn.o_proj.weight": ("wo", True),
+    "model.layers.{i}.post_attention_layernorm.weight": ("mlp_norm", False),
+    "model.layers.{i}.mlp.gate_proj.weight": ("w_gate", True),
+    "model.layers.{i}.mlp.up_proj.weight": ("w_up", True),
+    "model.layers.{i}.mlp.down_proj.weight": ("w_down", True),
+}
+
+
+def find_checkpoint_dir(model_path: str, model_name: str) -> str | None:
+    """Locate a safetensors checkpoint under MODEL_PATH for model_name."""
+    candidates = [
+        model_path,
+        os.path.join(model_path, model_name.replace(":", "_")),
+        os.path.join(model_path, model_name.replace(":", "-")),
+        os.path.join(model_path, model_name),
+    ]
+    for c in candidates:
+        if os.path.isdir(c) and any(f.endswith(".safetensors")
+                                    for f in os.listdir(c)):
+            return c
+    return None
+
+
+def _open_all_tensors(ckpt_dir: str) -> dict[str, Any]:
+    """Map tensor name → (file handle accessor). Supports sharded index."""
+    from safetensors import safe_open
+
+    files = sorted(f for f in os.listdir(ckpt_dir) if f.endswith(".safetensors"))
+    index_path = os.path.join(ckpt_dir, "model.safetensors.index.json")
+    name_to_file: dict[str, str] = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            name_to_file = json.load(f)["weight_map"]
+    else:
+        for fname in files:
+            with safe_open(os.path.join(ckpt_dir, fname), framework="numpy") as sf:
+                for key in sf.keys():
+                    name_to_file[key] = fname
+    return name_to_file
+
+
+def load_params(cfg: ModelConfig, ckpt_dir: str,
+                dtype: jnp.dtype = jnp.bfloat16,
+                put: Callable[[np.ndarray, str], jax.Array] | None = None,
+                ) -> Params:
+    """Load a HF Llama checkpoint into the stacked pytree.
+
+    ``put(host_array, pytree_path) -> jax.Array`` lets the caller place
+    each tensor with a sharding (parallel/sharding.py provides one);
+    default is plain device_put.
+    """
+    from safetensors import safe_open
+
+    name_to_file = _open_all_tensors(ckpt_dir)
+    handles: dict[str, Any] = {}
+
+    def get(name: str) -> np.ndarray:
+        fname = name_to_file[name]
+        if fname not in handles:
+            handles[fname] = safe_open(os.path.join(ckpt_dir, fname),
+                                       framework="numpy")
+        t = handles[fname].get_tensor(name)
+        if t.dtype == np.dtype("uint16"):  # raw bf16 comes back as u16
+            t = t.view(np.uint16)
+            t = (t.astype(np.uint32) << 16).view(np.float32)
+        return t
+
+    if put is None:
+        def put(arr: np.ndarray, path: str) -> jax.Array:  # noqa: ARG001
+            return jax.device_put(jnp.asarray(arr, dtype))
+
+    def cast(a: np.ndarray) -> np.ndarray:
+        return np.asarray(a, np.float32)
+
+    params: Params = {
+        "embed": put(cast(get("model.embed_tokens.weight")), "embed"),
+        "final_norm": put(cast(get("model.norm.weight")), "final_norm"),
+        "layers": {},
+    }
+    for tmpl, (path, transpose) in _LAYER_MAP.items():
+        stacked = []
+        for i in range(cfg.num_layers):
+            t = cast(get(tmpl.format(i=i)))
+            stacked.append(t.T if transpose else t)
+        params["layers"][path] = put(np.stack(stacked), f"layers/{path}")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = put(cast(get("lm_head.weight")).T, "lm_head")
+    for h in handles.values():
+        h.__exit__(None, None, None)
+    log.info(f"Loaded checkpoint from {ckpt_dir}", model=cfg.name)
+    return params
+
+
+def load_or_init(cfg: ModelConfig, model_path: str,
+                 dtype: jnp.dtype = jnp.bfloat16,
+                 put: Callable[[np.ndarray, str], jax.Array] | None = None,
+                 seed: int = 0) -> tuple[Params, bool]:
+    """Load weights if a checkpoint exists under model_path, else random
+    init (architecture-faithful; used for tests and weight-free perf work).
+
+    Returns (params, loaded_from_checkpoint).
+    """
+    ckpt = find_checkpoint_dir(model_path, cfg.name) if model_path else None
+    if ckpt:
+        return load_params(cfg, ckpt, dtype, put), True
+    log.warning(
+        f"No checkpoint for {cfg.name!r} under {model_path!r}; "
+        "using random-initialised weights")
+    params = init_params(cfg, jax.random.PRNGKey(seed), dtype)
+    if put is not None:
+        params = jax.tree_util.tree_map_with_path(
+            lambda path, a: put(np.asarray(a),
+                                "/".join(str(getattr(k, "key", k)) for k in path)),
+            params)
+    return params, False

@@ -23,11 +23,23 @@ def _env_str(name: str, default: str) -> str:
 
 
 def _env_int(name: str, default: int) -> int:
-    return int(os.getenv(name, str(default)))
+    raw = os.getenv(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"env {name} must be an integer, got {raw!r}") from None
 
 
 def _env_float(name: str, default: float) -> float:
-    return float(os.getenv(name, str(default)))
+    raw = os.getenv(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"env {name} must be a number, got {raw!r}") from None
 
 
 def _env_bool(name: str, default: bool) -> bool:
@@ -53,7 +65,7 @@ def detect_compute_device() -> str:
         if requested in available:
             return requested
         # Requested device unavailable: fall through to best available.
-    for dev in VALID_DEVICES:
+    for dev in ("tpu", "cuda", "mps", "cpu"):
         if dev in available:
             return dev
     return "cpu"

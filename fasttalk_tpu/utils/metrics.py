@@ -12,6 +12,7 @@ from __future__ import annotations
 import bisect
 import threading
 import time
+from collections import deque
 from typing import Any, Iterable
 
 
@@ -69,8 +70,7 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._n = 0
-        self._window: list[float] = []
-        self._window_cap = window
+        self._window: deque[float] = deque(maxlen=window)
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -79,28 +79,32 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._n += 1
-            if len(self._window) >= self._window_cap:
-                self._window.pop(0)
             self._window.append(value)
+
+    @staticmethod
+    def _quantile(sorted_window: list[float], q: float) -> float:
+        if not sorted_window:
+            return 0.0
+        idx = min(len(sorted_window) - 1,
+                  max(0, int(q / 100.0 * len(sorted_window))))
+        return sorted_window[idx]
 
     def percentile(self, q: float) -> float:
         with self._lock:
-            if not self._window:
-                return 0.0
             s = sorted(self._window)
-            idx = min(len(s) - 1, max(0, int(q / 100.0 * len(s))))
-            return s[idx]
+        return self._quantile(s, q)
 
     def summary(self) -> dict[str, float]:
-        with self._lock:
+        with self._lock:  # one consistent snapshot, one sort
             n, total = self._n, self._sum
+            s = sorted(self._window)
         return {
             "count": n,
             "sum": total,
             "mean": total / n if n else 0.0,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "p99": self.percentile(99),
+            "p50": self._quantile(s, 50),
+            "p95": self._quantile(s, 95),
+            "p99": self._quantile(s, 99),
         }
 
 

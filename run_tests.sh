@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Run the test suite on a pure-CPU 8-virtual-device JAX, immune to the
+# hosting image's axon TPU plugin (PYTHONPATH sitecustomize) — tests must
+# not depend on, or hang on, the TPU tunnel.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/ "$@"

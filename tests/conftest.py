@@ -8,7 +8,13 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the hosting image exports JAX_PLATFORMS=axon
+# globally, which would silently run "CPU" tests on the tunnelled TPU.
+# NOTE: if the axon relay is down, any process whose interpreter loaded
+# the axon sitecustomize (via PYTHONPATH=/root/.axon_site) can hang at
+# backend init even with JAX_PLATFORMS=cpu — run tests via ./run_tests.sh,
+# which strips PYTHONPATH.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,7 +23,12 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# This XLA CPU build runs f32 matmuls in reduced precision by default
+# (observed ~5e-2 divergence vs numpy). Numerics tests need true f32.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 
 def pytest_pyfunc_call(pyfuncitem):

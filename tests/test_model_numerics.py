@@ -1,0 +1,232 @@
+"""Model numerics on the CPU backend.
+
+The strongest check: logit parity against HuggingFace transformers'
+torch Llama implementation on a tiny random-weight config, routed
+through our safetensors loader (so the HF-name mapping and transposes
+are covered too).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fasttalk_tpu.models import (
+    KVCache,
+    forward,
+    get_model_config,
+    init_cache,
+    init_params,
+    param_count,
+)
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.sampling import sample_tokens
+
+TINY = get_model_config("test-tiny")
+
+
+def make_params(cfg: ModelConfig, seed: int = 0, dtype=jnp.float32):
+    return init_params(cfg, jax.random.PRNGKey(seed), dtype)
+
+
+class TestForward:
+    def test_shapes_and_finite(self):
+        params = make_params(TINY)
+        cache = init_cache(TINY, batch=2, max_len=64, dtype=jnp.float32)
+        tokens = jnp.array([[1, 2, 3, 4], [5, 6, 7, 8]])
+        positions = jnp.tile(jnp.arange(4), (2, 1))
+        logits, cache2 = forward(params, TINY, tokens, positions, cache,
+                                 jnp.zeros(2, jnp.int32))
+        assert logits.shape == (2, 4, TINY.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+        # cache rows 0..3 written, tail untouched (zeros)
+        assert not bool(jnp.all(cache2.k[:, :, :4] == 0))
+        assert bool(jnp.all(cache2.k[:, :, 4:] == 0))
+
+    def test_prefill_then_decode_matches_full_forward(self):
+        """Chunked prefill + single-token decode == one-shot forward."""
+        params = make_params(TINY)
+        t = 9
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, t), 0,
+                                    TINY.vocab_size)
+        positions = jnp.arange(t)[None, :]
+
+        cache = init_cache(TINY, 1, 32, jnp.float32)
+        full_logits, _ = forward(params, TINY, tokens, positions, cache,
+                                 jnp.zeros(1, jnp.int32))
+
+        # prefill first t-1, then decode the last token
+        cache = init_cache(TINY, 1, 32, jnp.float32)
+        _, cache = forward(params, TINY, tokens[:, :t - 1],
+                           positions[:, :t - 1], cache, jnp.zeros(1, jnp.int32))
+        step_logits, _ = forward(params, TINY, tokens[:, t - 1:],
+                                 positions[:, t - 1:], cache,
+                                 jnp.full((1,), t - 1, jnp.int32))
+        np.testing.assert_allclose(np.asarray(step_logits[0, 0]),
+                                   np.asarray(full_logits[0, -1]),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_per_row_write_offsets(self):
+        """Slots writing at different cache offsets don't interfere."""
+        params = make_params(TINY)
+        cache = init_cache(TINY, 2, 16, jnp.float32)
+        tokens = jnp.array([[3], [7]])
+        positions = jnp.array([[0], [5]])
+        _, cache2 = forward(params, TINY, tokens, positions, cache,
+                            jnp.array([0, 5]))
+        assert not bool(jnp.all(cache2.k[:, 0, 0] == 0))
+        assert bool(jnp.all(cache2.k[:, 0, 1:] == 0))
+        assert not bool(jnp.all(cache2.k[:, 1, 5] == 0))
+        assert bool(jnp.all(cache2.k[:, 1, :5] == 0))
+
+    def test_padding_does_not_leak(self):
+        """Garbage in the cache tail must not affect logits (position mask)."""
+        params = make_params(TINY)
+        tokens = jnp.array([[1, 2, 3]])
+        positions = jnp.arange(3)[None, :]
+        clean = init_cache(TINY, 1, 32, jnp.float32)
+        dirty = KVCache(k=clean.k.at[:, :, 10:].set(99.0),
+                        v=clean.v.at[:, :, 10:].set(-99.0))
+        lc, _ = forward(params, TINY, tokens, positions, clean,
+                        jnp.zeros(1, jnp.int32))
+        ld, _ = forward(params, TINY, tokens, positions, dirty,
+                        jnp.zeros(1, jnp.int32))
+        np.testing.assert_allclose(np.asarray(lc), np.asarray(ld), atol=1e-6)
+
+    def test_param_count_matches_config(self):
+        params = make_params(TINY)
+        assert param_count(params) == TINY.param_count()
+
+    def test_real_config_param_counts(self):
+        assert get_model_config("llama3.2:1b").param_count() == pytest.approx(
+            1.24e9, rel=0.02)
+        assert get_model_config("llama3:8b").param_count() == pytest.approx(
+            8.0e9, rel=0.01)
+        assert get_model_config("llama3:70b").param_count() == pytest.approx(
+            70.6e9, rel=0.01)
+
+
+class TestAttention:
+    def test_blockwise_matches_full(self):
+        rng = jax.random.PRNGKey(0)
+        b, t, s, nq, nkv, d = 2, 8, 64, 4, 2, 16
+        q = jax.random.normal(rng, (b, t, nq, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, nkv, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, nkv, d))
+        positions = jnp.tile(jnp.arange(20, 20 + t), (b, 1))
+        full = attend(q, k, v, positions)
+        blocked = attend_blockwise(q, k, v, positions, block_size=16)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(blocked),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_causality(self):
+        """Changing keys at positions beyond the query must not change out."""
+        rng = jax.random.PRNGKey(0)
+        q = jax.random.normal(rng, (1, 1, 4, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 2, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 32, 2, 16))
+        positions = jnp.array([[5]])
+        out1 = attend(q, k, v, positions)
+        k2 = k.at[:, 6:].set(123.0)
+        v2 = v.at[:, 6:].set(-123.0)
+        out2 = attend(q, k2, v2, positions)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+
+
+class TestSampling:
+    def test_greedy_at_zero_temperature(self):
+        logits = jnp.array([[0.1, 3.0, 0.2, -1.0], [5.0, 0.0, 0.0, 0.0]])
+        toks = sample_tokens(logits, jax.random.PRNGKey(0),
+                             temperature=jnp.zeros(2),
+                             top_k=jnp.zeros(2, jnp.int32),
+                             top_p=jnp.ones(2), max_candidates=4)
+        assert toks.tolist() == [1, 0]
+
+    def test_top_k_one_is_greedy(self):
+        logits = jax.random.normal(jax.random.PRNGKey(3), (4, 100))
+        toks = sample_tokens(logits, jax.random.PRNGKey(0),
+                             temperature=jnp.full(4, 1.0),
+                             top_k=jnp.ones(4, jnp.int32),
+                             top_p=jnp.ones(4), max_candidates=16)
+        assert toks.tolist() == jnp.argmax(logits, -1).tolist()
+
+    def test_top_k_respected(self):
+        """With top_k=3, only the 3 highest logits are ever sampled."""
+        logits = jnp.tile(jnp.arange(50.0)[None, :], (1, 1))
+        allowed = {49, 48, 47}
+        for seed in range(30):
+            toks = sample_tokens(logits, jax.random.PRNGKey(seed),
+                                 temperature=jnp.full(1, 2.0),
+                                 top_k=jnp.full(1, 3, jnp.int32),
+                                 top_p=jnp.ones(1), max_candidates=8)
+            assert int(toks[0]) in allowed
+
+    def test_top_p_keeps_head_token(self):
+        logits = jnp.array([[10.0, 1.0, 0.5, 0.1]])
+        toks = sample_tokens(logits, jax.random.PRNGKey(7),
+                             temperature=jnp.full(1, 1.0),
+                             top_k=jnp.zeros(1, jnp.int32),
+                             top_p=jnp.full(1, 0.01), max_candidates=4)
+        assert int(toks[0]) == 0
+
+    def test_per_row_settings_mix(self):
+        """One batched call: row0 greedy, row1 stochastic."""
+        logits = jnp.tile(jnp.arange(20.0)[None, :], (2, 1))
+        seen = set()
+        for seed in range(20):
+            toks = sample_tokens(logits, jax.random.PRNGKey(seed),
+                                 temperature=jnp.array([0.0, 3.0]),
+                                 top_k=jnp.array([0, 10], jnp.int32),
+                                 top_p=jnp.array([1.0, 1.0]),
+                                 max_candidates=16)
+            assert int(toks[0]) == 19
+            seen.add(int(toks[1]))
+        assert len(seen) > 1  # stochastic row actually varies
+
+
+@pytest.mark.slow
+class TestHFGoldenParity:
+    """Logit parity vs transformers' torch Llama through our loader."""
+
+    def test_logits_match_hf(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import LlamaConfig, LlamaForCausalLM
+        from safetensors.torch import save_file
+
+        hf_cfg = LlamaConfig(
+            vocab_size=TINY.vocab_size, hidden_size=TINY.hidden_size,
+            intermediate_size=TINY.intermediate_size,
+            num_hidden_layers=TINY.num_layers,
+            num_attention_heads=TINY.num_heads,
+            num_key_value_heads=TINY.num_kv_heads,
+            head_dim=TINY.head_dim, rope_theta=TINY.rope_theta,
+            rms_norm_eps=TINY.rms_eps, tie_word_embeddings=True,
+            max_position_embeddings=TINY.max_position,
+            attention_bias=False, mlp_bias=False,
+        )
+        torch.manual_seed(0)
+        hf_model = LlamaForCausalLM(hf_cfg).eval()
+
+        ckpt = tmp_path / "test-tiny"
+        ckpt.mkdir()
+        state = {k: v.contiguous() for k, v in hf_model.state_dict().items()
+                 if k != "lm_head.weight"}  # tied → loader uses embed
+        save_file(state, str(ckpt / "model.safetensors"))
+
+        from fasttalk_tpu.models.loader import load_params
+        params = load_params(TINY, str(ckpt), dtype=jnp.float32)
+
+        t = 12
+        tokens_np = np.random.RandomState(42).randint(0, TINY.vocab_size,
+                                                      (1, t))
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(tokens_np)).logits.numpy()
+
+        cache = init_cache(TINY, 1, 32, jnp.float32)
+        ours, _ = forward(params, TINY, jnp.asarray(tokens_np),
+                          jnp.arange(t)[None, :], cache,
+                          jnp.zeros(1, jnp.int32))
+        np.testing.assert_allclose(np.asarray(ours), hf_logits,
+                                   rtol=2e-3, atol=2e-3)
