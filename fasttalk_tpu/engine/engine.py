@@ -1,0 +1,609 @@
+"""The TPU inference engine: continuous batching over fixed decode slots.
+
+This replaces the external vLLM/Ollama containers of the reference with an
+in-process JAX engine (SURVEY.md §7 design stance: the engine is an
+in-process library behind the same async-generator seam the reference
+handlers exposed, vllm_handler.py:216-225).
+
+Architecture (JetStream-style, XLA-first):
+
+- **Fixed shapes.** S decode slots; one jitted decode step advances all
+  slots at once. Prefill is chunked into power-of-two buckets; each bucket
+  compiles once. KV-length buckets bound attention cost: the decode step
+  is compiled per cache-prefix length in {512, 1024, ...} and the engine
+  picks the smallest bucket covering the longest active sequence.
+- **Donated KV cache.** The cache pytree is donated through every jitted
+  call, so K/V updates happen in place in HBM. Idle slots are excluded
+  from cache writes by a per-slot write mask, so a parked session's
+  resident KV can never be clobbered by the batched step.
+- **Single engine thread** owns every device interaction; asyncio callers
+  talk to it through a command queue, and token deltas travel back via
+  ``loop.call_soon_threadsafe`` onto per-request ``asyncio.Queue``s. A
+  generation is therefore fully async on the serving side — the
+  event-loop-stalling sync-generator bug of the reference
+  (websocket_server_vllm.py:578, SURVEY.md §3.3 warning) cannot occur.
+- **Mid-decode cancellation.** Cancel is a command; the engine deactivates
+  the slot at the next step boundary, freeing capacity immediately
+  (reference flaw: cancel could not even be received until generation
+  completed, SURVEY.md §3.6).
+- **KV residency across turns.** Sessions pin slots (engine/slots.py);
+  a follow-up turn prefills only the token delta after prefix matching.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncGenerator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fasttalk_tpu.engine.slots import Slot, SlotManager
+from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.models.llama import KVCache, forward, init_cache
+from fasttalk_tpu.ops.sampling import sample_tokens
+from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from fasttalk_tpu.utils.logger import get_logger
+from fasttalk_tpu.utils.metrics import get_metrics
+
+log = get_logger("engine")
+
+_KV_BUCKETS = (512, 1024, 2048, 4096, 8192, 16384, 32768)
+_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+
+
+@dataclass
+class GenerationParams:
+    temperature: float = 0.7
+    top_k: int = 40
+    top_p: float = 0.9
+    max_tokens: int = 2048
+    stop: list[str] = field(default_factory=list)
+
+
+@dataclass
+class _Request:
+    request_id: str
+    session_id: str
+    prompt_tokens: list[int]
+    params: GenerationParams
+    out_queue: asyncio.Queue
+    loop: asyncio.AbstractEventLoop
+    submitted_at: float = field(default_factory=time.monotonic)
+    detok: StreamDetokenizer | None = None
+    slot: Slot | None = None
+    generated: int = 0
+    pending_text: str = ""     # held back for stop-string matching
+    first_token_at: float | None = None
+    cancelled: bool = False
+    finished: bool = False
+
+
+class EngineBase:
+    """The engine seam the serving layer depends on. Mirrors the surface
+    of the reference's backend handlers (generate stream + connection
+    check + model info + cancel, vllm_handler.py:117-326) as one async
+    interface; tests substitute a FakeEngine."""
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    def cancel(self, request_id: str) -> bool:
+        raise NotImplementedError
+
+    def release_session(self, session_id: str) -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        raise NotImplementedError
+
+    def get_model_info(self) -> dict:
+        raise NotImplementedError
+
+    def get_stats(self) -> dict:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+
+class TPUEngine(EngineBase):
+    """The real engine. Owns params, KV cache, tokenizer, decode loop."""
+
+    def __init__(self, model_cfg: ModelConfig, params: Any,
+                 tokenizer: Tokenizer, *, num_slots: int = 16,
+                 max_len: int = 8192, prefill_chunk: int = 512,
+                 dtype: Any = jnp.bfloat16, seed: int = 0,
+                 context_window: int | None = None):
+        self.cfg = model_cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.usable_len = min(max_len, context_window or max_len)
+        self.prefill_chunk = min(prefill_chunk, max(_PREFILL_BUCKETS))
+        self.dtype = dtype
+
+        self.cache = init_cache(model_cfg, num_slots, max_len, dtype)
+        self.slots = SlotManager(num_slots, max_len)
+        self._cur_tokens = jnp.zeros((num_slots,), jnp.int32)
+        self._positions = np.zeros((num_slots,), np.int32)
+        self._active_mask = np.zeros((num_slots,), bool)
+        self._temps = np.zeros((num_slots,), np.float32)
+        self._topks = np.zeros((num_slots,), np.int32)
+        self._topps = np.ones((num_slots,), np.float32)
+        self._base_key = jax.random.PRNGKey(seed)
+        self._step = 0
+
+        self._commands: queue.Queue = queue.Queue()
+        self._waiting: list[_Request] = []
+        self._running: dict[int, _Request] = {}  # slot index -> request
+        self._by_id: dict[str, _Request] = {}
+        self._release_after: set[str] = set()  # sessions to unpin on finish
+        self._thread: threading.Thread | None = None
+        self._stopped = threading.Event()
+        self._started = False
+        self._decode_fns: dict[int, Any] = {}
+        self._prefill_fns: dict[int, Any] = {}
+
+        m = get_metrics()
+        self._m_tokens = m.counter("engine_tokens_generated_total",
+                                   "tokens generated by the engine")
+        self._m_requests = m.counter("engine_requests_total",
+                                     "generation requests accepted")
+        self._m_ttft = m.histogram("engine_ttft_ms", "time to first token")
+        self._m_step = m.histogram(
+            "engine_decode_step_ms", "decode step wall time",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000))
+        self._m_active = m.gauge("engine_active_slots", "slots decoding")
+        self._m_queue = m.gauge("engine_queue_depth", "requests waiting")
+        self._m_prefix = m.counter("engine_prefix_tokens_reused_total",
+                                   "prompt tokens served from resident KV")
+
+    # ---------------- public (asyncio side) ----------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._stopped.clear()
+        self._thread = threading.Thread(target=self._run, name="tpu-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if not self._started:
+            return
+        self._commands.put(("stop", None))
+        self._stopped.wait(timeout=30)
+        self._started = False
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        """Stream events: {"type": "token", "text": ...} per delta, then a
+        terminal {"type": "done"|"error"|"cancelled", ...}."""
+        if not self.check_connection():
+            raise LLMServiceError("Engine is not running (call start())",
+                                  category=ErrorCategory.CONNECTION,
+                                  recoverable=True)
+        prompt = self.tokenizer.apply_chat_template(messages)
+        if len(prompt) >= self.usable_len:
+            raise LLMServiceError(
+                f"Prompt of {len(prompt)} tokens exceeds context window "
+                f"{self.usable_len}", category=ErrorCategory.VALIDATION,
+                recoverable=False)
+        req = _Request(
+            request_id=request_id, session_id=session_id,
+            prompt_tokens=prompt, params=params,
+            out_queue=asyncio.Queue(), loop=asyncio.get_running_loop(),
+            detok=StreamDetokenizer(self.tokenizer))
+        self._m_requests.inc()
+        # Register before enqueueing so an immediate cancel() can't race
+        # the engine thread's command drain.
+        self._by_id[request_id] = req
+        self._commands.put(("submit", req))
+        terminal = False
+        try:
+            while True:
+                event = await req.out_queue.get()
+                if event["type"] in ("done", "error", "cancelled"):
+                    terminal = True
+                yield event
+                if terminal:
+                    return
+        finally:
+            if not terminal:
+                # Caller abandoned the stream (e.g. WebSocket dropped):
+                # free the slot instead of decoding to max_tokens.
+                self.cancel(request_id)
+
+    def cancel(self, request_id: str) -> bool:
+        req = self._by_id.get(request_id)
+        if req is None:
+            return False
+        req.cancelled = True  # visible to the engine thread immediately
+        self._commands.put(("cancel", request_id))
+        return True
+
+    def release_session(self, session_id: str) -> None:
+        self._commands.put(("release", session_id))
+
+    def check_connection(self) -> bool:
+        return self._started and self._thread is not None \
+            and self._thread.is_alive()
+
+    def get_model_info(self) -> dict:
+        return {
+            "model": self.cfg.name,
+            "vocab_size": self.cfg.vocab_size,
+            "num_layers": self.cfg.num_layers,
+            "hidden_size": self.cfg.hidden_size,
+            "parameters": self.cfg.param_count(),
+            "context_window": self.usable_len,
+            "decode_slots": self.num_slots,
+            "dtype": jnp.dtype(self.dtype).name,
+            "devices": [str(d) for d in jax.devices()],
+        }
+
+    def get_stats(self) -> dict:
+        return {
+            "slots": self.slots.stats(),
+            "waiting": len(self._waiting),
+            "running": len(self._running),
+        }
+
+    # ---------------- jitted steps ----------------
+
+    def _get_decode_fn(self, kv_len: int):
+        fn = self._decode_fns.get(kv_len)
+        if fn is not None:
+            return fn
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_step(params, cache: KVCache, cur_tokens, positions,
+                        active, temps, topks, topps, rng):
+            ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
+            cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
+            logits, small = forward(
+                params, self.cfg, cur_tokens[:, None], positions[:, None],
+                KVCache(ck, cv), positions, write_mask=active)
+            nxt = sample_tokens(logits[:, -1], rng, temps, topks, topps)
+            new_k = jax.lax.dynamic_update_slice_in_dim(
+                cache.k, small.k, 0, axis=2)
+            new_v = jax.lax.dynamic_update_slice_in_dim(
+                cache.v, small.v, 0, axis=2)
+            return KVCache(new_k, new_v), nxt
+
+        self._decode_fns[kv_len] = decode_step
+        return decode_step
+
+    def _get_prefill_fn(self, chunk: int):
+        fn = self._prefill_fns.get(chunk)
+        if fn is not None:
+            return fn
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_step(params, cache: KVCache, tokens, start, slot,
+                         last_index):
+            """Run one prompt chunk for one slot; returns last-token logits."""
+            slot_shape = (self.cfg.num_layers, 1, self.max_len,
+                          self.cfg.num_kv_heads, self.cfg.head_dim)
+            lk = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0), slot_shape)
+            lv = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0), slot_shape)
+            positions = start + jnp.arange(chunk)[None, :]
+            logits, updated = forward(
+                params, self.cfg, tokens[None, :], positions,
+                KVCache(lk, lv), start[None], blockwise=True)
+            new_k = jax.lax.dynamic_update_slice(
+                cache.k, updated.k, (0, slot, 0, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(
+                cache.v, updated.v, (0, slot, 0, 0, 0))
+            last = jax.lax.dynamic_slice(
+                logits, (0, last_index, 0), (1, 1, logits.shape[-1]))[0, 0]
+            return KVCache(new_k, new_v), last
+
+        self._prefill_fns[chunk] = prefill_step
+        return prefill_step
+
+    def _next_rng(self) -> jax.Array:
+        self._step += 1
+        return jax.random.fold_in(self._base_key, self._step)
+
+    # ---------------- engine thread ----------------
+
+    def _run(self) -> None:
+        log.info("engine thread started",
+                 model=self.cfg.name, slots=self.num_slots,
+                 max_len=self.max_len)
+        try:
+            while True:
+                if not self._drain_commands(block=not self._running):
+                    break
+                self._admit()
+                if self._running:
+                    self._decode_once()
+                self._m_active.set(len(self._running))
+                self._m_queue.set(len(self._waiting))
+        except Exception as e:  # engine thread must not die silently
+            log.critical(f"engine thread crashed: {e}", exc_info=True)
+            self._abort_all(f"engine crashed: {e}")
+        else:
+            self._abort_all("engine shut down")
+        finally:
+            self._stopped.set()
+            log.info("engine thread stopped")
+
+    def _abort_all(self, reason: str) -> None:
+        """Terminal-event every outstanding request so no caller awaits
+        forever after a stop or crash."""
+        for req in list(self._by_id.values()):
+            if not req.finished:
+                req.finished = True
+                self._emit(req, {"type": "error", "error": reason,
+                                 "code": "internal_error"})
+        self._by_id.clear()
+        self._waiting.clear()
+        self._running.clear()
+
+    def _drain_commands(self, block: bool) -> bool:
+        """Process queued commands. Returns False on stop."""
+        while True:
+            try:
+                cmd, arg = self._commands.get(timeout=0.05 if block else 0.0)
+            except queue.Empty:
+                return True
+            block = False
+            if cmd == "stop":
+                return False
+            if cmd == "submit":
+                if arg.cancelled:  # cancelled before the drain saw it
+                    self._finish(arg, "cancelled")
+                else:
+                    self._waiting.append(arg)
+            elif cmd == "cancel":
+                req = self._by_id.get(arg)
+                if req is not None:
+                    req.cancelled = True
+                    if req in self._waiting:
+                        self._waiting.remove(req)
+                        self._finish(req, "cancelled")
+            elif cmd == "release":
+                slot = self.slots.lookup(arg)
+                if slot is not None and slot.active:
+                    self._release_after.add(arg)
+                else:
+                    self.slots.release_session(arg)
+
+    def _admit(self) -> None:
+        """Move waiting requests into free slots (chunked prefill).
+
+        Skips (rather than head-of-line blocks on) a request whose session
+        is still generating.
+        """
+        i = 0
+        while i < len(self._waiting):
+            req = self._waiting[i]
+            slot = self.slots.lookup(req.session_id)
+            if slot is not None and slot.active:
+                i += 1  # session busy; try the next waiting request
+                continue
+            slot = self.slots.acquire(req.session_id)
+            if slot is None:
+                return  # all slots actively decoding
+            self._waiting.pop(i)
+            try:
+                self._prefill(req, slot)
+            except Exception as e:
+                log.error(f"prefill failed for {req.request_id}: {e}",
+                          exc_info=True)
+                self._finish(req, "error", error=str(e))
+
+    def _prefill(self, req: _Request, slot: Slot) -> None:
+        prompt = req.prompt_tokens
+        reused = self.slots.reuse_prefix(slot, prompt)
+        if reused:
+            self._m_prefix.inc(reused)
+        todo = prompt[reused:]
+        start = reused
+        if start + len(todo) > self.usable_len:
+            self._finish(req, "error",
+                         error=f"prompt ({len(prompt)} tok) exceeds context")
+            return
+
+        last_logits = None
+        while todo:
+            take = min(len(todo), self.prefill_chunk)
+            bucket = next(b for b in _PREFILL_BUCKETS if b >= take)
+            # A padded bucket must not extend past the cache end —
+            # dynamic_update_slice would clamp the start and corrupt
+            # earlier rows. Shrink the chunk until its bucket fits.
+            while start + bucket > self.max_len and take > 1:
+                bucket //= 2
+                take = min(take, bucket)
+            if start + bucket > self.max_len:
+                self._finish(req, "error",
+                             error="KV cache exhausted during prefill")
+                return
+            chunk = todo[:take]
+            padded = np.zeros((bucket,), np.int32)
+            padded[:take] = chunk
+            fn = self._get_prefill_fn(bucket)
+            self.cache, last_logits = fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.int32(start), jnp.int32(slot.index),
+                jnp.int32(take - 1))
+            slot.tokens.extend(chunk)
+            start += take
+            todo = todo[take:]
+
+        first = sample_tokens(
+            last_logits[None, :], self._next_rng(),
+            jnp.full((1,), req.params.temperature, jnp.float32),
+            jnp.full((1,), req.params.top_k, jnp.int32),
+            jnp.full((1,), req.params.top_p, jnp.float32))
+        first_id = int(first[0])
+
+        s = slot.index
+        slot.active = True
+        req.slot = slot
+        self._running[s] = req
+        self._cur_tokens = self._cur_tokens.at[s].set(first_id)
+        self._positions[s] = len(slot.tokens)
+        self._active_mask[s] = True
+        self._temps[s] = req.params.temperature
+        self._topks[s] = req.params.top_k
+        self._topps[s] = req.params.top_p
+        self._consume_token(req, first_id)
+
+    def _decode_once(self) -> None:
+        t0 = time.monotonic()
+        active = [s for s in self._running]
+        max_pos = int(self._positions[active].max())
+        kv_len = next((b for b in _KV_BUCKETS
+                       if b > max_pos and b <= self.max_len), self.max_len)
+        fn = self._get_decode_fn(kv_len)
+        self.cache, nxt = fn(self.params, self.cache, self._cur_tokens,
+                             jnp.asarray(self._positions),
+                             jnp.asarray(self._active_mask),
+                             jnp.asarray(self._temps),
+                             jnp.asarray(self._topks),
+                             jnp.asarray(self._topps), self._next_rng())
+        tokens = np.asarray(nxt)  # sync point
+        self._m_step.observe((time.monotonic() - t0) * 1000)
+
+        self._cur_tokens = nxt
+        for s, req in list(self._running.items()):
+            # This step wrote the KV of the slot's current token at
+            # positions[s] and sampled the next token.
+            self._positions[s] += 1
+            self._consume_token(req, int(tokens[s]))
+
+    def _consume_token(self, req: _Request, token_id: int) -> None:
+        """Handle one newly sampled token for a request (host side)."""
+        if req.cancelled:
+            self._finish(req, "cancelled")
+            return
+        if token_id in self.tokenizer.eos_ids:
+            self._finish(req, "stop")
+            return
+        slot = req.slot
+        assert slot is not None and req.detok is not None
+        slot.tokens.append(token_id)
+        req.generated += 1
+        if req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+            self._m_ttft.observe(
+                (req.first_token_at - req.submitted_at) * 1000)
+        self._m_tokens.inc()
+        delta = req.detok.push(token_id)
+        if delta:
+            self._stream_text(req, delta)
+        if req.finished:
+            return  # stop string hit inside _stream_text
+        if req.generated >= req.params.max_tokens:
+            self._finish(req, "length")
+        elif len(slot.tokens) >= self.usable_len:
+            self._finish(req, "length")
+
+    def _stream_text(self, req: _Request, delta: str) -> None:
+        """Emit text, holding back any suffix that could start a stop seq."""
+        stops = req.params.stop
+        req.pending_text += delta
+        if not stops:
+            emit_now, req.pending_text = req.pending_text, ""
+            if emit_now:
+                self._emit(req, {"type": "token", "text": emit_now})
+            return
+        for stop in stops:
+            idx = req.pending_text.find(stop)
+            if idx >= 0:
+                text = req.pending_text[:idx]
+                if text:
+                    self._emit(req, {"type": "token", "text": text})
+                req.pending_text = ""
+                self._finish(req, "stop", suppress_flush=True)
+                return
+        hold = 0
+        for stop in stops:
+            for k in range(min(len(stop) - 1, len(req.pending_text)), 0, -1):
+                if req.pending_text.endswith(stop[:k]):
+                    hold = max(hold, k)
+                    break
+        cut = len(req.pending_text) - hold
+        emit_now, req.pending_text = req.pending_text[:cut], req.pending_text[cut:]
+        if emit_now:
+            self._emit(req, {"type": "token", "text": emit_now})
+
+    def _finish(self, req: _Request, reason: str, error: str | None = None,
+                suppress_flush: bool = False) -> None:
+        if req.finished:
+            return
+        req.finished = True
+        slot = req.slot
+        if slot is not None:
+            slot.active = False
+            slot.last_used = time.monotonic()
+            self._running.pop(slot.index, None)
+            self._active_mask[slot.index] = False
+            self._temps[slot.index] = 0.0
+            sid = slot.session_id
+            if sid is not None and sid in self._release_after:
+                self._release_after.discard(sid)
+                self.slots.release_session(sid)
+        self._by_id.pop(req.request_id, None)
+
+        if not suppress_flush and req.detok is not None \
+                and reason not in ("cancelled",):
+            req.pending_text += req.detok.flush()
+        if req.pending_text and reason != "cancelled":
+            # Final flush still honours stop strings (text that was held
+            # back may contain one).
+            text = req.pending_text
+            for stop in req.params.stop:
+                idx = text.find(stop)
+                if idx >= 0:
+                    text = text[:idx]
+                    reason = "stop"
+            if text:
+                self._emit(req, {"type": "token", "text": text})
+        req.pending_text = ""
+
+        if error is not None:
+            self._emit(req, {"type": "error", "error": error,
+                             "code": "model_error"})
+            return
+        duration = time.monotonic() - req.submitted_at
+        ttft_ms = ((req.first_token_at or time.monotonic())
+                   - req.submitted_at) * 1000
+        self._emit(req, {
+            "type": "cancelled" if reason == "cancelled" else "done",
+            "finish_reason": reason,
+            "stats": {
+                "tokens_generated": req.generated,
+                "processing_time_ms": duration * 1000,
+                "tokens_per_second": req.generated / duration
+                if duration > 0 else 0.0,
+                "ttft_ms": ttft_ms,
+                "prompt_tokens": len(req.prompt_tokens),
+            },
+        })
+
+    def _emit(self, req: _Request, event: dict) -> None:
+        try:
+            req.loop.call_soon_threadsafe(req.out_queue.put_nowait, event)
+        except RuntimeError:
+            pass  # client loop already closed; drop

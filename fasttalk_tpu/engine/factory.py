@@ -1,0 +1,47 @@
+"""Engine construction from service Config.
+
+The device branch the reference routed through _detect_compute_device
+(reference: app/utils/config.py:17-60) plus provider selection
+(websocket_server_vllm.py:74-138) collapse here into one factory: the
+``tpu`` provider builds the in-tree JAX engine on whatever platform JAX
+has (TPU in production, CPU in tests); ``fake`` builds the test engine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fasttalk_tpu.engine.engine import EngineBase, TPUEngine
+from fasttalk_tpu.engine.fake import FakeEngine
+from fasttalk_tpu.engine.tokenizer import load_tokenizer
+from fasttalk_tpu.models.configs import get_model_config
+from fasttalk_tpu.models.loader import load_or_init
+from fasttalk_tpu.utils.config import Config
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("engine.factory")
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+           "float16": jnp.float16}
+
+
+def build_engine(cfg: Config) -> EngineBase:
+    if cfg.llm_provider == "fake":  # internal/testing
+        return FakeEngine()
+    model_cfg = get_model_config(cfg.model_name)
+    dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
+    params, loaded = load_or_init(model_cfg, cfg.model_path, dtype)
+    tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
+                               cfg.tokenizer_path)
+    log.info(
+        f"Building TPU engine: model={model_cfg.name} "
+        f"({model_cfg.param_count() / 1e9:.2f}B params, "
+        f"weights {'loaded' if loaded else 'random-init'}), "
+        f"slots={cfg.decode_slots}, max_len={cfg.max_model_len}, "
+        f"dtype={cfg.dtype}")
+    engine = TPUEngine(
+        model_cfg, params, tokenizer,
+        num_slots=cfg.decode_slots, max_len=cfg.max_model_len,
+        prefill_chunk=cfg.prefill_chunk, dtype=dtype,
+        context_window=min(cfg.default_context_window, cfg.max_model_len))
+    return engine

@@ -1,0 +1,113 @@
+"""Decode-slot management: session pinning, prefix reuse, LRU eviction.
+
+The continuous-batching engine decodes a fixed batch of S slots (static
+shapes for XLA). Each slot owns one contiguous region of the KV cache
+arrays. A *session* (WebSocket conversation) is pinned to a slot between
+turns, so its KV stays resident in TPU HBM and a follow-up turn only
+prefills the new tokens — the north-star requirement the reference could
+not meet (its KV lived inside an external engine container and was gone
+between HTTP calls; BASELINE.json north_star).
+
+All methods are called from the engine thread only — no locks by design
+(contrast: the reference's lock-discipline bugs, SURVEY.md §5 race
+detection: get_detailed_stats self-deadlock).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Slot:
+    index: int
+    session_id: str | None = None     # pinned session (None = free)
+    tokens: list[int] = field(default_factory=list)  # ids whose KV is cached
+    active: bool = False              # currently decoding a request
+    last_used: float = 0.0
+
+    @property
+    def length(self) -> int:
+        return len(self.tokens)
+
+
+class SlotManager:
+    def __init__(self, num_slots: int, max_len: int):
+        self.slots = [Slot(i) for i in range(num_slots)]
+        self.max_len = max_len
+        self._by_session: dict[str, Slot] = {}
+
+    def lookup(self, session_id: str) -> Slot | None:
+        return self._by_session.get(session_id)
+
+    def acquire(self, session_id: str) -> Slot | None:
+        """Pin a slot for this session: existing pin → free slot → evict
+        the least-recently-used idle session. None if all slots are
+        actively decoding (caller queues the request)."""
+        slot = self._by_session.get(session_id)
+        if slot is not None:
+            slot.last_used = time.monotonic()
+            return slot
+        for slot in self.slots:
+            if slot.session_id is None:
+                return self._pin(slot, session_id)
+        victims = [s for s in self.slots if not s.active]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda s: s.last_used)
+        self._unpin(victim)
+        return self._pin(victim, session_id)
+
+    def _pin(self, slot: Slot, session_id: str) -> Slot:
+        slot.session_id = session_id
+        slot.tokens = []
+        slot.active = False
+        slot.last_used = time.monotonic()
+        self._by_session[session_id] = slot
+        return slot
+
+    def _unpin(self, slot: Slot) -> None:
+        if slot.session_id is not None:
+            self._by_session.pop(slot.session_id, None)
+        slot.session_id = None
+        slot.tokens = []
+        slot.active = False
+
+    def release_session(self, session_id: str) -> None:
+        slot = self._by_session.get(session_id)
+        if slot is not None and not slot.active:
+            self._unpin(slot)
+        elif slot is not None:
+            # Active request: mark for release when generation finishes.
+            slot.last_used = 0.0
+
+    def reuse_prefix(self, slot: Slot, prompt_tokens: list[int]) -> int:
+        """Longest reusable cached prefix for this prompt.
+
+        Returns the number of leading prompt tokens whose KV is already in
+        the slot (0 → full prefill). Never returns the full prompt length:
+        at least one token must run through the model to produce logits,
+        so reuse is capped at len(prompt) - 1.
+        """
+        cached = slot.tokens
+        limit = min(len(cached), len(prompt_tokens) - 1)
+        n = 0
+        while n < limit and cached[n] == prompt_tokens[n]:
+            n += 1
+        if n < len(cached):
+            # Divergence: the cache beyond n is for a different history.
+            # Positions beyond n will be overwritten by the new prefill.
+            slot.tokens = cached[:n]
+        return n
+
+    def active_slots(self) -> list[Slot]:
+        return [s for s in self.slots if s.active]
+
+    def stats(self) -> dict:
+        return {
+            "total_slots": len(self.slots),
+            "active": sum(1 for s in self.slots if s.active),
+            "pinned": sum(1 for s in self.slots if s.session_id is not None),
+            "resident_tokens": sum(s.length for s in self.slots),
+        }

@@ -1,0 +1,190 @@
+"""Tokenization: HF fast tokenizer when checkpoint files exist, byte-level
+fallback otherwise, plus the Llama-3 chat template and incremental
+detokenization for streaming.
+
+The reference never tokenized — its external engines did, and its "token"
+counts were actually stream-chunk counts (SURVEY.md §5 metrics gap). Here
+the framework owns the tokenizer, so streamed deltas and counters are real
+tokens.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol, Sequence
+
+Message = dict[str, str]  # {"role": ..., "content": ...}
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    eos_ids: frozenset[int]
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+    def apply_chat_template(self, messages: Sequence[Message],
+                            add_generation_prompt: bool = True) -> list[int]: ...
+
+
+class ByteTokenizer:
+    """Self-contained byte-level tokenizer (no files, no network).
+
+    ids 0..255 = raw bytes; specials above. Role headers are single
+    tokens so the chat template stays cheap and unambiguous. Used for
+    tests and for weight-free benchmarking; real checkpoints bring their
+    own tokenizer.json.
+    """
+
+    BOS = 256
+    EOS = 257
+    ROLE_SYSTEM = 258
+    ROLE_USER = 259
+    ROLE_ASSISTANT = 260
+    ROLE_TOOL = 261
+    pad_id = 262
+    vocab_size = 263
+
+    def __init__(self) -> None:
+        self.eos_ids = frozenset({self.EOS})
+        self._role_tokens = {
+            "system": self.ROLE_SYSTEM,
+            "user": self.ROLE_USER,
+            "assistant": self.ROLE_ASSISTANT,
+            "tool": self.ROLE_TOOL,
+        }
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: Sequence[Message],
+                            add_generation_prompt: bool = True) -> list[int]:
+        out = [self.BOS]
+        for m in messages:
+            out.append(self._role_tokens.get(m.get("role", "user"), self.ROLE_USER))
+            out.extend(self.encode(m.get("content", "")))
+            out.append(self.EOS)
+        if add_generation_prompt:
+            out.append(self.ROLE_ASSISTANT)
+        return out
+
+
+class HFTokenizer:
+    """Wraps a HuggingFace fast tokenizer (tokenizer.json) with the
+    Llama-3 instruct chat template rendered in-tree (templates are not
+    fetchable in a zero-egress deployment, and the format is fixed)."""
+
+    # Llama-3 special token ids (checkpoint-defined, stable across 3.x).
+    BOS_TEXT = "<|begin_of_text|>"
+    HDR_START = "<|start_header_id|>"
+    HDR_END = "<|end_header_id|>"
+    EOT = "<|eot_id|>"
+
+    def __init__(self, tokenizer_file: str):
+        from tokenizers import Tokenizer as RustTokenizer
+
+        self._tok = RustTokenizer.from_file(tokenizer_file)
+        self.vocab_size = self._tok.get_vocab_size()
+        eos = set()
+        for name in ("<|eot_id|>", "<|end_of_text|>", "</s>", "<|eom_id|>"):
+            tid = self._tok.token_to_id(name)
+            if tid is not None:
+                eos.add(tid)
+        self.eos_ids = frozenset(eos) or frozenset({self.vocab_size - 1})
+        pad = self._tok.token_to_id("<|finetune_right_pad_id|>")
+        self.pad_id = pad if pad is not None else 0
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def _header(self, role: str) -> str:
+        return f"{self.HDR_START}{role}{self.HDR_END}\n\n"
+
+    def apply_chat_template(self, messages: Sequence[Message],
+                            add_generation_prompt: bool = True) -> list[int]:
+        text = self.BOS_TEXT
+        for m in messages:
+            text += self._header(m.get("role", "user"))
+            text += m.get("content", "") + self.EOT
+        if add_generation_prompt:
+            text += self._header("assistant")
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+
+class StreamDetokenizer:
+    """Incremental detokenization for one stream.
+
+    Emits only complete, stable UTF-8 text: decodes the full generated-id
+    list and diffs against what was already emitted, holding back while
+    the decoded text ends in a replacement char (split multi-byte/
+    multi-token glyph).
+    """
+
+    # A legal UTF-8 glyph spans at most 4 bytes / a few tokens; past that,
+    # a trailing replacement char is genuinely invalid output and must be
+    # emitted rather than held back forever.
+    MAX_HOLDBACK_TOKENS = 4
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+        self._ids: list[int] = []
+        self._emitted = 0
+        self._held_since = 0
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        if text.endswith("�") and \
+                len(self._ids) - self._held_since <= self.MAX_HOLDBACK_TOKENS:
+            return ""
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        self._held_since = len(self._ids)
+        return delta
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+    @property
+    def text(self) -> str:
+        return self._tok.decode(self._ids)
+
+    @property
+    def token_count(self) -> int:
+        return len(self._ids)
+
+
+def find_tokenizer_file(model_path: str, model_name: str) -> str | None:
+    from fasttalk_tpu.models.loader import find_checkpoint_dir
+
+    candidates = []
+    ckpt = find_checkpoint_dir(model_path, model_name) if model_path else None
+    if ckpt:
+        candidates.append(os.path.join(ckpt, "tokenizer.json"))
+    if model_path:
+        candidates.append(os.path.join(model_path, "tokenizer.json"))
+    for c in candidates:
+        if os.path.isfile(c):
+            return c
+    return None
+
+
+def load_tokenizer(model_path: str, model_name: str,
+                   tokenizer_path: str = "") -> Tokenizer:
+    """HF tokenizer if files are present, else the byte fallback."""
+    tf = tokenizer_path if tokenizer_path and os.path.isfile(tokenizer_path) \
+        else find_tokenizer_file(model_path, model_name)
+    if tf:
+        return HFTokenizer(tf)
+    return ByteTokenizer()

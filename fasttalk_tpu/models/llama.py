@@ -91,22 +91,38 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 
 def _write_kv(cache_layer: jnp.ndarray, new: jnp.ndarray,
-              write_start: jnp.ndarray) -> jnp.ndarray:
-    """Write new [B, T, K, H] into cache [B, S, K, H] at per-row offsets."""
-    def row(c, n, s):
-        return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
-    return jax.vmap(row)(cache_layer, new, write_start)
+              write_start: jnp.ndarray,
+              write_mask: jnp.ndarray | None) -> jnp.ndarray:
+    """Write new [B, T, K, H] into cache [B, S, K, H] at per-row offsets.
+
+    ``write_mask`` [B] bool: rows with False keep their existing cache
+    contents (used by the batched decode step so idle slots can never
+    clobber resident KV of a parked session).
+    """
+    if write_mask is None:
+        def row(c, n, s):
+            return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+        return jax.vmap(row)(cache_layer, new, write_start)
+
+    def row(c, n, s, m):
+        cur = jax.lax.dynamic_slice(c, (s, 0, 0), n.shape)
+        return jax.lax.dynamic_update_slice(c, jnp.where(m, n, cur), (s, 0, 0))
+    return jax.vmap(row)(cache_layer, new, write_start, write_mask)
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, cache: KVCache, write_start: jnp.ndarray,
-            *, blockwise: bool = False) -> tuple[jnp.ndarray, KVCache]:
+            *, blockwise: bool = False,
+            write_mask: jnp.ndarray | None = None,
+            ) -> tuple[jnp.ndarray, KVCache]:
     """Run the transformer over ``tokens`` [B, T], updating the cache.
 
     positions [B, T]: absolute position of each token (also its RoPE phase
     and attention horizon). write_start [B]: cache index where this chunk's
-    K/V are written per row. Works for prefill (T=chunk) and decode (T=1)
-    alike; ``blockwise`` picks the flash-style attention for long chunks.
+    K/V are written per row. write_mask [B] (optional): rows with False
+    leave the cache untouched. Works for prefill (T=chunk) and decode
+    (T=1) alike; ``blockwise`` picks the flash-style attention for long
+    chunks.
 
     Returns (logits [B, T, vocab], updated cache).
     """
@@ -123,8 +139,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         v = (h @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        ck = _write_kv(ck, k, write_start)
-        cv = _write_kv(cv, v, write_start)
+        ck = _write_kv(ck, k, write_start, write_mask)
+        cv = _write_kv(cv, v, write_start, write_mask)
         attn_fn = attend_blockwise if blockwise else attend
         o = attn_fn(q, ck, cv, positions)
         x = x + o.reshape(b, t, cfg.q_dim) @ lp["wo"]

@@ -66,7 +66,7 @@ def _open_all_tensors(ckpt_dir: str) -> dict[str, Any]:
             name_to_file = json.load(f)["weight_map"]
     else:
         for fname in files:
-            with safe_open(os.path.join(ckpt_dir, fname), framework="numpy") as sf:
+            with safe_open(os.path.join(ckpt_dir, fname), framework="pt") as sf:
                 for key in sf.keys():
                     name_to_file[key] = fname
     return name_to_file
@@ -88,15 +88,18 @@ def load_params(cfg: ModelConfig, ckpt_dir: str,
     handles: dict[str, Any] = {}
 
     def get(name: str) -> np.ndarray:
+        # framework="pt": the numpy framework cannot represent bf16 (raises
+        # TypeError), and real HF Llama checkpoints are stored bf16.
+        import torch
+
         fname = name_to_file[name]
         if fname not in handles:
             handles[fname] = safe_open(os.path.join(ckpt_dir, fname),
-                                       framework="numpy")
+                                       framework="pt")
         t = handles[fname].get_tensor(name)
-        if t.dtype == np.dtype("uint16"):  # raw bf16 comes back as u16
-            t = t.view(np.uint16)
-            t = (t.astype(np.uint32) << 16).view(np.float32)
-        return t
+        if t.dtype == torch.bfloat16:
+            t = t.to(torch.float32)
+        return t.numpy()
 
     if put is None:
         def put(arr: np.ndarray, path: str) -> jax.Array:  # noqa: ARG001

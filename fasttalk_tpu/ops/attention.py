@@ -56,6 +56,7 @@ def attend_blockwise(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Online-softmax GQA over key blocks (flash-attention recurrence)."""
     b, t, nq, d = q.shape
     s, nkv = k.shape[1], k.shape[2]
+    block_size = min(block_size, s)
     if s % block_size:
         raise ValueError(f"cache length {s} not divisible by block {block_size}")
     nblocks = s // block_size

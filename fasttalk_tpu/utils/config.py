@@ -15,7 +15,7 @@ from dataclasses import dataclass, field, fields
 from typing import Any
 
 VALID_DEVICES = ("tpu", "cuda", "cpu", "mps")
-VALID_PROVIDERS = ("tpu", "vllm", "ollama", "openai")
+VALID_PROVIDERS = ("tpu", "vllm", "ollama", "openai", "fake")
 
 
 def _env_str(name: str, default: str) -> str:

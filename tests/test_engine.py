@@ -1,0 +1,277 @@
+"""Engine-layer tests: tokenizer, slots, and the full continuous-batching
+engine on the CPU backend with the tiny model."""
+
+import asyncio
+
+import pytest
+
+from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+from fasttalk_tpu.engine.slots import SlotManager
+from fasttalk_tpu.engine.tokenizer import ByteTokenizer, StreamDetokenizer
+from fasttalk_tpu.models import get_model_config, init_params
+
+TINY = get_model_config("test-tiny")
+
+
+class TestByteTokenizer:
+    def test_round_trip(self):
+        tok = ByteTokenizer()
+        text = "Hello, wörld! 你好"
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_chat_template(self):
+        tok = ByteTokenizer()
+        ids = tok.apply_chat_template([
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+        ])
+        assert ids[0] == ByteTokenizer.BOS
+        assert ids[1] == ByteTokenizer.ROLE_SYSTEM
+        assert ids[-1] == ByteTokenizer.ROLE_ASSISTANT
+        assert ids.count(ByteTokenizer.EOS) == 2
+
+    def test_stream_detokenizer_utf8_holdback(self):
+        tok = ByteTokenizer()
+        detok = StreamDetokenizer(tok)
+        out = []
+        for b in "héllo".encode("utf-8"):
+            out.append(detok.push(b))
+        # The é is split over two bytes: first byte must emit nothing.
+        assert "" in out
+        assert "".join(out) == "héllo"
+
+
+class TestSlotManager:
+    def test_acquire_pin_and_reuse(self):
+        sm = SlotManager(2, 128)
+        a = sm.acquire("sess-a")
+        assert a is not None
+        a.tokens = [1, 2, 3]
+        assert sm.acquire("sess-a") is a  # pinned
+
+    def test_eviction_lru(self):
+        sm = SlotManager(2, 128)
+        a = sm.acquire("a")
+        b = sm.acquire("b")
+        a.last_used = 1.0
+        b.last_used = 2.0
+        c = sm.acquire("c")  # evicts a (older)
+        assert c is a
+        assert sm.lookup("a") is None
+        assert sm.lookup("b") is b
+
+    def test_no_eviction_of_active(self):
+        sm = SlotManager(1, 128)
+        a = sm.acquire("a")
+        a.active = True
+        assert sm.acquire("b") is None
+
+    def test_prefix_reuse(self):
+        sm = SlotManager(1, 128)
+        s = sm.acquire("a")
+        s.tokens = [1, 2, 3, 4]
+        # identical history + new tokens: reuse all cached
+        assert sm.reuse_prefix(s, [1, 2, 3, 4, 5, 6]) == 4
+        # divergent history: truncates cache to common prefix
+        s.tokens = [1, 2, 3, 4]
+        assert sm.reuse_prefix(s, [1, 2, 9, 9, 9]) == 2
+        assert s.tokens == [1, 2]
+        # reuse never covers the whole prompt (need logits for sampling)
+        s.tokens = [1, 2, 3]
+        assert sm.reuse_prefix(s, [1, 2, 3]) == 2
+
+
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=4,
+                    max_len=256, prefill_chunk=64)
+    eng.start()
+    yield eng
+    eng.shutdown()
+
+
+def _collect(engine, request_id, session_id, messages, params):
+    async def run():
+        events = []
+        async for ev in engine.generate(request_id, session_id, messages,
+                                        params):
+            events.append(ev)
+        return events
+    return asyncio.run(run())
+
+
+GREEDY = dict(temperature=0.0, top_k=0, top_p=1.0)
+
+
+class TestTPUEngine:
+    def test_basic_generation(self, engine):
+        events = _collect(engine, "r1", "s1",
+                          [{"role": "user", "content": "hello"}],
+                          GenerationParams(max_tokens=8, **GREEDY))
+        kinds = [e["type"] for e in events]
+        assert kinds[-1] == "done"
+        stats = events[-1]["stats"]
+        assert 0 < stats["tokens_generated"] <= 8
+        assert stats["ttft_ms"] > 0
+        assert stats["prompt_tokens"] > 0
+
+    def test_deterministic_greedy(self, engine):
+        msgs = [{"role": "user", "content": "determinism"}]
+        p = GenerationParams(max_tokens=6, **GREEDY)
+        t1 = "".join(e.get("text", "") for e in
+                     _collect(engine, "d1", "sd1", msgs, p))
+        t2 = "".join(e.get("text", "") for e in
+                     _collect(engine, "d2", "sd2", msgs, p))
+        assert t1 == t2
+
+    def test_multi_turn_prefix_reuse(self, engine):
+        msgs = [{"role": "user", "content": "first turn message"}]
+        _collect(engine, "t1", "multi", msgs,
+                 GenerationParams(max_tokens=4, **GREEDY))
+        reused_before = engine._m_prefix.value
+        slot = engine.slots.lookup("multi")
+        assert slot is not None and slot.length > 0  # KV resident
+
+        msgs2 = msgs + [
+            {"role": "assistant", "content": "reply"},
+            {"role": "user", "content": "second turn"},
+        ]
+        _collect(engine, "t2", "multi", msgs2,
+                 GenerationParams(max_tokens=4, **GREEDY))
+        reused_after = engine._m_prefix.value
+        assert reused_after > reused_before  # delta-only prefill happened
+
+    def test_concurrent_sessions_batched(self, engine):
+        async def run_all():
+            async def one(i):
+                out = []
+                async for ev in engine.generate(
+                        f"c{i}", f"cs{i}",
+                        [{"role": "user", "content": f"request {i}"}],
+                        GenerationParams(max_tokens=6, **GREEDY)):
+                    out.append(ev)
+                return out
+            return await asyncio.gather(*[one(i) for i in range(4)])
+
+        results = asyncio.run(run_all())
+        assert len(results) == 4
+        for events in results:
+            assert events[-1]["type"] == "done"
+            assert events[-1]["stats"]["tokens_generated"] > 0
+
+    def test_more_requests_than_slots(self, engine):
+        """8 concurrent requests on 4 slots: all must complete (queueing)."""
+        async def run_all():
+            async def one(i):
+                out = []
+                async for ev in engine.generate(
+                        f"q{i}", f"qs{i}",
+                        [{"role": "user", "content": f"r{i}"}],
+                        GenerationParams(max_tokens=4, **GREEDY)):
+                    out.append(ev)
+                return out
+            return await asyncio.gather(*[one(i) for i in range(8)])
+
+        results = asyncio.run(run_all())
+        assert all(r[-1]["type"] == "done" for r in results)
+
+    def test_cancellation_frees_slot(self, engine):
+        async def run():
+            agen = engine.generate(
+                "cx", "cxs", [{"role": "user", "content": "cancel me"}],
+                GenerationParams(max_tokens=10_000, temperature=0.8,
+                                 top_k=40, top_p=0.9))
+            first = None
+            async for ev in agen:
+                first = ev
+                break
+            assert first is not None
+            assert engine.cancel("cx") is True
+            final = None
+            async for ev in agen:
+                final = ev
+            return final
+
+        final = asyncio.run(run())
+        assert final is not None and final["type"] == "cancelled"
+        # slot is no longer active
+        slot = engine.slots.lookup("cxs")
+        assert slot is None or not slot.active
+
+    def test_cancel_unknown_request(self, engine):
+        assert engine.cancel("never-existed") is False
+
+    def test_max_tokens_respected(self, engine):
+        events = _collect(engine, "m1", "ms1",
+                          [{"role": "user", "content": "count"}],
+                          GenerationParams(max_tokens=3, **GREEDY))
+        assert events[-1]["stats"]["tokens_generated"] <= 3
+
+    def test_stop_string(self, engine):
+        # Greedy output from the random model is deterministic; find what
+        # it emits, then re-run with a stop string cut from the middle.
+        p = GenerationParams(max_tokens=24, **GREEDY)
+        full = "".join(e.get("text", "") for e in _collect(
+            engine, "st0", "sts0",
+            [{"role": "user", "content": "stop test"}], p))
+        if len(full) < 4:
+            pytest.skip("model emitted too little printable text")
+        stop = full[2:4]
+        p2 = GenerationParams(max_tokens=24, stop=[stop], **GREEDY)
+        events = _collect(engine, "st1", "sts1",
+                          [{"role": "user", "content": "stop test"}], p2)
+        text = "".join(e.get("text", "") for e in events)
+        assert stop not in text
+        assert text == full.split(stop)[0]
+
+    def test_prompt_too_long_rejected(self, engine):
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        async def run():
+            agen = engine.generate(
+                "big", "bigs",
+                [{"role": "user", "content": "x" * 10_000}],
+                GenerationParams(max_tokens=4))
+            async for _ in agen:
+                pass
+
+        with pytest.raises(LLMServiceError, match="context"):
+            asyncio.run(run())
+
+    def test_release_session_unpins(self, engine):
+        _collect(engine, "rel1", "rels",
+                 [{"role": "user", "content": "hello"}],
+                 GenerationParams(max_tokens=3, **GREEDY))
+        assert engine.slots.lookup("rels") is not None
+        engine.release_session("rels")
+        import time
+        deadline = time.monotonic() + 2
+        while engine.slots.lookup("rels") and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert engine.slots.lookup("rels") is None
+
+    def test_model_info(self, engine):
+        info = engine.get_model_info()
+        assert info["model"] == "test-tiny"
+        assert info["decode_slots"] == 4
+        assert info["parameters"] == TINY.param_count()
+
+    def test_per_session_params_mixed(self, engine):
+        """Different sampling settings per concurrent session."""
+        async def run_all():
+            async def one(i, temp):
+                out = []
+                async for ev in engine.generate(
+                        f"p{i}", f"ps{i}",
+                        [{"role": "user", "content": "mix"}],
+                        GenerationParams(max_tokens=5, temperature=temp,
+                                         top_k=20, top_p=0.95)):
+                    out.append(ev)
+                return out
+            return await asyncio.gather(one(0, 0.0), one(1, 1.5))
+
+        res = asyncio.run(run_all())
+        assert all(r[-1]["type"] == "done" for r in res)

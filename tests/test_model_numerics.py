@@ -230,3 +230,33 @@ class TestHFGoldenParity:
                           jnp.zeros(1, jnp.int32))
         np.testing.assert_allclose(np.asarray(ours), hf_logits,
                                    rtol=2e-3, atol=2e-3)
+
+    def test_bf16_checkpoint_loads(self, tmp_path):
+        """Real HF Llama checkpoints are stored bf16; loader must read them."""
+        torch = pytest.importorskip("torch")
+        from transformers import LlamaConfig, LlamaForCausalLM
+        from safetensors.torch import save_file
+
+        hf_cfg = LlamaConfig(
+            vocab_size=TINY.vocab_size, hidden_size=TINY.hidden_size,
+            intermediate_size=TINY.intermediate_size,
+            num_hidden_layers=TINY.num_layers,
+            num_attention_heads=TINY.num_heads,
+            num_key_value_heads=TINY.num_kv_heads,
+            head_dim=TINY.head_dim, tie_word_embeddings=True,
+        )
+        torch.manual_seed(1)
+        model = LlamaForCausalLM(hf_cfg)
+        ckpt = tmp_path / "bf16"
+        ckpt.mkdir()
+        state = {k: v.to(torch.bfloat16).contiguous()
+                 for k, v in model.state_dict().items()
+                 if k != "lm_head.weight"}
+        save_file(state, str(ckpt / "model.safetensors"))
+
+        from fasttalk_tpu.models.loader import load_params
+        params = load_params(TINY, str(ckpt), dtype=jnp.bfloat16)
+        embed = np.asarray(params["embed"], dtype=np.float32)
+        want = model.state_dict()["model.embed_tokens.weight"] \
+            .to(torch.bfloat16).to(torch.float32).numpy()
+        np.testing.assert_allclose(embed, want, rtol=1e-2, atol=1e-2)
