@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Scripted E2E client for the FastTalk-TPU WebSocket service.
+
+Plays the role of the reference's manual test client
+(test_llm_client.py — which needed interactive input) as a
+non-interactive script usable in CI: health check, full protocol
+exercise (session_started → start_session → session_configured →
+user_message → token stream → response_complete → end_session), exit
+code 0/1.
+
+Usage: python client.py [--url ws://localhost:8000/ws/llm]
+                        [--prompt "..."] [--max-tokens N] [--quiet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import aiohttp
+
+
+async def check_health(base_url: str, quiet: bool) -> bool:
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base_url}/health",
+                             timeout=aiohttp.ClientTimeout(total=10)) as r:
+                body = await r.json()
+                if not quiet:
+                    print(f"health: {body.get('status')} "
+                          f"(model={body.get('model')})")
+                return r.status == 200
+    except Exception as e:
+        print(f"health check failed: {e}", file=sys.stderr)
+        return False
+
+
+async def run_session(ws_url: str, prompt: str, max_tokens: int,
+                      quiet: bool) -> bool:
+    async with aiohttp.ClientSession() as session:
+        async with session.ws_connect(ws_url) as ws:
+            msg = json.loads((await ws.receive()).data)
+            assert msg["type"] == "session_started", msg
+            if not quiet:
+                print(f"session: {msg['session_id']} "
+                      f"(provider={msg.get('provider')})")
+
+            await ws.send_json({
+                "type": "start_session",
+                "config": {
+                    "system_prompt": "You are a concise assistant.",
+                    "max_tokens": max_tokens,
+                },
+            })
+            msg = json.loads((await ws.receive()).data)
+            assert msg["type"] == "session_configured", msg
+
+            await ws.send_json({"type": "user_message", "text": prompt})
+            tokens = 0
+            stats = {}
+            while True:
+                raw = await ws.receive()
+                if raw.type != aiohttp.WSMsgType.TEXT:
+                    print(f"unexpected frame: {raw.type}", file=sys.stderr)
+                    return False
+                msg = json.loads(raw.data)
+                if msg["type"] == "token":
+                    tokens += 1
+                    if not quiet:
+                        print(msg.get("data", ""), end="", flush=True)
+                elif msg["type"] == "response_complete":
+                    stats = msg.get("stats", {})
+                    break
+                elif msg["type"] == "error":
+                    print(f"\nerror: {msg.get('error')}", file=sys.stderr)
+                    return False
+            if not quiet:
+                print(f"\nstats: {stats.get('tokens_generated')} tok, "
+                      f"{stats.get('tokens_per_second', 0):.1f} tok/s, "
+                      f"ttft {stats.get('ttft_ms', 0):.0f} ms")
+
+            await ws.send_json({"type": "end_session"})
+            msg = json.loads((await ws.receive()).data)
+            assert msg["type"] == "session_ended", msg
+            return True
+
+
+async def amain(args: argparse.Namespace) -> int:
+    base = args.url.replace("ws://", "http://").replace(
+        "wss://", "https://").rsplit("/ws/", 1)[0]
+    if not await check_health(base, args.quiet):
+        return 1
+    ok = await run_session(args.url, args.prompt, args.max_tokens,
+                           args.quiet)
+    if ok and not args.quiet:
+        print("E2E OK")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", default="ws://localhost:8000/ws/llm")
+    p.add_argument("--prompt", default="Write a haiku about oceans.")
+    p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--quiet", action="store_true")
+    return asyncio.run(amain(p.parse_args()))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Monitoring endpoints on a dedicated port.
+
+Capability parity with the reference's Flask sidecar
+(app/monitoring/service_monitor.py:85-137: /health with psutil system
+stats and threshold warnings, k8s-style /health/ready and /health/live,
+/metrics, /info), rebuilt as a second aiohttp app in the same event loop
+(no extra thread, no Flask) and backed by the ONE process-wide metrics
+registry — fixing the reference gap where the sidecar's counters were
+never wired and /metrics always reported zeros (SURVEY.md §5).
+
+/metrics serves Prometheus text; /metrics.json serves the JSON form.
+"""
+
+from __future__ import annotations
+
+import psutil
+from aiohttp import web
+
+from fasttalk_tpu import __version__
+from fasttalk_tpu.utils.metrics import get_metrics
+
+
+def build_monitoring_app(ready_check=None) -> web.Application:
+    app = web.Application()
+
+    async def health(request: web.Request) -> web.Response:
+        cpu = psutil.cpu_percent(interval=0)
+        mem = psutil.virtual_memory()
+        m = get_metrics()
+        body = {
+            "status": "healthy",
+            "uptime_seconds": m.uptime(),
+            "system": {
+                "cpu_percent": cpu,
+                "memory_percent": mem.percent,
+                "memory_available_gb": mem.available / (1024 ** 3),
+            },
+            "metrics": m.to_dict(),
+        }
+        warnings = []
+        if cpu > 90:
+            warnings.append("High CPU usage")
+        if mem.percent > 90:
+            warnings.append("High memory usage")
+        if warnings:
+            body["warnings"] = warnings
+        return web.json_response(body)
+
+    async def ready(request: web.Request) -> web.Response:
+        if ready_check is not None and not ready_check():
+            return web.json_response({"status": "not_ready"}, status=503)
+        return web.json_response({"status": "ready"})
+
+    async def live(request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=get_metrics().prometheus(),
+                            content_type="text/plain")
+
+    async def metrics_json(request: web.Request) -> web.Response:
+        return web.json_response(get_metrics().to_dict())
+
+    async def info(request: web.Request) -> web.Response:
+        return web.json_response({
+            "service": "fasttalk-tpu",
+            "version": __version__,
+            "uptime_seconds": get_metrics().uptime(),
+        })
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/health/ready", ready)
+    app.router.add_get("/health/live", live)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/metrics.json", metrics_json)
+    app.router.add_get("/info", info)
+    return app

@@ -1,0 +1,96 @@
+"""Service lifecycle: build engine, verify, serve, shut down cleanly.
+
+Parity with the reference launcher (app/core/websocket_launcher.py:41-147:
+signal handlers, provider-based server selection, pre-flight backend
+verification, uvicorn run, shutdown cleanup) — rebuilt around one asyncio
+event loop running both the main app and the monitoring app (the
+reference needed a separate Flask thread for monitoring).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+from aiohttp import web
+
+from fasttalk_tpu.engine.engine import EngineBase
+from fasttalk_tpu.engine.factory import build_engine
+from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+from fasttalk_tpu.serving.server import WebSocketLLMServer
+from fasttalk_tpu.utils.config import Config
+from fasttalk_tpu.utils.errors import LLMServiceError
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("serving.launcher")
+
+
+def build_agent(config: Config, engine: EngineBase):
+    """Construct the tool-calling agent when enabled (None otherwise)."""
+    if not (config.enable_agent and config.enable_tools):
+        return None
+    try:
+        from fasttalk_tpu.agents.voice_agent import VoiceAgent
+
+        return VoiceAgent(engine, config)
+    except ImportError:
+        return None
+
+
+class ServerLauncher:
+    def __init__(self, config: Config, engine: EngineBase | None = None):
+        self.config = config
+        self.engine = engine if engine is not None else build_engine(config)
+        self.agent = build_agent(config, self.engine)
+        self.server = WebSocketLLMServer(config, self.engine, self.agent)
+        self._stop = asyncio.Event()
+
+    def verify_backend(self) -> None:
+        """Pre-flight: refuse to serve if the engine isn't healthy
+        (reference: websocket_launcher.py:104-105 hard-exits here)."""
+        self.engine.start()
+        if not self.engine.check_connection():
+            raise LLMServiceError("Engine failed pre-flight check")
+        log.info("engine pre-flight check passed",
+                 model=self.engine.get_model_info().get("model"))
+
+    async def run(self) -> None:
+        self.verify_backend()
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+
+        main_runner = web.AppRunner(self.server.app)
+        await main_runner.setup()
+        await web.TCPSite(main_runner, self.config.host,
+                          self.config.port).start()
+        log.info(f"WebSocket server on ws://{self.config.host}:"
+                 f"{self.config.port}/ws/llm")
+
+        mon_app = build_monitoring_app(
+            ready_check=self.engine.check_connection)
+        mon_runner = web.AppRunner(mon_app)
+        await mon_runner.setup()
+        await web.TCPSite(mon_runner, self.config.monitoring_host,
+                          self.config.monitoring_port).start()
+        log.info(f"Monitoring on http://{self.config.monitoring_host}:"
+                 f"{self.config.monitoring_port}/health")
+
+        try:
+            await self._stop.wait()
+        finally:
+            log.info("shutting down")
+            await main_runner.cleanup()
+            await mon_runner.cleanup()
+            self.engine.shutdown()
+
+    def start(self) -> None:
+        """Blocking entry point (signal-driven shutdown)."""
+        asyncio.run(self.run())
+
+    def stop(self) -> None:
+        self._stop.set()

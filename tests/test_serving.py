@@ -1,0 +1,502 @@
+"""Serving-layer tests: WS protocol against FakeEngine, HTTP endpoints,
+managers, and an end-to-end round on the real tiny engine."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.engine.fake import FakeEngine
+from fasttalk_tpu.serving.conversation import ConversationManager
+from fasttalk_tpu.serving.server import WebSocketLLMServer
+from fasttalk_tpu.serving.text_processor import extract_speakable_chunk, text_similarity
+from fasttalk_tpu.utils.config import Config
+
+
+def make_config(**env):
+    import os
+    old = {}
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    try:
+        return Config()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+async def make_ws_client(server: WebSocketLLMServer) -> TestClient:
+    client = TestClient(TestServer(server.app))
+    await client.start_server()
+    return client
+
+
+async def recv_json(ws):
+    msg = await asyncio.wait_for(ws.receive(), timeout=10)
+    return json.loads(msg.data)
+
+
+class TestProtocol:
+    async def _setup(self, **cfg_env):
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false", **cfg_env)
+        engine = FakeEngine(delay_s=0.001)
+        engine.start()
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        return config, engine, server, client
+
+    async def test_full_session_flow(self):
+        _, engine, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            started = await recv_json(ws)
+            assert started["type"] == "session_started"
+            assert started["provider"] == "fake"
+            sid = started["session_id"]
+
+            await ws.send_json({"type": "start_session", "config": {
+                "system_prompt": "be nice", "max_tokens": 5}})
+            configured = await recv_json(ws)
+            assert configured["type"] == "session_configured"
+            assert configured["config"]["system_prompt"] == "be nice"
+
+            await ws.send_json({"type": "user_message", "text": "hello"})
+            text, stats = "", None
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "token":
+                    text += msg["data"]
+                elif msg["type"] == "response_complete":
+                    stats = msg["stats"]
+                    break
+            assert text
+            assert stats["tokens_generated"] > 0
+            assert stats["provider"] == "fake"
+            # per-session max_tokens override was applied (reference
+            # dropped it — SURVEY.md known flaw)
+            assert engine.requests_seen[0]["params"].max_tokens == 5
+            # system prompt made it into the engine-visible history
+            assert engine.requests_seen[0]["messages"][0]["role"] == "system"
+
+            await ws.send_json({"type": "end_session"})
+            ended = await recv_json(ws)
+            assert ended["type"] == "session_ended"
+            assert ended["stats"]["session_id"] == sid
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_invalid_json_and_unknown_type(self):
+        _, _, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)  # session_started
+            await ws.send_str("{not json")
+            err = await recv_json(ws)
+            assert err["type"] == "error"
+            assert err["error"]["code"] == "invalid_json"
+
+            await ws.send_json({"type": "teleport"})
+            err = await recv_json(ws)
+            assert err["error"]["code"] == "unknown_message_type"
+
+            await ws.send_json({"type": "user_message", "text": ""})
+            err = await recv_json(ws)
+            assert err["error"]["code"] == "empty_message"
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_cancel_mid_stream(self):
+        _, engine, server, client = await self._setup()
+        engine.delay_s = 0.05
+        engine.n_repeats = 100
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "start_session", "config": {}})
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "go"})
+            # read two tokens, then cancel mid-generation
+            for _ in range(2):
+                msg = await recv_json(ws)
+                assert msg["type"] == "token"
+            await ws.send_json({"type": "cancel"})
+            saw_cancelled, saw_complete = False, False
+            while not (saw_cancelled and saw_complete):
+                msg = await recv_json(ws)
+                if msg["type"] == "cancelled":
+                    saw_cancelled = msg["success"] is True
+                elif msg["type"] == "response_complete":
+                    saw_complete = True
+                    assert msg["stats"]["finish_reason"] == "cancelled"
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_second_message_while_generating_rejected(self):
+        _, engine, server, client = await self._setup()
+        engine.delay_s = 0.05
+        engine.n_repeats = 50
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "one"})
+            msg = await recv_json(ws)
+            assert msg["type"] == "token"
+            await ws.send_json({"type": "user_message", "text": "two"})
+            # next non-token message must be the in-progress error
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] != "token":
+                    break
+            assert msg["type"] == "error"
+            assert msg["error"]["code"] == "generation_in_progress"
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_update_config_applies(self):
+        _, engine, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "update_config",
+                                "config": {"temperature": 0.123,
+                                           "max_tokens": 7}})
+            upd = await recv_json(ws)
+            assert upd["type"] == "config_updated" and upd["success"]
+
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "response_complete":
+                    break
+            p = engine.requests_seen[-1]["params"]
+            assert p.temperature == 0.123
+            assert p.max_tokens == 7
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_string_stop_not_exploded(self):
+        """A bare string stop value is one stop sequence, not N chars."""
+        _, engine, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "start_session",
+                                "config": {"stop": "</s>"}})
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "response_complete":
+                    break
+            assert engine.requests_seen[-1]["params"].stop == ["</s>"]
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_default_system_prompt_without_start_session(self):
+        _, engine, server, client = await self._setup(
+            SYSTEM_PROMPT="the default prompt")
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "direct"})
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "response_complete":
+                    break
+            msgs = engine.requests_seen[-1]["messages"]
+            assert msgs[0] == {"role": "system",
+                               "content": "the default prompt"}
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_end_session_mid_stream_no_trailing_tokens(self):
+        _, engine, server, client = await self._setup()
+        engine.delay_s = 0.05
+        engine.n_repeats = 100
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "go"})
+            msg = await recv_json(ws)
+            assert msg["type"] == "token"
+            await ws.send_json({"type": "end_session"})
+            # after session_ended, no token frames may follow
+            saw_ended = False
+            for _ in range(50):
+                msg = await recv_json(ws)
+                if msg["type"] == "session_ended":
+                    saw_ended = True
+                    break
+            assert saw_ended
+            await ws.send_json({"type": "end_session"})  # drain any frames
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "session_ended":
+                    break
+                assert msg["type"] != "token", "token after session_ended"
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_update_config_hostile_keys(self):
+        """Client-supplied keys like session_id must not crash dispatch."""
+        _, engine, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "update_config",
+                                "config": {"session_id": "evil",
+                                           "overrides": {}, "self": 1,
+                                           "temperature": 0.4}})
+            upd = await recv_json(ws)
+            assert upd["type"] == "config_updated" and upd["success"]
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "response_complete":
+                    break
+            assert engine.requests_seen[-1]["params"].temperature == 0.4
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_admission_limit(self):
+        _, _, server, client = await self._setup(LLM_MAX_CONNECTIONS="1")
+        try:
+            ws1 = await client.ws_connect("/ws/llm")
+            first = await recv_json(ws1)
+            assert first["type"] == "session_started"
+            ws2 = await client.ws_connect("/ws/llm")
+            err = await recv_json(ws2)
+            assert err["type"] == "error"
+            assert err["error"]["code"] == "max_connections"
+            await ws1.close()
+        finally:
+            await client.close()
+
+    async def test_disconnect_releases_engine_session(self):
+        _, engine, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            started = await recv_json(ws)
+            sid = started["session_id"]
+            await ws.close()
+            await asyncio.sleep(0.1)
+            assert sid in engine.released_sessions
+        finally:
+            await client.close()
+
+    async def test_tts_chunking_mode(self):
+        _, engine, server, client = await self._setup()
+        engine.reply = "One two three. Four five six. "
+        engine.n_repeats = 2
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "start_session",
+                                "config": {"tts_chunking": True}})
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "speak"})
+            chunks = []
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "token":
+                    assert msg.get("speakable") is True
+                    chunks.append(msg["data"])
+                elif msg["type"] == "response_complete":
+                    break
+            # sentence-boundary chunks, not single tokens
+            assert any(c.rstrip().endswith(".") for c in chunks)
+            await ws.close()
+        finally:
+            await client.close()
+
+
+class TestHTTP:
+    async def test_endpoints(self):
+        config = make_config(LLM_PROVIDER="fake")
+        engine = FakeEngine()
+        engine.start()
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        try:
+            r = await client.get("/")
+            assert r.status == 200
+            body = await r.json()
+            assert body["service"].startswith("FastTalk")
+
+            r = await client.get("/health")
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "healthy"
+            assert body["backend_connection"] is True
+
+            r = await client.get("/stats")
+            body = await r.json()
+            assert "connections" in body and "engine" in body
+
+            r = await client.get("/models")
+            body = await r.json()
+            assert body["model"] == "fake"
+        finally:
+            await client.close()
+
+    async def test_health_degraded_when_engine_down(self):
+        config = make_config(LLM_PROVIDER="fake")
+        engine = FakeEngine()  # not started
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        try:
+            r = await client.get("/health")
+            assert r.status == 503
+        finally:
+            await client.close()
+
+
+class TestMonitoringApp:
+    async def test_monitoring_endpoints(self):
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        get_metrics().counter("engine_tokens_generated_total").inc(5)
+        app = build_monitoring_app(ready_check=lambda: True)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/health")
+            body = await r.json()
+            assert body["status"] == "healthy"
+            assert "system" in body
+            assert body["metrics"]["engine_tokens_generated_total"] == 5
+
+            assert (await client.get("/health/ready")).status == 200
+            assert (await client.get("/health/live")).status == 200
+
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "engine_tokens_generated_total 5" in text
+
+            r = await client.get("/info")
+            assert (await r.json())["service"] == "fasttalk-tpu"
+        finally:
+            await client.close()
+
+    async def test_ready_reflects_engine(self):
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+        app = build_monitoring_app(ready_check=lambda: False)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert (await client.get("/health/ready")).status == 503
+        finally:
+            await client.close()
+
+
+class TestConversationManager:
+    def test_token_budget_trim_keeps_system_and_recent(self):
+        cm = ConversationManager(count_tokens=lambda s: len(s.split()),
+                                 max_history_tokens=40)
+        cm.create_session("s", system_prompt="sys prompt here")
+        for i in range(20):
+            cm.add_user_message("s", f"user message number {i} padding words")
+            cm.add_assistant_message("s", f"reply {i}")
+        msgs = cm.get_messages_for_generation("s")
+        assert msgs[0]["role"] == "system"
+        assert msgs[-1]["content"] == "reply 19"  # newest kept
+        assert len(msgs) < 41  # trimmed
+        # oldest messages dropped
+        assert all("number 0 " not in m["content"] for m in msgs[1:])
+
+    def test_single_huge_message_still_sent(self):
+        cm = ConversationManager(count_tokens=lambda s: len(s),
+                                 max_history_tokens=10)
+        cm.add_user_message("s", "x" * 1000)
+        msgs = cm.get_messages_for_generation("s")
+        assert len(msgs) == 1
+
+    def test_idle_cleanup(self):
+        cm = ConversationManager(session_timeout=0.0)
+        cm.create_session("a")
+        cm.create_session("b")
+        import time
+        assert cm.cleanup_idle_sessions(now=time.time() + 1) == 2
+        assert cm.get_session_count() == 0
+
+    def test_gen_config_stored(self):
+        cm = ConversationManager()
+        cm.create_session("s", gen_config={"temperature": 0.2})
+        cm.update_config("s", {"top_k": 7, "system_prompt": "new sys"})
+        st = cm.get("s")
+        assert st.gen_config == {"temperature": 0.2, "top_k": 7}
+        assert st.system_prompt == "new sys"
+
+
+class TestTextProcessor:
+    def test_extract_chunk(self):
+        chunk, rest = extract_speakable_chunk(
+            "Hello there, this is a sentence. And more")
+        assert chunk.endswith(",") or chunk.endswith(".")
+        assert chunk + rest == "Hello there, this is a sentence. And more"
+
+    def test_no_chunk_too_short(self):
+        chunk, rest = extract_speakable_chunk("Hi.")
+        assert chunk == ""
+        assert rest == "Hi."
+
+    def test_similarity(self):
+        assert text_similarity("a b c", "a b c") == 1.0
+        assert text_similarity("a b", "c d") == 0.0
+        assert 0 < text_similarity("a b c", "b c d") < 1
+
+
+@pytest.mark.slow
+class TestRealEngineE2E:
+    async def test_ws_round_trip_on_tiny_engine(self):
+        import jax
+
+        from fasttalk_tpu.engine.engine import TPUEngine
+        from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+        from fasttalk_tpu.models import get_model_config, init_params
+
+        tiny = get_model_config("test-tiny")
+        engine = TPUEngine(tiny, init_params(tiny, jax.random.PRNGKey(0)),
+                           ByteTokenizer(), num_slots=2, max_len=128,
+                           prefill_chunk=32)
+        engine.start()
+        config = make_config(LLM_PROVIDER="tpu")
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "start_session",
+                                "config": {"max_tokens": 6}})
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "hello"})
+            stats = None
+            while True:
+                msg = await recv_json(ws)
+                if msg["type"] == "response_complete":
+                    stats = msg["stats"]
+                    break
+                assert msg["type"] == "token"
+            assert stats["tokens_generated"] > 0
+            assert stats["ttft_ms"] is not None
+            await ws.close()
+        finally:
+            await client.close()
+            engine.shutdown()
