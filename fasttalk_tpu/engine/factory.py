@@ -28,6 +28,21 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
 def build_engine(cfg: Config) -> EngineBase:
     if cfg.llm_provider == "fake":  # internal/testing
         return FakeEngine()
+    if cfg.llm_provider in ("vllm", "openai"):
+        # "openai" = any OpenAI-compatible HTTP backend; same wire
+        # protocol as vLLM. (The reference validated 'openai' but had no
+        # handler for it — SURVEY.md §5 config notes.)
+        from fasttalk_tpu.engine.remote import VLLMRemoteEngine
+
+        return VLLMRemoteEngine(cfg.vllm_base_url, cfg.vllm_model,
+                                api_key=cfg.vllm_api_key,
+                                timeout_s=cfg.vllm_timeout)
+    if cfg.llm_provider == "ollama":
+        from fasttalk_tpu.engine.remote import OllamaRemoteEngine
+
+        return OllamaRemoteEngine(cfg.ollama_base_url, cfg.model_name,
+                                  keep_alive=cfg.ollama_keep_alive,
+                                  timeout_s=cfg.ollama_timeout)
     model_cfg = get_model_config(cfg.model_name)
     dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
     params, loaded = load_or_init(model_cfg, cfg.model_path, dtype)

@@ -1,0 +1,283 @@
+"""Legacy remote backends behind the engine seam: vLLM and Ollama.
+
+Back-compat parity with the reference's L1 handler layer — the vLLM
+OpenAI-SSE client (app/core/vllm_handler.py:117-308) and the Ollama
+NDJSON client (app/core/ollama_handler.py:110-339) — rebuilt as
+EngineBase implementations so the serving layer is provider-pluggable
+(tpu | vllm | ollama) exactly as SURVEY.md §7 prescribes. Fully async
+(aiohttp): no sync-generator-in-async-loop stalls (reference flaw,
+SURVEY.md §3.3), and cancellation closes the HTTP stream immediately.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, AsyncGenerator
+
+import aiohttp
+
+from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("engine.remote")
+
+
+class _RemoteEngine(EngineBase):
+    """Shared plumbing: lazy client session, cancel flags, lifecycle."""
+
+    def __init__(self, base_url: str, timeout_s: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._cancelled: set[str] = set()
+        self._session: aiohttp.ClientSession | None = None
+        self._started = False
+
+    def start(self) -> None:
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._started = False
+        session, self._session = self._session, None
+        if session is not None and not session.closed:
+            try:
+                loop = asyncio.get_event_loop()
+                if loop.is_running():
+                    loop.create_task(session.close())
+                else:
+                    loop.run_until_complete(session.close())
+            except RuntimeError:
+                pass
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s,
+                                              sock_connect=10))
+        return self._session
+
+    def cancel(self, request_id: str) -> bool:
+        self._cancelled.add(request_id)
+        return True
+
+    def release_session(self, session_id: str) -> None:
+        pass  # remote backends hold no per-session device state
+
+    def get_stats(self) -> dict:
+        return {"backend": self.base_url,
+                "cancelled_pending": len(self._cancelled)}
+
+    def _sync_get(self, url: str, timeout: float = 3.0) -> Any:
+        import requests
+
+        r = requests.get(url, timeout=timeout)
+        r.raise_for_status()
+        return r
+
+    def _finish_stats(self, tokens: int, started: float,
+                      ttft: float | None, prompt_tokens: int = 0) -> dict:
+        dur = time.monotonic() - started
+        return {
+            "tokens_generated": tokens,
+            "processing_time_ms": dur * 1000,
+            "tokens_per_second": tokens / dur if dur > 0 else 0.0,
+            "ttft_ms": ttft,
+            "prompt_tokens": prompt_tokens,
+        }
+
+
+class VLLMRemoteEngine(_RemoteEngine):
+    """OpenAI-compatible SSE streaming client against an external vLLM
+    (reference: vllm_handler.py — base URL config at config.py:96)."""
+
+    def __init__(self, base_url: str, model: str,
+                 api_key: str = "not-needed", timeout_s: float = 600.0):
+        super().__init__(base_url, timeout_s)
+        self.model = model
+        self.api_key = api_key
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        client = await self._client()
+        body = {
+            "model": self.model,
+            "messages": messages,
+            "temperature": params.temperature,
+            "top_p": params.top_p,
+            "max_tokens": params.max_tokens,
+            "stream": True,
+        }
+        if params.stop:
+            body["stop"] = params.stop
+        started = time.monotonic()
+        ttft = None
+        tokens = 0
+        finish = "stop"
+        try:
+            async with client.post(
+                    f"{self.base_url}/chat/completions", json=body,
+                    headers={"Authorization": f"Bearer {self.api_key}"},
+                    ) as resp:
+                if resp.status != 200:
+                    text = await resp.text()
+                    raise LLMServiceError(
+                        f"vLLM backend error {resp.status}: {text[:200]}",
+                        category=ErrorCategory.CONNECTION)
+                async for raw in resp.content:
+                    if request_id in self._cancelled:
+                        self._cancelled.discard(request_id)
+                        yield {"type": "cancelled",
+                               "finish_reason": "cancelled",
+                               "stats": self._finish_stats(tokens, started,
+                                                           ttft)}
+                        return
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        break
+                    try:
+                        obj = json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue
+                    choices = obj.get("choices") or []
+                    if not choices:
+                        continue
+                    delta = choices[0].get("delta", {})
+                    fr = choices[0].get("finish_reason")
+                    if fr:
+                        finish = fr
+                    content = delta.get("content")
+                    if content:
+                        tokens += 1
+                        if ttft is None:
+                            ttft = (time.monotonic() - started) * 1000
+                        yield {"type": "token", "text": content}
+            yield {"type": "done", "finish_reason": finish,
+                   "stats": self._finish_stats(tokens, started, ttft)}
+        except aiohttp.ClientError as e:
+            raise LLMServiceError(f"vLLM connection failed: {e}",
+                                  category=ErrorCategory.CONNECTION) from e
+        finally:
+            self._cancelled.discard(request_id)
+
+    def check_connection(self) -> bool:
+        if not self._started:
+            return False
+        try:
+            root = self.base_url.rsplit("/v1", 1)[0]
+            self._sync_get(f"{root}/health")
+            return True
+        except Exception:
+            return False
+
+    def get_model_info(self) -> dict:
+        # Static (no network): this runs inside async handlers, where a
+        # blocking round-trip would stall the event loop.
+        return {"model": self.model, "backend": "vllm",
+                "base_url": self.base_url}
+
+    def list_available_models(self) -> list[str]:
+        """Network call — do not use from the event loop."""
+        try:
+            r = self._sync_get(f"{self.base_url}/models")
+            return [m.get("id") for m in r.json().get("data", [])]
+        except Exception:
+            return []
+
+
+class OllamaRemoteEngine(_RemoteEngine):
+    """NDJSON streaming client against an external Ollama
+    (reference: ollama_handler.py — base URL config at config.py:116)."""
+
+    def __init__(self, base_url: str, model: str,
+                 keep_alive: str = "5m", timeout_s: float = 600.0):
+        super().__init__(base_url, timeout_s)
+        self.model = model
+        self.keep_alive = keep_alive
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        client = await self._client()
+        body = {
+            "model": self.model,
+            "messages": messages,
+            "stream": True,
+            "keep_alive": self.keep_alive,
+            "options": {
+                "temperature": params.temperature,
+                "top_p": params.top_p,
+                "top_k": params.top_k,
+                "num_predict": params.max_tokens,
+            },
+        }
+        if params.stop:
+            body["options"]["stop"] = params.stop
+        started = time.monotonic()
+        ttft = None
+        tokens = 0
+        try:
+            async with client.post(f"{self.base_url}/api/chat",
+                                   json=body) as resp:
+                if resp.status != 200:
+                    text = await resp.text()
+                    raise LLMServiceError(
+                        f"Ollama backend error {resp.status}: {text[:200]}",
+                        category=ErrorCategory.CONNECTION)
+                async for raw in resp.content:
+                    if request_id in self._cancelled:
+                        self._cancelled.discard(request_id)
+                        yield {"type": "cancelled",
+                               "finish_reason": "cancelled",
+                               "stats": self._finish_stats(tokens, started,
+                                                           ttft)}
+                        return
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    content = (obj.get("message") or {}).get("content")
+                    if content:
+                        tokens += 1
+                        if ttft is None:
+                            ttft = (time.monotonic() - started) * 1000
+                        yield {"type": "token", "text": content}
+                    if obj.get("done"):
+                        break
+            yield {"type": "done", "finish_reason": "stop",
+                   "stats": self._finish_stats(tokens, started, ttft)}
+        except aiohttp.ClientError as e:
+            raise LLMServiceError(f"Ollama connection failed: {e}",
+                                  category=ErrorCategory.CONNECTION) from e
+        finally:
+            self._cancelled.discard(request_id)
+
+    def check_connection(self) -> bool:
+        if not self._started:
+            return False
+        try:
+            self._sync_get(f"{self.base_url}/")
+            return True
+        except Exception:
+            return False
+
+    def get_model_info(self) -> dict:
+        # Static (no network): see VLLMRemoteEngine.get_model_info.
+        return {"model": self.model, "backend": "ollama",
+                "base_url": self.base_url}
+
+    def list_available_models(self) -> list[str]:
+        """Network call — do not use from the event loop."""
+        try:
+            r = self._sync_get(f"{self.base_url}/api/tags")
+            return [m.get("name") for m in r.json().get("models", [])]
+        except Exception:
+            return []

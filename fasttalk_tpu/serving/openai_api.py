@@ -1,0 +1,200 @@
+"""OpenAI-compatible HTTP API on the main serving port.
+
+The reference reached its engine through this API from the client side
+(vllm_handler.py:117-308 spoke /v1/chat/completions as a consumer);
+serving it here means OpenAI-SDK clients, the reference's own vLLM
+handler, and any PydanticAI-style framework can point at THIS engine —
+the vLLM-parity surface of BASELINE config #3.
+
+Implements: POST /v1/chat/completions (stream SSE + non-stream),
+GET /v1/models. Authentication mirrors vLLM's "not needed but accepted".
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any
+
+from aiohttp import web
+
+from typing import Callable
+
+from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.utils.errors import CircuitBreaker, CircuitBreakerOpen
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("serving.openai")
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def register_openai_routes(app: web.Application,
+                           backend: EngineBase | Callable[[], Any],
+                           model_name: str | Callable[[], str],
+                           defaults: dict[str, Any] | None = None,
+                           breaker: CircuitBreaker | None = None) -> None:
+    """``backend`` may be a callable returning the current backend (engine
+    or agent — both expose the same generate seam), so the OpenAI route
+    goes through the same tool-calling/breaker path as the WebSocket
+    route instead of bypassing it."""
+    defaults = defaults or {}
+    get_backend = backend if callable(backend) else (lambda: backend)
+    get_name = model_name if callable(model_name) else (lambda: model_name)
+
+    async def models(request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{
+                "id": get_name(),
+                "object": "model",
+                "created": _now(),
+                "owned_by": "fasttalk-tpu",
+            }],
+        })
+
+    def _params(body: dict) -> GenerationParams:
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        return GenerationParams(
+            temperature=float(body.get(
+                "temperature", defaults.get("temperature", 0.7))),
+            top_p=float(body.get("top_p", defaults.get("top_p", 0.9))),
+            top_k=int(body.get("top_k", defaults.get("top_k", 40))),
+            max_tokens=int(body.get("max_tokens")
+                           or body.get("max_completion_tokens")
+                           or defaults.get("max_tokens", 1024)),
+            stop=[s for s in stop if isinstance(s, str) and s],
+        )
+
+    async def chat_completions(request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body",
+                           "type": "invalid_request_error"}}, status=400)
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return web.json_response(
+                {"error": {"message": "messages must be a non-empty list",
+                           "type": "invalid_request_error"}}, status=400)
+        params = _params(body)
+        completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = _now()
+        session_id = body.get("user") or f"oai-{completion_id}"
+        req_model = body.get("model", get_name())
+        engine = get_backend()
+        if breaker is not None:
+            try:
+                breaker.check()
+            except CircuitBreakerOpen as e:
+                return web.json_response(
+                    {"error": {"message": e.message,
+                               "type": "server_error",
+                               "retry_after": e.retry_after}}, status=503)
+
+        if body.get("stream"):
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            })
+            await resp.prepare(request)
+
+            def chunk(delta: dict, finish: str | None = None) -> bytes:
+                payload = {
+                    "id": completion_id, "object": "chat.completion.chunk",
+                    "created": created, "model": req_model,
+                    "choices": [{"index": 0, "delta": delta,
+                                 "finish_reason": finish}],
+                }
+                return f"data: {json.dumps(payload)}\n\n".encode()
+
+            try:
+                await resp.write(chunk({"role": "assistant"}))
+                finish_reason = "stop"
+                failed = False
+                async for event in engine.generate(completion_id, session_id,
+                                                   messages, params):
+                    if event["type"] == "token":
+                        await resp.write(chunk({"content": event["text"]}))
+                    elif event["type"] in ("done", "cancelled"):
+                        finish_reason = _oai_finish(
+                            event.get("finish_reason", "stop"))
+                    elif event["type"] == "error":
+                        failed = True
+                        await resp.write(
+                            f"data: {json.dumps({'error': event.get('error')})}\n\n"
+                            .encode())
+                        break
+                if breaker is not None:
+                    (breaker.record_failure if failed
+                     else breaker.record_success)()
+                await resp.write(chunk({}, finish=finish_reason))
+                await resp.write(b"data: [DONE]\n\n")
+            except Exception:
+                if breaker is not None:
+                    breaker.record_failure()
+                raise
+            finally:
+                engine.release_session(session_id)
+            return resp
+
+        # Non-streaming
+        text = ""
+        stats: dict[str, Any] = {}
+        finish_reason = "stop"
+        try:
+            async for event in engine.generate(completion_id, session_id,
+                                               messages, params):
+                if event["type"] == "token":
+                    text += event["text"]
+                elif event["type"] in ("done", "cancelled"):
+                    stats = event.get("stats", {})
+                    finish_reason = _oai_finish(
+                        event.get("finish_reason", "stop"))
+                elif event["type"] == "error":
+                    if breaker is not None:
+                        breaker.record_failure()
+                    return web.json_response(
+                        {"error": {"message": str(event.get("error")),
+                                   "type": "server_error"}}, status=500)
+            if breaker is not None:
+                breaker.record_success()
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        finally:
+            engine.release_session(session_id)
+        prompt_tokens = int(stats.get("prompt_tokens", 0))
+        completion_tokens = int(stats.get("tokens_generated", 0))
+        return web.json_response({
+            "id": completion_id,
+            "object": "chat.completion",
+            "created": created,
+            "model": req_model,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
+            },
+        })
+
+    app.router.add_get("/v1/models", models)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+
+
+def _oai_finish(reason: str) -> str:
+    return {"stop": "stop", "length": "length", "cancelled": "stop",
+            "tool_rounds": "stop"}.get(reason, "stop")

@@ -85,6 +85,18 @@ class WebSocketLLMServer:
         self.app.router.add_get("/stats", self._http_stats)
         self.app.router.add_get("/models", self._http_models)
         self.app.router.add_get("/ws/llm", self.handle_websocket)
+        from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+        register_openai_routes(
+            self.app,
+            backend=lambda: self.agent if self.agent is not None
+            else self.engine,
+            model_name=self._model_name,
+            defaults={"temperature": config.default_temperature,
+                      "top_p": config.default_top_p,
+                      "top_k": config.default_top_k,
+                      "max_tokens": config.default_max_tokens},
+            breaker=self.breaker)
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
 
@@ -118,6 +130,12 @@ class WebSocketLLMServer:
 
     # ---------------- HTTP ----------------
 
+
+    def _backend(self):
+        """The generation backend the server talks to: agent when
+        enabled (same seam), bare engine otherwise."""
+        return self.agent if self.agent is not None else self.engine
+
     def _model_name(self) -> str:
         try:
             return self.engine.get_model_info().get("model",
@@ -139,7 +157,8 @@ class WebSocketLLMServer:
 
     async def _http_health(self, request: web.Request) -> web.Response:
         try:
-            ok = self.engine.check_connection()
+            # to_thread: remote-backend engines may do a blocking probe.
+            ok = await asyncio.to_thread(self.engine.check_connection)
             body = {
                 "status": "healthy" if ok else "degraded",
                 "provider": self.config.llm_provider,
@@ -176,7 +195,8 @@ class WebSocketLLMServer:
 
     async def _http_models(self, request: web.Request) -> web.Response:
         try:
-            return web.json_response(self.engine.get_model_info())
+            source = self.agent if self.agent is not None else self.engine
+            return web.json_response(source.get_model_info())
         except Exception as e:
             return web.json_response({"error": str(e)})
 
@@ -220,8 +240,8 @@ class WebSocketLLMServer:
                 task.cancel()
             rid = self._cur_request.pop(session_id, None)
             if rid is not None:
-                self.engine.cancel(rid)
-            self.engine.release_session(session_id)
+                self._backend().cancel(rid)
+            self._backend().release_session(session_id)
             self.connection_manager.remove_connection(session_id)
             self.conversation_manager.end_session(session_id)
             log.log_connection(session_id, "closed")
@@ -414,7 +434,7 @@ class WebSocketLLMServer:
                 },
             })
         except asyncio.CancelledError:
-            self.engine.cancel(request_id)
+            self._backend().cancel(request_id)
             raise
         except CircuitBreakerOpen as e:
             await self._send(session_id, ws,
@@ -441,7 +461,7 @@ class WebSocketLLMServer:
     async def _handle_cancel(self, session_id: str,
                              ws: web.WebSocketResponse) -> None:
         rid = self._cur_request.get(session_id)
-        ok = self.engine.cancel(rid) if rid else False
+        ok = self._backend().cancel(rid) if rid else False
         await self._send(session_id, ws, {"type": "cancelled", "success": ok})
 
     async def _handle_end_session(self, session_id: str,
@@ -453,14 +473,14 @@ class WebSocketLLMServer:
         if task is not None and not task.done():
             rid = self._cur_request.get(session_id)
             if rid:
-                self.engine.cancel(rid)
+                self._backend().cancel(rid)
             task.cancel()
             try:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
         info = self.connection_manager.get_connection(session_id)
-        self.engine.release_session(session_id)
+        self._backend().release_session(session_id)
         self.conversation_manager.end_session(session_id)
         await self._send(session_id, ws, {
             "type": "session_ended",

@@ -1,0 +1,365 @@
+"""Agent-layer tests: hermes parsing, tool registry, the native
+tool-calling loop, and the OpenAI-compatible endpoint."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.agents.hermes import HermesStreamParser, tools_system_prompt
+from fasttalk_tpu.agents.tools import (
+    OfflineSearchBackend,
+    Tool,
+    ToolRegistry,
+    build_default_registry,
+)
+from fasttalk_tpu.agents.voice_agent import VoiceAgent
+from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+
+
+class TestHermesParser:
+    def test_plain_text_passthrough(self):
+        p = HermesStreamParser()
+        text, calls = p.feed("hello world")
+        assert text == "hello world"
+        assert calls == []
+
+    def test_tool_call_extracted(self):
+        p = HermesStreamParser()
+        text, calls = p.feed(
+            'before <tool_call>{"name": "t", "arguments": {"x": 1}}'
+            "</tool_call> after")
+        assert text.startswith("before ")
+        assert " after" in text
+        assert len(calls) == 1
+        assert calls[0].name == "t"
+        assert calls[0].arguments == {"x": 1}
+
+    def test_split_across_deltas(self):
+        p = HermesStreamParser()
+        out, all_calls = "", []
+        pieces = ["Hi <to", "ol_call>{\"name\": \"clock\",",
+                  " \"arguments\": {}}</tool", "_call> done"]
+        for piece in pieces:
+            t, c = p.feed(piece)
+            out += t
+            all_calls += c
+        out += p.flush()
+        assert out == "Hi  done"
+        assert len(all_calls) == 1
+        assert all_calls[0].name == "clock"
+
+    def test_false_prefix_released(self):
+        p = HermesStreamParser()
+        t1, _ = p.feed("a < b")
+        t2, _ = p.feed(" and c")
+        assert (t1 + t2 + p.flush()) == "a < b and c"
+
+    def test_stringified_arguments(self):
+        p = HermesStreamParser()
+        _, calls = p.feed(
+            '<tool_call>{"name": "t", "arguments": "{\\"q\\": \\"x\\"}"}'
+            "</tool_call>")
+        assert calls[0].arguments == {"q": "x"}
+
+    def test_malformed_json_safe(self):
+        p = HermesStreamParser()
+        _, calls = p.feed("<tool_call>not json</tool_call>")
+        assert calls[0].name == ""
+
+    def test_unterminated_call_dropped(self):
+        p = HermesStreamParser()
+        text, calls = p.feed('<tool_call>{"name": "t"')
+        assert text == "" and calls == []
+        assert p.flush() == ""
+
+    def test_system_prompt_lists_tools(self):
+        s = tools_system_prompt([{"name": "a"}, {"name": "b"}])
+        assert "<tool_call>" in s and '"a"' in s and '"b"' in s
+
+
+class TestToolRegistry:
+    def test_builtins_execute(self):
+        reg = build_default_registry(enable_web_search=True)
+        assert set(reg.names()) == {"get_current_time", "get_session_info",
+                                    "web_search"}
+        out = asyncio.run(reg.execute("get_current_time", {}))
+        assert "UTC" in out
+        out = asyncio.run(reg.execute("get_session_info", {},
+                                      context={"session_id": "s9"}))
+        assert json.loads(out)["session_id"] == "s9"
+
+    def test_offline_web_search_degrades_gracefully(self):
+        reg = build_default_registry(enable_web_search=True,
+                                     search_rate_limit_s=0.0)
+        out = json.loads(asyncio.run(
+            reg.execute("web_search", {"query": "weather"})))
+        assert out["query"] == "weather"
+        assert "unavailable" in out["results"][0]["title"].lower()
+
+    def test_unknown_tool_reports_available(self):
+        reg = build_default_registry()
+        out = json.loads(asyncio.run(reg.execute("teleport", {})))
+        assert "unknown tool" in out["error"]
+        assert "get_current_time" in out["available"]
+
+    def test_tool_exception_becomes_result(self):
+        reg = ToolRegistry()
+        reg.register(Tool("boom", "explodes", {}, lambda: 1 / 0))
+        out = json.loads(asyncio.run(reg.execute("boom", {})))
+        assert "failed" in out["error"]
+
+    def test_unexpected_args_filtered(self):
+        reg = build_default_registry()
+        out = asyncio.run(reg.execute("get_current_time",
+                                      {"bogus_arg": 42}))
+        assert "UTC" in out
+
+
+class ScriptedEngine(EngineBase):
+    """Engine yielding a scripted sequence of responses, one per call."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+        self._started = True
+
+    def start(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+    async def generate(self, request_id, session_id, messages, params):
+        self.calls.append({"messages": messages})
+        text = self.responses.pop(0)
+        for i in range(0, len(text), 7):  # stream in small chunks
+            yield {"type": "token", "text": text[i:i + 7]}
+        yield {"type": "done", "finish_reason": "stop",
+               "stats": {"tokens_generated": len(text) // 4 + 1,
+                         "prompt_tokens": 10}}
+
+    def cancel(self, request_id):
+        return True
+
+    def release_session(self, session_id):
+        pass
+
+    def check_connection(self):
+        return True
+
+    def get_model_info(self):
+        return {"model": "scripted"}
+
+    def get_stats(self):
+        return {}
+
+
+def run_agent(agent, messages, params=None):
+    async def go():
+        events = []
+        async for ev in agent.generate("r", "s", messages,
+                                       params or GenerationParams(
+                                           max_tokens=64)):
+            events.append(ev)
+        return events
+    return asyncio.run(go())
+
+
+class TestVoiceAgent:
+    def test_no_tool_call_passthrough(self):
+        eng = ScriptedEngine(["Just a plain answer."])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        events = run_agent(agent, [{"role": "user", "content": "hi"}])
+        text = "".join(e.get("text", "") for e in events
+                       if e["type"] == "token")
+        assert text == "Just a plain answer."
+        assert events[-1]["type"] == "done"
+        # tool section was injected into the system prompt
+        assert eng.calls[0]["messages"][0]["role"] == "system"
+        assert "<tool_call>" in eng.calls[0]["messages"][0]["content"]
+
+    def test_tool_call_executes_and_resumes(self):
+        eng = ScriptedEngine([
+            'Let me check. <tool_call>{"name": "get_current_time", '
+            '"arguments": {}}</tool_call>',
+            "It is now exactly noon.",
+        ])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        events = run_agent(agent, [{"role": "user", "content": "time?"}])
+        kinds = [e["type"] for e in events]
+        assert "tool_call" in kinds
+        tc = next(e for e in events if e["type"] == "tool_call")
+        assert tc["tool"] == "get_current_time"
+        text = "".join(e.get("text", "") for e in events
+                       if e["type"] == "token")
+        assert "<tool_call>" not in text  # markup suppressed
+        assert "It is now exactly noon." in text
+        # second engine call got the tool response appended
+        msgs2 = eng.calls[1]["messages"]
+        assert msgs2[-1]["role"] == "tool"
+        assert "tool_response" in msgs2[-1]["content"]
+
+    def test_tool_round_limit(self):
+        looping = ('<tool_call>{"name": "get_current_time", '
+                   '"arguments": {}}</tool_call>')
+        eng = ScriptedEngine([looping] * 10)
+        agent = VoiceAgent(eng, registry=build_default_registry(),
+                           max_tool_rounds=2)
+        events = run_agent(agent, [{"role": "user", "content": "loop"}])
+        assert events[-1]["type"] == "done"
+        assert events[-1]["finish_reason"] == "tool_rounds"
+        n_calls = sum(1 for e in events if e["type"] == "tool_call")
+        assert n_calls == 2
+
+    def test_stats_aggregated(self):
+        eng = ScriptedEngine([
+            '<tool_call>{"name": "get_current_time", "arguments": {}}'
+            "</tool_call>",
+            "Done now.",
+        ])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        events = run_agent(agent, [{"role": "user", "content": "x"}])
+        stats = events[-1]["stats"]
+        assert stats["tokens_generated"] > 0
+        assert stats["ttft_ms"] is not None
+
+
+class TestAgentCancel:
+    def test_cancel_maps_to_engine_sub_request(self):
+        from fasttalk_tpu.engine.fake import FakeEngine
+
+        eng = FakeEngine(delay_s=0.02, n_repeats=100)
+        eng.start()
+        agent = VoiceAgent(eng, registry=build_default_registry())
+
+        async def run():
+            agen = agent.generate("top", "s",
+                                  [{"role": "user", "content": "hi"}],
+                                  GenerationParams(max_tokens=10_000))
+            got = None
+            async for ev in agen:
+                if ev["type"] == "token":
+                    # Cancel using the TOP-LEVEL id; the agent must map
+                    # it to the live engine sub-request.
+                    assert agent.cancel("top") is True
+                if ev["type"] in ("cancelled", "done", "error"):
+                    got = ev["type"]
+                    break
+            return got
+
+        assert asyncio.run(run()) == "cancelled"
+
+
+class TestAgentOverWebSocket:
+    async def test_tool_call_frames_reach_client(self):
+        from aiohttp.test_utils import TestClient as TC
+        from aiohttp.test_utils import TestServer as TS
+
+        from fasttalk_tpu.serving.server import WebSocketLLMServer
+        from fasttalk_tpu.utils.config import Config
+
+        eng = ScriptedEngine([
+            'Checking. <tool_call>{"name": "get_current_time", '
+            '"arguments": {}}</tool_call>',
+            "The time is told.",
+        ])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        import os
+        os.environ["LLM_PROVIDER"] = "fake"
+        try:
+            config = Config()
+        finally:
+            del os.environ["LLM_PROVIDER"]
+        server = WebSocketLLMServer(config, eng, agent)
+        client = TC(TS(server.app))
+        await client.start_server()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await ws.receive()  # session_started
+            await ws.send_json({"type": "user_message", "text": "time?"})
+            saw_tool, text = False, ""
+            while True:
+                msg = json.loads((await ws.receive()).data)
+                if msg["type"] == "tool_call":
+                    saw_tool = True
+                    assert msg["tool"] == "get_current_time"
+                elif msg["type"] == "token":
+                    text += msg["data"]
+                elif msg["type"] == "response_complete":
+                    break
+            assert saw_tool
+            assert "The time is told." in text
+            assert "<tool_call>" not in text
+            await ws.close()
+        finally:
+            await client.close()
+
+
+class TestOpenAIAPI:
+    async def _client(self):
+        from aiohttp import web
+
+        from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+        eng = ScriptedEngine(["Hello from TPU land."] * 10)
+        app = web.Application()
+        register_openai_routes(app, eng, "test-model")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client, eng
+
+    async def test_models(self):
+        client, _ = await self._client()
+        try:
+            r = await client.get("/v1/models")
+            body = await r.json()
+            assert body["data"][0]["id"] == "test-model"
+        finally:
+            await client.close()
+
+    async def test_non_streaming_completion(self):
+        client, _ = await self._client()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model",
+                "messages": [{"role": "user", "content": "hi"}],
+            })
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "chat.completion"
+            assert body["choices"][0]["message"]["content"] \
+                == "Hello from TPU land."
+            assert body["usage"]["completion_tokens"] > 0
+        finally:
+            await client.close()
+
+    async def test_streaming_completion(self):
+        client, _ = await self._client()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model", "stream": True,
+                "messages": [{"role": "user", "content": "hi"}],
+            })
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            raw = await r.text()
+            lines = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+            assert lines[-1] == "data: [DONE]"
+            chunks = [json.loads(ln[5:]) for ln in lines[:-1]]
+            text = "".join(c["choices"][0]["delta"].get("content", "")
+                           for c in chunks)
+            assert text == "Hello from TPU land."
+            assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        finally:
+            await client.close()
+
+    async def test_validation_errors(self):
+        client, _ = await self._client()
+        try:
+            r = await client.post("/v1/chat/completions", json={})
+            assert r.status == 400
+            r = await client.post("/v1/chat/completions", data=b"{nope")
+            assert r.status == 400
+        finally:
+            await client.close()

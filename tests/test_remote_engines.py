@@ -1,0 +1,128 @@
+"""Back-compat remote engines (vLLM SSE, Ollama NDJSON) against in-test
+fake backend HTTP servers."""
+
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from fasttalk_tpu.engine.engine import GenerationParams
+from fasttalk_tpu.engine.remote import OllamaRemoteEngine, VLLMRemoteEngine
+
+
+async def make_fake_vllm():
+    """Minimal OpenAI-compatible SSE backend."""
+    app = web.Application()
+
+    async def chat(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        assert body["stream"] is True
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        for word in ["Stream", "ing ", "works."]:
+            chunk = {"choices": [{"delta": {"content": word},
+                                  "finish_reason": None}]}
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        done = {"choices": [{"delta": {}, "finish_reason": "stop"}]}
+        await resp.write(f"data: {json.dumps(done)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    async def health(request):
+        return web.json_response({})
+
+    async def models(request):
+        return web.json_response({"data": [{"id": "m1"}]})
+
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/health", health)
+    app.router.add_get("/v1/models", models)
+    server = TestServer(app)
+    await server.start_server()
+    return server
+
+
+async def make_fake_ollama():
+    app = web.Application()
+
+    async def chat(request: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        for word in ["Old", " school", " NDJSON"]:
+            line = {"message": {"content": word}, "done": False}
+            await resp.write((json.dumps(line) + "\n").encode())
+        await resp.write((json.dumps({"message": {"content": ""},
+                                      "done": True}) + "\n").encode())
+        return resp
+
+    async def root(request):
+        return web.Response(text="Ollama is running")
+
+    async def tags(request):
+        return web.json_response({"models": [{"name": "llama3.2:1b"}]})
+
+    app.router.add_post("/api/chat", chat)
+    app.router.add_get("/", root)
+    app.router.add_get("/api/tags", tags)
+    server = TestServer(app)
+    await server.start_server()
+    return server
+
+
+class TestVLLMRemote:
+    async def test_streaming(self):
+        server = await make_fake_vllm()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1")
+            eng.start()
+            events = []
+            async for ev in eng.generate("r1", "s1",
+                                         [{"role": "user", "content": "x"}],
+                                         GenerationParams()):
+                events.append(ev)
+            text = "".join(e.get("text", "") for e in events
+                           if e["type"] == "token")
+            assert text == "Streaming works."
+            assert events[-1]["type"] == "done"
+            assert events[-1]["stats"]["tokens_generated"] == 3
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_backend_down_raises_connection_error(self):
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        eng = VLLMRemoteEngine("http://127.0.0.1:1/v1", "m1")
+        eng.start()
+        try:
+            async for _ in eng.generate("r", "s",
+                                        [{"role": "user", "content": "x"}],
+                                        GenerationParams()):
+                pass
+            raise AssertionError("expected LLMServiceError")
+        except LLMServiceError as e:
+            assert e.category.value == "connection_error"
+        eng.shutdown()
+
+
+class TestOllamaRemote:
+    async def test_streaming(self):
+        server = await make_fake_ollama()
+        try:
+            eng = OllamaRemoteEngine(
+                f"http://127.0.0.1:{server.port}", "llama3.2:1b")
+            eng.start()
+            events = []
+            async for ev in eng.generate("r1", "s1",
+                                         [{"role": "user", "content": "x"}],
+                                         GenerationParams()):
+                events.append(ev)
+            text = "".join(e.get("text", "") for e in events
+                           if e["type"] == "token")
+            assert text == "Old school NDJSON"
+            assert events[-1]["type"] == "done"
+            eng.shutdown()
+        finally:
+            await server.close()
