@@ -127,7 +127,7 @@ class TPUEngine(EngineBase):
                  tokenizer: Tokenizer, *, num_slots: int = 16,
                  max_len: int = 8192, prefill_chunk: int = 512,
                  dtype: Any = jnp.bfloat16, seed: int = 0,
-                 context_window: int | None = None):
+                 context_window: int | None = None, mesh: Any = None):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -136,8 +136,33 @@ class TPUEngine(EngineBase):
         self.usable_len = min(max_len, context_window or max_len)
         self.prefill_chunk = min(prefill_chunk, max(_PREFILL_BUCKETS))
         self.dtype = dtype
+        self.mesh = mesh
 
-        self.cache = init_cache(model_cfg, num_slots, max_len, dtype)
+        if mesh is None:
+            self.cache = init_cache(model_cfg, num_slots, max_len, dtype)
+        else:
+            # Tensor-parallel serving: weights and KV sharded over ICI;
+            # GSPMD turns the row-parallel matmuls into all-reduces.
+            # (The reference's only TP story was forwarding
+            # --tensor-parallel-size to an external container,
+            # docker-compose.vllm.yml:42.) The cache is created directly
+            # in its shards; params are re-placed (a no-op when the
+            # loader already put them with parallel.sharding.param_put).
+            from jax.sharding import NamedSharding
+
+            from fasttalk_tpu.parallel.sharding import (cache_pspecs,
+                                                        shard_params,
+                                                        validate_mesh)
+            validate_mesh(mesh, num_kv_heads=model_cfg.num_kv_heads,
+                          num_heads=model_cfg.num_heads,
+                          hidden=model_cfg.hidden_size,
+                          intermediate=model_cfg.intermediate_size,
+                          vocab=model_cfg.vocab_size,
+                          num_slots=num_slots, max_len=max_len)
+            self.params = shard_params(params, mesh)
+            self.cache = init_cache(
+                model_cfg, num_slots, max_len, dtype,
+                device=NamedSharding(mesh, cache_pspecs().k))
         self.slots = SlotManager(num_slots, max_len)
         self._cur_tokens = jnp.zeros((num_slots,), jnp.int32)
         self._positions = np.zeros((num_slots,), np.int32)
@@ -257,6 +282,7 @@ class TPUEngine(EngineBase):
             "decode_slots": self.num_slots,
             "dtype": jnp.dtype(self.dtype).name,
             "devices": [str(d) for d in jax.devices()],
+            "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
         }
 
     def get_stats(self) -> dict:

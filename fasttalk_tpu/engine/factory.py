@@ -45,7 +45,16 @@ def build_engine(cfg: Config) -> EngineBase:
                                   timeout_s=cfg.ollama_timeout)
     model_cfg = get_model_config(cfg.model_name)
     dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
-    params, loaded = load_or_init(model_cfg, cfg.model_path, dtype)
+    mesh = put = None
+    if cfg.tp_size > 1 or cfg.dp_size > 1:
+        from fasttalk_tpu.parallel.mesh import make_mesh
+        from fasttalk_tpu.parallel.sharding import param_put
+
+        mesh = make_mesh(dp=cfg.dp_size, tp=cfg.tp_size)
+        # Weights go straight into their TP shards as they stream off
+        # disk — a 70B checkpoint must never materialise on one chip.
+        put = param_put(mesh)
+    params, loaded = load_or_init(model_cfg, cfg.model_path, dtype, put=put)
     tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
                                cfg.tokenizer_path)
     log.info(
@@ -53,10 +62,12 @@ def build_engine(cfg: Config) -> EngineBase:
         f"({model_cfg.param_count() / 1e9:.2f}B params, "
         f"weights {'loaded' if loaded else 'random-init'}), "
         f"slots={cfg.decode_slots}, max_len={cfg.max_model_len}, "
-        f"dtype={cfg.dtype}")
+        f"dtype={cfg.dtype}, "
+        f"mesh={dict(mesh.shape) if mesh else 'single-device'}")
     engine = TPUEngine(
         model_cfg, params, tokenizer,
         num_slots=cfg.decode_slots, max_len=cfg.max_model_len,
         prefill_chunk=cfg.prefill_chunk, dtype=dtype,
-        context_window=min(cfg.default_context_window, cfg.max_model_len))
+        context_window=min(cfg.default_context_window, cfg.max_model_len),
+        mesh=mesh)
     return engine

@@ -48,9 +48,12 @@ class KVCache(NamedTuple):
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int,
-               dtype: jnp.dtype = jnp.bfloat16) -> KVCache:
+               dtype: jnp.dtype = jnp.bfloat16, device=None) -> KVCache:
+    """``device`` may be a Sharding — the cache is then created directly
+    in its shards (never materialised on a single chip)."""
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    return KVCache(k=jnp.zeros(shape, dtype, device=device),
+                   v=jnp.zeros(shape, dtype, device=device))
 
 
 def init_params(cfg: ModelConfig, rng: jax.Array,

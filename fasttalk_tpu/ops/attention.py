@@ -50,6 +50,50 @@ def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(b, t, q.shape[2], q.shape[3])
 
 
+def online_softmax_fold(qg: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        q_positions: jnp.ndarray, key_pos: jnp.ndarray,
+                        carry: tuple) -> tuple:
+    """Fold one K/V block into flash-attention online-softmax state.
+
+    The single source of the numerics-critical recurrence, shared by
+    ``attend_blockwise`` (local key blocks) and
+    ``parallel.ring_attention`` (blocks visiting over ICI).
+
+    qg [B, Tq, K, G, D] float32; k/v [B, Tk, K, D] any dtype;
+    q_positions [B, Tq] and key_pos [Tk] are absolute positions;
+    carry = (m [B,Tq,K,G], l [B,Tq,K,G], acc [B,Tq,K,G,D]), all float32.
+    """
+    m, l, acc = carry
+    scale = qg.shape[-1] ** -0.5
+    scores = jnp.einsum("btkgd,bskd->btkgs", qg, k.astype(jnp.float32)) * scale
+    mask = key_pos[None, None, :] <= q_positions[:, :, None]  # [B, Tq, Tk]
+    scores = jnp.where(mask[:, :, None, None, :], scores, _NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum(
+        "btkgs,bskd->btkgd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def fold_init(b: int, t: int, nkv: int, g: int, d: int) -> tuple:
+    """Initial (m, l, acc) state for ``online_softmax_fold``."""
+    return (
+        jnp.full((b, t, nkv, g), _NEG_INF, jnp.float32),
+        jnp.zeros((b, t, nkv, g), jnp.float32),
+        jnp.zeros((b, t, nkv, g, d), jnp.float32),
+    )
+
+
+def fold_finish(carry: tuple, out_dtype) -> jnp.ndarray:
+    """Normalise the accumulated state into [B, T, Nq, D] output."""
+    _, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    b, t, nkv, g, d = acc.shape
+    return out.reshape(b, t, nkv * g, d).astype(out_dtype)
+
+
 def attend_blockwise(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      q_positions: jnp.ndarray, block_size: int = 512
                      ) -> jnp.ndarray:
@@ -60,7 +104,6 @@ def attend_blockwise(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if s % block_size:
         raise ValueError(f"cache length {s} not divisible by block {block_size}")
     nblocks = s // block_size
-    scale = d ** -0.5
     qg = _split_gqa(q, nkv).astype(jnp.float32)
 
     kb = k.reshape(b, nblocks, block_size, nkv, d)
@@ -70,26 +113,12 @@ def attend_blockwise(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     block_offsets = jnp.arange(nblocks) * block_size
 
     def step(carry, xs):
-        m, l, acc = carry  # running max, normaliser, weighted sum
         kblk, vblk, off = xs
-        scores = jnp.einsum("btkgd,bskd->btkgs", qg, kblk.astype(jnp.float32)) * scale
         key_pos = off + jnp.arange(block_size)
-        mask = key_pos[None, None, :] <= q_positions[:, :, None]
-        scores = jnp.where(mask[:, :, None, None, :], scores, _NEG_INF)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        correction = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])
-        l_new = l * correction + p.sum(axis=-1)
-        acc_new = acc * correction[..., None] + jnp.einsum(
-            "btkgs,bskd->btkgd", p, vblk.astype(jnp.float32))
-        return (m_new, l_new, acc_new), None
+        return online_softmax_fold(qg, kblk, vblk, q_positions, key_pos,
+                                   carry), None
 
     g = nq // nkv
-    init = (
-        jnp.full((b, t, nkv, g), _NEG_INF, jnp.float32),
-        jnp.zeros((b, t, nkv, g), jnp.float32),
-        jnp.zeros((b, t, nkv, g, d), jnp.float32),
-    )
-    (m, l, acc), _ = jax.lax.scan(step, init, (kb, vb, block_offsets))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.reshape(b, t, nq, d).astype(q.dtype)
+    carry, _ = jax.lax.scan(step, fold_init(b, t, nkv, g, d),
+                            (kb, vb, block_offsets))
+    return fold_finish(carry, q.dtype)

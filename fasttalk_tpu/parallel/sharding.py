@@ -1,0 +1,141 @@
+"""Sharding rules for the Llama parameter pytree and KV cache.
+
+Megatron-style tensor parallelism, expressed as PartitionSpecs and left
+to GSPMD to lower into ICI collectives (the idiomatic TPU replacement for
+the NCCL all-reduces inside the reference's vLLM container):
+
+- wq/wk/wv and w_gate/w_up are column-parallel (output axis sharded over
+  "tp") — each chip computes its own heads / FFN slice with no
+  communication.
+- wo and w_down are row-parallel (contraction axis sharded) — XLA emits
+  one all-reduce per block to rejoin the residual stream.
+- The embedding is sharded over the hidden axis, so with tied embeddings
+  the output head is automatically row-parallel (partial logits +
+  all-reduce); an untied lm_head is column-parallel over vocab.
+- KV cache shards over KV heads on "tp" and slots on "dp"; with GQA
+  (8 KV heads on every production config, models/configs.py) TP≤8
+  divides evenly.
+
+Norm scales and rope tables are tiny and stay replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fasttalk_tpu.models.llama import KVCache
+
+# Rules keyed by parameter leaf name; specs include the leading stacked
+# layer axis for everything under "layers".
+_LAYER_RULES: dict[str, P] = {
+    "attn_norm": P(None, None),
+    "mlp_norm": P(None, None),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),
+}
+_TOP_RULES: dict[str, P] = {
+    "embed": P(None, "tp"),
+    "final_norm": P(None),
+    "lm_head": P(None, "tp"),
+}
+
+
+def _leaf_name(path) -> str:
+    for entry in reversed(path):
+        if hasattr(entry, "key"):
+            return str(entry.key)
+    return ""
+
+
+def param_pspecs(params: Any) -> Any:
+    """PartitionSpec pytree matching ``params`` (models/llama.py
+    init_params / models/loader.py structure)."""
+
+    def rule(path, leaf):
+        name = _leaf_name(path)
+        spec = _TOP_RULES.get(name) or _LAYER_RULES.get(name)
+        if spec is None:
+            spec = P(*([None] * leaf.ndim))  # unknown leaves: replicate
+        if len(spec) != leaf.ndim:
+            raise ValueError(
+                f"spec {spec} rank mismatch for {name} with shape {leaf.shape}")
+        return spec
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def cache_pspecs() -> KVCache:
+    """Cache layout [L, slots, S, kv_heads, head_dim]: slots over "dp",
+    sequence over "sp", KV heads over "tp"."""
+    spec = P(None, "dp", "sp", "tp", None)
+    return KVCache(k=spec, v=spec)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    specs = param_pspecs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def shard_cache(cache: KVCache, mesh: Mesh) -> KVCache:
+    specs = cache_pspecs()
+    return KVCache(
+        k=jax.device_put(cache.k, NamedSharding(mesh, specs.k)),
+        v=jax.device_put(cache.v, NamedSharding(mesh, specs.v)))
+
+
+def validate_tp(tp: int, num_kv_heads: int, num_heads: int,
+                hidden: int, intermediate: int,
+                vocab: int | None = None) -> None:
+    """Fail fast on meshes the model can't shard evenly (the reference
+    left this to vLLM to discover at container boot)."""
+    dims = [(num_kv_heads, "num_kv_heads"), (num_heads, "num_heads"),
+            (hidden, "hidden_size"), (intermediate, "intermediate_size")]
+    if vocab is not None:
+        dims.append((vocab, "vocab_size"))  # lm_head is vocab-sharded
+    for dim, label in dims:
+        if dim % tp:
+            raise ValueError(f"tp={tp} does not divide {label}={dim}")
+
+
+def validate_mesh(mesh: Mesh, *, num_kv_heads: int, num_heads: int,
+                  hidden: int, intermediate: int, vocab: int,
+                  num_slots: int, max_len: int) -> None:
+    """Validate every mesh axis against the tensors it shards, so a bad
+    TPU_TP_SIZE/TPU_DP_SIZE fails with a named message at engine build
+    instead of an opaque device_put error mid-startup."""
+    validate_tp(mesh.shape.get("tp", 1), num_kv_heads, num_heads, hidden,
+                intermediate, vocab)
+    dp = mesh.shape.get("dp", 1)
+    if num_slots % dp:
+        raise ValueError(
+            f"dp={dp} does not divide decode_slots={num_slots}")
+    sp = mesh.shape.get("sp", 1)
+    if max_len % sp:
+        raise ValueError(f"sp={sp} does not divide max_model_len={max_len}")
+
+
+def param_put(mesh: Mesh):
+    """A ``put(host_array, path) -> jax.Array`` hook for
+    ``models.loader.load_params`` that places each weight directly into
+    its TP shards — each device receives only its slice, so a 70B
+    checkpoint loads onto a v5e-8 without ever materialising a full
+    tensor on one chip."""
+    import jax.numpy as jnp
+
+    def put(arr, path: str) -> jax.Array:
+        name = path.split("/")[-1]
+        spec = _TOP_RULES.get(name) or _LAYER_RULES.get(name)
+        if spec is None:
+            spec = P(*([None] * arr.ndim))
+        return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+    return put

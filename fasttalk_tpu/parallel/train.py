@@ -1,0 +1,101 @@
+"""Sharded training/fine-tuning step over a ("dp", "sp", "tp") mesh.
+
+The serving framework's models are trainable with the same param pytree
+and forward pass the engine serves (models/llama.py) — no separate
+"training model". Parallelism is pure sharding annotation:
+
+- params sharded per `parallel.sharding.param_pspecs` (TP);
+- the token batch sharded ("dp" over batch rows, "sp" over sequence);
+- optax state inherits param shardings (`optimizer.init` is
+  `tree_map(zeros_like)`, which preserves placement);
+- GSPMD lowers the rest to ICI collectives: all-reduce of row-parallel
+  matmuls (TP), all-gather of K/V along "sp" for attention, and gradient
+  all-reduce over "dp".
+
+The explicit-schedule ring attention variant for sequences that do not
+fit one chip lives in `parallel.ring_attention` and is exercised by the
+long-context tests; this step uses GSPMD's all-to-all/all-gather form.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.models.llama import KVCache, forward
+from fasttalk_tpu.parallel.sharding import param_pspecs, shard_params
+
+
+def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray,
+                   loss_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Next-token cross-entropy over ``tokens`` [B, T]. ``loss_mask``
+    [B, T-1] weights target positions (1 = count)."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    b, t = inputs.shape
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    kv_dtype = params["embed"].dtype  # K/V written from activations
+    empty = KVCache(
+        k=jnp.zeros((cfg.num_layers, b, t, cfg.num_kv_heads, cfg.head_dim),
+                    kv_dtype),
+        v=jnp.zeros((cfg.num_layers, b, t, cfg.num_kv_heads, cfg.head_dim),
+                    kv_dtype))
+    logits, _ = forward(params, cfg, inputs, positions, empty,
+                        jnp.zeros((b,), jnp.int32))
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if loss_mask is None:
+        return losses.mean()
+    return (losses * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)
+
+
+def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
+                    mesh: Mesh) -> Callable:
+    """Build the jitted sharded train step:
+    ``(params, opt_state, tokens) -> (params, opt_state, loss)``.
+
+    Call with params already sharded (see `init_sharded_training`); the
+    donated params/opt_state keep their layouts across steps, so weights
+    never leave the mesh between updates.
+    """
+    batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        loss, grads = jax.value_and_grad(causal_lm_loss)(params, cfg, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_sharded_training(cfg: ModelConfig, params: Any, mesh: Mesh,
+                          learning_rate: float = 1e-4,
+                          ) -> tuple[Any, Any, optax.GradientTransformation]:
+    """Shard params onto the mesh and build matching optimizer state."""
+    params = shard_params(params, mesh)
+    optimizer = optax.adamw(learning_rate)
+    opt_state = optimizer.init(params)  # zeros_like → inherits shardings
+    return params, opt_state, optimizer
+
+
+def eval_step(cfg: ModelConfig, mesh: Mesh) -> Callable:
+    """Jitted sharded eval loss: ``(params, tokens) -> loss``."""
+    batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+
+    @jax.jit
+    def step(params, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        return causal_lm_loss(params, cfg, tokens)
+
+    return step
+
+
+__all__ = ["causal_lm_loss", "make_train_step", "init_sharded_training",
+           "eval_step", "param_pspecs"]
