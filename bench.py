@@ -1,0 +1,130 @@
+"""Benchmark: streamed output tokens/sec on the in-tree TPU engine.
+
+Measures the BASELINE north-star metric — output tok/s and p50 TTFT for
+Llama-3.2-1B with 16 concurrent streaming sessions — at the engine's
+async-generator seam (the same seam the WebSocket server consumes, so
+per-token asyncio delivery overhead is included; only the socket write
+itself is excluded).
+
+Weights are random-init (no checkpoint in the image): compute cost is
+identical to real weights, which is what throughput measures.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+vs_baseline compares against the reference's published ~150 tok/s for
+llama3.2:1b on an RTX 3090 (reference: README.md:474, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+import os
+
+BASELINE_TOKS = 150.0  # reference llama3.2:1b on RTX 3090 (README.md:474)
+# Env overrides are for smoke-testing on CPU; the driver runs defaults.
+MODEL = os.environ.get("BENCH_MODEL", "llama3.2:1b")
+NUM_SESSIONS = int(os.environ.get("BENCH_SESSIONS", "16"))
+MAX_TOKENS = int(os.environ.get("BENCH_MAX_TOKENS", "128"))
+PROMPT = ("You are a concise assistant for a realtime voice app. "
+          "Explain, in plain language, how a systolic array multiplies "
+          "matrices and why that favours large batched matmuls.")
+
+
+async def run_session(engine, i: int, max_tokens: int) -> dict:
+    from fasttalk_tpu.engine.engine import GenerationParams
+
+    t0 = time.monotonic()
+    ttft = None
+    tokens = 0
+    params = GenerationParams(temperature=0.7, top_k=40, top_p=0.9,
+                              max_tokens=max_tokens)
+    messages = [{"role": "user", "content": f"[session {i}] {PROMPT}"}]
+    async for event in engine.generate(f"bench-req-{i}", f"bench-sess-{i}",
+                                       messages, params):
+        if event["type"] == "token":
+            if ttft is None:
+                ttft = (time.monotonic() - t0) * 1000.0
+        elif event["type"] == "done":
+            tokens = event["stats"]["tokens_generated"]
+        elif event["type"] == "error":
+            raise RuntimeError(f"generation failed: {event}")
+    return {"tokens": tokens, "ttft_ms": ttft or 0.0,
+            "wall_s": time.monotonic() - t0}
+
+
+async def bench(engine) -> dict:
+    # Warmup: trigger prefill + decode compiles for the buckets we'll hit.
+    log("warmup (compiling prefill + decode buckets)...")
+    t0 = time.monotonic()
+    await run_session(engine, 999, max_tokens=8)
+    engine.release_session("bench-sess-999")
+    log(f"warmup done in {time.monotonic() - t0:.1f}s")
+
+    log("single-session run...")
+    single = await run_session(engine, 0, MAX_TOKENS)
+    engine.release_session("bench-sess-0")
+    single_tps = single["tokens"] / single["wall_s"]
+    log(f"  1 session: {single['tokens']} tok in {single['wall_s']:.2f}s "
+        f"= {single_tps:.1f} tok/s, TTFT {single['ttft_ms']:.0f}ms")
+
+    log(f"{NUM_SESSIONS} concurrent sessions...")
+    t0 = time.monotonic()
+    results = await asyncio.gather(
+        *(run_session(engine, i, MAX_TOKENS) for i in range(NUM_SESSIONS)))
+    wall = time.monotonic() - t0
+    for i in range(NUM_SESSIONS):
+        engine.release_session(f"bench-sess-{i}")
+    total_tokens = sum(r["tokens"] for r in results)
+    agg_tps = total_tokens / wall
+    p50_ttft = statistics.median(r["ttft_ms"] for r in results)
+    log(f"  {NUM_SESSIONS} sessions: {total_tokens} tok in {wall:.2f}s "
+        f"= {agg_tps:.1f} tok/s aggregate, p50 TTFT {p50_ttft:.0f}ms")
+
+    return {"single_tps": single_tps, "single_ttft_ms": single["ttft_ms"],
+            "agg_tps": agg_tps, "p50_ttft_ms": p50_ttft}
+
+
+def main() -> None:
+    import jax
+
+    log(f"jax devices: {jax.devices()}")
+
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.utils.config import Config
+
+    cfg = Config(llm_provider="tpu", model_name=MODEL,
+                 decode_slots=NUM_SESSIONS, max_model_len=2048,
+                 default_context_window=2048, prefill_chunk=512,
+                 dtype="bfloat16")
+    t0 = time.monotonic()
+    engine = build_engine(cfg)
+    engine.start()
+    log(f"engine up in {time.monotonic() - t0:.1f}s")
+    try:
+        r = asyncio.run(bench(engine))
+    finally:
+        engine.shutdown()
+
+    print(json.dumps({
+        "metric": (f"WebSocket output tok/s, {MODEL}, "
+                   f"{NUM_SESSIONS} concurrent sessions (p50 TTFT "
+                   f"{r['p50_ttft_ms']:.0f}ms; 1-session "
+                   f"{r['single_tps']:.1f} tok/s)"),
+        "value": round(r["agg_tps"], 1),
+        "unit": "tok/s",
+        "vs_baseline": round(r["agg_tps"] / BASELINE_TOKS, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -53,7 +53,7 @@ def build_engine(cfg: Config) -> EngineBase:
         mesh = make_mesh(dp=cfg.dp_size, tp=cfg.tp_size)
         # Weights go straight into their TP shards as they stream off
         # disk — a 70B checkpoint must never materialise on one chip.
-        put = param_put(mesh)
+        put = param_put(mesh, dtype)
     params, loaded = load_or_init(model_cfg, cfg.model_path, dtype, put=put)
     tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
                                cfg.tokenizer_path)

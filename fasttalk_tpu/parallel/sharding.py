@@ -55,21 +55,23 @@ def _leaf_name(path) -> str:
     return ""
 
 
+def _spec_for(name: str, ndim: int, shape=None) -> P:
+    """The PartitionSpec for a parameter leaf name (unknown: replicate)."""
+    spec = _TOP_RULES.get(name) or _LAYER_RULES.get(name)
+    if spec is None:
+        return P(*([None] * ndim))
+    if len(spec) != ndim:
+        raise ValueError(
+            f"spec {spec} rank mismatch for {name} with shape {shape}")
+    return spec
+
+
 def param_pspecs(params: Any) -> Any:
     """PartitionSpec pytree matching ``params`` (models/llama.py
     init_params / models/loader.py structure)."""
-
-    def rule(path, leaf):
-        name = _leaf_name(path)
-        spec = _TOP_RULES.get(name) or _LAYER_RULES.get(name)
-        if spec is None:
-            spec = P(*([None] * leaf.ndim))  # unknown leaves: replicate
-        if len(spec) != leaf.ndim:
-            raise ValueError(
-                f"spec {spec} rank mismatch for {name} with shape {leaf.shape}")
-        return spec
-
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _spec_for(_leaf_name(path), leaf.ndim, leaf.shape),
+        params)
 
 
 def cache_pspecs() -> KVCache:
@@ -123,19 +125,19 @@ def validate_mesh(mesh: Mesh, *, num_kv_heads: int, num_heads: int,
         raise ValueError(f"sp={sp} does not divide max_model_len={max_len}")
 
 
-def param_put(mesh: Mesh):
+def param_put(mesh: Mesh, dtype: Any = None):
     """A ``put(host_array, path) -> jax.Array`` hook for
     ``models.loader.load_params`` that places each weight directly into
     its TP shards — each device receives only its slice, so a 70B
     checkpoint loads onto a v5e-8 without ever materialising a full
-    tensor on one chip."""
+    tensor on one chip. ``dtype`` casts on placement (checkpoint tensors
+    arrive host-side as float32; the engine serves bfloat16)."""
     import jax.numpy as jnp
 
     def put(arr, path: str) -> jax.Array:
         name = path.split("/")[-1]
-        spec = _TOP_RULES.get(name) or _LAYER_RULES.get(name)
-        if spec is None:
-            spec = P(*([None] * arr.ndim))
-        return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+        spec = _spec_for(name, arr.ndim, getattr(arr, "shape", None))
+        return jax.device_put(jnp.asarray(arr, dtype),
+                              NamedSharding(mesh, spec))
 
     return put

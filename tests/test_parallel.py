@@ -230,3 +230,18 @@ def test_param_put_loads_directly_sharded():
     # Each device holds only its slice of the column-parallel weight.
     shard = wq.addressable_shards[0]
     assert shard.data.shape[-1] == wq.shape[-1] // 2
+
+
+def test_param_put_casts_to_engine_dtype():
+    """Checkpoint tensors arrive host-side as f32; the put hook must land
+    them on-device in the engine dtype (else TP serving doubles weight
+    HBM and diverges from the single-device bf16 path)."""
+    import numpy as np
+
+    from fasttalk_tpu.parallel.sharding import param_put
+
+    mesh = make_mesh(tp=2)
+    put = param_put(mesh, jnp.bfloat16)
+    out = put(np.ones((4, 8), np.float32), "embed")
+    assert out.dtype == jnp.bfloat16
+    assert out.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
