@@ -127,19 +127,28 @@ class TPUEngine(EngineBase):
                  tokenizer: Tokenizer, *, num_slots: int = 16,
                  max_len: int = 8192, prefill_chunk: int = 512,
                  dtype: Any = jnp.bfloat16, seed: int = 0,
-                 context_window: int | None = None, mesh: Any = None):
+                 context_window: int | None = None, mesh: Any = None,
+                 use_pallas_attention: bool = False):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
         self.num_slots = num_slots
-        self.max_len = max_len
+        # Cache length rounds up to the bucket granule: the flash prefill
+        # (block 512) and the Pallas decode kernel (block 128) both need
+        # a divisible key axis, and an off-granule TPU_MAX_MODEL_LEN like
+        # 1000 is a legal config. The request-visible limit stays at the
+        # configured length via usable_len.
+        self.max_len = -(-max_len // _KV_BUCKETS[0]) * _KV_BUCKETS[0]
         self.usable_len = min(max_len, context_window or max_len)
         self.prefill_chunk = min(prefill_chunk, max(_PREFILL_BUCKETS))
         self.dtype = dtype
         self.mesh = mesh
+        # GSPMD cannot partition a custom kernel over a mesh; the Pallas
+        # decode path is a single-device optimisation only.
+        self.use_pallas_attention = use_pallas_attention and mesh is None
 
         if mesh is None:
-            self.cache = init_cache(model_cfg, num_slots, max_len, dtype)
+            self.cache = init_cache(model_cfg, num_slots, self.max_len, dtype)
         else:
             # Tensor-parallel serving: weights and KV sharded over ICI;
             # GSPMD turns the row-parallel matmuls into all-reduces.
@@ -158,12 +167,12 @@ class TPUEngine(EngineBase):
                           hidden=model_cfg.hidden_size,
                           intermediate=model_cfg.intermediate_size,
                           vocab=model_cfg.vocab_size,
-                          num_slots=num_slots, max_len=max_len)
+                          num_slots=num_slots, max_len=self.max_len)
             self.params = shard_params(params, mesh)
             self.cache = init_cache(
-                model_cfg, num_slots, max_len, dtype,
+                model_cfg, num_slots, self.max_len, dtype,
                 device=NamedSharding(mesh, cache_pspecs().k))
-        self.slots = SlotManager(num_slots, max_len)
+        self.slots = SlotManager(num_slots, self.max_len)
         self._cur_tokens = jnp.zeros((num_slots,), jnp.int32)
         self._positions = np.zeros((num_slots,), np.int32)
         self._active_mask = np.zeros((num_slots,), bool)
@@ -304,9 +313,12 @@ class TPUEngine(EngineBase):
                         active, temps, topks, topps, rng):
             ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
             cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
+            # The Pallas kernel needs a 128-divisible bucket; the final
+            # fallback bucket (= max_len) may not be — use XLA there.
             logits, small = forward(
                 params, self.cfg, cur_tokens[:, None], positions[:, None],
-                KVCache(ck, cv), positions, write_mask=active)
+                KVCache(ck, cv), positions, write_mask=active,
+                pallas_decode=self.use_pallas_attention and kv_len % 128 == 0)
             nxt = sample_tokens(logits[:, -1], rng, temps, topks, topps)
             new_k = jax.lax.dynamic_update_slice_in_dim(
                 cache.k, small.k, 0, axis=2)

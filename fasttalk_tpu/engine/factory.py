@@ -69,5 +69,5 @@ def build_engine(cfg: Config) -> EngineBase:
         num_slots=cfg.decode_slots, max_len=cfg.max_model_len,
         prefill_chunk=cfg.prefill_chunk, dtype=dtype,
         context_window=min(cfg.default_context_window, cfg.max_model_len),
-        mesh=mesh)
+        mesh=mesh, use_pallas_attention=cfg.use_pallas_attention)
     return engine

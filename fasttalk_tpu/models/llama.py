@@ -117,6 +117,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, cache: KVCache, write_start: jnp.ndarray,
             *, blockwise: bool = False,
             write_mask: jnp.ndarray | None = None,
+            pallas_decode: bool = False,
             ) -> tuple[jnp.ndarray, KVCache]:
     """Run the transformer over ``tokens`` [B, T], updating the cache.
 
@@ -125,7 +126,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     K/V are written per row. write_mask [B] (optional): rows with False
     leave the cache untouched. Works for prefill (T=chunk) and decode
     (T=1) alike; ``blockwise`` picks the flash-style attention for long
-    chunks.
+    chunks, ``pallas_decode`` the length-pruning Pallas kernel for T=1
+    (single-device only — see ops/pallas_attention.py).
 
     Returns (logits [B, T, vocab], updated cache).
     """
@@ -144,8 +146,13 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         k = apply_rope(k, positions, inv_freq)
         ck = _write_kv(ck, k, write_start, write_mask)
         cv = _write_kv(cv, v, write_start, write_mask)
-        attn_fn = attend_blockwise if blockwise else attend
-        o = attn_fn(q, ck, cv, positions)
+        if pallas_decode and t == 1:
+            from fasttalk_tpu.ops.pallas_attention import decode_attend
+
+            o = decode_attend(q[:, 0], ck, cv, positions[:, 0] + 1)[:, None]
+        else:
+            attn_fn = attend_blockwise if blockwise else attend
+            o = attn_fn(q, ck, cv, positions)
         x = x + o.reshape(b, t, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))

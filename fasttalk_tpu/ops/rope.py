@@ -6,14 +6,17 @@ values in bf16 loses precision at long context).
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import jax.numpy as jnp
 import numpy as np
 
-from fasttalk_tpu.models.configs import RopeScaling
+if TYPE_CHECKING:  # import at runtime would cycle: ops → models → ops
+    from fasttalk_tpu.models.configs import RopeScaling
 
 
 def rope_frequencies(head_dim: int, theta: float,
-                     scaling: RopeScaling | None) -> np.ndarray:
+                     scaling: "RopeScaling | None") -> np.ndarray:
     """Per-pair inverse frequencies [head_dim/2], float32, host-computed."""
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
     if scaling is not None:
