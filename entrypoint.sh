@@ -1,0 +1,17 @@
+#!/bin/bash
+# Container entrypoint (reference: entrypoint.sh — permissions + config
+# echo + exec the service). Single process: engine is in-tree.
+set -e
+
+mkdir -p "${LOG_PATH:-/app/logs}" "${MODEL_PATH:-/app/models}" 2>/dev/null || true
+
+echo "=== FastTalk-TPU ==="
+echo "provider:   ${LLM_PROVIDER:-tpu}"
+echo "model:      ${LLM_MODEL:-llama3.2:1b}"
+echo "device:     ${COMPUTE_DEVICE:-tpu}"
+echo "port:       ${LLM_PORT:-8000} (monitoring: ${LLM_MONITORING_PORT:-9092})"
+echo "tp x dp:    ${TPU_TP_SIZE:-1} x ${TPU_DP_SIZE:-1}"
+echo "slots/ctx:  ${TPU_DECODE_SLOTS:-16} slots, ${TPU_MAX_MODEL_LEN:-8192} tokens"
+echo "===================="
+
+exec python main.py websocket "$@"

@@ -202,6 +202,9 @@ class TPUEngine(EngineBase):
         self._m_step = m.histogram(
             "engine_decode_step_ms", "decode step wall time",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000))
+        self._m_prefill = m.histogram(
+            "engine_prefill_ms", "prefill wall time per request",
+            buckets=(4, 16, 64, 256, 1000, 4000, 16000, 60000))
         self._m_active = m.gauge("engine_active_slots", "slots decoding")
         self._m_queue = m.gauge("engine_queue_depth", "requests waiting")
         self._m_prefix = m.counter("engine_prefix_tokens_reused_total",
@@ -313,8 +316,9 @@ class TPUEngine(EngineBase):
                         active, temps, topks, topps, rng):
             ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
             cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
-            # The Pallas kernel needs a 128-divisible bucket; the final
-            # fallback bucket (= max_len) may not be — use XLA there.
+            # kv_len is always 128-divisible (max_len rounds up to the
+            # 512 bucket granule at __init__); the check is a defensive
+            # fallback to XLA attention should that invariant ever break.
             logits, small = forward(
                 params, self.cfg, cur_tokens[:, None], positions[:, None],
                 KVCache(ck, cv), positions, write_mask=active,
@@ -451,6 +455,7 @@ class TPUEngine(EngineBase):
                 self._finish(req, "error", error=str(e))
 
     def _prefill(self, req: _Request, slot: Slot) -> None:
+        t0 = time.monotonic()
         prompt = req.prompt_tokens
         reused = self.slots.reuse_prefix(slot, prompt)
         if reused:
@@ -488,6 +493,7 @@ class TPUEngine(EngineBase):
             start += take
             todo = todo[take:]
 
+        self._m_prefill.observe((time.monotonic() - t0) * 1000)
         first = sample_tokens(
             last_logits[None, :], self._next_rng(),
             jnp.full((1,), req.params.temperature, jnp.float32),

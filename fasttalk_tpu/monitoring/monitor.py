@@ -9,15 +9,45 @@ registry — fixing the reference gap where the sidecar's counters were
 never wired and /metrics always reported zeros (SURVEY.md §5).
 
 /metrics serves Prometheus text; /metrics.json serves the JSON form.
+
+Tracing (SURVEY.md §5 "TPU equivalent: jax.profiler trace endpoint"):
+POST /profiler/start {"log_dir": ...} and POST /profiler/stop capture an
+XLA device trace viewable in TensorBoard/Perfetto; GET /profiler/memory
+reports live per-device HBM stats. The reference had no profiler at all
+— only wall-clock log lines (logger.py:208-224, never called).
 """
 
 from __future__ import annotations
+
+import time
 
 import psutil
 from aiohttp import web
 
 from fasttalk_tpu import __version__
 from fasttalk_tpu.utils.metrics import get_metrics
+
+_profiler_state = {"active": False, "log_dir": None, "started_at": None}
+
+
+def _device_memory() -> list[dict]:
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        out.append({
+            "device": str(d),
+            "platform": d.platform,
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        })
+    return out
 
 
 def build_monitoring_app(ready_check=None) -> web.Application:
@@ -68,10 +98,61 @@ def build_monitoring_app(ready_check=None) -> web.Application:
             "uptime_seconds": get_metrics().uptime(),
         })
 
+    async def profiler_start(request: web.Request) -> web.Response:
+        import jax
+
+        if _profiler_state["active"]:
+            return web.json_response(
+                {"error": "trace already active",
+                 "log_dir": _profiler_state["log_dir"]}, status=409)
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                pass
+        log_dir = body.get("log_dir", "/tmp/fasttalk-tpu-trace")
+        try:
+            # Off the event loop: profiler setup does filesystem work and
+            # this loop is also serving every WebSocket token stream.
+            import asyncio
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.start_trace, log_dir)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        _profiler_state.update(active=True, log_dir=log_dir,
+                               started_at=time.monotonic())
+        return web.json_response({"status": "tracing", "log_dir": log_dir})
+
+    async def profiler_stop(request: web.Request) -> web.Response:
+        import jax
+
+        if not _profiler_state["active"]:
+            return web.json_response({"error": "no active trace"}, status=409)
+        try:
+            # stop_trace serializes the whole trace to disk — keep that
+            # multi-second write off the serving event loop.
+            import asyncio
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.stop_trace)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        duration = time.monotonic() - (_profiler_state["started_at"] or 0)
+        log_dir = _profiler_state["log_dir"]
+        _profiler_state.update(active=False, log_dir=None, started_at=None)
+        return web.json_response({"status": "stopped", "log_dir": log_dir,
+                                  "duration_seconds": duration})
+
+    async def profiler_memory(request: web.Request) -> web.Response:
+        return web.json_response({"devices": _device_memory()})
+
     app.router.add_get("/health", health)
     app.router.add_get("/health/ready", ready)
     app.router.add_get("/health/live", live)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/metrics.json", metrics_json)
     app.router.add_get("/info", info)
+    app.router.add_post("/profiler/start", profiler_start)
+    app.router.add_post("/profiler/stop", profiler_stop)
+    app.router.add_get("/profiler/memory", profiler_memory)
     return app

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Run FastTalk-TPU on a CPU-only host (development / CI).
+# The same in-tree engine runs on the JAX CPU backend; useful with
+# LLM_MODEL=test-tiny for protocol work without TPU hardware.
+set -e
+
+cd "$(dirname "$0")"
+
+if [ ! -d ".venv" ]; then
+    python3 -m venv .venv
+fi
+# shellcheck disable=SC1091
+source .venv/bin/activate
+
+if ! python -c "import jax" 2>/dev/null; then
+    pip install --quiet --upgrade pip
+    pip install --quiet -e .
+fi
+
+# Thread pinning for CPU inference (reference: run-cpu.sh:49-52).
+export OMP_NUM_THREADS="${OMP_NUM_THREADS:-$(nproc)}"
+export JAX_PLATFORMS=cpu
+export COMPUTE_DEVICE=cpu
+export LLM_PROVIDER="${LLM_PROVIDER:-tpu}"
+export LLM_MODEL="${LLM_MODEL:-test-tiny}"
+export TPU_DTYPE="${TPU_DTYPE:-float32}"
+export TPU_DECODE_SLOTS="${TPU_DECODE_SLOTS:-4}"
+export TPU_MAX_MODEL_LEN="${TPU_MAX_MODEL_LEN:-2048}"
+
+exec python main.py websocket "$@"

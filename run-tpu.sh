@@ -1,0 +1,42 @@
+#!/bin/bash
+# Run FastTalk-TPU directly on a Cloud TPU VM (no Docker).
+# Parity with the reference run-{gpu,cpu,apple}.sh scripts: venv
+# bootstrap + device env + `python main.py websocket`.
+set -e
+
+cd "$(dirname "$0")"
+
+echo "FastTalk-TPU launcher"
+
+# venv bootstrap
+if [ ! -d ".venv" ]; then
+    echo "Creating virtual environment..."
+    python3 -m venv .venv
+fi
+# shellcheck disable=SC1091
+source .venv/bin/activate
+
+if ! python -c "import jax" 2>/dev/null; then
+    echo "Installing dependencies (jax[tpu] + pyproject deps)..."
+    pip install --quiet --upgrade pip
+    pip install --quiet "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+    pip install --quiet -e .
+fi
+
+# TPU-first env
+export COMPUTE_DEVICE="${COMPUTE_DEVICE:-tpu}"
+export LLM_PROVIDER="${LLM_PROVIDER:-tpu}"
+export LLM_MODEL="${LLM_MODEL:-llama3.2:1b}"
+export TPU_DTYPE="${TPU_DTYPE:-bfloat16}"
+export TPU_DECODE_SLOTS="${TPU_DECODE_SLOTS:-16}"
+
+# Quick device sanity (mirrors the reference scripts' device detection,
+# reference: run-apple.sh:17-25).
+python - <<'EOF'
+import jax
+devs = jax.devices()
+print(f"JAX backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind if hasattr(devs[0], 'device_kind') else '?'})")
+EOF
+
+exec python main.py websocket "$@"

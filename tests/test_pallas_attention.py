@@ -66,9 +66,10 @@ def test_rejects_unaligned_bucket():
 
 
 def test_engine_pallas_unaligned_fallback_bucket():
-    """max_len not divisible by 128: once decode crosses the last
-    power-of-two bucket the engine falls back to kv_len=max_len, which
-    must route to the XLA path instead of crashing the engine thread."""
+    """Off-granule max_len (600): the engine rounds the cache up to the
+    512-granule (1024) so every kv bucket — including the fallback
+    kv_len=max_len — stays 128-divisible and the Pallas decode path
+    keeps working past the last power-of-two bucket."""
     from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
     from fasttalk_tpu.engine.tokenizer import ByteTokenizer
     from fasttalk_tpu.models import get_model_config, init_params

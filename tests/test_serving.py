@@ -394,6 +394,36 @@ class TestMonitoringApp:
         finally:
             await client.close()
 
+    async def test_profiler_endpoints(self, tmp_path):
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+        app = build_monitoring_app(ready_check=lambda: True)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/profiler/memory")
+            assert r.status == 200
+            assert "devices" in await r.json()
+
+            r = await client.post("/profiler/stop")
+            assert r.status == 409  # nothing active
+
+            r = await client.post("/profiler/start",
+                                  json={"log_dir": str(tmp_path)})
+            assert r.status == 200
+            r = await client.post("/profiler/start",
+                                  json={"log_dir": str(tmp_path)})
+            assert r.status == 409  # already tracing
+
+            r = await client.post("/profiler/stop")
+            assert r.status == 200
+            body = await r.json()
+            assert body["log_dir"] == str(tmp_path)
+            # jax.profiler writes a plugins/profile dump under log_dir.
+            assert list(tmp_path.rglob("*")), "trace wrote nothing"
+        finally:
+            await client.close()
+
     async def test_ready_reflects_engine(self):
         from fasttalk_tpu.monitoring.monitor import build_monitoring_app
 
