@@ -22,6 +22,17 @@ Architecture (JetStream-style, XLA-first):
   generation is therefore fully async on the serving side — the
   event-loop-stalling sync-generator bug of the reference
   (websocket_server_vllm.py:578, SURVEY.md §3.3 warning) cannot occur.
+- **Device-resident decode state, multi-token calls, pipelined dispatch.**
+  Positions, active mask, per-slot sampling params, the current token and
+  the PRNG key all live on the device and are chained call-to-call; one
+  jitted call runs ``steps_per_call`` decode steps under ``lax.scan`` and
+  returns all sampled tokens, and up to ``pipeline_depth`` calls stay in
+  flight so the host-side fetch/detokenise of call N overlaps the device
+  compute of call N+1. Host mirrors are reconciled (and re-uploaded) only
+  when the slot set changes — request admission, completion, cancel. A
+  slot that finishes mid-call keeps decoding garbage until the pipeline
+  drains; those tokens are dropped on the host and their (masked or
+  past-the-kept-length) KV writes are never attended to.
 - **Mid-decode cancellation.** Cancel is a command; the engine deactivates
   the slot at the next step boundary, freeing capacity immediately
   (reference flaw: cancel could not even be received until generation
@@ -36,6 +47,7 @@ import asyncio
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, AsyncGenerator
@@ -128,7 +140,8 @@ class TPUEngine(EngineBase):
                  max_len: int = 8192, prefill_chunk: int = 512,
                  dtype: Any = jnp.bfloat16, seed: int = 0,
                  context_window: int | None = None, mesh: Any = None,
-                 use_pallas_attention: bool = False):
+                 use_pallas_attention: bool = False,
+                 steps_per_call: int = 8, pipeline_depth: int = 2):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -173,13 +186,29 @@ class TPUEngine(EngineBase):
                 model_cfg, num_slots, self.max_len, dtype,
                 device=NamedSharding(mesh, cache_pspecs().k))
         self.slots = SlotManager(num_slots, self.max_len)
-        self._cur_tokens = jnp.zeros((num_slots,), jnp.int32)
+        self.steps_per_call = max(1, steps_per_call)
+        self.pipeline_depth = max(1, pipeline_depth)
+        # Host mirrors of the per-slot decode state. The authoritative
+        # copies live on the device and chain through decode calls; the
+        # mirrors are pushed with _upload_slot_state whenever the slot
+        # set changes (_dirty).
         self._positions = np.zeros((num_slots,), np.int32)
         self._active_mask = np.zeros((num_slots,), bool)
         self._temps = np.zeros((num_slots,), np.float32)
         self._topks = np.zeros((num_slots,), np.int32)
         self._topps = np.ones((num_slots,), np.float32)
-        self._base_key = jax.random.PRNGKey(seed)
+        self._cur_tokens = self._put(np.zeros((num_slots,), np.int32))
+        self._positions_dev = self._put(self._positions)
+        self._active_dev = self._put(self._active_mask)
+        self._temps_dev = self._put(self._temps)
+        self._topks_dev = self._put(self._topks)
+        self._topps_dev = self._put(self._topps)
+        self._rng_dev = self._put(jax.random.PRNGKey(seed))
+        self._dirty = False
+        # In-flight decode calls: (tokens_device_array [K, S], slot ids
+        # that were running at dispatch time).
+        self._inflight: deque[tuple[Any, list[int]]] = deque()
+        self._base_key = jax.random.PRNGKey(seed + 1)
         self._step = 0
 
         self._commands: queue.Queue = queue.Queue()
@@ -200,8 +229,10 @@ class TPUEngine(EngineBase):
                                      "generation requests accepted")
         self._m_ttft = m.histogram("engine_ttft_ms", "time to first token")
         self._m_step = m.histogram(
-            "engine_decode_step_ms", "decode step wall time",
-            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000))
+            "engine_decode_wait_ms",
+            "host blocking wait per retired K-step decode call "
+            "(near zero when retirement overlaps the next call)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000, 4000))
         self._m_prefill = m.histogram(
             "engine_prefill_ms", "prefill wall time per request",
             buckets=(4, 16, 64, 256, 1000, 4000, 16000, 60000))
@@ -306,32 +337,62 @@ class TPUEngine(EngineBase):
 
     # ---------------- jitted steps ----------------
 
+    def _put(self, arr):
+        """Host array (or PRNG key) → device, replicated over the mesh
+        when present."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec()))
+
     def _get_decode_fn(self, kv_len: int):
+        """K decode steps in one jitted call (K = steps_per_call).
+
+        The whole per-slot decode state is threaded through the call so
+        nothing round-trips to the host between steps: carry = (sliced
+        K/V, current token, positions, rng). Returns all K sampled
+        tokens; the host consumes them at retirement (SURVEY.md §7 hard
+        part #3 — the naive per-step blocking get this replaces
+        serialised device and host work).
+        """
         fn = self._decode_fns.get(kv_len)
         if fn is not None:
             return fn
+        use_pallas = self.use_pallas_attention and kv_len % 128 == 0
 
         @partial(jax.jit, donate_argnums=(1,))
-        def decode_step(params, cache: KVCache, cur_tokens, positions,
+        def decode_call(params, cache: KVCache, cur_tokens, positions,
                         active, temps, topks, topps, rng):
             ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
             cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
-            # kv_len is always 128-divisible (max_len rounds up to the
-            # 512 bucket granule at __init__); the check is a defensive
-            # fallback to XLA attention should that invariant ever break.
-            logits, small = forward(
-                params, self.cfg, cur_tokens[:, None], positions[:, None],
-                KVCache(ck, cv), positions, write_mask=active,
-                pallas_decode=self.use_pallas_attention and kv_len % 128 == 0)
-            nxt = sample_tokens(logits[:, -1], rng, temps, topks, topps)
-            new_k = jax.lax.dynamic_update_slice_in_dim(
-                cache.k, small.k, 0, axis=2)
-            new_v = jax.lax.dynamic_update_slice_in_dim(
-                cache.v, small.v, 0, axis=2)
-            return KVCache(new_k, new_v), nxt
 
-        self._decode_fns[kv_len] = decode_step
-        return decode_step
+            def step(carry, _):
+                sk, sv, cur, pos, key = carry
+                key, sub = jax.random.split(key)
+                # A slot that finished mid-pipeline keeps "decoding" until
+                # the host reconciles; clamp it off the cache edge so its
+                # garbage writes can never clobber live rows.
+                act = jnp.logical_and(active, pos < kv_len)
+                logits, small = forward(
+                    params, self.cfg, cur[:, None], pos[:, None],
+                    KVCache(sk, sv), pos, write_mask=act,
+                    pallas_decode=use_pallas)
+                nxt = sample_tokens(logits[:, -1], sub, temps, topks, topps)
+                pos = pos + act.astype(pos.dtype)
+                return (small.k, small.v, nxt, pos, key), nxt
+
+            (ck, cv, cur, pos, rng), toks = jax.lax.scan(
+                step, (ck, cv, cur_tokens, positions, rng), None,
+                length=self.steps_per_call)
+            new_k = jax.lax.dynamic_update_slice_in_dim(
+                cache.k, ck, 0, axis=2)
+            new_v = jax.lax.dynamic_update_slice_in_dim(
+                cache.v, cv, 0, axis=2)
+            return KVCache(new_k, new_v), toks, cur, pos, rng
+
+        self._decode_fns[kv_len] = decode_call
+        return decode_call
 
     def _get_prefill_fn(self, chunk: int):
         fn = self._prefill_fns.get(chunk)
@@ -373,11 +434,24 @@ class TPUEngine(EngineBase):
                  max_len=self.max_len)
         try:
             while True:
-                if not self._drain_commands(block=not self._running):
+                idle = not self._running and not self._inflight
+                if not self._drain_commands(block=idle):
                     break
-                self._admit()
+                if self._can_admit():
+                    # Never prefill into a slot an in-flight call may
+                    # still write to: drain the pipeline first.
+                    self._flush_pipeline()
+                    self._admit()
                 if self._running:
-                    self._decode_once()
+                    if self._dirty:
+                        self._flush_pipeline()
+                        self._upload_slot_state()
+                    if self._running:
+                        self._dispatch_decode()
+                        if len(self._inflight) >= self.pipeline_depth:
+                            self._retire_oldest()
+                elif self._inflight:
+                    self._flush_pipeline()
                 self._m_active.set(len(self._running))
                 self._m_queue.set(len(self._waiting))
         except Exception as e:  # engine thread must not die silently
@@ -400,6 +474,7 @@ class TPUEngine(EngineBase):
         self._by_id.clear()
         self._waiting.clear()
         self._running.clear()
+        self._inflight.clear()
 
     def _drain_commands(self, block: bool) -> bool:
         """Process queued commands. Returns False on stop."""
@@ -429,6 +504,16 @@ class TPUEngine(EngineBase):
                     self._release_after.add(arg)
                 else:
                     self.slots.release_session(arg)
+
+    def _can_admit(self) -> bool:
+        """True iff _admit would actually place at least one request —
+        the pipeline is only worth draining when it would."""
+        if not self._waiting:
+            return False
+        if not any(not s.active for s in self.slots.slots):
+            return False
+        return any((slot := self.slots.lookup(r.session_id)) is None
+                   or not slot.active for r in self._waiting)
 
     def _admit(self) -> None:
         """Move waiting requests into free slots (chunked prefill).
@@ -491,6 +576,7 @@ class TPUEngine(EngineBase):
                 jnp.int32(take - 1))
             slot.tokens.extend(chunk)
             start += take
+            slot.kv_written = start
             todo = todo[take:]
 
         self._m_prefill.observe((time.monotonic() - t0) * 1000)
@@ -511,30 +597,53 @@ class TPUEngine(EngineBase):
         self._temps[s] = req.params.temperature
         self._topks[s] = req.params.top_k
         self._topps[s] = req.params.top_p
+        self._dirty = True
         self._consume_token(req, first_id)
 
-    def _decode_once(self) -> None:
-        t0 = time.monotonic()
-        active = [s for s in self._running]
-        max_pos = int(self._positions[active].max())
-        kv_len = next((b for b in _KV_BUCKETS
-                       if b > max_pos and b <= self.max_len), self.max_len)
-        fn = self._get_decode_fn(kv_len)
-        self.cache, nxt = fn(self.params, self.cache, self._cur_tokens,
-                             jnp.asarray(self._positions),
-                             jnp.asarray(self._active_mask),
-                             jnp.asarray(self._temps),
-                             jnp.asarray(self._topks),
-                             jnp.asarray(self._topps), self._next_rng())
-        tokens = np.asarray(nxt)  # sync point
-        self._m_step.observe((time.monotonic() - t0) * 1000)
+    def _upload_slot_state(self) -> None:
+        """Push host mirrors to the device after a slot-set change."""
+        self._positions_dev = self._put(self._positions)
+        self._active_dev = self._put(self._active_mask)
+        self._temps_dev = self._put(self._temps)
+        self._topks_dev = self._put(self._topks)
+        self._topps_dev = self._put(self._topps)
+        self._dirty = False
 
-        self._cur_tokens = nxt
-        for s, req in list(self._running.items()):
-            # This step wrote the KV of the slot's current token at
-            # positions[s] and sampled the next token.
-            self._positions[s] += 1
-            self._consume_token(req, int(tokens[s]))
+    def _dispatch_decode(self) -> None:
+        """Launch one K-step decode call; does not wait for results."""
+        active = [s for s in self._running]
+        # Device positions lead the host mirrors by one K-step call per
+        # in-flight dispatch; size the KV bucket for where the device
+        # will be at the END of this call.
+        max_pos = int(self._positions[active].max()) \
+            + (len(self._inflight) + 1) * self.steps_per_call
+        kv_len = next((b for b in _KV_BUCKETS
+                       if b >= max_pos and b <= self.max_len), self.max_len)
+        fn = self._get_decode_fn(kv_len)
+        (self.cache, toks, self._cur_tokens, self._positions_dev,
+         self._rng_dev) = fn(
+            self.params, self.cache, self._cur_tokens, self._positions_dev,
+            self._active_dev, self._temps_dev, self._topks_dev,
+            self._topps_dev, self._rng_dev)
+        self._inflight.append((toks, active))
+
+    def _retire_oldest(self) -> None:
+        """Block on the oldest in-flight call and consume its tokens."""
+        toks_dev, slot_ids = self._inflight.popleft()
+        t0 = time.monotonic()
+        toks = np.asarray(toks_dev)  # [K, S] — sync point
+        self._m_step.observe((time.monotonic() - t0) * 1000)
+        for k in range(toks.shape[0]):
+            for s in slot_ids:
+                req = self._running.get(s)
+                if req is None or req.finished:
+                    continue  # finished earlier in this call; drop token
+                self._positions[s] += 1
+                self._consume_token(req, int(toks[k, s]))
+
+    def _flush_pipeline(self) -> None:
+        while self._inflight:
+            self._retire_oldest()
 
     def _consume_token(self, req: _Request, token_id: int) -> None:
         """Handle one newly sampled token for a request (host side)."""
@@ -604,6 +713,15 @@ class TPUEngine(EngineBase):
             self._running.pop(slot.index, None)
             self._active_mask[slot.index] = False
             self._temps[slot.index] = 0.0
+            # KV rows are written only up to the position reached by
+            # *feeding* tokens; a final token kept on max_tokens/stop was
+            # sampled but never fed, so its row is not trusted for reuse.
+            slot.kv_written = min(slot.length,
+                                  int(self._positions[slot.index]))
+            # Host positions mirror is authoritative again (the device
+            # copy may have speculatively advanced past the kept length).
+            self._positions[slot.index] = slot.length
+            self._dirty = True
             sid = slot.session_id
             if sid is not None and sid in self._release_after:
                 self._release_after.discard(sid)

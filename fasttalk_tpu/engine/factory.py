@@ -56,7 +56,8 @@ def build_engine(cfg: Config) -> EngineBase:
         put = param_put(mesh, dtype)
     params, loaded = load_or_init(model_cfg, cfg.model_path, dtype, put=put)
     tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
-                               cfg.tokenizer_path)
+                               cfg.tokenizer_path,
+                               template=model_cfg.chat_template)
     log.info(
         f"Building TPU engine: model={model_cfg.name} "
         f"({model_cfg.param_count() / 1e9:.2f}B params, "
@@ -69,5 +70,7 @@ def build_engine(cfg: Config) -> EngineBase:
         num_slots=cfg.decode_slots, max_len=cfg.max_model_len,
         prefill_chunk=cfg.prefill_chunk, dtype=dtype,
         context_window=min(cfg.default_context_window, cfg.max_model_len),
-        mesh=mesh, use_pallas_attention=cfg.use_pallas_attention)
+        mesh=mesh, use_pallas_attention=cfg.use_pallas_attention,
+        steps_per_call=cfg.decode_steps_per_call,
+        pipeline_depth=cfg.pipeline_depth)
     return engine

@@ -23,7 +23,13 @@ from dataclasses import dataclass, field
 class Slot:
     index: int
     session_id: str | None = None     # pinned session (None = free)
-    tokens: list[int] = field(default_factory=list)  # ids whose KV is cached
+    tokens: list[int] = field(default_factory=list)  # kept token ids
+    # How many leading entries of ``tokens`` have their KV actually
+    # written in HBM. A token's KV is written when it is *fed*, one step
+    # after it is sampled — so a request finishing on max_tokens keeps a
+    # final token whose KV row was never written. Prefix reuse must not
+    # trust rows beyond this watermark.
+    kv_written: int = 0
     active: bool = False              # currently decoding a request
     last_used: float = 0.0
 
@@ -62,6 +68,7 @@ class SlotManager:
     def _pin(self, slot: Slot, session_id: str) -> Slot:
         slot.session_id = session_id
         slot.tokens = []
+        slot.kv_written = 0
         slot.active = False
         slot.last_used = time.monotonic()
         self._by_session[session_id] = slot
@@ -72,6 +79,7 @@ class SlotManager:
             self._by_session.pop(slot.session_id, None)
         slot.session_id = None
         slot.tokens = []
+        slot.kv_written = 0
         slot.active = False
 
     def release_session(self, session_id: str) -> None:
@@ -88,10 +96,12 @@ class SlotManager:
         Returns the number of leading prompt tokens whose KV is already in
         the slot (0 → full prefill). Never returns the full prompt length:
         at least one token must run through the model to produce logits,
-        so reuse is capped at len(prompt) - 1.
+        so reuse is capped at len(prompt) - 1. Also capped at kv_written —
+        a kept token whose KV row was never written (request finished the
+        step it was sampled) must be re-fed, not trusted.
         """
         cached = slot.tokens
-        limit = min(len(cached), len(prompt_tokens) - 1)
+        limit = min(len(cached), len(prompt_tokens) - 1, slot.kv_written)
         n = 0
         while n < limit and cached[n] == prompt_tokens[n]:
             n += 1

@@ -60,7 +60,25 @@ class ByteTokenizer:
         return list(text.encode("utf-8"))
 
     def decode(self, ids: Sequence[int]) -> str:
-        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+        """Bytes decode to text; specials decode to nothing; ids beyond
+        this tokenizer's vocab (possible when the model's vocab is larger,
+        e.g. weight-free benchmarking of a 128k-vocab model over the byte
+        fallback) decode to a private-use-area glyph instead of vanishing,
+        so streaming still carries one visible delta per token."""
+        out: list[str] = []
+        byte_run: list[int] = []
+        for i in ids:
+            if i < 256:
+                byte_run.append(i)
+                continue
+            if byte_run:
+                out.append(bytes(byte_run).decode("utf-8", errors="replace"))
+                byte_run = []
+            if i >= self.vocab_size:
+                out.append(chr(0xE000 + i % 6400))
+        if byte_run:
+            out.append(bytes(byte_run).decode("utf-8", errors="replace"))
+        return "".join(out)
 
     def apply_chat_template(self, messages: Sequence[Message],
                             add_generation_prompt: bool = True) -> list[int]:
@@ -74,24 +92,77 @@ class ByteTokenizer:
         return out
 
 
+def render_llama3(messages: Sequence[Message],
+                  add_generation_prompt: bool = True) -> str:
+    """Llama-3 instruct template (checkpoint-defined, stable across 3.x)."""
+    def header(role: str) -> str:
+        return f"<|start_header_id|>{role}<|end_header_id|>\n\n"
+
+    text = "<|begin_of_text|>"
+    for m in messages:
+        text += header(m.get("role", "user"))
+        text += m.get("content", "") + "<|eot_id|>"
+    if add_generation_prompt:
+        text += header("assistant")
+    return text
+
+
+def render_chatml(messages: Sequence[Message],
+                  add_generation_prompt: bool = True) -> str:
+    """ChatML template (Qwen 2.x instruct)."""
+    text = ""
+    for m in messages:
+        role = m.get("role", "user")
+        text += f"<|im_start|>{role}\n{m.get('content', '')}<|im_end|>\n"
+    if add_generation_prompt:
+        text += "<|im_start|>assistant\n"
+    return text
+
+
+def render_mistral(messages: Sequence[Message],
+                   add_generation_prompt: bool = True) -> str:
+    """Mistral instruct template: [INST] turns; a system message is folded
+    into the first user turn (the format has no system role)."""
+    system = ""
+    text = "<s>"
+    for m in messages:
+        role, content = m.get("role", "user"), m.get("content", "")
+        if role == "system":
+            system = content
+            continue
+        if role == "user":
+            if system:
+                content = f"{system}\n\n{content}"
+                system = ""
+            text += f"[INST] {content} [/INST]"
+        else:  # assistant / tool result turns close with </s>
+            text += f" {content}</s>"
+    if system:
+        # System message with no following user turn (e.g. lone system
+        # prompt): still surface it rather than dropping it silently.
+        text += f"[INST] {system} [/INST]"
+    return text
+
+
+_TEMPLATES = {"llama3": render_llama3, "chatml": render_chatml,
+              "mistral": render_mistral}
+
+
 class HFTokenizer:
-    """Wraps a HuggingFace fast tokenizer (tokenizer.json) with the
-    Llama-3 instruct chat template rendered in-tree (templates are not
-    fetchable in a zero-egress deployment, and the format is fixed)."""
+    """Wraps a HuggingFace fast tokenizer (tokenizer.json) with the chat
+    template rendered in-tree (templates are not fetchable in a
+    zero-egress deployment, and the formats are fixed per family —
+    models/configs.py names which one each model uses)."""
 
-    # Llama-3 special token ids (checkpoint-defined, stable across 3.x).
-    BOS_TEXT = "<|begin_of_text|>"
-    HDR_START = "<|start_header_id|>"
-    HDR_END = "<|end_header_id|>"
-    EOT = "<|eot_id|>"
-
-    def __init__(self, tokenizer_file: str):
+    def __init__(self, tokenizer_file: str, template: str = "llama3"):
         from tokenizers import Tokenizer as RustTokenizer
 
         self._tok = RustTokenizer.from_file(tokenizer_file)
+        self._render = _TEMPLATES.get(template, render_llama3)
         self.vocab_size = self._tok.get_vocab_size()
         eos = set()
-        for name in ("<|eot_id|>", "<|end_of_text|>", "</s>", "<|eom_id|>"):
+        for name in ("<|eot_id|>", "<|end_of_text|>", "</s>", "<|eom_id|>",
+                     "<|im_end|>", "<|endoftext|>"):
             tid = self._tok.token_to_id(name)
             if tid is not None:
                 eos.add(tid)
@@ -105,17 +176,9 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
-    def _header(self, role: str) -> str:
-        return f"{self.HDR_START}{role}{self.HDR_END}\n\n"
-
     def apply_chat_template(self, messages: Sequence[Message],
                             add_generation_prompt: bool = True) -> list[int]:
-        text = self.BOS_TEXT
-        for m in messages:
-            text += self._header(m.get("role", "user"))
-            text += m.get("content", "") + self.EOT
-        if add_generation_prompt:
-            text += self._header("assistant")
+        text = self._render(messages, add_generation_prompt)
         return self._tok.encode(text, add_special_tokens=False).ids
 
 
@@ -181,10 +244,11 @@ def find_tokenizer_file(model_path: str, model_name: str) -> str | None:
 
 
 def load_tokenizer(model_path: str, model_name: str,
-                   tokenizer_path: str = "") -> Tokenizer:
+                   tokenizer_path: str = "",
+                   template: str = "llama3") -> Tokenizer:
     """HF tokenizer if files are present, else the byte fallback."""
     tf = tokenizer_path if tokenizer_path and os.path.isfile(tokenizer_path) \
         else find_tokenizer_file(model_path, model_name)
     if tf:
-        return HFTokenizer(tf)
+        return HFTokenizer(tf, template=template)
     return ByteTokenizer()

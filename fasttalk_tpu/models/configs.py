@@ -1,9 +1,12 @@
-"""Model architecture configs for the Llama family the reference serves.
+"""Model architecture configs for the families the reference serves.
 
-The reference serves these models by name through external engines
+The reference serves models by name through external engines
 (reference: README.md model tables, app/utils/config.py:86 LLM_MODEL
 defaults to "llama3.2:1b"); here the architecture lives in-tree so the
-JAX engine can build and shard the real thing.
+JAX engine can build and shard the real thing. Covered families: Llama
+3.x (the reference's benchmark models), Qwen 2.5 (QKV bias + ChatML
+template) and Mistral 7B — the popular Ollama-servable chat families
+share this GQA/SwiGLU skeleton, differing only in the flags below.
 """
 
 from __future__ import annotations
@@ -36,6 +39,8 @@ class ModelConfig:
     tie_embeddings: bool = True
     max_position: int = 131072
     rope_scaling: RopeScaling | None = None
+    qkv_bias: bool = False          # Qwen2-style attention biases
+    chat_template: str = "llama3"   # llama3 | chatml | mistral (tokenizer.py)
 
     @property
     def q_dim(self) -> int:
@@ -51,6 +56,8 @@ class ModelConfig:
             + self.q_dim * self.hidden_size
         mlp = 3 * self.hidden_size * self.intermediate_size
         norms = 2 * self.hidden_size
+        if self.qkv_bias:
+            attn += self.q_dim + 2 * self.kv_dim
         per_layer = attn + mlp + norms
         head = 0 if self.tie_embeddings else embed
         return embed + self.num_layers * per_layer + self.hidden_size + head
@@ -94,12 +101,49 @@ _register(ModelConfig(
     head_dim=128, tie_embeddings=False, max_position=8192),
     "llama3.1:70b", "meta-llama/Meta-Llama-3-70B-Instruct")
 
+# --- Qwen 2.5 family (HF Qwen/Qwen2.5-*-Instruct configs) ---
+_register(ModelConfig(
+    name="qwen2.5:0.5b", vocab_size=151936, hidden_size=896,
+    intermediate_size=4864, num_layers=24, num_heads=14, num_kv_heads=2,
+    head_dim=64, rope_theta=1000000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_position=32768, qkv_bias=True, chat_template="chatml"),
+    "Qwen/Qwen2.5-0.5B-Instruct")
+
+_register(ModelConfig(
+    name="qwen2.5:1.5b", vocab_size=151936, hidden_size=1536,
+    intermediate_size=8960, num_layers=28, num_heads=12, num_kv_heads=2,
+    head_dim=128, rope_theta=1000000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_position=32768, qkv_bias=True, chat_template="chatml"),
+    "Qwen/Qwen2.5-1.5B-Instruct")
+
+_register(ModelConfig(
+    name="qwen2.5:7b", vocab_size=152064, hidden_size=3584,
+    intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+    head_dim=128, rope_theta=1000000.0, rms_eps=1e-6, tie_embeddings=False,
+    max_position=32768, qkv_bias=True, chat_template="chatml"),
+    "Qwen/Qwen2.5-7B-Instruct")
+
+# --- Mistral 7B (HF mistralai/Mistral-7B-Instruct-v0.3 config) ---
+_register(ModelConfig(
+    name="mistral:7b", vocab_size=32768, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=1000000.0, rms_eps=1e-5, tie_embeddings=False,
+    max_position=32768, chat_template="mistral"),
+    "mistralai/Mistral-7B-Instruct-v0.3")
+
 # Tiny config for tests and CI: runs everywhere in milliseconds. Vocab is
 # sized for the byte-level fallback tokenizer (256 bytes + specials).
 _register(ModelConfig(
     name="test-tiny", vocab_size=384, hidden_size=64, intermediate_size=256,
     num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
     tie_embeddings=True, max_position=2048, rope_theta=10000.0))
+
+# Qwen-shaped tiny config: exercises the qkv_bias + ChatML path in tests.
+_register(ModelConfig(
+    name="test-tiny-qwen", vocab_size=384, hidden_size=64,
+    intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, tie_embeddings=True, max_position=2048, rope_theta=10000.0,
+    qkv_bias=True, chat_template="chatml"))
 
 # Small-but-real config for on-TPU smoke benchmarks without weights.
 _register(ModelConfig(

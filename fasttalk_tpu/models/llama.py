@@ -82,6 +82,10 @@ def init_params(cfg: ModelConfig, rng: jax.Array,
         },
         "final_norm": jnp.ones((d,), dtype),
     }
+    if cfg.qkv_bias:  # Qwen2-style attention biases
+        params["layers"]["bq"] = jnp.zeros((l, cfg.q_dim), dtype)
+        params["layers"]["bk"] = jnp.zeros((l, cfg.kv_dim), dtype)
+        params["layers"]["bv"] = jnp.zeros((l, cfg.kv_dim), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(next(keys), (d, cfg.vocab_size), scale)
     return params
@@ -139,9 +143,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     def layer(x, scanned):
         lp, ck, cv = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = (h @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         ck = _write_kv(ck, k, write_start, write_mask)

@@ -37,6 +37,12 @@ _LAYER_MAP = {
     "model.layers.{i}.mlp.up_proj.weight": ("w_up", True),
     "model.layers.{i}.mlp.down_proj.weight": ("w_down", True),
 }
+# Qwen2-style attention biases, present only when cfg.qkv_bias.
+_BIAS_MAP = {
+    "model.layers.{i}.self_attn.q_proj.bias": ("bq", False),
+    "model.layers.{i}.self_attn.k_proj.bias": ("bk", False),
+    "model.layers.{i}.self_attn.v_proj.bias": ("bv", False),
+}
 
 
 def find_checkpoint_dir(model_path: str, model_name: str) -> str | None:
@@ -113,7 +119,10 @@ def load_params(cfg: ModelConfig, ckpt_dir: str,
         "final_norm": put(cast(get("model.norm.weight")), "final_norm"),
         "layers": {},
     }
-    for tmpl, (path, transpose) in _LAYER_MAP.items():
+    layer_map = dict(_LAYER_MAP)
+    if cfg.qkv_bias:
+        layer_map.update(_BIAS_MAP)
+    for tmpl, (path, transpose) in layer_map.items():
         stacked = []
         for i in range(cfg.num_layers):
             t = cast(get(tmpl.format(i=i)))

@@ -19,6 +19,7 @@ reports live per-device HBM stats. The reference had no profiler at all
 
 from __future__ import annotations
 
+import os
 import time
 
 import psutil
@@ -111,7 +112,26 @@ def build_monitoring_app(ready_check=None) -> web.Application:
                 body = await request.json()
             except Exception:
                 pass
-        log_dir = body.get("log_dir", "/tmp/fasttalk-tpu-trace")
+        # The monitoring port is unauthenticated: never let the request
+        # choose an arbitrary filesystem path. Traces go under a fixed
+        # base; the body may only name a subdirectory within it.
+        base = os.path.realpath(
+            os.environ.get("PROFILER_TRACE_DIR", "/tmp/fasttalk-tpu-trace"))
+        sub = str(body.get("log_dir", ""))
+        if os.path.isabs(sub):
+            return web.json_response(
+                {"error": "log_dir must be a relative subdirectory of "
+                 f"{base} (set PROFILER_TRACE_DIR to move the base)"},
+                status=400)
+        log_dir = os.path.realpath(os.path.join(base, sub)) if sub else base
+        if log_dir != base and not log_dir.startswith(base + os.sep):
+            return web.json_response(
+                {"error": "log_dir must be a relative subdirectory of "
+                 f"{base}"}, status=400)
+        # Claim the state *before* the awaited start so a concurrent
+        # request sees 409 rather than racing into jax.profiler.
+        _profiler_state.update(active=True, log_dir=log_dir,
+                               started_at=time.monotonic())
         try:
             # Off the event loop: profiler setup does filesystem work and
             # this loop is also serving every WebSocket token stream.
@@ -119,9 +139,9 @@ def build_monitoring_app(ready_check=None) -> web.Application:
             await asyncio.get_running_loop().run_in_executor(
                 None, jax.profiler.start_trace, log_dir)
         except Exception as e:
+            _profiler_state.update(active=False, log_dir=None,
+                                   started_at=None)
             return web.json_response({"error": str(e)}, status=500)
-        _profiler_state.update(active=True, log_dir=log_dir,
-                               started_at=time.monotonic())
         return web.json_response({"status": "tracing", "log_dir": log_dir})
 
     async def profiler_stop(request: web.Request) -> web.Response:
@@ -129,6 +149,11 @@ def build_monitoring_app(ready_check=None) -> web.Application:
 
         if not _profiler_state["active"]:
             return web.json_response({"error": "no active trace"}, status=409)
+        duration = time.monotonic() - (_profiler_state["started_at"] or 0)
+        log_dir = _profiler_state["log_dir"]
+        # Release the claim before the awaited stop: a concurrent stop
+        # gets a clean 409 instead of double-calling stop_trace.
+        _profiler_state.update(active=False, log_dir=None, started_at=None)
         try:
             # stop_trace serializes the whole trace to disk — keep that
             # multi-second write off the serving event loop.
@@ -137,9 +162,6 @@ def build_monitoring_app(ready_check=None) -> web.Application:
                 None, jax.profiler.stop_trace)
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)
-        duration = time.monotonic() - (_profiler_state["started_at"] or 0)
-        log_dir = _profiler_state["log_dir"]
-        _profiler_state.update(active=False, log_dir=None, started_at=None)
         return web.json_response({"status": "stopped", "log_dir": log_dir,
                                   "duration_seconds": duration})
 

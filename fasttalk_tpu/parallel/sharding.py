@@ -36,6 +36,10 @@ _LAYER_RULES: dict[str, P] = {
     "wq": P(None, None, "tp"),
     "wk": P(None, None, "tp"),
     "wv": P(None, None, "tp"),
+    # Column-parallel biases shard with their matmul's output axis.
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
     "wo": P(None, "tp", None),
     "w_gate": P(None, None, "tp"),
     "w_up": P(None, None, "tp"),

@@ -176,6 +176,14 @@ class Config:
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
     use_pallas_attention: bool = field(
         default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", False))
+    # Tokens decoded per device call (lax.scan inside one jitted step) and
+    # number of calls kept in flight. Together these amortise and overlap
+    # per-call host/dispatch latency — the dominant cost when the chip is
+    # reached over a relay, and still a measurable one locally.
+    decode_steps_per_call: int = field(
+        default_factory=lambda: _env_int("TPU_DECODE_STEPS", 8))
+    pipeline_depth: int = field(
+        default_factory=lambda: _env_int("TPU_PIPELINE_DEPTH", 2))
 
     def __post_init__(self) -> None:
         self._validate()
@@ -206,6 +214,10 @@ class Config:
             errs.append("prefill_chunk must be a positive power of two")
         if self.tp_size <= 0 or self.dp_size <= 0:
             errs.append("tp_size and dp_size must be >= 1")
+        if self.decode_steps_per_call <= 0:
+            errs.append("decode_steps_per_call must be >= 1")
+        if self.pipeline_depth <= 0:
+            errs.append("pipeline_depth must be >= 1")
         if self.default_context_window < self.default_max_tokens:
             # Reference warns here (config.py:184-187); we keep it a warning.
             pass

@@ -70,15 +70,29 @@ class TestSlotManager:
         sm = SlotManager(1, 128)
         s = sm.acquire("a")
         s.tokens = [1, 2, 3, 4]
+        s.kv_written = 4
         # identical history + new tokens: reuse all cached
         assert sm.reuse_prefix(s, [1, 2, 3, 4, 5, 6]) == 4
         # divergent history: truncates cache to common prefix
         s.tokens = [1, 2, 3, 4]
+        s.kv_written = 4
         assert sm.reuse_prefix(s, [1, 2, 9, 9, 9]) == 2
         assert s.tokens == [1, 2]
         # reuse never covers the whole prompt (need logits for sampling)
         s.tokens = [1, 2, 3]
+        s.kv_written = 3
         assert sm.reuse_prefix(s, [1, 2, 3]) == 2
+
+    def test_prefix_reuse_capped_by_kv_written(self):
+        """A kept token whose KV row was never written (request finished
+        the step it was sampled, e.g. max_tokens) must be re-fed."""
+        sm = SlotManager(1, 128)
+        s = sm.acquire("a")
+        s.tokens = [1, 2, 3, 4]
+        s.kv_written = 3  # token 4 sampled but never fed
+        assert sm.reuse_prefix(s, [1, 2, 3, 4, 5, 6]) == 3
+        # tokens truncated to the trusted prefix; 4 will be re-prefilled
+        assert s.tokens == [1, 2, 3]
 
 
 @pytest.fixture(scope="module")
@@ -275,3 +289,88 @@ class TestTPUEngine:
 
         res = asyncio.run(run_all())
         assert all(r[-1]["type"] == "done" for r in res)
+
+
+class TestChatTemplates:
+    MSGS = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello"},
+        {"role": "user", "content": "again"},
+    ]
+
+    def test_llama3_render(self):
+        from fasttalk_tpu.engine.tokenizer import render_llama3
+
+        text = render_llama3(self.MSGS)
+        assert text.startswith("<|begin_of_text|>")
+        assert "<|start_header_id|>system<|end_header_id|>\n\nbe brief<|eot_id|>" in text
+        assert text.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+    def test_chatml_render(self):
+        from fasttalk_tpu.engine.tokenizer import render_chatml
+
+        text = render_chatml(self.MSGS)
+        assert "<|im_start|>system\nbe brief<|im_end|>\n" in text
+        assert "<|im_start|>user\nhi<|im_end|>\n" in text
+        assert text.endswith("<|im_start|>assistant\n")
+
+    def test_mistral_render_folds_system(self):
+        from fasttalk_tpu.engine.tokenizer import render_mistral
+
+        text = render_mistral(self.MSGS)
+        # System folded into the first user turn; no system role marker.
+        assert text.startswith("<s>[INST] be brief\n\nhi [/INST]")
+        assert " hello</s>" in text
+        assert text.endswith("[INST] again [/INST]")
+
+    def test_model_configs_pick_templates(self):
+        from fasttalk_tpu.models import get_model_config
+
+        assert get_model_config("llama3.2:1b").chat_template == "llama3"
+        assert get_model_config("qwen2.5:7b").chat_template == "chatml"
+        assert get_model_config("mistral:7b").chat_template == "mistral"
+
+
+def test_out_of_vocab_ids_stream_visibly():
+    """Model vocab larger than the byte fallback tokenizer (weight-free
+    benchmarking): sampled ids beyond the vocab must still produce
+    visible streamed deltas rather than vanishing."""
+    tok = ByteTokenizer()
+    detok = StreamDetokenizer(tok)
+    out = "".join(detok.push(i) for i in [70000, 70001, 104, 105])
+    out += detok.flush()
+    assert "hi" in out
+    assert len(out) == 4  # two glyphs + "hi"
+
+
+def test_engine_generation_with_qkv_bias_model():
+    """End-to-end decode on the Qwen-shaped tiny config (bias path)."""
+    import jax
+
+    from fasttalk_tpu.models import get_model_config
+
+    qcfg = get_model_config("test-tiny-qwen")
+    params = init_params(qcfg, jax.random.PRNGKey(0))
+    eng = TPUEngine(qcfg, params, ByteTokenizer(), num_slots=2,
+                    max_len=128, prefill_chunk=32, steps_per_call=4)
+    eng.start()
+    try:
+        events = _collect(eng, "qw1", "qws1",
+                          [{"role": "user", "content": "hello"}],
+                          GenerationParams(max_tokens=6, **GREEDY))
+        assert events[-1]["type"] == "done"
+        assert events[-1]["stats"]["tokens_generated"] > 0
+    finally:
+        eng.shutdown()
+
+
+def test_kv_written_watermark_after_max_tokens(engine):
+    """max_tokens finish: last kept token's KV row is unwritten and the
+    watermark must exclude it."""
+    _collect(engine, "wm1", "wms1",
+             [{"role": "user", "content": "watermark"}],
+             GenerationParams(max_tokens=4, **GREEDY))
+    slot = engine.slots.lookup("wms1")
+    assert slot is not None
+    assert slot.kv_written == slot.length - 1

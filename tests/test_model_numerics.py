@@ -260,3 +260,53 @@ class TestHFGoldenParity:
         want = model.state_dict()["model.embed_tokens.weight"] \
             .to(torch.bfloat16).to(torch.float32).numpy()
         np.testing.assert_allclose(embed, want, rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.slow
+class TestQwen2GoldenParity:
+    """Logit parity vs transformers' torch Qwen2 (QKV-bias path) through
+    our loader — validates the qkv_bias forward branch and bias loading."""
+
+    def test_logits_match_hf_qwen2(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from safetensors.torch import save_file
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+
+        from fasttalk_tpu.models import get_model_config
+
+        QTINY = get_model_config("test-tiny-qwen")
+        hf_cfg = Qwen2Config(
+            vocab_size=QTINY.vocab_size, hidden_size=QTINY.hidden_size,
+            intermediate_size=QTINY.intermediate_size,
+            num_hidden_layers=QTINY.num_layers,
+            num_attention_heads=QTINY.num_heads,
+            num_key_value_heads=QTINY.num_kv_heads,
+            rope_theta=QTINY.rope_theta, rms_norm_eps=QTINY.rms_eps,
+            tie_word_embeddings=True,
+            max_position_embeddings=QTINY.max_position,
+        )
+        torch.manual_seed(0)
+        hf_model = Qwen2ForCausalLM(hf_cfg).eval()
+
+        ckpt = tmp_path / "test-tiny-qwen"
+        ckpt.mkdir()
+        state = {k: v.contiguous() for k, v in hf_model.state_dict().items()
+                 if k != "lm_head.weight"}
+        save_file(state, str(ckpt / "model.safetensors"))
+
+        from fasttalk_tpu.models.loader import load_params
+        params = load_params(QTINY, str(ckpt), dtype=jnp.float32)
+        assert "bq" in params["layers"]  # biases actually loaded
+
+        t = 12
+        tokens_np = np.random.RandomState(7).randint(0, QTINY.vocab_size,
+                                                     (1, t))
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(tokens_np)).logits.numpy()
+
+        cache = init_cache(QTINY, 1, 32, jnp.float32)
+        ours, _ = forward(params, QTINY, jnp.asarray(tokens_np),
+                          jnp.arange(t)[None, :], cache,
+                          jnp.zeros(1, jnp.int32))
+        np.testing.assert_allclose(np.asarray(ours), hf_logits,
+                                   rtol=2e-3, atol=2e-3)

@@ -394,9 +394,12 @@ class TestMonitoringApp:
         finally:
             await client.close()
 
-    async def test_profiler_endpoints(self, tmp_path):
+    async def test_profiler_endpoints(self, tmp_path, monkeypatch):
         from fasttalk_tpu.monitoring.monitor import build_monitoring_app
 
+        # The endpoint sandboxes traces under PROFILER_TRACE_DIR: the
+        # unauthenticated monitoring port must not take arbitrary paths.
+        monkeypatch.setenv("PROFILER_TRACE_DIR", str(tmp_path))
         app = build_monitoring_app(ready_check=lambda: True)
         client = TestClient(TestServer(app))
         await client.start_server()
@@ -409,16 +412,24 @@ class TestMonitoringApp:
             assert r.status == 409  # nothing active
 
             r = await client.post("/profiler/start",
-                                  json={"log_dir": str(tmp_path)})
+                                  json={"log_dir": "/etc/somewhere"})
+            assert r.status == 400  # absolute paths rejected
+
+            r = await client.post("/profiler/start",
+                                  json={"log_dir": "../../escape"})
+            assert r.status == 400  # traversal rejected
+
+            r = await client.post("/profiler/start",
+                                  json={"log_dir": "run1"})
             assert r.status == 200
             r = await client.post("/profiler/start",
-                                  json={"log_dir": str(tmp_path)})
+                                  json={"log_dir": "run1"})
             assert r.status == 409  # already tracing
 
             r = await client.post("/profiler/stop")
             assert r.status == 200
             body = await r.json()
-            assert body["log_dir"] == str(tmp_path)
+            assert body["log_dir"] == str(tmp_path / "run1")
             # jax.profiler writes a plugins/profile dump under log_dir.
             assert list(tmp_path.rglob("*")), "trace wrote nothing"
         finally:
