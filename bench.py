@@ -63,11 +63,18 @@ async def run_session(engine, i: int, max_tokens: int) -> dict:
 
 
 async def bench(engine) -> dict:
-    # Warmup: trigger prefill + decode compiles for the buckets we'll hit.
+    # Warmup: trigger prefill + decode compiles for every shape the
+    # measurement hits — the single-session path AND the concurrent-burst
+    # path (batched prefill compiles a full-batch group shape).
     log("warmup (compiling prefill + decode buckets)...")
     t0 = time.monotonic()
     await run_session(engine, 999, max_tokens=8)
     engine.release_session("bench-sess-999")
+    await asyncio.gather(
+        *(run_session(engine, 900 + i, max_tokens=8)
+          for i in range(NUM_SESSIONS)))
+    for i in range(NUM_SESSIONS):
+        engine.release_session(f"bench-sess-{900 + i}")
     log(f"warmup done in {time.monotonic() - t0:.1f}s")
 
     log("single-session run...")

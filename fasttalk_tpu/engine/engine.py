@@ -422,6 +422,39 @@ class TPUEngine(EngineBase):
         self._prefill_fns[chunk] = prefill_step
         return prefill_step
 
+    def _get_batched_prefill_fn(self, chunk: int, group: int):
+        """One prompt chunk for ``group`` slots at once.
+
+        Gathers the target slots' KV rows, runs one [group, chunk]
+        forward (per-row write offsets via write_start), scatters the
+        rows back. Padding rows carry write_mask=False and an
+        out-of-range slot index, so their scatter is dropped.
+        """
+        key = (chunk, group)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def batched_prefill(params, cache: KVCache, tokens, starts,
+                            slot_idx, last_idx, mask):
+            gk = cache.k[:, slot_idx]  # [L, group, S, Kv, H] gather
+            gv = cache.v[:, slot_idx]
+            positions = starts[:, None] + jnp.arange(chunk)[None, :]
+            logits, upd = forward(
+                params, self.cfg, tokens, positions, KVCache(gk, gv),
+                starts, blockwise=True, write_mask=mask)
+            new_k = cache.k.at[:, slot_idx].set(
+                upd.k, mode="drop", unique_indices=True)
+            new_v = cache.v.at[:, slot_idx].set(
+                upd.v, mode="drop", unique_indices=True)
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1)[:, 0]
+            return KVCache(new_k, new_v), last
+
+        self._prefill_fns[key] = batched_prefill
+        return batched_prefill
+
     def _next_rng(self) -> jax.Array:
         self._step += 1
         return jax.random.fold_in(self._base_key, self._step)
@@ -516,11 +549,17 @@ class TPUEngine(EngineBase):
                    or not slot.active for r in self._waiting)
 
     def _admit(self) -> None:
-        """Move waiting requests into free slots (chunked prefill).
+        """Move waiting requests into free slots.
 
         Skips (rather than head-of-line blocks on) a request whose session
-        is still generating.
+        is still generating. Requests whose remaining prompt fits one
+        prefill bucket (the common chat-turn case) are prefetched together
+        in one batched device call — a burst of N arrivals costs one
+        prefill + one sample round-trip instead of 2N (the reference
+        serialised engine-side prefills the same way it serialised
+        everything: one HTTP request at a time).
         """
+        batch: list[tuple[_Request, Slot, int, list[int]]] = []
         i = 0
         while i < len(self._waiting):
             req = self._waiting[i]
@@ -530,28 +569,42 @@ class TPUEngine(EngineBase):
                 continue
             slot = self.slots.acquire(req.session_id)
             if slot is None:
-                return  # all slots actively decoding
+                break  # all slots actively decoding
             self._waiting.pop(i)
-            try:
-                self._prefill(req, slot)
-            except Exception as e:
-                log.error(f"prefill failed for {req.request_id}: {e}",
-                          exc_info=True)
-                self._finish(req, "error", error=str(e))
+            # Reserve immediately: activation is deferred to after the
+            # batched prefill, and an unreserved slot would be fair game
+            # for eviction by the next acquire in this same loop.
+            req.slot = slot
+            slot.active = True
+            prompt = req.prompt_tokens
+            reused = self.slots.reuse_prefix(slot, prompt)
+            if reused:
+                self._m_prefix.inc(reused)
+            todo = prompt[reused:]
+            if reused + len(todo) > self.usable_len:
+                self._finish(req, "error",
+                             error=f"prompt ({len(prompt)} tok) exceeds "
+                             "context")
+                continue
+            bucket = next((b for b in _PREFILL_BUCKETS if b >= len(todo)),
+                          None)
+            if bucket is not None and len(todo) <= self.prefill_chunk \
+                    and reused + bucket <= self.max_len:
+                batch.append((req, slot, reused, todo))
+            else:
+                try:
+                    self._prefill_chunked(req, slot, reused, todo)
+                except Exception as e:
+                    log.error(f"prefill failed for {req.request_id}: {e}",
+                              exc_info=True)
+                    self._finish(req, "error", error=str(e))
+        if batch:
+            self._prefill_batched(batch)
 
-    def _prefill(self, req: _Request, slot: Slot) -> None:
+    def _prefill_chunked(self, req: _Request, slot: Slot, start: int,
+                         todo: list[int]) -> None:
+        """Long-prompt path: one slot, chunk by chunk."""
         t0 = time.monotonic()
-        prompt = req.prompt_tokens
-        reused = self.slots.reuse_prefix(slot, prompt)
-        if reused:
-            self._m_prefix.inc(reused)
-        todo = prompt[reused:]
-        start = reused
-        if start + len(todo) > self.usable_len:
-            self._finish(req, "error",
-                         error=f"prompt ({len(prompt)} tok) exceeds context")
-            return
-
         last_logits = None
         while todo:
             take = min(len(todo), self.prefill_chunk)
@@ -585,8 +638,81 @@ class TPUEngine(EngineBase):
             jnp.full((1,), req.params.temperature, jnp.float32),
             jnp.full((1,), req.params.top_k, jnp.int32),
             jnp.full((1,), req.params.top_p, jnp.float32))
-        first_id = int(first[0])
+        self._activate(req, slot, int(first[0]))
 
+    def _prefill_batched(
+            self, batch: list[tuple[_Request, Slot, int, list[int]]]) -> None:
+        """Prefill several single-bucket prompts in one device call per
+        (bucket, group-size) shape: gather the target slots' KV rows,
+        run one batched forward, scatter the rows back, then sample every
+        first token in a single batched call."""
+        t0 = time.monotonic()
+        by_bucket: dict[int, list] = {}
+        for item in batch:
+            bucket = next(b for b in _PREFILL_BUCKETS
+                          if b >= max(1, len(item[3])))
+            by_bucket.setdefault(bucket, []).append(item)
+        for bucket, group in sorted(by_bucket.items()):
+            while group:
+                sub, group = group[:self.num_slots], group[self.num_slots:]
+                try:
+                    self._prefill_group(bucket, sub)
+                except Exception as e:
+                    # Scoped to this device call: requests in other
+                    # groups (possibly already activated and streaming)
+                    # are untouched.
+                    log.error(f"batched prefill failed: {e}", exc_info=True)
+                    for req, _, _, _ in sub:
+                        self._finish(req, "error", error=str(e))
+        self._m_prefill.observe((time.monotonic() - t0) * 1000)
+
+    def _prefill_group(self, bucket: int,
+                       sub: list[tuple[_Request, Slot, int, list[int]]],
+                       ) -> None:
+        """One batched prefill device call + one batched first-token
+        sample for a same-bucket group of requests."""
+        g = len(sub)
+        # Only two group shapes ever compile per bucket: 1 and num_slots.
+        # A mid-size burst pads to the full batch (the padded rows are
+        # masked) — wasted FLOPs are bounded and tiny next to the cost of
+        # compiling per burst size.
+        gp = 1 if g == 1 else self.num_slots
+        tokens = np.zeros((gp, bucket), np.int32)
+        starts = np.zeros((gp,), np.int32)
+        # Padding rows scatter out of range (mode="drop"); each gets a
+        # distinct index so unique_indices holds.
+        slot_idx = np.arange(self.num_slots,
+                             self.num_slots + gp, dtype=np.int32)
+        last_idx = np.zeros((gp,), np.int32)
+        mask = np.zeros((gp,), bool)
+        temps = np.ones((gp,), np.float32)
+        topks = np.zeros((gp,), np.int32)
+        topps = np.ones((gp,), np.float32)
+        for j, (req, slot, start, todo) in enumerate(sub):
+            tokens[j, :len(todo)] = todo
+            starts[j] = start
+            slot_idx[j] = slot.index
+            last_idx[j] = len(todo) - 1
+            mask[j] = True
+            temps[j] = req.params.temperature
+            topks[j] = req.params.top_k
+            topps[j] = req.params.top_p
+        fn = self._get_batched_prefill_fn(bucket, gp)
+        self.cache, last_logits = fn(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(starts), jnp.asarray(slot_idx),
+            jnp.asarray(last_idx), jnp.asarray(mask))
+        firsts = np.asarray(sample_tokens(
+            last_logits, self._next_rng(), jnp.asarray(temps),
+            jnp.asarray(topks), jnp.asarray(topps)))  # one sync
+        for j, (req, slot, start, todo) in enumerate(sub):
+            slot.tokens.extend(todo)
+            slot.kv_written = start + len(todo)
+            self._activate(req, slot, int(firsts[j]))
+
+    def _activate(self, req: _Request, slot: Slot, first_id: int) -> None:
+        """Mark a freshly prefilled slot as decoding and emit its first
+        sampled token."""
         s = slot.index
         slot.active = True
         req.slot = slot
@@ -708,16 +834,21 @@ class TPUEngine(EngineBase):
         req.finished = True
         slot = req.slot
         if slot is not None:
+            decoding = self._running.get(slot.index) is req
             slot.active = False
             slot.last_used = time.monotonic()
             self._running.pop(slot.index, None)
             self._active_mask[slot.index] = False
             self._temps[slot.index] = 0.0
-            # KV rows are written only up to the position reached by
-            # *feeding* tokens; a final token kept on max_tokens/stop was
-            # sampled but never fed, so its row is not trusted for reuse.
-            slot.kv_written = min(slot.length,
-                                  int(self._positions[slot.index]))
+            if decoding:
+                # KV rows are written only up to the position reached by
+                # *feeding* tokens; a final token kept on max_tokens/stop
+                # was sampled but never fed — not trusted for reuse.
+                # (If the request died before activation, the prefill
+                # paths maintained kv_written themselves and the
+                # positions mirror is stale — leave it alone.)
+                slot.kv_written = min(slot.length,
+                                      int(self._positions[slot.index]))
             # Host positions mirror is authoritative again (the device
             # copy may have speculatively advanced past the kept length).
             self._positions[slot.index] = slot.length

@@ -25,6 +25,58 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
 
 
+def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
+    """Account weights + KV cache against the HBM budget before any
+    allocation, so a bad TPU_DECODE_SLOTS / TPU_MAX_MODEL_LEN fails with
+    a named message instead of an opaque device OOM mid-load. Wires the
+    TPU_HBM_UTILIZATION knob the way the reference never wired its
+    VLLM_GPU_MEMORY_UTILIZATION passthrough (reference:
+    .env.vllm.example:40 — forwarded to the external container, no
+    in-tree accounting).
+
+    Returns the accounting dict (bytes, per device); raises ValueError
+    when over budget. Skips silently when the backend exposes no memory
+    stats (CPU tests).
+
+    Sharding facts the math encodes (parallel/sharding.py): weights
+    shard over "tp" only (each dp replica holds a full copy); the KV
+    cache shards over both "tp" (kv heads) and "dp" (slots). Int8
+    weights count int8 bytes because quantization happens host-side
+    before placement (ops/quant.py quantizing_put) — HBM never holds
+    the bf16 copy.
+    """
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+    except Exception:
+        limit = None
+    dsize = jnp.dtype(dtype).itemsize
+    wbytes = model_cfg.param_count() * (1 if cfg.quantize == "int8" else dsize)
+    kv = (model_cfg.num_layers * cfg.decode_slots * cfg.max_model_len
+          * model_cfg.num_kv_heads * model_cfg.head_dim * 2 * dsize)
+    acct = {
+        "weight_bytes_per_device": wbytes // max(1, cfg.tp_size),
+        "kv_cache_bytes_per_device": kv // n_devices,
+        "hbm_limit_bytes": limit,
+        "hbm_utilization": cfg.hbm_util,
+    }
+    if limit:
+        budget = limit * cfg.hbm_util
+        need = acct["weight_bytes_per_device"] + acct["kv_cache_bytes_per_device"]
+        if need > budget:
+            raise ValueError(
+                f"Model + KV cache need {need / 2**30:.2f} GiB/device but the "
+                f"HBM budget is {budget / 2**30:.2f} GiB "
+                f"({limit / 2**30:.2f} GiB x TPU_HBM_UTILIZATION="
+                f"{cfg.hbm_util}). Lower TPU_DECODE_SLOTS "
+                f"({cfg.decode_slots}) or TPU_MAX_MODEL_LEN "
+                f"({cfg.max_model_len}), enable TPU_QUANTIZE=int8, or raise "
+                "TPU_TP_SIZE to shard over more chips.")
+    return acct
+
+
 def build_engine(cfg: Config) -> EngineBase:
     if cfg.llm_provider == "fake":  # internal/testing
         return FakeEngine()
@@ -45,7 +97,13 @@ def build_engine(cfg: Config) -> EngineBase:
                                   timeout_s=cfg.ollama_timeout)
     model_cfg = get_model_config(cfg.model_name)
     dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
-    mesh = put = None
+    acct = check_hbm_budget(model_cfg, cfg, dtype,
+                            n_devices=max(1, cfg.tp_size * cfg.dp_size))
+    log.info("HBM budget check passed",
+             weight_gib=round(acct["weight_bytes_per_device"] / 2**30, 2),
+             kv_gib=round(acct["kv_cache_bytes_per_device"] / 2**30, 2),
+             limit_gib=round((acct["hbm_limit_bytes"] or 0) / 2**30, 2))
+    mesh = put = raw_put = None
     if cfg.tp_size > 1 or cfg.dp_size > 1:
         from fasttalk_tpu.parallel.mesh import make_mesh
         from fasttalk_tpu.parallel.sharding import param_put
@@ -54,7 +112,21 @@ def build_engine(cfg: Config) -> EngineBase:
         # Weights go straight into their TP shards as they stream off
         # disk — a 70B checkpoint must never materialise on one chip.
         put = param_put(mesh, dtype)
+        raw_put = param_put(mesh, None)
+    if cfg.quantize == "int8":
+        from fasttalk_tpu.ops.quant import quantizing_put
+
+        import jax
+
+        if put is None:
+            put = lambda arr, path: jax.device_put(jnp.asarray(arr, dtype))  # noqa: E731
+            raw_put = lambda arr, path: jax.device_put(jnp.asarray(arr))  # noqa: E731
+        # Quantize host-side, tensor by tensor, before placement: device
+        # HBM peaks at int8 bytes, not the transient bf16 copy.
+        put = quantizing_put(put, raw_put)
     params, loaded = load_or_init(model_cfg, cfg.model_path, dtype, put=put)
+    if cfg.quantize == "int8":
+        log.info("Quantized matmul weights to int8 (per-channel symmetric)")
     tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
                                cfg.tokenizer_path,
                                template=model_cfg.chat_template)

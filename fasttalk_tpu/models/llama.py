@@ -27,6 +27,7 @@ import numpy as np
 
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.quant import matmul as qmm
 from fasttalk_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = dict[str, Any]
@@ -143,7 +144,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     def layer(x, scanned):
         lp, ck, cv = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        q, k, v = qmm(h, lp["wq"]), qmm(h, lp["wk"]), qmm(h, lp["wv"])
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
@@ -160,18 +161,20 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         else:
             attn_fn = attend_blockwise if blockwise else attend
             o = attn_fn(q, ck, cv, positions)
-        x = x + o.reshape(b, t, cfg.q_dim) @ lp["wo"]
+        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
-        up = (h @ lp["w_up"]).astype(jnp.float32)
-        x = x + (gate * up).astype(x.dtype) @ lp["w_down"]
+        gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
+        up = qmm(h, lp["w_up"]).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"])
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    if cfg.tie_embeddings:
+        logits = (x @ params["embed"].T).astype(jnp.float32)
+    else:
+        logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v)
 
 

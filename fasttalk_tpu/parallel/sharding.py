@@ -59,8 +59,30 @@ def _leaf_name(path) -> str:
     return ""
 
 
-def _spec_for(name: str, ndim: int, shape=None) -> P:
-    """The PartitionSpec for a parameter leaf name (unknown: replicate)."""
+def _parent_name(path) -> str:
+    keys = [str(e.key) for e in path if hasattr(e, "key")]
+    return keys[-2] if len(keys) >= 2 else ""
+
+
+def _spec_for(name: str, ndim: int, shape=None, parent: str = "") -> P:
+    """The PartitionSpec for a parameter leaf name (unknown: replicate).
+
+    Int8-quantized leaves (ops/quant.py) appear as {"q", "s"} dicts under
+    the weight's name: "q" shards exactly like the original weight; the
+    per-output-channel scale "s" shards like the weight's last axis.
+    """
+    if name in ("q", "s") and parent:
+        base = _TOP_RULES.get(parent) or _LAYER_RULES.get(parent)
+        if base is not None:
+            if name == "q":
+                spec = base
+            else:  # scale: leading stacked-layer axis (if any) + out axis
+                spec = P(*base[:ndim - 1], base[-1])
+            if len(spec) != ndim:
+                raise ValueError(
+                    f"spec {spec} rank mismatch for {parent}/{name} "
+                    f"with shape {shape}")
+            return spec
     spec = _TOP_RULES.get(name) or _LAYER_RULES.get(name)
     if spec is None:
         return P(*([None] * ndim))
@@ -74,7 +96,8 @@ def param_pspecs(params: Any) -> Any:
     """PartitionSpec pytree matching ``params`` (models/llama.py
     init_params / models/loader.py structure)."""
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: _spec_for(_leaf_name(path), leaf.ndim, leaf.shape),
+        lambda path, leaf: _spec_for(_leaf_name(path), leaf.ndim, leaf.shape,
+                                     parent=_parent_name(path)),
         params)
 
 
@@ -139,8 +162,10 @@ def param_put(mesh: Mesh, dtype: Any = None):
     import jax.numpy as jnp
 
     def put(arr, path: str) -> jax.Array:
-        name = path.split("/")[-1]
-        spec = _spec_for(name, arr.ndim, getattr(arr, "shape", None))
+        parts = path.split("/")
+        parent = parts[-2] if len(parts) >= 2 else ""
+        spec = _spec_for(parts[-1], arr.ndim, getattr(arr, "shape", None),
+                         parent=parent)
         return jax.device_put(jnp.asarray(arr, dtype),
                               NamedSharding(mesh, spec))
 

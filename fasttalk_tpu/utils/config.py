@@ -181,9 +181,13 @@ class Config:
     # per-call host/dispatch latency — the dominant cost when the chip is
     # reached over a relay, and still a measurable one locally.
     decode_steps_per_call: int = field(
-        default_factory=lambda: _env_int("TPU_DECODE_STEPS", 8))
+        default_factory=lambda: _env_int("TPU_DECODE_STEPS", 16))
     pipeline_depth: int = field(
         default_factory=lambda: _env_int("TPU_PIPELINE_DEPTH", 2))
+    # Weight quantization for serving: "none" | "int8" (per-output-channel
+    # symmetric, in-tree replacement for the reference's external AWQ
+    # engine config, .env.vllm.example:21).
+    quantize: str = field(default_factory=lambda: _env_str("TPU_QUANTIZE", "none"))
 
     def __post_init__(self) -> None:
         self._validate()
@@ -218,6 +222,8 @@ class Config:
             errs.append("decode_steps_per_call must be >= 1")
         if self.pipeline_depth <= 0:
             errs.append("pipeline_depth must be >= 1")
+        if self.quantize not in ("none", "int8"):
+            errs.append("quantize must be 'none' or 'int8'")
         if self.default_context_window < self.default_max_tokens:
             # Reference warns here (config.py:184-187); we keep it a warning.
             pass

@@ -374,3 +374,113 @@ def test_kv_written_watermark_after_max_tokens(engine):
     slot = engine.slots.lookup("wms1")
     assert slot is not None
     assert slot.kv_written == slot.length - 1
+
+
+class TestHBMBudget:
+    def test_over_budget_raises_named_error(self):
+        from fasttalk_tpu.engine.factory import check_hbm_budget
+        from fasttalk_tpu.models import get_model_config
+        from fasttalk_tpu.utils.config import Config
+
+        import jax.numpy as jnp
+
+        cfg = Config(llm_provider="tpu", model_name="llama3:70b",
+                     decode_slots=16, max_model_len=8192)
+        big = get_model_config("llama3:70b")
+        # Fake a 16 GiB device by monkeying the accounting inputs is
+        # awkward; instead call with the real backend. CPU exposes no
+        # bytes_limit, so only assert the accounting math here.
+        acct = check_hbm_budget(big, cfg, jnp.bfloat16, n_devices=1)
+        assert acct["weight_bytes_per_device"] == big.param_count() * 2
+        kv = (big.num_layers * 16 * 8192 * big.num_kv_heads
+              * big.head_dim * 2 * 2)
+        assert acct["kv_cache_bytes_per_device"] == kv
+
+    def test_budget_enforced_when_limit_known(self, monkeypatch):
+        import jax
+
+        from fasttalk_tpu.engine.factory import check_hbm_budget
+        from fasttalk_tpu.models import get_model_config
+        from fasttalk_tpu.utils.config import Config
+
+        import jax.numpy as jnp
+        import pytest
+
+        class FakeDev:
+            def memory_stats(self):
+                return {"bytes_limit": 16 * 2**30}  # one v5e chip
+
+        monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+        cfg = Config(llm_provider="tpu", model_name="llama3:70b",
+                     decode_slots=16, max_model_len=8192)
+        big = get_model_config("llama3:70b")
+        with pytest.raises(ValueError, match="TPU_DECODE_SLOTS"):
+            check_hbm_budget(big, cfg, jnp.bfloat16, n_devices=1)
+        # 70B over 8 chips with int8 + fewer slots fits
+        cfg2 = Config(llm_provider="tpu", model_name="llama3:70b",
+                      decode_slots=8, max_model_len=4096, tp_size=8)
+        cfg2.quantize = "int8"
+        acct = check_hbm_budget(big, cfg2, jnp.bfloat16, n_devices=8)
+        assert acct["weight_bytes_per_device"] < 16 * 2**30 * 0.9
+
+
+def test_hbm_budget_counts_dp_weight_replication(monkeypatch):
+    """Weights shard over tp only — dp replicas each hold a full copy."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from fasttalk_tpu.engine.factory import check_hbm_budget
+    from fasttalk_tpu.models import get_model_config
+    from fasttalk_tpu.utils.config import Config
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_limit": 16 * 2**30}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+    # llama3:8b bf16 ~16 GiB of weights; dp=4 must NOT divide them.
+    cfg = Config(llm_provider="tpu", model_name="llama3:8b",
+                 decode_slots=16, max_model_len=8192, dp_size=4)
+    big = get_model_config("llama3:8b")
+    with pytest.raises(ValueError, match="HBM budget"):
+        check_hbm_budget(big, cfg, jnp.bfloat16, n_devices=4)
+
+
+def test_quantizing_put_places_int8_before_device():
+    """Factory int8 path: weights quantize host-side per tensor; the
+    device never sees the bf16 copy, and the engine decodes fine."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fasttalk_tpu.models.loader import load_or_init
+    from fasttalk_tpu.ops.quant import is_quantized, quantizing_put
+
+    inner = lambda arr, path: jax.device_put(jnp.asarray(arr, jnp.bfloat16))
+    raw = lambda arr, path: jax.device_put(jnp.asarray(arr))
+    params, loaded = load_or_init(TINY, "", put=quantizing_put(inner, raw))
+    assert not loaded
+    assert is_quantized(params)
+    assert params["layers"]["wq"]["q"].dtype == jnp.int8
+    assert params["layers"]["wq"]["s"].dtype == jnp.float32
+    assert params["embed"].dtype == jnp.bfloat16  # not quantized
+
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                    max_len=128, prefill_chunk=32)
+    eng.start()
+    try:
+        async def run():
+            out = []
+            async for ev in eng.generate(
+                    "qp1", "qps1", [{"role": "user", "content": "hi"}],
+                    GenerationParams(max_tokens=4, **GREEDY)):
+                out.append(ev)
+            return out
+
+        events = asyncio.run(run())
+        assert events[-1]["type"] == "done"
+    finally:
+        eng.shutdown()

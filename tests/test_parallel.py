@@ -245,3 +245,50 @@ def test_param_put_casts_to_engine_dtype():
     out = put(np.ones((4, 8), np.float32), "embed")
     assert out.dtype == jnp.bfloat16
     assert out.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
+
+
+def test_tp_sharded_quantized_forward_matches_single_device():
+    """Int8-quantized params shard over TP and reproduce the same
+    quantized logits as single-device (q shards like the weight, the
+    per-channel scale like the output axis; the per-channel max over a
+    TP-sharded contraction axis lowers to a local max + all-reduce)."""
+    from fasttalk_tpu.ops.quant import quantize_params
+
+    cfg = get_model_config("test-small")
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    qparams = quantize_params(jax.tree.map(lambda x: x.copy(), params))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0,
+                                cfg.vocab_size)
+    cache = init_cache(cfg, 2, 64, jnp.float32)
+    ref_logits, _ = jax.jit(_prefill_logits, static_argnums=0)(
+        cfg, qparams, cache, tokens)
+
+    mesh = make_mesh(tp=4)
+    sq = shard_params(qparams, mesh)
+    # int8 leaf carries the weight's own spec
+    assert "tp" in str(sq["layers"]["wq"]["q"].sharding.spec)
+    scache = shard_cache(init_cache(cfg, 2, 64, jnp.float32), mesh)
+    logits, _ = jax.jit(_prefill_logits, static_argnums=0)(
+        cfg, sq, scache, tokens)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_quantize_after_shard_matches_quantize_before():
+    """Factory order (shard bf16 → quantize on device) must equal
+    host-side quantize → shard."""
+    from fasttalk_tpu.ops.quant import quantize_params
+
+    cfg = get_model_config("test-small")
+    params = init_params(cfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    mesh = make_mesh(tp=4)
+
+    a = quantize_params(shard_params(
+        jax.tree.map(lambda x: x.copy(), params), mesh))
+    b = shard_params(quantize_params(
+        jax.tree.map(lambda x: x.copy(), params)), mesh)
+    np.testing.assert_array_equal(np.asarray(a["layers"]["wq"]["q"]),
+                                  np.asarray(b["layers"]["wq"]["q"]))
+    np.testing.assert_allclose(np.asarray(a["layers"]["w_down"]["s"]),
+                               np.asarray(b["layers"]["w_down"]["s"]),
+                               rtol=1e-6)
