@@ -81,6 +81,21 @@ class GenerationParams:
 
 
 @dataclass
+class _PrefillState:
+    """A long prompt being prefilled chunk-by-chunk, interleaved with
+    decode calls so running sessions keep streaming (one chunk per engine
+    loop iteration; the reference's analogue was head-of-line blocking
+    the whole gateway on a single HTTP request)."""
+
+    req: "_Request"
+    slot: Slot
+    start: int
+    todo: list[int]
+    t0: float = field(default_factory=time.monotonic)
+    last_logits: Any = None
+
+
+@dataclass
 class _Request:
     request_id: str
     session_id: str
@@ -205,14 +220,18 @@ class TPUEngine(EngineBase):
         self._topps_dev = self._put(self._topps)
         self._rng_dev = self._put(jax.random.PRNGKey(seed))
         self._dirty = False
-        # In-flight decode calls: (tokens_device_array [K, S], slot ids
-        # that were running at dispatch time).
-        self._inflight: deque[tuple[Any, list[int]]] = deque()
+        # In-flight decode calls: (tokens_device_array [K, S], the
+        # (slot index, request) pairs running at dispatch time). Tokens
+        # are attributed to the dispatch-time request, never to whoever
+        # occupies the slot at retirement — a slot can be re-admitted to
+        # a new request while an older call is still in flight.
+        self._inflight: deque[tuple[Any, list[tuple[int, _Request]]]] = deque()
         self._base_key = jax.random.PRNGKey(seed + 1)
         self._step = 0
 
         self._commands: queue.Queue = queue.Queue()
         self._waiting: list[_Request] = []
+        self._prefilling: list[_PrefillState] = []  # long prompts, FIFO
         self._running: dict[int, _Request] = {}  # slot index -> request
         self._by_id: dict[str, _Request] = {}
         self._release_after: set[str] = set()  # sessions to unpin on finish
@@ -422,15 +441,18 @@ class TPUEngine(EngineBase):
         self._prefill_fns[chunk] = prefill_step
         return prefill_step
 
-    def _get_batched_prefill_fn(self, chunk: int, group: int):
+    def _get_batched_prefill_fn(self, chunk: int, group: int, ctx: int):
         """One prompt chunk for ``group`` slots at once.
 
-        Gathers the target slots' KV rows, runs one [group, chunk]
-        forward (per-row write offsets via write_start), scatters the
-        rows back. Padding rows carry write_mask=False and an
-        out-of-range slot index, so their scatter is dropped.
+        Gathers the first ``ctx`` KV positions of the target slots (the
+        forward never reads or writes past start+chunk <= ctx, and
+        gathering full max_len rows would transiently double the KV
+        cache's HBM), runs one [group, chunk] forward with per-row write
+        offsets, scatters the region back. Padding rows carry
+        write_mask=False and an out-of-range slot index, so their
+        scatter is dropped.
         """
-        key = (chunk, group)
+        key = (chunk, group, ctx)
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
@@ -438,15 +460,15 @@ class TPUEngine(EngineBase):
         @partial(jax.jit, donate_argnums=(1,))
         def batched_prefill(params, cache: KVCache, tokens, starts,
                             slot_idx, last_idx, mask):
-            gk = cache.k[:, slot_idx]  # [L, group, S, Kv, H] gather
-            gv = cache.v[:, slot_idx]
+            gk = cache.k[:, slot_idx, :ctx]  # [L, group, ctx, Kv, H]
+            gv = cache.v[:, slot_idx, :ctx]
             positions = starts[:, None] + jnp.arange(chunk)[None, :]
             logits, upd = forward(
                 params, self.cfg, tokens, positions, KVCache(gk, gv),
                 starts, blockwise=True, write_mask=mask)
-            new_k = cache.k.at[:, slot_idx].set(
+            new_k = cache.k.at[:, slot_idx, :ctx].set(
                 upd.k, mode="drop", unique_indices=True)
-            new_v = cache.v.at[:, slot_idx].set(
+            new_v = cache.v.at[:, slot_idx, :ctx].set(
                 upd.v, mode="drop", unique_indices=True)
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1)[:, 0]
@@ -467,14 +489,20 @@ class TPUEngine(EngineBase):
                  max_len=self.max_len)
         try:
             while True:
-                idle = not self._running and not self._inflight
+                idle = not self._running and not self._inflight \
+                    and not self._prefilling
                 if not self._drain_commands(block=idle):
                     break
-                if self._can_admit():
-                    # Never prefill into a slot an in-flight call may
-                    # still write to: drain the pipeline first.
-                    self._flush_pipeline()
+                if self._waiting:
                     self._admit()
+                if self._prefilling:
+                    # One chunk per iteration: long prompts interleave
+                    # with decode calls instead of stalling every
+                    # running session for their whole prefill. Safe
+                    # without draining the pipeline: chunk writes target
+                    # reserved slots and are ordered behind in-flight
+                    # calls by the cache data dependency.
+                    self._advance_prefill()
                 if self._running:
                     if self._dirty:
                         self._flush_pipeline()
@@ -486,7 +514,8 @@ class TPUEngine(EngineBase):
                 elif self._inflight:
                     self._flush_pipeline()
                 self._m_active.set(len(self._running))
-                self._m_queue.set(len(self._waiting))
+                self._m_queue.set(len(self._waiting)
+                                  + len(self._prefilling))
         except Exception as e:  # engine thread must not die silently
             log.critical(f"engine thread crashed: {e}", exc_info=True)
             self._abort_all(f"engine crashed: {e}")
@@ -506,6 +535,7 @@ class TPUEngine(EngineBase):
                                  "code": "internal_error"})
         self._by_id.clear()
         self._waiting.clear()
+        self._prefilling.clear()
         self._running.clear()
         self._inflight.clear()
 
@@ -537,16 +567,6 @@ class TPUEngine(EngineBase):
                     self._release_after.add(arg)
                 else:
                     self.slots.release_session(arg)
-
-    def _can_admit(self) -> bool:
-        """True iff _admit would actually place at least one request —
-        the pipeline is only worth draining when it would."""
-        if not self._waiting:
-            return False
-        if not any(not s.active for s in self.slots.slots):
-            return False
-        return any((slot := self.slots.lookup(r.session_id)) is None
-                   or not slot.active for r in self._waiting)
 
     def _admit(self) -> None:
         """Move waiting requests into free slots.
@@ -592,53 +612,70 @@ class TPUEngine(EngineBase):
                     and reused + bucket <= self.max_len:
                 batch.append((req, slot, reused, todo))
             else:
-                try:
-                    self._prefill_chunked(req, slot, reused, todo)
-                except Exception as e:
-                    log.error(f"prefill failed for {req.request_id}: {e}",
-                              exc_info=True)
-                    self._finish(req, "error", error=str(e))
+                self._prefilling.append(
+                    _PrefillState(req=req, slot=slot, start=reused,
+                                  todo=todo))
         if batch:
             self._prefill_batched(batch)
 
-    def _prefill_chunked(self, req: _Request, slot: Slot, start: int,
-                         todo: list[int]) -> None:
-        """Long-prompt path: one slot, chunk by chunk."""
-        t0 = time.monotonic()
-        last_logits = None
-        while todo:
-            take = min(len(todo), self.prefill_chunk)
+    def _advance_prefill(self) -> None:
+        """Run ONE chunk of the oldest in-progress long prefill."""
+        while self._prefilling:
+            st = self._prefilling[0]
+            if st.req.finished:
+                self._prefilling.pop(0)
+                continue
+            if st.req.cancelled:
+                self._prefilling.pop(0)
+                self._finish(st.req, "cancelled")
+                continue
+            break
+        else:
+            return
+        st = self._prefilling[0]
+        req, slot = st.req, st.slot
+        try:
+            take = min(len(st.todo), self.prefill_chunk)
             bucket = next(b for b in _PREFILL_BUCKETS if b >= take)
             # A padded bucket must not extend past the cache end —
             # dynamic_update_slice would clamp the start and corrupt
             # earlier rows. Shrink the chunk until its bucket fits.
-            while start + bucket > self.max_len and take > 1:
+            while st.start + bucket > self.max_len and take > 1:
                 bucket //= 2
                 take = min(take, bucket)
-            if start + bucket > self.max_len:
+            if st.start + bucket > self.max_len:
+                self._prefilling.pop(0)
                 self._finish(req, "error",
                              error="KV cache exhausted during prefill")
                 return
-            chunk = todo[:take]
+            chunk = st.todo[:take]
             padded = np.zeros((bucket,), np.int32)
             padded[:take] = chunk
             fn = self._get_prefill_fn(bucket)
-            self.cache, last_logits = fn(
+            self.cache, st.last_logits = fn(
                 self.params, self.cache, jnp.asarray(padded),
-                jnp.int32(start), jnp.int32(slot.index),
+                jnp.int32(st.start), jnp.int32(slot.index),
                 jnp.int32(take - 1))
             slot.tokens.extend(chunk)
-            start += take
-            slot.kv_written = start
-            todo = todo[take:]
-
-        self._m_prefill.observe((time.monotonic() - t0) * 1000)
-        first = sample_tokens(
-            last_logits[None, :], self._next_rng(),
-            jnp.full((1,), req.params.temperature, jnp.float32),
-            jnp.full((1,), req.params.top_k, jnp.int32),
-            jnp.full((1,), req.params.top_p, jnp.float32))
-        self._activate(req, slot, int(first[0]))
+            st.start += take
+            slot.kv_written = st.start
+            st.todo = st.todo[take:]
+            if st.todo:
+                return  # next chunk on a later iteration
+            self._prefilling.pop(0)
+            self._m_prefill.observe((time.monotonic() - st.t0) * 1000)
+            first = sample_tokens(
+                st.last_logits[None, :], self._next_rng(),
+                jnp.full((1,), req.params.temperature, jnp.float32),
+                jnp.full((1,), req.params.top_k, jnp.int32),
+                jnp.full((1,), req.params.top_p, jnp.float32))
+            self._activate(req, slot, int(first[0]))
+        except Exception as e:
+            log.error(f"prefill failed for {req.request_id}: {e}",
+                      exc_info=True)
+            if self._prefilling and self._prefilling[0] is st:
+                self._prefilling.pop(0)
+            self._finish(req, "error", error=str(e))
 
     def _prefill_batched(
             self, batch: list[tuple[_Request, Slot, int, list[int]]]) -> None:
@@ -697,7 +734,12 @@ class TPUEngine(EngineBase):
             temps[j] = req.params.temperature
             topks[j] = req.params.top_k
             topps[j] = req.params.top_p
-        fn = self._get_batched_prefill_fn(bucket, gp)
+        # Gather only as much of each slot row as this chunk can touch,
+        # rounded to a KV bucket so the shape set stays small.
+        need = int(starts.max()) + bucket
+        ctx = next((b for b in _KV_BUCKETS
+                    if b >= need and b <= self.max_len), self.max_len)
+        fn = self._get_batched_prefill_fn(bucket, gp, ctx)
         self.cache, last_logits = fn(
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(starts), jnp.asarray(slot_idx),
@@ -737,7 +779,8 @@ class TPUEngine(EngineBase):
 
     def _dispatch_decode(self) -> None:
         """Launch one K-step decode call; does not wait for results."""
-        active = [s for s in self._running]
+        active = list(self._running)
+        snapshot = list(self._running.items())
         # Device positions lead the host mirrors by one K-step call per
         # in-flight dispatch; size the KV bucket for where the device
         # will be at the END of this call.
@@ -751,19 +794,20 @@ class TPUEngine(EngineBase):
             self.params, self.cache, self._cur_tokens, self._positions_dev,
             self._active_dev, self._temps_dev, self._topks_dev,
             self._topps_dev, self._rng_dev)
-        self._inflight.append((toks, active))
+        self._inflight.append((toks, snapshot))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
-        toks_dev, slot_ids = self._inflight.popleft()
+        toks_dev, snapshot = self._inflight.popleft()
         t0 = time.monotonic()
         toks = np.asarray(toks_dev)  # [K, S] — sync point
         self._m_step.observe((time.monotonic() - t0) * 1000)
         for k in range(toks.shape[0]):
-            for s in slot_ids:
-                req = self._running.get(s)
-                if req is None or req.finished:
-                    continue  # finished earlier in this call; drop token
+            for s, req in snapshot:
+                if req.finished or self._running.get(s) is not req:
+                    # Request ended earlier in this call, or the slot was
+                    # re-admitted to a newer request: drop the token.
+                    continue
                 self._positions[s] += 1
                 self._consume_token(req, int(toks[k, s]))
 

@@ -53,7 +53,27 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
     except Exception:
         limit = None
     dsize = jnp.dtype(dtype).itemsize
-    wbytes = model_cfg.param_count() * (1 if cfg.quantize == "int8" else dsize)
+    if cfg.quantize == "int8":
+        # Only matmul weights quantize (ops/quant.py QUANTIZED_LEAVES);
+        # the embedding, norms and biases stay at the engine dtype, and
+        # every quantized tensor gains a float32 per-output-channel
+        # scale row.
+        m = model_cfg
+        matmul_per_layer = (m.hidden_size * m.q_dim
+                            + 2 * m.hidden_size * m.kv_dim
+                            + m.q_dim * m.hidden_size
+                            + 3 * m.hidden_size * m.intermediate_size)
+        scales_per_layer = (m.q_dim + 2 * m.kv_dim + m.hidden_size
+                            + 2 * m.intermediate_size + m.hidden_size)
+        matmul = m.num_layers * matmul_per_layer
+        scales = m.num_layers * scales_per_layer
+        if not m.tie_embeddings:
+            matmul += m.hidden_size * m.vocab_size
+            scales += m.vocab_size
+        other = m.param_count() - matmul
+        wbytes = matmul + other * dsize + scales * 4
+    else:
+        wbytes = model_cfg.param_count() * dsize
     kv = (model_cfg.num_layers * cfg.decode_slots * cfg.max_model_len
           * model_cfg.num_kv_heads * model_cfg.head_dim * 2 * dsize)
     acct = {
@@ -95,6 +115,13 @@ def build_engine(cfg: Config) -> EngineBase:
         return OllamaRemoteEngine(cfg.ollama_base_url, cfg.model_name,
                                   keep_alive=cfg.ollama_keep_alive,
                                   timeout_s=cfg.ollama_timeout)
+    # Multi-host: bring up the JAX distributed runtime (DCN) before any
+    # device use so meshes can span every host. No-op outside a
+    # configured/pod environment. Lives here (not in the CLI) so bench,
+    # `main.py test`, and library users all inherit it.
+    from fasttalk_tpu.parallel.distributed import maybe_initialize
+
+    maybe_initialize()
     model_cfg = get_model_config(cfg.model_name)
     dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
     acct = check_hbm_budget(model_cfg, cfg, dtype,

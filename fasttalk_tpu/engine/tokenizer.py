@@ -185,47 +185,68 @@ class HFTokenizer:
 class StreamDetokenizer:
     """Incremental detokenization for one stream.
 
-    Emits only complete, stable UTF-8 text: decodes the full generated-id
-    list and diffs against what was already emitted, holding back while
-    the decoded text ends in a replacement char (split multi-byte/
-    multi-token glyph).
+    Emits only complete, stable UTF-8 text, holding back while the
+    decoded tail ends in a replacement char (split multi-byte/multi-token
+    glyph). Decodes only the ids since the last stable emit — per-token
+    cost is O(window), not O(tokens generated so far); the naive
+    decode-everything-each-push is quadratic per request and becomes a
+    real host-side cost at >1k streamed tok/s.
     """
 
     # A legal UTF-8 glyph spans at most 4 bytes / a few tokens; past that,
     # a trailing replacement char is genuinely invalid output and must be
     # emitted rather than held back forever.
     MAX_HOLDBACK_TOKENS = 4
+    # Stable ids kept as decode context so tokenizers whose decoders are
+    # position-sensitive (e.g. Metaspace stripping the leading space at
+    # sequence start) join window text exactly as a full decode would.
+    PREFIX_CONTEXT = 4
 
     def __init__(self, tokenizer: Tokenizer):
         self._tok = tokenizer
-        self._ids: list[int] = []
-        self._emitted = 0
-        self._held_since = 0
+        self._prefix: list[int] = []   # stable context ids
+        self._window: list[int] = []   # ids not yet emitted as stable text
+        self._emitted_text: list[str] = []
+        self._count = 0
+
+    def _pending(self) -> tuple[str, str]:
+        """(decoded context, decoded context+window)."""
+        prev = self._tok.decode(self._prefix) if self._prefix else ""
+        full = self._tok.decode(self._prefix + self._window)
+        return prev, full
 
     def push(self, token_id: int) -> str:
-        self._ids.append(token_id)
-        text = self._tok.decode(self._ids)
-        if text.endswith("�") and \
-                len(self._ids) - self._held_since <= self.MAX_HOLDBACK_TOKENS:
+        self._window.append(token_id)
+        self._count += 1
+        prev, full = self._pending()
+        if full.endswith("�") and \
+                len(self._window) <= self.MAX_HOLDBACK_TOKENS:
             return ""
-        delta = text[self._emitted:]
-        self._emitted = len(text)
-        self._held_since = len(self._ids)
+        delta = full[len(prev):] if len(full) > len(prev) else ""
+        self._prefix = (self._prefix + self._window)[-self.PREFIX_CONTEXT:]
+        self._window.clear()
+        if delta:
+            self._emitted_text.append(delta)
         return delta
 
     def flush(self) -> str:
-        text = self._tok.decode(self._ids)
-        delta = text[self._emitted:]
-        self._emitted = len(text)
+        prev, full = self._pending()
+        delta = full[len(prev):] if len(full) > len(prev) else ""
+        self._prefix = (self._prefix + self._window)[-self.PREFIX_CONTEXT:]
+        self._window.clear()
+        if delta:
+            self._emitted_text.append(delta)
         return delta
 
     @property
     def text(self) -> str:
-        return self._tok.decode(self._ids)
+        prev, full = self._pending()
+        pending = full[len(prev):] if len(full) > len(prev) else ""
+        return "".join(self._emitted_text) + pending
 
     @property
     def token_count(self) -> int:
-        return len(self._ids)
+        return self._count
 
 
 def find_tokenizer_file(model_path: str, model_name: str) -> str | None:

@@ -175,7 +175,7 @@ class Config:
     dp_size: int = field(default_factory=lambda: _env_int("TPU_DP_SIZE", 1))
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
     use_pallas_attention: bool = field(
-        default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", False))
+        default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", True))
     # Tokens decoded per device call (lax.scan inside one jitted step) and
     # number of calls kept in flight. Together these amortise and overlap
     # per-call host/dispatch latency — the dominant cost when the chip is

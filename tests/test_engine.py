@@ -484,3 +484,81 @@ def test_quantizing_put_places_int8_before_device():
         assert events[-1]["type"] == "done"
     finally:
         eng.shutdown()
+
+
+def test_long_prompt_interleaved_with_decode(engine):
+    """A prompt longer than prefill_chunk (64) prefills chunk-by-chunk
+    interleaved with a concurrently decoding session; both complete."""
+    async def run_all():
+        async def short():
+            out = []
+            async for ev in engine.generate(
+                    "il-s", "il-ss", [{"role": "user", "content": "short"}],
+                    GenerationParams(max_tokens=12, **GREEDY)):
+                out.append(ev)
+            return out
+
+        async def long():
+            text = "long prompt " * 14  # ~168 bytes > chunk of 64
+            out = []
+            async for ev in engine.generate(
+                    "il-l", "il-ls", [{"role": "user", "content": text}],
+                    GenerationParams(max_tokens=4, **GREEDY)):
+                out.append(ev)
+            return out
+
+        return await asyncio.gather(short(), long())
+
+    short_ev, long_ev = asyncio.run(run_all())
+    assert short_ev[-1]["type"] == "done"
+    assert long_ev[-1]["type"] == "done"
+    assert long_ev[-1]["stats"]["prompt_tokens"] > 64
+
+
+def test_cancel_during_long_prefill(engine):
+    """Cancel arriving while a long prompt is mid-prefill must terminate
+    the request promptly with a cancelled event."""
+    async def run():
+        text = "cancel mid prefill " * 12
+        agen = engine.generate(
+            "cp1", "cps1", [{"role": "user", "content": text}],
+            GenerationParams(max_tokens=50, **GREEDY))
+        task = asyncio.ensure_future(agen.__anext__())
+        await asyncio.sleep(0.01)
+        engine.cancel("cp1")
+        events = []
+        try:
+            events.append(await task)
+            async for ev in agen:
+                events.append(ev)
+        except StopAsyncIteration:
+            pass
+        return events
+
+    events = asyncio.run(run())
+    assert events, "no events received"
+    assert events[-1]["type"] in ("cancelled", "done")
+
+
+def test_stream_detokenizer_incremental_equals_full_decode():
+    """Windowed incremental decode must reproduce the full decode exactly,
+    including multi-byte glyphs crossing emit boundaries."""
+    import random
+
+    tok = ByteTokenizer()
+    text = "héllo wörld — 你好世界 🎉 plain ascii tail"
+    ids = tok.encode(text)
+    rng = random.Random(0)
+    for _ in range(5):
+        detok = StreamDetokenizer(tok)
+        out = []
+        i = 0
+        while i < len(ids):
+            step = rng.randint(1, 3)
+            for t in ids[i:i + step]:
+                out.append(detok.push(t))
+            i += step
+        out.append(detok.flush())
+        assert "".join(out) == text
+        assert detok.token_count == len(ids)
+        assert detok.text == text
