@@ -146,6 +146,10 @@ class EngineBase:
     def shutdown(self) -> None:
         raise NotImplementedError
 
+    def warmup(self, level: str = "off") -> None:
+        """Pre-compile hot shapes before serving traffic (no-op by
+        default; the TPU engine overrides)."""
+
 
 class TPUEngine(EngineBase):
     """The real engine. Owns params, KV cache, tokenizer, decode loop."""
@@ -277,6 +281,75 @@ class TPUEngine(EngineBase):
         self._commands.put(("stop", None))
         self._stopped.wait(timeout=30)
         self._started = False
+
+    def warmup(self, level: str = "fast") -> None:
+        """Compile hot shapes before serving traffic, so the first users
+        never pay the 20-40s XLA compile (the reference's analogue was
+        the engine container's multi-minute cold start behind a 300s
+        health start_period, docker-compose.vllm.yml:62-67).
+
+        Must run before ``start()`` (single-threaded device access).
+        ``fast`` compiles the common chat shapes (~4 executables): the
+        first decode KV bucket, batched prefill at the typical prompt
+        bucket and the configured chunk for group sizes {1, num_slots}.
+        ``full`` adds every decode KV bucket up to max_len, every
+        prefill bucket, and the single-slot long-prompt path. Warmup
+        calls mask their writes (or, for the single-slot path, write
+        into a slot region no session has claimed yet), so no later
+        request can observe warmup garbage.
+        """
+        if level in ("off", "", "none"):
+            return
+        if self._started:
+            raise RuntimeError("warmup() must be called before start()")
+        t0 = time.monotonic()
+        kv_buckets = [b for b in _KV_BUCKETS if b <= self.max_len] \
+            or [self.max_len]
+        pbuckets = [b for b in _PREFILL_BUCKETS if b <= self.prefill_chunk]
+        if level != "full":
+            common = 64 if 64 in pbuckets else pbuckets[0]
+            pbuckets = sorted({common, pbuckets[-1]})
+        decode_buckets = kv_buckets if level == "full" else kv_buckets[:1]
+
+        inactive = self._put(np.zeros((self.num_slots,), bool))
+        for b in decode_buckets:
+            fn = self._get_decode_fn(b)
+            self.cache, toks, _, _, _ = fn(
+                self.params, self.cache, self._cur_tokens,
+                self._positions_dev, inactive, self._temps_dev,
+                self._topks_dev, self._topps_dev, self._rng_dev)
+            jax.block_until_ready(toks)
+
+        ctx = kv_buckets[0]
+        for b in pbuckets:
+            for gp in sorted({1, self.num_slots}):
+                fn = self._get_batched_prefill_fn(b, gp, ctx)
+                # All rows masked + out-of-range scatter: no cache writes.
+                self.cache, last = fn(
+                    self.params, self.cache,
+                    jnp.zeros((gp, b), jnp.int32),
+                    jnp.zeros((gp,), jnp.int32),
+                    jnp.arange(self.num_slots, self.num_slots + gp,
+                               dtype=jnp.int32),
+                    jnp.zeros((gp,), jnp.int32),
+                    jnp.zeros((gp,), bool))
+                sample_tokens(last, self._next_rng(),
+                              jnp.ones((gp,), jnp.float32),
+                              jnp.full((gp,), 40, jnp.int32),
+                              jnp.full((gp,), 0.9, jnp.float32))
+            if level == "full":
+                # Single-slot long-prompt path: writes land in slot 0's
+                # region, unclaimed at warmup time (kv_written stays 0,
+                # so nothing ever trusts them).
+                fn = self._get_prefill_fn(b)
+                self.cache, _ = fn(self.params, self.cache,
+                                   jnp.zeros((b,), jnp.int32),
+                                   jnp.int32(0), jnp.int32(0),
+                                   jnp.int32(b - 1))
+        jax.block_until_ready(self.cache.k)
+        log.info(f"warmup({level}) compiled "
+                 f"{len(self._decode_fns) + len(self._prefill_fns)} "
+                 f"executables in {time.monotonic() - t0:.1f}s")
 
     async def generate(self, request_id: str, session_id: str,
                        messages: list[dict], params: GenerationParams,
@@ -620,17 +693,19 @@ class TPUEngine(EngineBase):
 
     def _advance_prefill(self) -> None:
         """Run ONE chunk of the oldest in-progress long prefill."""
-        while self._prefilling:
-            st = self._prefilling[0]
+        # Sweep the WHOLE queue for cancelled/finished entries — a
+        # cancel must free its reserved slot and emit its terminal event
+        # immediately, not after every earlier long prefill completes.
+        keep: list[_PrefillState] = []
+        for st in self._prefilling:
             if st.req.finished:
-                self._prefilling.pop(0)
                 continue
             if st.req.cancelled:
-                self._prefilling.pop(0)
                 self._finish(st.req, "cancelled")
                 continue
-            break
-        else:
+            keep.append(st)
+        self._prefilling = keep
+        if not self._prefilling:
             return
         st = self._prefilling[0]
         req, slot = st.req, st.slot

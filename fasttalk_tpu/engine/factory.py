@@ -15,7 +15,7 @@ from fasttalk_tpu.engine.engine import EngineBase, TPUEngine
 from fasttalk_tpu.engine.fake import FakeEngine
 from fasttalk_tpu.engine.tokenizer import load_tokenizer
 from fasttalk_tpu.models.configs import get_model_config
-from fasttalk_tpu.models.loader import load_or_init
+from fasttalk_tpu.models.loader import find_checkpoint_dir, load_params
 from fasttalk_tpu.utils.config import Config
 from fasttalk_tpu.utils.logger import get_logger
 
@@ -151,9 +151,24 @@ def build_engine(cfg: Config) -> EngineBase:
         # Quantize host-side, tensor by tensor, before placement: device
         # HBM peaks at int8 bytes, not the transient bf16 copy.
         put = quantizing_put(put, raw_put)
-    params, loaded = load_or_init(model_cfg, cfg.model_path, dtype, put=put)
-    if cfg.quantize == "int8":
-        log.info("Quantized matmul weights to int8 (per-channel symmetric)")
+
+    ckpt = find_checkpoint_dir(cfg.model_path, model_cfg.name) \
+        if cfg.model_path else None
+    if ckpt:
+        params, loaded = load_params(model_cfg, ckpt, dtype, put), True
+        if cfg.quantize == "int8":
+            log.info("Quantized matmul weights to int8 "
+                     "(per-channel symmetric, host-side per tensor)")
+    else:
+        # No checkpoint: random init directly on the device(s) — zero
+        # host->device weight transfer (models/loader.py).
+        from fasttalk_tpu.models.loader import init_params_device
+
+        log.warning(f"No checkpoint for {model_cfg.name!r} under "
+                    f"{cfg.model_path!r}; using random-initialised weights")
+        params, loaded = init_params_device(
+            model_cfg, dtype, mesh=mesh,
+            quantize=cfg.quantize == "int8"), False
     tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
                                cfg.tokenizer_path,
                                template=model_cfg.chat_template)

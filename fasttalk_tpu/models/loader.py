@@ -136,6 +136,64 @@ def load_params(cfg: ModelConfig, ckpt_dir: str,
     return params
 
 
+def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
+                       mesh=None, quantize: bool = False,
+                       seed: int = 0) -> Params:
+    """Architecture-faithful random init generated ON the device(s),
+    leaf by leaf — zero host->device weight transfer, which matters both
+    for multi-chip placement (each leaf materialises directly in its TP
+    shards) and for weight-free benchmarking over a slow host link
+    (host-initialising an 8B model ships gigabytes through the relay;
+    this ships RNG keys). ``quantize`` int8-quantizes matmul leaves in
+    place, so peak HBM is the int8 model plus one bf16 leaf.
+    """
+    shapes = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(seed), dtype))
+
+    def gen(path, sds):
+        name = str(getattr(path[-1], "key", path[-1]))
+        shape = sds.shape
+
+        def init_leaf(key):
+            if "norm" in name:
+                return jnp.ones(shape, dtype)
+            if name in ("bq", "bk", "bv"):
+                return jnp.zeros(shape, dtype)
+            fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * fan_in ** -0.5).astype(dtype)
+
+        sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from fasttalk_tpu.parallel.sharding import _parent_name, _spec_for
+            sharding = NamedSharding(
+                mesh, _spec_for(name, len(shape), shape,
+                                parent=_parent_name(path)))
+        # crc32, not hash(): Python's hash is salted per process, which
+        # would give each host of a multi-host slice different weights
+        # for the same leaf (and break same-seed reproducibility).
+        import zlib
+
+        full = "/".join(str(getattr(k, "key", k)) for k in path)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                 zlib.crc32(full.encode()) & 0x7FFFFFFF)
+        leaf = jax.jit(init_leaf, out_shardings=sharding)(key)
+        if quantize:
+            from fasttalk_tpu.ops.quant import (QUANTIZED_LEAVES,
+                                                _quantize_leaf)
+            if name in QUANTIZED_LEAVES:
+                return _quantize_leaf(leaf)  # donates the bf16 leaf
+        return leaf
+
+    params = jax.tree_util.tree_map_with_path(gen, shapes)
+    log.info(f"Random-initialised {cfg.name} on device "
+             f"({'int8' if quantize else jnp.dtype(dtype).name}"
+             f"{', sharded' if mesh is not None else ''})")
+    return params
+
+
 def load_or_init(cfg: ModelConfig, model_path: str,
                  dtype: jnp.dtype = jnp.bfloat16,
                  put: Callable[[np.ndarray, str], jax.Array] | None = None,
@@ -151,10 +209,7 @@ def load_or_init(cfg: ModelConfig, model_path: str,
     log.warning(
         f"No checkpoint for {cfg.name!r} under {model_path!r}; "
         "using random-initialised weights")
-    params = init_params(cfg, jax.random.PRNGKey(seed), dtype)
-    if put is not None:
-        params = jax.tree_util.tree_map_with_path(
-            lambda path, a: put(np.asarray(a),
-                                "/".join(str(getattr(k, "key", k)) for k in path)),
-            params)
-    return params, False
+    # Random init ignores ``put``: sharded/quantized random init goes
+    # through init_params_device (no host->device weight transfer),
+    # which is what engine/factory.py uses.
+    return init_params(cfg, jax.random.PRNGKey(seed), dtype), False

@@ -48,6 +48,7 @@ class ServerLauncher:
     def verify_backend(self) -> None:
         """Pre-flight: refuse to serve if the engine isn't healthy
         (reference: websocket_launcher.py:104-105 hard-exits here)."""
+        self.engine.warmup(self.config.warmup)
         self.engine.start()
         if not self.engine.check_connection():
             raise LLMServiceError("Engine failed pre-flight check")

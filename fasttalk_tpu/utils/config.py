@@ -188,6 +188,10 @@ class Config:
     # symmetric, in-tree replacement for the reference's external AWQ
     # engine config, .env.vllm.example:21).
     quantize: str = field(default_factory=lambda: _env_str("TPU_QUANTIZE", "none"))
+    # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
+    # in-tree replacement for the reference's 300s engine-container
+    # health start_period (docker-compose.vllm.yml:62-67).
+    warmup: str = field(default_factory=lambda: _env_str("TPU_WARMUP", "off"))
 
     def __post_init__(self) -> None:
         self._validate()
@@ -224,6 +228,8 @@ class Config:
             errs.append("pipeline_depth must be >= 1")
         if self.quantize not in ("none", "int8"):
             errs.append("quantize must be 'none' or 'int8'")
+        if self.warmup not in ("off", "fast", "full"):
+            errs.append("warmup must be 'off', 'fast' or 'full'")
         if self.default_context_window < self.default_max_tokens:
             # Reference warns here (config.py:184-187); we keep it a warning.
             pass

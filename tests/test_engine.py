@@ -447,22 +447,40 @@ def test_hbm_budget_counts_dp_weight_replication(monkeypatch):
         check_hbm_budget(big, cfg, jnp.bfloat16, n_devices=4)
 
 
-def test_quantizing_put_places_int8_before_device():
-    """Factory int8 path: weights quantize host-side per tensor; the
-    device never sees the bf16 copy, and the engine decodes fine."""
+def test_quantizing_put_places_int8_before_device(tmp_path):
+    """Factory int8 checkpoint path: weights quantize host-side per
+    tensor as they stream off disk; the device never sees the bf16 copy,
+    and the engine decodes fine."""
     import asyncio
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
+    import torch
+    from safetensors.torch import save_file
+    from transformers import LlamaConfig, LlamaForCausalLM
 
-    from fasttalk_tpu.models.loader import load_or_init
+    from fasttalk_tpu.models.loader import load_params
     from fasttalk_tpu.ops.quant import is_quantized, quantizing_put
+
+    hf_cfg = LlamaConfig(
+        vocab_size=TINY.vocab_size, hidden_size=TINY.hidden_size,
+        intermediate_size=TINY.intermediate_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        num_key_value_heads=TINY.num_kv_heads,
+        head_dim=TINY.head_dim, tie_word_embeddings=True,
+    )
+    torch.manual_seed(3)
+    model = LlamaForCausalLM(hf_cfg)
+    ckpt = tmp_path / "q"
+    ckpt.mkdir()
+    save_file({k: v.contiguous() for k, v in model.state_dict().items()
+               if k != "lm_head.weight"}, str(ckpt / "model.safetensors"))
 
     inner = lambda arr, path: jax.device_put(jnp.asarray(arr, jnp.bfloat16))
     raw = lambda arr, path: jax.device_put(jnp.asarray(arr))
-    params, loaded = load_or_init(TINY, "", put=quantizing_put(inner, raw))
-    assert not loaded
+    params = load_params(TINY, str(ckpt),
+                         put=quantizing_put(inner, raw))
     assert is_quantized(params)
     assert params["layers"]["wq"]["q"].dtype == jnp.int8
     assert params["layers"]["wq"]["s"].dtype == jnp.float32
@@ -540,6 +558,47 @@ def test_cancel_during_long_prefill(engine):
     assert events[-1]["type"] in ("cancelled", "done")
 
 
+def test_cancel_of_queued_long_prefill_is_prompt(engine):
+    """Cancelling a long prefill that is NOT at the head of the prefill
+    queue must still terminate promptly and release its reserved slot
+    (not wait for every earlier long prefill to finish)."""
+    async def run():
+        t1 = "first long prompt " * 12
+        t2 = "second long prompt " * 12
+        a = engine.generate("qc1", "qcs1",
+                            [{"role": "user", "content": t1}],
+                            GenerationParams(max_tokens=30, **GREEDY))
+        b = engine.generate("qc2", "qcs2",
+                            [{"role": "user", "content": t2}],
+                            GenerationParams(max_tokens=30, **GREEDY))
+        ta = asyncio.ensure_future(a.__anext__())
+        tb = asyncio.ensure_future(b.__anext__())
+        await asyncio.sleep(0.01)
+        engine.cancel("qc2")  # b is behind a in the prefill queue
+        import time
+        t0 = time.monotonic()
+        events_b = []
+        try:
+            events_b.append(await tb)
+            async for ev in b:
+                events_b.append(ev)
+        except StopAsyncIteration:
+            pass
+        cancelled_latency = time.monotonic() - t0
+        # drain a as well
+        try:
+            await ta
+            async for _ in a:
+                pass
+        except StopAsyncIteration:
+            pass
+        return events_b, cancelled_latency
+
+    events_b, latency = asyncio.run(run())
+    assert events_b[-1]["type"] in ("cancelled", "done")
+    assert latency < 5.0
+
+
 def test_stream_detokenizer_incremental_equals_full_decode():
     """Windowed incremental decode must reproduce the full decode exactly,
     including multi-byte glyphs crossing emit boundaries."""
@@ -562,3 +621,39 @@ def test_stream_detokenizer_incremental_equals_full_decode():
         assert "".join(out) == text
         assert detok.token_count == len(ids)
         assert detok.text == text
+
+
+def test_warmup_compiles_and_serves():
+    """fast warmup pre-compiles; generation afterwards works and the KV
+    cache semantics are unaffected."""
+    import jax
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                    max_len=128, prefill_chunk=32, steps_per_call=4)
+    eng.warmup("fast")
+    n = len(eng._decode_fns) + len(eng._prefill_fns)
+    assert n >= 3  # decode bucket + batched prefill {1, num_slots}
+    eng.start()
+    try:
+        events = _collect(eng, "w1", "ws1",
+                          [{"role": "user", "content": "warm"}],
+                          GenerationParams(max_tokens=5, **GREEDY))
+        assert events[-1]["type"] == "done"
+    finally:
+        eng.shutdown()
+
+
+def test_warmup_after_start_rejected():
+    import jax
+    import pytest
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                    max_len=128, prefill_chunk=32)
+    eng.start()
+    try:
+        with pytest.raises(RuntimeError, match="before start"):
+            eng.warmup("fast")
+    finally:
+        eng.shutdown()

@@ -215,21 +215,23 @@ def test_validate_mesh_named_errors():
         validate_mesh(mesh, num_slots=3, **kw)
 
 
-def test_param_put_loads_directly_sharded():
-    """The loader's put hook places weights straight into TP shards."""
-    from fasttalk_tpu.models.loader import load_or_init
-    from fasttalk_tpu.parallel.sharding import param_put
+def test_random_init_materialises_directly_sharded():
+    """Sharded random init places weights straight into TP shards
+    (factory path: models/loader.py init_params_device)."""
+    from fasttalk_tpu.models.loader import init_params_device
 
     cfg = get_model_config("test-tiny")
     mesh = make_mesh(tp=2)
-    params, loaded = load_or_init(cfg, "/nonexistent", jnp.float32,
-                                  put=param_put(mesh))
-    assert not loaded  # random init path
+    params = init_params_device(cfg, jnp.float32, mesh=mesh)
     wq = params["layers"]["wq"]
     assert wq.sharding.spec == jax.sharding.PartitionSpec(None, None, "tp")
     # Each device holds only its slice of the column-parallel weight.
     shard = wq.addressable_shards[0]
     assert shard.data.shape[-1] == wq.shape[-1] // 2
+    # Deterministic across calls (crc32 path keys, not salted hash()).
+    again = init_params_device(cfg, jnp.float32, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(wq),
+                                  np.asarray(again["layers"]["wq"]))
 
 
 def test_param_put_casts_to_engine_dtype():
@@ -378,3 +380,43 @@ def test_distributed_init_noop_without_config(monkeypatch):
     info = distributed.process_info()
     assert info["process_count"] == 1
     assert info["initialized"] is False
+
+
+def test_init_params_device_sharded_quantized():
+    """Device-side random init: leaves materialise directly in their TP
+    shards, matmul leaves int8-quantized, no host round-trip."""
+    from fasttalk_tpu.models.loader import init_params_device
+    from fasttalk_tpu.ops.quant import is_quantized
+
+    cfg = get_model_config("test-small")
+    mesh = make_mesh(tp=4)
+    params = init_params_device(cfg, jnp.bfloat16, mesh=mesh, quantize=True)
+    assert is_quantized(params)
+    assert params["layers"]["wq"]["q"].dtype == jnp.int8
+    assert "tp" in str(params["layers"]["wq"]["q"].sharding.spec)
+    assert params["layers"]["attn_norm"].dtype == jnp.bfloat16
+
+    # And the engine can decode with it.
+    import asyncio
+
+    from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+    from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+
+    eng = TPUEngine(cfg, params, ByteTokenizer(), num_slots=2,
+                    max_len=128, prefill_chunk=32, mesh=mesh,
+                    steps_per_call=4)
+    eng.start()
+    try:
+        async def run():
+            out = []
+            async for ev in eng.generate(
+                    "di1", "dis1", [{"role": "user", "content": "hi"}],
+                    GenerationParams(max_tokens=4, temperature=0.0,
+                                     top_k=0, top_p=1.0)):
+                out.append(ev)
+            return out
+
+        events = asyncio.run(run())
+        assert events[-1]["type"] == "done"
+    finally:
+        eng.shutdown()
