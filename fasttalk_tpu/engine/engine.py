@@ -305,7 +305,10 @@ class TPUEngine(EngineBase):
         t0 = time.monotonic()
         kv_buckets = [b for b in _KV_BUCKETS if b <= self.max_len] \
             or [self.max_len]
-        pbuckets = [b for b in _PREFILL_BUCKETS if b <= self.prefill_chunk]
+        # Serving picks buckets from _PREFILL_BUCKETS with b >= chunk, so
+        # a sub-16 prefill_chunk still lands on the smallest bucket.
+        pbuckets = [b for b in _PREFILL_BUCKETS
+                    if b <= self.prefill_chunk] or [_PREFILL_BUCKETS[0]]
         if level != "full":
             common = 64 if 64 in pbuckets else pbuckets[0]
             pbuckets = sorted({common, pbuckets[-1]})
@@ -320,8 +323,10 @@ class TPUEngine(EngineBase):
                 self._topks_dev, self._topps_dev, self._rng_dev)
             jax.block_until_ready(toks)
 
-        ctx = kv_buckets[0]
         for b in pbuckets:
+            # Must match the ctx _prefill_group derives for a fresh
+            # session (starts=0): the smallest KV bucket covering b.
+            ctx = next((k for k in kv_buckets if k >= b), self.max_len)
             for gp in sorted({1, self.num_slots}):
                 fn = self._get_batched_prefill_fn(b, gp, ctx)
                 # All rows masked + out-of-range scatter: no cache writes.

@@ -155,10 +155,25 @@ def build_engine(cfg: Config) -> EngineBase:
     ckpt = find_checkpoint_dir(cfg.model_path, model_cfg.name) \
         if cfg.model_path else None
     if ckpt:
-        params, loaded = load_params(model_cfg, ckpt, dtype, put), True
-        if cfg.quantize == "int8":
-            log.info("Quantized matmul weights to int8 "
-                     "(per-channel symmetric, host-side per tensor)")
+        from fasttalk_tpu.models.prepared_cache import (cache_meta,
+                                                        load_prepared,
+                                                        save_prepared)
+
+        quant = cfg.quantize == "int8"
+        params = load_prepared(model_cfg, cfg.model_path, dtype, quant,
+                               mesh, ckpt_dir=ckpt)
+        loaded = True
+        if params is None:
+            params = load_params(model_cfg, ckpt, dtype, put)
+            if quant:
+                log.info("Quantized matmul weights to int8 "
+                         "(per-channel symmetric, host-side per tensor)")
+            # Cache the engine-ready pytree so the next restart skips
+            # the whole safetensors->stack->cast->quantize->shard
+            # pipeline (best-effort).
+            save_prepared(params, cfg.model_path,
+                          cache_meta(model_cfg, dtype, quant, mesh,
+                                     ckpt_dir=ckpt))
     else:
         # No checkpoint: random init directly on the device(s) — zero
         # host->device weight transfer (models/loader.py).

@@ -197,9 +197,16 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
 def load_or_init(cfg: ModelConfig, model_path: str,
                  dtype: jnp.dtype = jnp.bfloat16,
                  put: Callable[[np.ndarray, str], jax.Array] | None = None,
-                 seed: int = 0) -> tuple[Params, bool]:
+                 seed: int = 0, mesh=None,
+                 quantize: bool = False) -> tuple[Params, bool]:
     """Load weights if a checkpoint exists under model_path, else random
     init (architecture-faithful; used for tests and weight-free perf work).
+
+    ``put`` applies to the checkpoint-streaming path. The random path
+    routes through init_params_device when ``mesh``/``quantize`` is
+    given (direct-to-shard, no host->device weight transfer) — a bare
+    ``put`` cannot express those semantics, so passing put without a
+    checkpoint is rejected rather than silently ignored.
 
     Returns (params, loaded_from_checkpoint).
     """
@@ -209,7 +216,12 @@ def load_or_init(cfg: ModelConfig, model_path: str,
     log.warning(
         f"No checkpoint for {cfg.name!r} under {model_path!r}; "
         "using random-initialised weights")
-    # Random init ignores ``put``: sharded/quantized random init goes
-    # through init_params_device (no host->device weight transfer),
-    # which is what engine/factory.py uses.
+    if put is not None:
+        raise ValueError(
+            "load_or_init: no checkpoint found and `put` cannot drive "
+            "random init — pass mesh=/quantize= (routed through "
+            "init_params_device) instead")
+    if mesh is not None or quantize:
+        return init_params_device(cfg, dtype, mesh=mesh,
+                                  quantize=quantize, seed=seed), False
     return init_params(cfg, jax.random.PRNGKey(seed), dtype), False

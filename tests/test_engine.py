@@ -447,6 +447,7 @@ def test_hbm_budget_counts_dp_weight_replication(monkeypatch):
         check_hbm_budget(big, cfg, jnp.bfloat16, n_devices=4)
 
 
+@pytest.mark.slow
 def test_quantizing_put_places_int8_before_device(tmp_path):
     """Factory int8 checkpoint path: weights quantize host-side per
     tensor as they stream off disk; the device never sees the bf16 copy,

@@ -372,3 +372,83 @@ class TestQuantization:
             assert events[-1]["stats"]["tokens_generated"] > 0
         finally:
             eng.shutdown()
+
+
+@pytest.mark.slow
+class TestPreparedCache:
+    def _make_ckpt(self, tmp_path):
+        import torch
+        from safetensors.torch import save_file
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        hf_cfg = LlamaConfig(
+            vocab_size=TINY.vocab_size, hidden_size=TINY.hidden_size,
+            intermediate_size=TINY.intermediate_size,
+            num_hidden_layers=TINY.num_layers,
+            num_attention_heads=TINY.num_heads,
+            num_key_value_heads=TINY.num_kv_heads,
+            head_dim=TINY.head_dim, tie_word_embeddings=True,
+        )
+        torch.manual_seed(5)
+        model = LlamaForCausalLM(hf_cfg)
+        save_file({k: v.contiguous() for k, v in model.state_dict().items()
+                   if k != "lm_head.weight"},
+                  str(tmp_path / "model.safetensors"))
+
+    def test_roundtrip_plain(self, tmp_path):
+        from fasttalk_tpu.models.loader import load_params
+        from fasttalk_tpu.models.prepared_cache import (cache_meta,
+                                                        load_prepared,
+                                                        save_prepared)
+
+        self._make_ckpt(tmp_path)
+        params = load_params(TINY, str(tmp_path), dtype=jnp.float32)
+        meta = cache_meta(TINY, jnp.float32, False, None)
+        assert save_prepared(params, str(tmp_path), meta) is not None
+
+        restored = load_prepared(TINY, str(tmp_path), jnp.float32,
+                                 False, None)
+        assert restored is not None
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_roundtrip_quantized(self, tmp_path):
+        import jax as _jax
+
+        from fasttalk_tpu.models.loader import load_params
+        from fasttalk_tpu.models.prepared_cache import (cache_meta,
+                                                        load_prepared,
+                                                        save_prepared)
+        from fasttalk_tpu.ops.quant import is_quantized, quantizing_put
+
+        self._make_ckpt(tmp_path)
+        inner = lambda arr, path: _jax.device_put(
+            jnp.asarray(arr, jnp.bfloat16))
+        raw = lambda arr, path: _jax.device_put(jnp.asarray(arr))
+        params = load_params(TINY, str(tmp_path),
+                             put=quantizing_put(inner, raw))
+        meta = cache_meta(TINY, jnp.bfloat16, True, None)
+        save_prepared(params, str(tmp_path), meta)
+
+        restored = load_prepared(TINY, str(tmp_path), jnp.bfloat16,
+                                 True, None)
+        assert restored is not None
+        assert is_quantized(restored)
+        assert restored["layers"]["wq"]["q"].dtype == jnp.int8
+        np.testing.assert_array_equal(
+            np.asarray(params["layers"]["wq"]["q"]),
+            np.asarray(restored["layers"]["wq"]["q"]))
+
+    def test_mismatched_meta_ignored(self, tmp_path):
+        from fasttalk_tpu.models.loader import load_params
+        from fasttalk_tpu.models.prepared_cache import (cache_meta,
+                                                        load_prepared,
+                                                        save_prepared)
+
+        self._make_ckpt(tmp_path)
+        params = load_params(TINY, str(tmp_path), dtype=jnp.float32)
+        meta = cache_meta(TINY, jnp.float32, False, None)
+        save_prepared(params, str(tmp_path), meta)
+        # Different dtype keys a different dir -> no hit.
+        assert load_prepared(TINY, str(tmp_path), jnp.bfloat16,
+                             False, None) is None

@@ -420,3 +420,30 @@ def test_init_params_device_sharded_quantized():
         assert events[-1]["type"] == "done"
     finally:
         eng.shutdown()
+
+
+def test_prepared_cache_roundtrip_sharded():
+    """Prepared-weight cache restores straight into TP shards."""
+    import tempfile
+
+    from fasttalk_tpu.models.loader import init_params_device
+    from fasttalk_tpu.models.prepared_cache import (cache_meta,
+                                                    load_prepared,
+                                                    save_prepared)
+
+    cfg = get_model_config("test-tiny")
+    mesh = make_mesh(tp=2)
+    params = init_params_device(cfg, jnp.float32, mesh=mesh, quantize=True)
+    d = tempfile.mkdtemp()
+    meta = cache_meta(cfg, jnp.float32, True, mesh)
+    assert save_prepared(params, d, meta) is not None
+
+    restored = load_prepared(cfg, d, jnp.float32, True, mesh)
+    assert restored is not None
+    wq = restored["layers"]["wq"]["q"]
+    assert wq.dtype == jnp.int8
+    assert "tp" in str(wq.sharding.spec)
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"]["wq"]["q"]), np.asarray(wq))
+    # mesh-shape mismatch is ignored
+    assert load_prepared(cfg, d, jnp.float32, True, make_mesh(tp=4)) is None
