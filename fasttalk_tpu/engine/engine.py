@@ -330,18 +330,19 @@ class TPUEngine(EngineBase):
             for gp in sorted({1, self.num_slots}):
                 fn = self._get_batched_prefill_fn(b, gp, ctx)
                 # All rows masked + out-of-range scatter: no cache writes.
-                self.cache, last = fn(
+                self.cache, firsts, self._rng_dev = fn(
                     self.params, self.cache,
                     jnp.zeros((gp, b), jnp.int32),
                     jnp.zeros((gp,), jnp.int32),
                     jnp.arange(self.num_slots, self.num_slots + gp,
                                dtype=jnp.int32),
                     jnp.zeros((gp,), jnp.int32),
-                    jnp.zeros((gp,), bool))
-                sample_tokens(last, self._next_rng(),
-                              jnp.ones((gp,), jnp.float32),
-                              jnp.full((gp,), 40, jnp.int32),
-                              jnp.full((gp,), 0.9, jnp.float32))
+                    jnp.zeros((gp,), bool),
+                    self._put(np.ones((gp,), np.float32)),
+                    self._put(np.full((gp,), 40, np.int32)),
+                    self._put(np.full((gp,), 0.9, np.float32)),
+                    self._rng_dev)
+                jax.block_until_ready(firsts)
             if level == "full":
                 # Single-slot long-prompt path: writes land in slot 0's
                 # region, unclaimed at warmup time (kv_written stays 0,
@@ -351,6 +352,13 @@ class TPUEngine(EngineBase):
                                    jnp.zeros((b,), jnp.int32),
                                    jnp.int32(0), jnp.int32(0),
                                    jnp.int32(b - 1))
+        # The single-slot long-prompt path samples its first token with
+        # the STANDALONE jitted sample_tokens at shape (1, vocab) — a
+        # compile not covered by the fused prefill/decode executables.
+        jax.block_until_ready(sample_tokens(
+            jnp.zeros((1, self.cfg.vocab_size), jnp.float32),
+            self._next_rng(), jnp.ones((1,), jnp.float32),
+            jnp.full((1,), 40, jnp.int32), jnp.full((1,), 0.9, jnp.float32)))
         jax.block_until_ready(self.cache.k)
         log.info(f"warmup({level}) compiled "
                  f"{len(self._decode_fns) + len(self._prefill_fns)} "
@@ -537,7 +545,8 @@ class TPUEngine(EngineBase):
 
         @partial(jax.jit, donate_argnums=(1,))
         def batched_prefill(params, cache: KVCache, tokens, starts,
-                            slot_idx, last_idx, mask):
+                            slot_idx, last_idx, mask, temps, topks, topps,
+                            rng):
             gk = cache.k[:, slot_idx, :ctx]  # [L, group, ctx, Kv, H]
             gv = cache.v[:, slot_idx, :ctx]
             positions = starts[:, None] + jnp.arange(chunk)[None, :]
@@ -550,7 +559,11 @@ class TPUEngine(EngineBase):
                 upd.v, mode="drop", unique_indices=True)
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1)[:, 0]
-            return KVCache(new_k, new_v), last
+            # First-token sampling fused into the same call: one device
+            # round-trip per burst instead of two (TTFT-critical).
+            rng, sub = jax.random.split(rng)
+            firsts = sample_tokens(last, sub, temps, topks, topps)
+            return KVCache(new_k, new_v), firsts, rng
 
         self._prefill_fns[key] = batched_prefill
         return batched_prefill
@@ -820,13 +833,13 @@ class TPUEngine(EngineBase):
         ctx = next((b for b in _KV_BUCKETS
                     if b >= need and b <= self.max_len), self.max_len)
         fn = self._get_batched_prefill_fn(bucket, gp, ctx)
-        self.cache, last_logits = fn(
+        self.cache, firsts_dev, self._rng_dev = fn(
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(starts), jnp.asarray(slot_idx),
-            jnp.asarray(last_idx), jnp.asarray(mask))
-        firsts = np.asarray(sample_tokens(
-            last_logits, self._next_rng(), jnp.asarray(temps),
-            jnp.asarray(topks), jnp.asarray(topps)))  # one sync
+            jnp.asarray(last_idx), jnp.asarray(mask),
+            self._put(temps), self._put(topks), self._put(topps),
+            self._rng_dev)
+        firsts = np.asarray(firsts_dev)  # one sync for the whole burst
         for j, (req, slot, start, todo) in enumerate(sub):
             slot.tokens.extend(todo)
             slot.kv_written = start + len(todo)

@@ -105,9 +105,11 @@ def abstract_params(cfg: ModelConfig, dtype, quantize: bool, mesh) -> Any:
     return jax.tree_util.tree_map_with_path(to_abstract, shapes)
 
 
-def save_prepared(params: Any, model_path: str, meta: dict) -> str | None:
+def save_prepared(params: Any, model_path: str, meta: dict,
+                  block: bool = False) -> str | None:
     """Write the engine-ready pytree; best-effort (serving works without
-    it — the cache only accelerates the next restart)."""
+    it — the cache only accelerates the next restart). Serialization
+    finishes on a background thread unless ``block`` (tests)."""
     try:
         import orbax.checkpoint as ocp
 
@@ -119,10 +121,28 @@ def save_prepared(params: Any, model_path: str, meta: dict) -> str | None:
             return None
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.abspath(path), params, force=True)
-        ckptr.wait_until_finished()
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump(meta, f)
-        log.info(f"prepared-weight cache written: {path}")
+
+        # Serialization of a large pytree takes as long as the disk
+        # write; finish it (and only then publish the meta marker that
+        # makes the cache eligible for restore) off the startup path —
+        # the cache only helps the NEXT boot, so this boot must not
+        # block on it.
+        def _finalize() -> None:
+            try:
+                ckptr.wait_until_finished()
+                with open(os.path.join(path, _META), "w") as f:
+                    json.dump(meta, f)
+                log.info(f"prepared-weight cache written: {path}")
+            except Exception as e:  # pragma: no cover - disk races
+                log.warning(f"prepared cache finalize failed: {e}")
+
+        if block:
+            _finalize()
+        else:
+            import threading
+
+            threading.Thread(target=_finalize, name="prepared-cache-save",
+                             daemon=True).start()
         return path
     except Exception as e:
         log.warning(f"prepared cache save failed (continuing): {e}")

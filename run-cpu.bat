@@ -1,0 +1,26 @@
+@echo off
+REM Run FastTalk-TPU on a CPU-only Windows host (development).
+REM Mirror of run-cpu.sh (reference shipped run-cpu.bat the same way).
+cd /d "%~dp0"
+
+if not exist ".venv" (
+    python -m venv .venv
+)
+call .venv\Scripts\activate.bat
+
+python -c "import jax" 2>NUL
+if errorlevel 1 (
+    pip install --quiet --upgrade pip
+    pip install --quiet -e .
+)
+
+if "%OMP_NUM_THREADS%"=="" set OMP_NUM_THREADS=%NUMBER_OF_PROCESSORS%
+set JAX_PLATFORMS=cpu
+set COMPUTE_DEVICE=cpu
+if "%LLM_PROVIDER%"=="" set LLM_PROVIDER=tpu
+if "%LLM_MODEL%"=="" set LLM_MODEL=test-tiny
+if "%TPU_DTYPE%"=="" set TPU_DTYPE=float32
+if "%TPU_DECODE_SLOTS%"=="" set TPU_DECODE_SLOTS=4
+if "%TPU_MAX_MODEL_LEN%"=="" set TPU_MAX_MODEL_LEN=2048
+
+python main.py websocket %*

@@ -658,3 +658,38 @@ def test_warmup_after_start_rejected():
             eng.warmup("fast")
     finally:
         eng.shutdown()
+
+
+def test_engine_crash_aborts_requests_with_error_events():
+    """If the engine thread dies mid-generation, every outstanding caller
+    gets a terminal error event (no caller hangs forever)."""
+    import jax
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                    max_len=128, prefill_chunk=32, steps_per_call=4)
+    eng.start()
+    # Sabotage the decode path BEFORE any request: prefill succeeds (the
+    # first token streams), then the first decode dispatch raises and
+    # the engine thread must abort all requests and stop cleanly.
+    eng._get_decode_fn = None  # type: ignore[assignment]
+
+    async def run():
+        agen = eng.generate(
+            "crash1", "crashs1",
+            [{"role": "user", "content": "doomed"}],
+            GenerationParams(max_tokens=10_000, temperature=0.9,
+                             top_k=40, top_p=0.9))
+        events = []
+        async for ev in agen:
+            events.append(ev)
+        return events
+
+    events = asyncio.run(run())
+    assert events[-1]["type"] == "error"
+    assert "engine" in events[-1]["error"] or events[-1]["code"] == "internal_error"
+    # Thread exited; engine reports unhealthy.
+    deadline = __import__("time").monotonic() + 5
+    while eng.check_connection() and __import__("time").monotonic() < deadline:
+        __import__("time").sleep(0.05)
+    assert not eng.check_connection()

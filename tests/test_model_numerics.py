@@ -404,7 +404,7 @@ class TestPreparedCache:
         self._make_ckpt(tmp_path)
         params = load_params(TINY, str(tmp_path), dtype=jnp.float32)
         meta = cache_meta(TINY, jnp.float32, False, None)
-        assert save_prepared(params, str(tmp_path), meta) is not None
+        assert save_prepared(params, str(tmp_path), meta, block=True) is not None
 
         restored = load_prepared(TINY, str(tmp_path), jnp.float32,
                                  False, None)
@@ -428,7 +428,7 @@ class TestPreparedCache:
         params = load_params(TINY, str(tmp_path),
                              put=quantizing_put(inner, raw))
         meta = cache_meta(TINY, jnp.bfloat16, True, None)
-        save_prepared(params, str(tmp_path), meta)
+        save_prepared(params, str(tmp_path), meta, block=True)
 
         restored = load_prepared(TINY, str(tmp_path), jnp.bfloat16,
                                  True, None)
@@ -448,7 +448,7 @@ class TestPreparedCache:
         self._make_ckpt(tmp_path)
         params = load_params(TINY, str(tmp_path), dtype=jnp.float32)
         meta = cache_meta(TINY, jnp.float32, False, None)
-        save_prepared(params, str(tmp_path), meta)
+        save_prepared(params, str(tmp_path), meta, block=True)
         # Different dtype keys a different dir -> no hit.
         assert load_prepared(TINY, str(tmp_path), jnp.bfloat16,
                              False, None) is None

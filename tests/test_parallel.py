@@ -436,7 +436,7 @@ def test_prepared_cache_roundtrip_sharded():
     params = init_params_device(cfg, jnp.float32, mesh=mesh, quantize=True)
     d = tempfile.mkdtemp()
     meta = cache_meta(cfg, jnp.float32, True, mesh)
-    assert save_prepared(params, d, meta) is not None
+    assert save_prepared(params, d, meta, block=True) is not None
 
     restored = load_prepared(cfg, d, jnp.float32, True, mesh)
     assert restored is not None
