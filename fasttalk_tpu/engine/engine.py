@@ -160,6 +160,7 @@ class TPUEngine(EngineBase):
                  dtype: Any = jnp.bfloat16, seed: int = 0,
                  context_window: int | None = None, mesh: Any = None,
                  use_pallas_attention: bool = False,
+                 use_pallas_int8: bool = True,
                  steps_per_call: int = 8, pipeline_depth: int = 2):
         self.cfg = model_cfg
         self.params = params
@@ -176,8 +177,10 @@ class TPUEngine(EngineBase):
         self.dtype = dtype
         self.mesh = mesh
         # GSPMD cannot partition a custom kernel over a mesh; the Pallas
-        # decode path is a single-device optimisation only.
+        # paths are single-device optimisations only. The attention and
+        # int8-matmul kernels gate independently.
         self.use_pallas_attention = use_pallas_attention and mesh is None
+        self.use_pallas_int8 = use_pallas_int8 and mesh is None
 
         if mesh is None:
             self.cache = init_cache(model_cfg, num_slots, self.max_len, dtype)
@@ -346,19 +349,20 @@ class TPUEngine(EngineBase):
             if level == "full":
                 # Single-slot long-prompt path: writes land in slot 0's
                 # region, unclaimed at warmup time (kv_written stays 0,
-                # so nothing ever trusts them).
+                # so nothing ever trusts them). Its first-token sample
+                # uses the STANDALONE jitted sample_tokens — warm it from
+                # this fn's own logits so the compiled executable keys on
+                # the exact aval/sharding the serving path will pass.
                 fn = self._get_prefill_fn(b)
-                self.cache, _ = fn(self.params, self.cache,
-                                   jnp.zeros((b,), jnp.int32),
-                                   jnp.int32(0), jnp.int32(0),
-                                   jnp.int32(b - 1))
-        # The single-slot long-prompt path samples its first token with
-        # the STANDALONE jitted sample_tokens at shape (1, vocab) — a
-        # compile not covered by the fused prefill/decode executables.
-        jax.block_until_ready(sample_tokens(
-            jnp.zeros((1, self.cfg.vocab_size), jnp.float32),
-            self._next_rng(), jnp.ones((1,), jnp.float32),
-            jnp.full((1,), 40, jnp.int32), jnp.full((1,), 0.9, jnp.float32)))
+                self.cache, last = fn(self.params, self.cache,
+                                      jnp.zeros((b,), jnp.int32),
+                                      jnp.int32(0), jnp.int32(0),
+                                      jnp.int32(b - 1))
+                jax.block_until_ready(sample_tokens(
+                    last[None, :], self._next_rng(),
+                    jnp.ones((1,), jnp.float32),
+                    jnp.full((1,), 40, jnp.int32),
+                    jnp.full((1,), 0.9, jnp.float32)))
         jax.block_until_ready(self.cache.k)
         log.info(f"warmup({level}) compiled "
                  f"{len(self._decode_fns) + len(self._prefill_fns)} "
@@ -482,7 +486,8 @@ class TPUEngine(EngineBase):
                 logits, small = forward(
                     params, self.cfg, cur[:, None], pos[:, None],
                     KVCache(sk, sv), pos, write_mask=act,
-                    pallas_decode=use_pallas)
+                    pallas_decode=use_pallas,
+                    pallas_int8=self.use_pallas_int8)
                 nxt = sample_tokens(logits[:, -1], sub, temps, topks, topps)
                 pos = pos + act.astype(pos.dtype)
                 return (small.k, small.v, nxt, pos, key), nxt

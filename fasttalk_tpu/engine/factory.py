@@ -200,6 +200,7 @@ def build_engine(cfg: Config) -> EngineBase:
         prefill_chunk=cfg.prefill_chunk, dtype=dtype,
         context_window=min(cfg.default_context_window, cfg.max_model_len),
         mesh=mesh, use_pallas_attention=cfg.use_pallas_attention,
+        use_pallas_int8=cfg.use_pallas_int8,
         steps_per_call=cfg.decode_steps_per_call,
         pipeline_depth=cfg.pipeline_depth)
     return engine

@@ -123,6 +123,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             *, blockwise: bool = False,
             write_mask: jnp.ndarray | None = None,
             pallas_decode: bool = False,
+            pallas_int8: bool = False,
             ) -> tuple[jnp.ndarray, KVCache]:
     """Run the transformer over ``tokens`` [B, T], updating the cache.
 
@@ -140,11 +141,17 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                             cfg.rope_scaling))
     x = jnp.take(params["embed"], tokens, axis=0)
     b, t = tokens.shape
+    # The int8 dequant-fused matmul kernel applies in the single-device
+    # T=1 decode regime; its gate (pallas_int8) is independent of the
+    # attention kernel's (pallas_decode) — disabling one must not
+    # silently disable the other.
+    pok = pallas_int8 and t == 1
 
     def layer(x, scanned):
         lp, ck, cv = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = qmm(h, lp["wq"]), qmm(h, lp["wk"]), qmm(h, lp["wv"])
+        q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
+                   qmm(h, lp["wv"], pok))
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
@@ -161,11 +168,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         else:
             attn_fn = attend_blockwise if blockwise else attend
             o = attn_fn(q, ck, cv, positions)
-        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"])
+        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
-        up = qmm(h, lp["w_up"]).astype(jnp.float32)
-        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"])
+        gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
+        up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -174,7 +181,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     if cfg.tie_embeddings:
         logits = (x @ params["embed"].T).astype(jnp.float32)
     else:
-        logits = qmm(x, params["lm_head"]).astype(jnp.float32)
+        logits = qmm(x, params["lm_head"], pok).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v)
 
 

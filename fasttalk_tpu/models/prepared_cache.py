@@ -141,8 +141,12 @@ def save_prepared(params: Any, model_path: str, meta: dict,
         else:
             import threading
 
+            # Non-daemon: a short-lived process (bench, smoke run) joins
+            # this at exit instead of killing the serialization midway —
+            # otherwise the meta marker never lands and every such run
+            # repays the full slow load.
             threading.Thread(target=_finalize, name="prepared-cache-save",
-                             daemon=True).start()
+                             daemon=False).start()
         return path
     except Exception as e:
         log.warning(f"prepared cache save failed (continuing): {e}")

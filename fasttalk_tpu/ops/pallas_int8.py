@@ -1,0 +1,94 @@
+"""Pallas TPU kernel: matmul with int8 weights, dequantized in-kernel.
+
+Why: decode with int8 weight-only quantization should be HBM-bound on
+the int8 bytes, but the XLA lowering of ``(x @ q.astype(bf16)) * s``
+re-materialises the converted weight tile on the VPU every scan step —
+measured on a v5e-1 this cost int8 ~30% of its aggregate throughput
+advantage (README perf table). Here the int8 tile is DMA'd to VMEM,
+converted once in registers as the MXU consumes it, and the per-output-
+channel scale is applied to the (tiny) accumulator instead of the (huge)
+weight.
+
+Shapes: y[M, N] = x[M, K] @ (q[K, N] * s[N]); M is the decode batch
+(num_slots — small), K/N are model dims. Grid (N/bn, K/bk) with the K
+axis innermost, accumulating in an f32 VMEM scratch; the scale multiply
+happens once at the last K block. M stays unblocked (a whole-axis block
+is always legal), so any slot count works.
+
+Single-device path (like ops/pallas_attention.py): under a TP mesh GSPMD
+cannot partition a custom kernel, so the mesh path keeps the XLA matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, k_blocks: int,
+            out_dtype):
+    kb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    w = q_ref[:].astype(x_ref.dtype)  # int8 -> compute dtype, in VMEM
+    acc_ref[:] += jax.lax.dot(x_ref[:], w,
+                              preferred_element_type=jnp.float32)
+
+    @pl.when(kb == k_blocks - 1)
+    def _scale_out():
+        scale = s_ref[0].astype(jnp.float32)[None, :]
+        o_ref[:] = (acc_ref[:] * scale).astype(out_dtype)
+
+
+def _pick_block(dim: int, candidates: tuple[int, ...]) -> int | None:
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    return None
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray,
+                interpret: bool | None = None) -> jnp.ndarray:
+    """x [M, K] @ dequant(q [K, N] int8, s [N]) -> [M, K dtype, N]."""
+    m, k = x.shape
+    k2, n = q.shape
+    assert k == k2 and s.shape == (n,)
+    bk = _pick_block(k, (512, 256, 128))
+    bn = _pick_block(n, (512, 256, 128))
+    assert bk is not None and bn is not None, (k, n)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k_blocks = k // bk
+
+    return pl.pallas_call(
+        functools.partial(_kernel, k_blocks=k_blocks, out_dtype=x.dtype),
+        grid=(n // bn, k_blocks),
+        in_specs=[
+            pl.BlockSpec((m, bk), lambda nb, kb: (0, kb)),
+            pl.BlockSpec((bk, bn), lambda nb, kb: (kb, nb)),
+            pl.BlockSpec((1, bn), lambda nb, kb: (0, nb)),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda nb, kb: (0, nb)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, q, s.reshape(1, n))
+
+
+def supports(x_shape, q_shape) -> bool:
+    """True when the kernel's blocking constraints hold for these shapes."""
+    if len(x_shape) != 2 or len(q_shape) != 2:
+        return False
+    k, n = q_shape
+    return _pick_block(k, (512, 256, 128)) is not None \
+        and _pick_block(n, (512, 256, 128)) is not None

@@ -64,13 +64,20 @@ def quantize_params(params: Any) -> Any:
     return out
 
 
-def matmul(x: jax.Array, w: Any) -> jax.Array:
+def matmul(x: jax.Array, w: Any, pallas_ok: bool = False) -> jax.Array:
     """``x @ w`` for a plain or quantized weight leaf.
 
-    For int8 weights the convert happens inside the matmul fusion — the
-    scale multiply is applied to the (much smaller) output.
+    For int8 weights the convert happens inside the matmul; with
+    ``pallas_ok`` (single-device decode, T=1) the Pallas kernel
+    (ops/pallas_int8.py) converts tile-by-tile in VMEM and scales the
+    accumulator, avoiding XLA's per-step weight re-materialisation.
     """
     if isinstance(w, dict):
+        if pallas_ok and x.ndim == 3 and x.shape[1] == 1:
+            from fasttalk_tpu.ops.pallas_int8 import int8_matmul, supports
+
+            if supports((x.shape[0], x.shape[2]), w["q"].shape):
+                return int8_matmul(x[:, 0], w["q"], w["s"])[:, None]
         return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
     return x @ w
 

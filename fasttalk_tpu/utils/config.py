@@ -176,6 +176,10 @@ class Config:
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
     use_pallas_attention: bool = field(
         default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", True))
+    # Int8 dequant-fused matmul kernel (single-device decode); gates
+    # independently of the attention kernel.
+    use_pallas_int8: bool = field(
+        default_factory=lambda: _env_bool("TPU_USE_PALLAS_INT8", True))
     # Tokens decoded per device call (lax.scan inside one jitted step) and
     # number of calls kept in flight. Together these amortise and overlap
     # per-call host/dispatch latency — the dominant cost when the chip is

@@ -693,3 +693,54 @@ def test_engine_crash_aborts_requests_with_error_events():
     while eng.check_connection() and __import__("time").monotonic() < deadline:
         __import__("time").sleep(0.05)
     assert not eng.check_connection()
+
+
+def test_session_churn_stress():
+    """Waves of short sessions (4x slots, overlapping, with sporadic
+    cancels) across slot eviction churn: every request must terminate
+    with exactly one terminal event and the engine must stay healthy."""
+    import jax
+    import random as _random
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                    max_len=128, prefill_chunk=32, steps_per_call=4)
+    eng.start()
+    rng = _random.Random(7)
+    try:
+        async def one(i):
+            events = []
+            cancel_after = rng.random() < 0.2
+            agen = eng.generate(
+                f"ch{i}", f"chs{i % 12}",  # session reuse across waves
+                [{"role": "user", "content": f"wave msg {i}"}],
+                GenerationParams(max_tokens=rng.randint(1, 6),
+                                 temperature=0.5, top_k=20, top_p=0.9))
+            async for ev in agen:
+                events.append(ev)
+                if cancel_after and ev["type"] == "token":
+                    eng.cancel(f"ch{i}")
+                    cancel_after = False
+            return events
+
+        async def wave(base):
+            return await asyncio.gather(*[one(base + j) for j in range(8)])
+
+        async def run():
+            out = []
+            for w in range(4):
+                out.extend(await wave(w * 8))
+            return out
+
+        results = asyncio.run(run())
+        assert len(results) == 32
+        for events in results:
+            terminal = [e for e in events
+                        if e["type"] in ("done", "cancelled", "error")]
+            assert len(terminal) == 1, events
+            assert terminal[0]["type"] in ("done", "cancelled")
+        assert eng.check_connection()
+        stats = eng.get_stats()
+        assert stats["running"] == 0 and stats["waiting"] == 0
+    finally:
+        eng.shutdown()
