@@ -292,11 +292,13 @@ class TPUEngine(EngineBase):
         health start_period, docker-compose.vllm.yml:62-67).
 
         Must run before ``start()`` (single-threaded device access).
-        ``fast`` compiles the common chat shapes (~4 executables): the
+        ``fast`` compiles the common chat shapes (~6 executables): the
         first decode KV bucket, batched prefill at the typical prompt
-        bucket and the configured chunk for group sizes {1, num_slots}.
-        ``full`` adds every decode KV bucket up to max_len, every
-        prefill bucket, and the single-slot long-prompt path. Warmup
+        bucket and the configured chunk for group sizes {1, num_slots},
+        plus the single-slot long-prompt path at the full chunk size
+        (one long system prompt is common in voice deployments).
+        ``full`` adds every decode KV bucket up to max_len and every
+        prefill bucket. Warmup
         calls mask their writes (or, for the single-slot path, write
         into a slot region no session has claimed yet), so no later
         request can observe warmup garbage.
@@ -314,7 +316,12 @@ class TPUEngine(EngineBase):
                     if b <= self.prefill_chunk] or [_PREFILL_BUCKETS[0]]
         if level != "full":
             common = 64 if 64 in pbuckets else pbuckets[0]
-            pbuckets = sorted({common, pbuckets[-1]})
+            # Include the long-prompt chunk bucket so the fast warmup's
+            # single-slot compile below actually triggers.
+            chunk_bucket = next((x for x in _PREFILL_BUCKETS
+                                 if x >= self.prefill_chunk),
+                                _PREFILL_BUCKETS[-1])
+            pbuckets = sorted({common, pbuckets[-1], chunk_bucket})
         decode_buckets = kv_buckets if level == "full" else kv_buckets[:1]
 
         inactive = self._put(np.zeros((self.num_slots,), bool))
@@ -326,6 +333,12 @@ class TPUEngine(EngineBase):
                 self._topks_dev, self._topps_dev, self._rng_dev)
             jax.block_until_ready(toks)
 
+        # The single-slot long-prompt path buckets by the smallest
+        # _PREFILL_BUCKETS entry covering a full chunk — warm exactly
+        # that shape (pbuckets[-1] only equals it when prefill_chunk is
+        # itself a bucket value).
+        long_bucket = next((x for x in _PREFILL_BUCKETS
+                            if x >= self.prefill_chunk), _PREFILL_BUCKETS[-1])
         for b in pbuckets:
             # Must match the ctx _prefill_group derives for a fresh
             # session (starts=0): the smallest KV bucket covering b.
@@ -346,7 +359,7 @@ class TPUEngine(EngineBase):
                     self._put(np.full((gp,), 0.9, np.float32)),
                     self._rng_dev)
                 jax.block_until_ready(firsts)
-            if level == "full":
+            if level == "full" or b == long_bucket:
                 # Single-slot long-prompt path: writes land in slot 0's
                 # region, unclaimed at warmup time (kv_written stays 0,
                 # so nothing ever trusts them). Its first-token sample
