@@ -139,55 +139,82 @@ def load_params(cfg: ModelConfig, ckpt_dir: str,
 def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
                        mesh=None, quantize: bool = False,
                        seed: int = 0) -> Params:
-    """Architecture-faithful random init generated ON the device(s),
-    leaf by leaf — zero host->device weight transfer, which matters both
-    for multi-chip placement (each leaf materialises directly in its TP
-    shards) and for weight-free benchmarking over a slow host link
-    (host-initialising an 8B model ships gigabytes through the relay;
-    this ships RNG keys). ``quantize`` int8-quantizes matmul leaves in
-    place, so peak HBM is the int8 model plus one bf16 leaf.
+    """Architecture-faithful random init generated ON the device(s) in
+    ONE jitted program — zero host->device weight transfer, which
+    matters both for multi-chip placement (each leaf materialises
+    directly in its TP shards) and for weight-free benchmarking over a
+    slow host link (host-initialising an 8B model ships gigabytes
+    through the relay; this ships one RNG key). ``quantize``
+    int8-quantizes matmul leaves inside the same program; each bf16
+    copy is a transient XLA buffer (freed by liveness analysis once its
+    quantize consumes it), not a committed allocation.
     """
+    import zlib
+
+    from fasttalk_tpu.ops.quant import QUANTIZED_LEAVES
+
     shapes = jax.eval_shape(
         lambda: init_params(cfg, jax.random.PRNGKey(seed), dtype))
 
-    def gen(path, sds):
-        name = str(getattr(path[-1], "key", path[-1]))
-        shape = sds.shape
-
-        def init_leaf(key):
+    def build(base_key):
+        # The whole pytree — RNG, scaling, dtype cast, and int8
+        # quantization — is generated inside ONE jitted program. Leaf-by-
+        # leaf init costs a compile + dispatch round-trip per leaf, which
+        # over a relay-attached chip dominated engine startup (~7s x 11
+        # leaves for the 1B); one fused program is one round-trip.
+        def gen(path, sds):
+            name = str(getattr(path[-1], "key", path[-1]))
+            shape = sds.shape
             if "norm" in name:
                 return jnp.ones(shape, dtype)
             if name in ("bq", "bk", "bv"):
                 return jnp.zeros(shape, dtype)
             fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
-            return (jax.random.normal(key, shape, jnp.float32)
+            # crc32, not hash(): Python's hash is salted per process,
+            # which would give each host of a multi-host slice different
+            # weights for the same leaf (and break same-seed
+            # reproducibility).
+            full = "/".join(str(getattr(k, "key", k)) for k in path)
+            key = jax.random.fold_in(base_key,
+                                     zlib.crc32(full.encode()) & 0x7FFFFFFF)
+            leaf = (jax.random.normal(key, shape, jnp.float32)
                     * fan_in ** -0.5).astype(dtype)
+            if quantize and name in QUANTIZED_LEAVES:
+                # Same math as ops/quant.py _quantize_leaf, fused here so
+                # the bf16 copy is a transient XLA buffer, never a
+                # committed allocation.
+                wf = leaf.astype(jnp.float32)
+                s = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2) / 127.0, 1e-8)
+                return {"q": jnp.round(wf / s[..., None, :]).astype(jnp.int8),
+                        "s": s}
+            return leaf
 
-        sharding = None
-        if mesh is not None:
-            from jax.sharding import NamedSharding
+        return jax.tree_util.tree_map_with_path(gen, shapes)
 
-            from fasttalk_tpu.parallel.sharding import _parent_name, _spec_for
-            sharding = NamedSharding(
-                mesh, _spec_for(name, len(shape), shape,
-                                parent=_parent_name(path)))
-        # crc32, not hash(): Python's hash is salted per process, which
-        # would give each host of a multi-host slice different weights
-        # for the same leaf (and break same-seed reproducibility).
-        import zlib
+    # "rbg" (XLA RngBitGenerator), not threefry: the init program is
+    # compile-time-bound, and threefry over 10^9 elements compiles ~4x
+    # slower (threefry lowers to a long fused integer pipeline; rbg is
+    # one hardware op per leaf). rbg is also the JAX-recommended impl
+    # for sharded generation (no cross-device communication). Weight-
+    # free init only feeds tests and benchmarks, so RNG quality is not
+    # load-bearing.
+    base_key = jax.random.key(seed, impl="rbg")
 
-        full = "/".join(str(getattr(k, "key", k)) for k in path)
-        key = jax.random.fold_in(jax.random.PRNGKey(seed),
-                                 zlib.crc32(full.encode()) & 0x7FFFFFFF)
-        leaf = jax.jit(init_leaf, out_shardings=sharding)(key)
-        if quantize:
-            from fasttalk_tpu.ops.quant import (QUANTIZED_LEAVES,
-                                                _quantize_leaf)
-            if name in QUANTIZED_LEAVES:
-                return _quantize_leaf(leaf)  # donates the bf16 leaf
-        return leaf
+    out_shardings = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
 
-    params = jax.tree_util.tree_map_with_path(gen, shapes)
+        from fasttalk_tpu.parallel.sharding import (_leaf_name, _parent_name,
+                                                    _spec_for)
+
+        out_shapes = jax.eval_shape(build, base_key)
+        out_shardings = jax.tree_util.tree_map_with_path(
+            lambda path, sds: NamedSharding(
+                mesh, _spec_for(_leaf_name(path), sds.ndim, sds.shape,
+                                parent=_parent_name(path))),
+            out_shapes)
+
+    params = jax.jit(build, out_shardings=out_shardings)(base_key)
     log.info(f"Random-initialised {cfg.name} on device "
              f"({'int8' if quantize else jnp.dtype(dtype).name}"
              f"{', sharded' if mesh is not None else ''})")
