@@ -194,10 +194,16 @@ class Config:
     quantize: str = field(default_factory=lambda: _env_str("TPU_QUANTIZE", "none"))
     # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
     # in-tree replacement for the reference's 300s engine-container
-    # health start_period (docker-compose.vllm.yml:62-67).
-    warmup: str = field(default_factory=lambda: _env_str("TPU_WARMUP", "off"))
+    # health start_period (docker-compose.vllm.yml:62-67). Empty means
+    # provider-dependent: "fast" for the in-tree tpu engine (so the bare
+    # `python main.py websocket` never serves first traffic through
+    # 20-40s XLA compiles), "off" for remote/fake providers which have
+    # nothing to compile.
+    warmup: str = field(default_factory=lambda: _env_str("TPU_WARMUP", ""))
 
     def __post_init__(self) -> None:
+        if not self.warmup:
+            self.warmup = "fast" if self.llm_provider == "tpu" else "off"
         self._validate()
 
     def _validate(self) -> None:
