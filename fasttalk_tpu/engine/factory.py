@@ -53,12 +53,20 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
     except Exception:
         limit = None
     dsize = jnp.dtype(dtype).itemsize
+    tp = max(1, cfg.tp_size)
+    m = model_cfg
+    # Norm scales replicate on every chip (parallel/sharding.py
+    # _LAYER_RULES/_TOP_RULES); everything else — matmuls, embedding,
+    # qkv biases — shards over "tp". Counting replicated leaves at
+    # 1/tp size underestimates per-device bytes near the budget edge.
+    norm_params = (2 * m.num_layers + 1) * m.hidden_size
     if cfg.quantize == "int8":
         # Only matmul weights quantize (ops/quant.py QUANTIZED_LEAVES);
         # the embedding, norms and biases stay at the engine dtype, and
         # every quantized tensor gains a float32 per-output-channel
-        # scale row.
-        m = model_cfg
+        # scale row. Row-parallel (wo/w_down) scales replicate; the
+        # rest shard — all are KiB-scale, so count them all replicated
+        # (conservative).
         matmul_per_layer = (m.hidden_size * m.q_dim
                             + 2 * m.hidden_size * m.kv_dim
                             + m.q_dim * m.hidden_size
@@ -70,14 +78,16 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
         if not m.tie_embeddings:
             matmul += m.hidden_size * m.vocab_size
             scales += m.vocab_size
-        other = m.param_count() - matmul
-        wbytes = matmul + other * dsize + scales * 4
+        other_sharded = m.param_count() - matmul - norm_params
+        wbytes_dev = (matmul // tp + other_sharded * dsize // tp
+                      + scales * 4 + norm_params * dsize)
     else:
-        wbytes = model_cfg.param_count() * dsize
+        wbytes_dev = ((m.param_count() - norm_params) * dsize // tp
+                      + norm_params * dsize)
     kv = (model_cfg.num_layers * cfg.decode_slots * cfg.max_model_len
           * model_cfg.num_kv_heads * model_cfg.head_dim * 2 * dsize)
     acct = {
-        "weight_bytes_per_device": wbytes // max(1, cfg.tp_size),
+        "weight_bytes_per_device": wbytes_dev,
         "kv_cache_bytes_per_device": kv // n_devices,
         "hbm_limit_bytes": limit,
         "hbm_utilization": cfg.hbm_util,

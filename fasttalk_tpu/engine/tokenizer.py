@@ -121,25 +121,33 @@ def render_chatml(messages: Sequence[Message],
 
 def render_mistral(messages: Sequence[Message],
                    add_generation_prompt: bool = True) -> str:
-    """Mistral instruct template: [INST] turns; a system message is folded
-    into the first user turn (the format has no system role)."""
-    system = ""
+    """Mistral instruct template: [INST] turns; the format has no system
+    role, so a system message is prepended to the LAST user turn —
+    matching mistral-common / the HF chat template for Instruct-v0.3
+    (folding into the first turn deviates from the checkpoint's trained
+    format on multi-turn prompts)."""
+    sys_parts: list[str] = []
+    last_user = -1
+    for i, m in enumerate(messages):
+        if m.get("role", "user") == "system":
+            sys_parts.append(m.get("content", ""))
+        elif m.get("role", "user") == "user":
+            last_user = i
+    system = "\n\n".join(p for p in sys_parts if p)
     text = "<s>"
-    for m in messages:
+    for i, m in enumerate(messages):
         role, content = m.get("role", "user"), m.get("content", "")
         if role == "system":
-            system = content
             continue
         if role == "user":
-            if system:
+            if system and i == last_user:
                 content = f"{system}\n\n{content}"
-                system = ""
             text += f"[INST] {content} [/INST]"
         else:  # assistant / tool result turns close with </s>
             text += f" {content}</s>"
-    if system:
-        # System message with no following user turn (e.g. lone system
-        # prompt): still surface it rather than dropping it silently.
+    if system and last_user < 0:
+        # System message with no user turn (e.g. lone system prompt):
+        # still surface it rather than dropping it silently.
         text += f"[INST] {system} [/INST]"
     return text
 

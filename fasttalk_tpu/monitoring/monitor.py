@@ -102,10 +102,6 @@ def build_monitoring_app(ready_check=None) -> web.Application:
     async def profiler_start(request: web.Request) -> web.Response:
         import jax
 
-        if _profiler_state["active"]:
-            return web.json_response(
-                {"error": "trace already active",
-                 "log_dir": _profiler_state["log_dir"]}, status=409)
         body = {}
         if request.can_read_body:
             try:
@@ -128,8 +124,15 @@ def build_monitoring_app(ready_check=None) -> web.Application:
             return web.json_response(
                 {"error": "log_dir must be a relative subdirectory of "
                  f"{base}"}, status=400)
-        # Claim the state *before* the awaited start so a concurrent
-        # request sees 409 rather than racing into jax.profiler.
+        # Check-and-claim atomically: no await between the active check
+        # and the claim (body parsing above already suspended), so two
+        # concurrent POSTs can't both pass the check — the loser would
+        # otherwise reset active=False in its error path and orphan the
+        # winner's still-running trace.
+        if _profiler_state["active"]:
+            return web.json_response(
+                {"error": "trace already active",
+                 "log_dir": _profiler_state["log_dir"]}, status=409)
         _profiler_state.update(active=True, log_dir=log_dir,
                                started_at=time.monotonic())
         try:

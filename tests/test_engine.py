@@ -319,10 +319,24 @@ class TestChatTemplates:
         from fasttalk_tpu.engine.tokenizer import render_mistral
 
         text = render_mistral(self.MSGS)
-        # System folded into the first user turn; no system role marker.
-        assert text.startswith("<s>[INST] be brief\n\nhi [/INST]")
+        # System folded into the LAST user turn (mistral-common / HF
+        # Instruct-v0.3 template behavior); no system role marker.
+        assert text.startswith("<s>[INST] hi [/INST]")
         assert " hello</s>" in text
-        assert text.endswith("[INST] again [/INST]")
+        assert text.endswith("[INST] be brief\n\nagain [/INST]")
+
+    def test_mistral_render_concatenates_all_systems(self):
+        from fasttalk_tpu.engine.tokenizer import render_mistral
+
+        msgs = [{"role": "system", "content": "A"},
+                {"role": "user", "content": "q1"},
+                {"role": "assistant", "content": "a1"},
+                {"role": "system", "content": "B"},
+                {"role": "user", "content": "q2"}]
+        text = render_mistral(msgs)
+        # Every system message survives, folded into the last user turn.
+        assert "[INST] A\n\nB\n\nq2 [/INST]" in text
+        assert text.startswith("<s>[INST] q1 [/INST]")
 
     def test_model_configs_pick_templates(self):
         from fasttalk_tpu.models import get_model_config
