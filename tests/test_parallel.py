@@ -447,3 +447,64 @@ def test_prepared_cache_roundtrip_sharded():
         np.asarray(params["layers"]["wq"]["q"]), np.asarray(wq))
     # mesh-shape mismatch is ignored
     assert load_prepared(cfg, d, jnp.float32, True, make_mesh(tp=4)) is None
+
+
+def test_llama70b_shapes_shard_on_v5e8_mesh():
+    """BASELINE config #5 (llama3:70b TP=8 on v5e-8) at eval_shape level:
+    every sharded axis of the real 70B params + KV divides the mesh
+    evenly, and the factory's HBM accounting shows int8 70B + KV fits a
+    16 GiB/chip v5e-8 while bf16 provably does not (reference delegated
+    this discovery to vLLM container boot, .env.vllm.example:25)."""
+    from fasttalk_tpu.engine.factory import check_hbm_budget
+    from fasttalk_tpu.models.llama import init_cache
+    from fasttalk_tpu.parallel.sharding import validate_mesh
+    from fasttalk_tpu.utils.config import Config
+
+    cfg = get_model_config("llama3:70b")
+    slots, max_len = 8, 4096
+    mesh = make_mesh(tp=8)
+    validate_mesh(mesh, num_kv_heads=cfg.num_kv_heads,
+                  num_heads=cfg.num_heads, hidden=cfg.hidden_size,
+                  intermediate=cfg.intermediate_size, vocab=cfg.vocab_size,
+                  num_slots=slots, max_len=max_len)
+
+    shapes = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16))
+    specs = param_pspecs(shapes)
+
+    def assert_divisible(path, sds, spec):
+        for dim, axis in zip(sds.shape, spec):
+            if axis is not None:
+                size = mesh.shape[axis]
+                assert dim % size == 0, (
+                    f"{jax.tree_util.keystr(path)}: dim {dim} not divisible "
+                    f"by {axis}={size}")
+
+    jax.tree_util.tree_map_with_path(assert_divisible, shapes, specs)
+
+    cache_shapes = jax.eval_shape(
+        lambda: init_cache(cfg, slots, max_len, jnp.bfloat16))
+    cspecs = cache_pspecs()
+    for sds, spec in ((cache_shapes.k, cspecs.k), (cache_shapes.v, cspecs.v)):
+        for dim, axis in zip(sds.shape, spec):
+            if axis is not None:
+                assert dim % mesh.shape[axis] == 0, (dim, axis)
+
+    svc = Config()
+    svc.tp_size, svc.dp_size = 8, 1
+    svc.decode_slots, svc.max_model_len = slots, max_len
+    svc.hbm_util = 0.9
+    v5e_hbm = 16 * 2**30
+
+    svc.quantize = "int8"
+    acct = check_hbm_budget(cfg, svc, jnp.bfloat16, n_devices=8)
+    need = (acct["weight_bytes_per_device"]
+            + acct["kv_cache_bytes_per_device"])
+    assert need <= svc.hbm_util * v5e_hbm, (
+        f"int8 70B must fit v5e-8: need {need / 2**30:.2f} GiB/chip")
+
+    svc.quantize = "none"
+    acct = check_hbm_budget(cfg, svc, jnp.bfloat16, n_devices=8)
+    assert acct["weight_bytes_per_device"] > svc.hbm_util * v5e_hbm, (
+        "bf16 70B must overflow a v5e-8 chip — the budget check has to "
+        "catch it at build time")
