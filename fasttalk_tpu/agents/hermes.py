@@ -94,6 +94,19 @@ def format_tool_result(name: str, result: str) -> str:
     return f"<tool_response>\n{json.dumps({'name': name, 'content': result})}\n</tool_response>"
 
 
+def inject_tools_section(messages: list[dict], section: str) -> list[dict]:
+    """Merge a tools section into the conversation's system prompt
+    (append to an existing leading system message, else insert one).
+    Shared by the agent loop and the OpenAI route so the placement rule
+    can't drift between them."""
+    msgs = [dict(m) for m in messages]
+    if msgs and msgs[0].get("role") == "system":
+        msgs[0]["content"] = msgs[0]["content"] + "\n\n" + section
+    else:
+        msgs.insert(0, {"role": "system", "content": section})
+    return msgs
+
+
 def tools_system_prompt(tool_specs: list[dict]) -> str:
     """System-prompt section teaching the model the hermes call format."""
     lines = [

@@ -26,6 +26,7 @@ from typing import Any, AsyncGenerator
 from fasttalk_tpu.agents.hermes import (
     HermesStreamParser,
     format_tool_result,
+    inject_tools_section,
     tools_system_prompt,
 )
 from fasttalk_tpu.agents.tools import ToolRegistry, build_default_registry
@@ -45,10 +46,14 @@ class VoiceAgent:
         if registry is not None:
             self.registry = registry
         else:
+            from fasttalk_tpu.agents.search import backend_from_config
+
             enable_search = bool(getattr(config, "enable_web_search", True))
             rate = float(getattr(config, "web_search_rate_limit", 1.0))
             self.registry = build_default_registry(
                 enable_web_search=enable_search,
+                search_backend=(backend_from_config(config)
+                                if enable_search else None),
                 search_rate_limit_s=rate)
         self._m_calls = get_metrics().counter(
             "agent_tool_calls_total", "tool calls executed by the agent")
@@ -64,13 +69,7 @@ class VoiceAgent:
         specs = self.registry.specs()
         if not specs:
             return messages
-        section = tools_system_prompt(specs)
-        msgs = [dict(m) for m in messages]
-        if msgs and msgs[0].get("role") == "system":
-            msgs[0]["content"] = msgs[0]["content"] + "\n\n" + section
-        else:
-            msgs.insert(0, {"role": "system", "content": section})
-        return msgs
+        return inject_tools_section(messages, tools_system_prompt(specs))
 
     async def generate(self, request_id: str, session_id: str,
                        messages: list[dict], params: GenerationParams,

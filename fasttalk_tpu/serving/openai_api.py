@@ -6,7 +6,11 @@ serving it here means OpenAI-SDK clients, the reference's own vLLM
 handler, and any PydanticAI-style framework can point at THIS engine —
 the vLLM-parity surface of BASELINE config #3.
 
-Implements: POST /v1/chat/completions (stream SSE + non-stream),
+Implements: POST /v1/chat/completions (stream SSE + non-stream, with
+OpenAI tools/tool_choice/tool_calls — the reference launched vLLM with
+--enable-auto-tool-choice --tool-call-parser hermes,
+docker-compose.vllm.yml:50-51, so PydanticAI could drive the tool loop;
+here the hermes parsing is in-tree and the client drives the loop),
 GET /v1/models. Authentication mirrors vLLM's "not needed but accepted".
 """
 
@@ -21,6 +25,12 @@ from aiohttp import web
 
 from typing import Callable
 
+from fasttalk_tpu.agents.hermes import (
+    HermesStreamParser,
+    format_tool_result,
+    inject_tools_section,
+    tools_system_prompt,
+)
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.utils.errors import CircuitBreaker, CircuitBreakerOpen
 from fasttalk_tpu.utils.logger import get_logger
@@ -30,6 +40,126 @@ log = get_logger("serving.openai")
 
 def _now() -> int:
     return int(time.time())
+
+
+def _content_str(content: Any) -> str:
+    """OpenAI message content may be a string or a list of typed parts."""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        return "".join(p.get("text", "") for p in content
+                       if isinstance(p, dict) and p.get("type") == "text")
+    return "" if content is None else str(content)
+
+
+class _BadRequest(ValueError):
+    """Client-shape error: surfaces as a 400, never a 500/breaker hit."""
+
+
+def _parse_tools(body: dict) -> tuple[list[dict], str | None]:
+    """Extract hermes-format tool specs from an OpenAI `tools` array and
+    resolve `tool_choice`. Returns (specs, forced_tool_name) — specs empty
+    when tools are absent or tool_choice is "none"; forced_tool_name set
+    for tool_choice "required" ("" = any tool) or a named function."""
+    tools = body.get("tools")
+    choice = body.get("tool_choice")
+    if tools is not None and not isinstance(tools, list):
+        raise _BadRequest("tools must be a list")
+    if not tools:
+        if choice == "required" or isinstance(choice, dict):
+            raise _BadRequest("tool_choice requires a non-empty tools list")
+        return [], None
+    if choice == "none":
+        return [], None
+    specs = []
+    for t in tools:
+        fn = t.get("function", t) if isinstance(t, dict) else None
+        if not isinstance(fn, dict) or not fn.get("name"):
+            raise _BadRequest("each tool needs a function.name")
+        specs.append({
+            "name": fn["name"],
+            "description": fn.get("description", ""),
+            "parameters": fn.get("parameters",
+                                 {"type": "object", "properties": {}}),
+        })
+    forced: str | None = None
+    if choice == "required":
+        forced = ""
+    elif isinstance(choice, dict):
+        fn = choice.get("function")
+        if not isinstance(fn, dict) or not fn.get("name"):
+            raise _BadRequest(
+                "tool_choice object must be "
+                '{"type": "function", "function": {"name": ...}}')
+        forced = fn["name"]
+        if forced not in {s["name"] for s in specs}:
+            raise _BadRequest(
+                f"tool_choice names unknown tool {forced!r}")
+    elif choice not in (None, "auto"):
+        raise _BadRequest(f"unsupported tool_choice {choice!r}")
+    return specs, forced
+
+
+def _hermes_messages(messages: list[dict]) -> list[dict]:
+    """Rewrite OpenAI tool-protocol messages (assistant `tool_calls`,
+    role "tool" results keyed by tool_call_id) into the hermes markup the
+    engine's chat templates render natively."""
+    id_to_name: dict[str, str] = {}
+    out: list[dict] = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = _content_str(m.get("content"))
+        if role == "assistant" and m.get("tool_calls"):
+            if not isinstance(m["tool_calls"], list):
+                raise _BadRequest("tool_calls must be a list")
+            parts = [content] if content else []
+            for tc in m["tool_calls"]:
+                if not isinstance(tc, dict):
+                    raise _BadRequest("tool_calls entries must be objects")
+                fn = tc.get("function", {})
+                if not isinstance(fn, dict):
+                    raise _BadRequest("tool_calls function must be an "
+                                      "object")
+                args = fn.get("arguments", "{}")
+                if isinstance(args, str):
+                    try:
+                        args = json.loads(args) if args else {}
+                    except json.JSONDecodeError:
+                        args = {"raw": args}
+                if tc.get("id"):
+                    id_to_name[tc["id"]] = fn.get("name", "")
+                parts.append("<tool_call>" + json.dumps(
+                    {"name": fn.get("name", ""), "arguments": args})
+                    + "</tool_call>")
+            out.append({"role": "assistant", "content": "".join(parts)})
+        elif role == "tool":
+            name = (m.get("name")
+                    or id_to_name.get(m.get("tool_call_id", ""), "tool"))
+            out.append({"role": "tool",
+                        "content": format_tool_result(name, content)})
+        else:
+            out.append({"role": role, "content": content})
+    return out
+
+
+def _inject_tools_prompt(messages: list[dict], specs: list[dict],
+                         forced: str | None) -> list[dict]:
+    section = tools_system_prompt(specs)
+    if forced == "":
+        section += "\nYou MUST call one of the tools now."
+    elif forced:
+        section += f"\nYou MUST call the tool {forced!r} now."
+    return inject_tools_section(messages, section)
+
+
+def _oai_tool_call(call, index: int) -> dict:
+    return {
+        "index": index,
+        "id": f"call_{uuid.uuid4().hex[:24]}",
+        "type": "function",
+        "function": {"name": call.name,
+                     "arguments": json.dumps(call.arguments)},
+    }
 
 
 def register_openai_routes(app: web.Application,
@@ -83,12 +213,34 @@ def register_openai_routes(app: web.Application,
             return web.json_response(
                 {"error": {"message": "messages must be a non-empty list",
                            "type": "invalid_request_error"}}, status=400)
-        params = _params(body)
+        try:
+            params = _params(body)
+            specs, forced = _parse_tools(body)
+            messages = _hermes_messages(messages)
+        except (_BadRequest, TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}}, status=400)
+        if specs:
+            messages = _inject_tools_prompt(messages, specs, forced)
+        parser = HermesStreamParser() if specs else None
         completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = _now()
         session_id = body.get("user") or f"oai-{completion_id}"
         req_model = body.get("model", get_name())
         engine = get_backend()
+        if specs:
+            # Client-declared tools mean the CLIENT drives the tool loop
+            # (PydanticAI-style). If the configured backend is the native
+            # agent, unwrap to the bare engine — otherwise the agent's
+            # own hermes loop would strip the markup and execute calls
+            # against the server-side registry before this route's parser
+            # ever saw them. Explicit isinstance: any other wrapper that
+            # happens to hold an inner .engine must NOT be bypassed.
+            from fasttalk_tpu.agents.voice_agent import VoiceAgent
+
+            if isinstance(engine, VoiceAgent):
+                engine = engine.engine
         if breaker is not None:
             try:
                 breaker.check()
@@ -119,10 +271,23 @@ def register_openai_routes(app: web.Application,
                 await resp.write(chunk({"role": "assistant"}))
                 finish_reason = "stop"
                 failed = False
+                n_calls = 0
                 async for event in engine.generate(completion_id, session_id,
                                                    messages, params):
                     if event["type"] == "token":
-                        await resp.write(chunk({"content": event["text"]}))
+                        if parser is None:
+                            await resp.write(chunk({"content":
+                                                    event["text"]}))
+                            continue
+                        text, calls = parser.feed(event["text"])
+                        if text:
+                            await resp.write(chunk({"content": text}))
+                        for call in calls:
+                            if not call.name:
+                                continue  # malformed markup: drop
+                            await resp.write(chunk({"tool_calls": [
+                                _oai_tool_call(call, n_calls)]}))
+                            n_calls += 1
                     elif event["type"] in ("done", "cancelled"):
                         finish_reason = _oai_finish(
                             event.get("finish_reason", "stop"))
@@ -132,10 +297,20 @@ def register_openai_routes(app: web.Application,
                             f"data: {json.dumps({'error': event.get('error')})}\n\n"
                             .encode())
                         break
+                if parser is not None and not failed:
+                    tail = parser.flush()
+                    if tail:
+                        await resp.write(chunk({"content": tail}))
+                    if n_calls:
+                        finish_reason = "tool_calls"
                 if breaker is not None:
                     (breaker.record_failure if failed
                      else breaker.record_success)()
-                await resp.write(chunk({}, finish=finish_reason))
+                if not failed:
+                    # A failed stream ends on the error frame + [DONE];
+                    # emitting a normal finish chunk would make the turn
+                    # look successfully completed to SDK clients.
+                    await resp.write(chunk({}, finish=finish_reason))
                 await resp.write(b"data: [DONE]\n\n")
             except Exception:
                 if breaker is not None:
@@ -147,13 +322,20 @@ def register_openai_routes(app: web.Application,
 
         # Non-streaming
         text = ""
+        tool_calls: list[dict] = []
         stats: dict[str, Any] = {}
         finish_reason = "stop"
         try:
             async for event in engine.generate(completion_id, session_id,
                                                messages, params):
                 if event["type"] == "token":
-                    text += event["text"]
+                    if parser is None:
+                        text += event["text"]
+                        continue
+                    t, calls = parser.feed(event["text"])
+                    text += t
+                    tool_calls.extend(_oai_tool_call(c, len(tool_calls))
+                                      for c in calls if c.name)
                 elif event["type"] in ("done", "cancelled"):
                     stats = event.get("stats", {})
                     finish_reason = _oai_finish(
@@ -172,6 +354,14 @@ def register_openai_routes(app: web.Application,
             raise
         finally:
             engine.release_session(session_id)
+        if parser is not None:
+            text += parser.flush()
+            if tool_calls:
+                finish_reason = "tool_calls"
+        message: dict[str, Any] = {"role": "assistant",
+                                   "content": text or None}
+        if tool_calls:
+            message["tool_calls"] = tool_calls
         prompt_tokens = int(stats.get("prompt_tokens", 0))
         completion_tokens = int(stats.get("tokens_generated", 0))
         return web.json_response({
@@ -181,7 +371,7 @@ def register_openai_routes(app: web.Application,
             "model": req_model,
             "choices": [{
                 "index": 0,
-                "message": {"role": "assistant", "content": text},
+                "message": message,
                 "finish_reason": finish_reason,
             }],
             "usage": {

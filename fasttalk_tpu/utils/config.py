@@ -135,6 +135,11 @@ class Config:
     enable_tools: bool = field(default_factory=lambda: _env_bool("ENABLE_TOOLS", True))
     web_search_rate_limit: float = field(
         default_factory=lambda: _env_float("DUCKDUCKGO_RATE_LIMIT", 1.0))
+    # auto = live DuckDuckGo with offline fallback; duckduckgo; offline
+    web_search_backend: str = field(
+        default_factory=lambda: _env_str("WEB_SEARCH_BACKEND", "auto"))
+    web_search_timeout: float = field(
+        default_factory=lambda: _env_float("WEB_SEARCH_TIMEOUT", 10.0))
     system_prompt: str = field(default_factory=lambda: _env_str(
         "SYSTEM_PROMPT",
         "You are a helpful voice assistant. Keep responses concise and conversational."))

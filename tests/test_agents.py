@@ -363,3 +363,292 @@ class TestOpenAIAPI:
             assert r.status == 400
         finally:
             await client.close()
+
+
+class TestOpenAIToolCalling:
+    """BASELINE config #4 parity: an OpenAI-SDK/PydanticAI-shaped client
+    drives the full request → tool_calls → tool-result → final-answer
+    loop over /v1/chat/completions (reference: voice_agent.py:127-139 +
+    vLLM's --tool-call-parser hermes, docker-compose.vllm.yml:50-51)."""
+
+    TOOLS = [{
+        "type": "function",
+        "function": {
+            "name": "get_current_time",
+            "description": "Get the current UTC time.",
+            "parameters": {"type": "object", "properties": {}},
+        },
+    }]
+
+    async def _client(self, responses):
+        from aiohttp import web
+
+        from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+        eng = ScriptedEngine(responses)
+        app = web.Application()
+        register_openai_routes(app, eng, "test-model")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client, eng
+
+    async def test_full_tool_loop_non_streaming(self):
+        client, eng = await self._client([
+            'Checking. <tool_call>{"name": "get_current_time", '
+            '"arguments": {}}</tool_call>',
+            "It is twelve noon UTC.",
+        ])
+        try:
+            convo = [{"role": "user", "content": "what time is it?"}]
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model", "messages": convo,
+                "tools": self.TOOLS, "tool_choice": "auto",
+            })
+            assert r.status == 200
+            body = await r.json()
+            choice = body["choices"][0]
+            assert choice["finish_reason"] == "tool_calls"
+            calls = choice["message"]["tool_calls"]
+            assert len(calls) == 1
+            assert calls[0]["type"] == "function"
+            assert calls[0]["function"]["name"] == "get_current_time"
+            assert json.loads(calls[0]["function"]["arguments"]) == {}
+            assert calls[0]["id"].startswith("call_")
+            # markup must be stripped from user-visible content
+            assert "<tool_call>" not in (choice["message"]["content"] or "")
+
+            # the tool section reached the engine's system prompt
+            sys0 = eng.calls[0]["messages"][0]
+            assert sys0["role"] == "system"
+            assert "get_current_time" in sys0["content"]
+
+            # round 2: client executes the tool and continues, OpenAI-style
+            convo = convo + [choice["message"], {
+                "role": "tool",
+                "tool_call_id": calls[0]["id"],
+                "content": '{"utc": "12:00:00 UTC"}',
+            }]
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model", "messages": convo,
+                "tools": self.TOOLS,
+            })
+            body = await r.json()
+            choice = body["choices"][0]
+            assert choice["finish_reason"] == "stop"
+            assert choice["message"]["content"] == "It is twelve noon UTC."
+
+            # the engine saw hermes markup, not OpenAI structures
+            seen = eng.calls[1]["messages"]
+            asst = [m for m in seen if m["role"] == "assistant"]
+            assert any("<tool_call>" in m["content"] for m in asst)
+            tool_msgs = [m for m in seen if m["role"] == "tool"]
+            assert len(tool_msgs) == 1
+            assert "<tool_response>" in tool_msgs[0]["content"]
+            assert "get_current_time" in tool_msgs[0]["content"]
+        finally:
+            await client.close()
+
+    async def test_streaming_tool_calls(self):
+        client, _ = await self._client([
+            'Let me check. <tool_call>{"name": "get_current_time", '
+            '"arguments": {"tz": "UTC"}}</tool_call>',
+        ])
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model", "stream": True,
+                "messages": [{"role": "user", "content": "time?"}],
+                "tools": self.TOOLS,
+            })
+            assert r.status == 200
+            raw = await r.text()
+            lines = [ln for ln in raw.splitlines()
+                     if ln.startswith("data:") and ln != "data: [DONE]"]
+            chunks = [json.loads(ln[5:]) for ln in lines]
+            text = "".join(c["choices"][0]["delta"].get("content", "")
+                           for c in chunks)
+            assert text == "Let me check. "
+            tc_chunks = [c for c in chunks
+                         if c["choices"][0]["delta"].get("tool_calls")]
+            assert len(tc_chunks) == 1
+            tc = tc_chunks[0]["choices"][0]["delta"]["tool_calls"][0]
+            assert tc["index"] == 0
+            assert tc["function"]["name"] == "get_current_time"
+            assert json.loads(tc["function"]["arguments"]) == {"tz": "UTC"}
+            assert chunks[-1]["choices"][0]["finish_reason"] == "tool_calls"
+        finally:
+            await client.close()
+
+    async def test_tool_choice_none_disables_parsing(self):
+        markup = '<tool_call>{"name": "t", "arguments": {}}</tool_call>'
+        client, eng = await self._client([markup])
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model",
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": self.TOOLS, "tool_choice": "none",
+            })
+            body = await r.json()
+            choice = body["choices"][0]
+            # no parsing, no prompt injection, markup passes through raw
+            assert choice["finish_reason"] == "stop"
+            assert choice["message"]["content"] == markup
+            assert "tool_calls" not in choice["message"]
+            assert eng.calls[0]["messages"][0]["role"] == "user"
+        finally:
+            await client.close()
+
+    async def test_forced_tool_choice_in_prompt(self):
+        client, eng = await self._client(["ok"])
+        try:
+            await client.post("/v1/chat/completions", json={
+                "model": "test-model",
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": self.TOOLS,
+                "tool_choice": {"type": "function",
+                                "function": {"name": "get_current_time"}},
+            })
+            sys0 = eng.calls[0]["messages"][0]["content"]
+            assert "MUST call the tool 'get_current_time'" in sys0
+        finally:
+            await client.close()
+
+    async def test_content_parts_flattened(self):
+        client, eng = await self._client(["ok"])
+        try:
+            await client.post("/v1/chat/completions", json={
+                "model": "test-model",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "part one "},
+                    {"type": "text", "text": "part two"},
+                ]}],
+            })
+            assert eng.calls[0]["messages"][0]["content"] \
+                == "part one part two"
+        finally:
+            await client.close()
+
+    async def test_agent_backend_unwrapped_for_client_tools(self):
+        """When the configured backend is the native VoiceAgent (the
+        default deployment), client-declared tools must reach the CLIENT
+        as tool_calls — the agent's own hermes loop must not intercept
+        and execute them against the server-side registry."""
+        from aiohttp import web
+
+        from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+        eng = ScriptedEngine([
+            '<tool_call>{"name": "client_side_tool", '
+            '"arguments": {"q": 1}}</tool_call>',
+        ])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        app = web.Application()
+        register_openai_routes(app, agent, "test-model")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model",
+                "messages": [{"role": "user", "content": "go"}],
+                "tools": [{"type": "function", "function": {
+                    "name": "client_side_tool",
+                    "parameters": {"type": "object", "properties": {}},
+                }}],
+            })
+            body = await r.json()
+            choice = body["choices"][0]
+            assert choice["finish_reason"] == "tool_calls"
+            assert choice["message"]["tool_calls"][0]["function"]["name"] \
+                == "client_side_tool"
+            # exactly one engine call: the agent loop did not run a
+            # second round with a server-side tool_response
+            assert len(eng.calls) == 1
+            sys0 = eng.calls[0]["messages"][0]["content"]
+            # only the client's tool section was injected
+            assert "client_side_tool" in sys0
+            assert "get_current_time" not in sys0
+        finally:
+            await client.close()
+
+    async def test_malformed_tool_shapes_are_400(self):
+        client, _ = await self._client(["ok"] * 4)
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": self.TOOLS,
+                "tool_choice": {"function": "get_current_time"},
+            })
+            assert r.status == 400
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": "not-a-list",
+            })
+            assert r.status == 400
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [
+                    {"role": "assistant", "tool_calls": ["bogus"]},
+                    {"role": "user", "content": "x"},
+                ],
+            })
+            assert r.status == 400
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": [{"type": "function", "function": {}}],
+            })
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    async def test_tool_choice_validation(self):
+        client, _ = await self._client(["ok"] * 2)
+        try:
+            # forced tool not in the declared tools
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": self.TOOLS,
+                "tool_choice": {"type": "function",
+                                "function": {"name": "nope"}},
+            })
+            assert r.status == 400
+            # required with no tools
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": [], "tool_choice": "required",
+            })
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    async def test_stream_error_suppresses_finish_chunk(self):
+        from aiohttp import web
+
+        from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+        class ErroringEngine(ScriptedEngine):
+            async def generate(self, request_id, session_id, messages,
+                               params):
+                yield {"type": "token",
+                       "text": '<tool_call>{"name": "get_current_time", '
+                               '"arguments": {}}</tool_call>'}
+                yield {"type": "error", "error": "backend dropped"}
+
+        app = web.Application()
+        register_openai_routes(app, ErroringEngine([]), "test-model")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "test-model", "stream": True,
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": self.TOOLS,
+            })
+            raw = await r.text()
+            lines = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+            assert lines[-1] == "data: [DONE]"
+            payloads = [json.loads(ln[5:]) for ln in lines[:-1]]
+            assert any("error" in p for p in payloads)
+            # no normal completion frame after the error
+            assert not any(
+                p.get("choices", [{}])[0].get("finish_reason")
+                for p in payloads if "choices" in p)
+        finally:
+            await client.close()
