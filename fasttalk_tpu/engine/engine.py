@@ -108,7 +108,9 @@ class _Request:
     slot: Slot | None = None
     generated: int = 0
     pending_text: str = ""     # held back for stop-string matching
+    emit_buf: str = ""         # text batched within one retirement
     first_token_at: float | None = None
+    first_pending: bool = False  # first sampled token not yet fetched
     cancelled: bool = False
     finished: bool = False
 
@@ -161,7 +163,8 @@ class TPUEngine(EngineBase):
                  context_window: int | None = None, mesh: Any = None,
                  use_pallas_attention: bool = False,
                  use_pallas_int8: bool = True,
-                 steps_per_call: int = 8, pipeline_depth: int = 2):
+                 steps_per_call: int = 8, pipeline_depth: int = 2,
+                 sampling_method: str = "fast"):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -210,10 +213,10 @@ class TPUEngine(EngineBase):
         self.slots = SlotManager(num_slots, self.max_len)
         self.steps_per_call = max(1, steps_per_call)
         self.pipeline_depth = max(1, pipeline_depth)
+        self.sampling_method = sampling_method
         # Host mirrors of the per-slot decode state. The authoritative
-        # copies live on the device and chain through decode calls; the
-        # mirrors are pushed with _upload_slot_state whenever the slot
-        # set changes (_dirty).
+        # copies live on the device and chain through decode calls; slot
+        # changes are scattered onto them with _patch_slot_state.
         self._positions = np.zeros((num_slots,), np.int32)
         self._active_mask = np.zeros((num_slots,), bool)
         self._temps = np.zeros((num_slots,), np.float32)
@@ -226,15 +229,22 @@ class TPUEngine(EngineBase):
         self._topks_dev = self._put(self._topks)
         self._topps_dev = self._put(self._topps)
         self._rng_dev = self._put(jax.random.PRNGKey(seed))
-        self._dirty = False
+        # Slots whose host mirrors changed since the last device patch.
+        # Changes are SCATTERED onto the chained device arrays instead of
+        # draining the pipeline and re-uploading everything — admission
+        # and completion never stall in-flight decode calls.
+        self._dirty_slots: set[int] = set()
         # In-flight decode calls: (tokens_device_array [K, S], the
         # (slot index, request) pairs running at dispatch time). Tokens
         # are attributed to the dispatch-time request, never to whoever
         # occupies the slot at retirement — a slot can be re-admitted to
         # a new request while an older call is still in flight.
         self._inflight: deque[tuple[Any, list[tuple[int, _Request]]]] = deque()
-        self._base_key = jax.random.PRNGKey(seed + 1)
-        self._step = 0
+        # First sampled tokens whose device→host copy is still in
+        # flight: (device_array, [(row, slot_index, request), ...]).
+        # Admission emits the first token only when the fetch lands, so
+        # prefill never blocks the engine thread on a device round trip.
+        self._pending_firsts: deque[tuple[Any, list]] = deque()
 
         self._commands: queue.Queue = queue.Queue()
         self._waiting: list[_Request] = []
@@ -247,6 +257,8 @@ class TPUEngine(EngineBase):
         self._started = False
         self._decode_fns: dict[int, Any] = {}
         self._prefill_fns: dict[int, Any] = {}
+        self._patch_fn: Any = None
+        self._sample_place_fn: Any = None
 
         m = get_metrics()
         self._m_tokens = m.counter("engine_tokens_generated_total",
@@ -332,6 +344,13 @@ class TPUEngine(EngineBase):
                 self._positions_dev, inactive, self._temps_dev,
                 self._topks_dev, self._topps_dev, self._rng_dev)
             jax.block_until_ready(toks)
+        # The admission-path helper programs (slot-state patch; they are
+        # tiny but a first-request compile is still seconds).
+        nopatch = np.zeros((self.num_slots, 6), np.float32)
+        (self._positions_dev, self._active_dev, self._temps_dev,
+         self._topks_dev, self._topps_dev) = self._get_patch_fn()(
+            self._arg(nopatch), self._positions_dev, self._active_dev,
+            self._temps_dev, self._topks_dev, self._topps_dev)
 
         # The single-slot long-prompt path buckets by the smallest
         # _PREFILL_BUCKETS entry covering a full chunk — warm exactly
@@ -345,37 +364,39 @@ class TPUEngine(EngineBase):
             ctx = next((k for k in kv_buckets if k >= b), self.max_len)
             for gp in sorted({1, self.num_slots}):
                 fn = self._get_batched_prefill_fn(b, gp, ctx)
-                # All rows masked + out-of-range scatter: no cache writes.
-                self.cache, firsts, self._rng_dev = fn(
+                # All rows masked + out-of-range scatter: no cache (or
+                # cur-token) writes. Args are built exactly as the
+                # serving path builds them (numpy via _arg) so the
+                # compiled executable keys on the same avals.
+                rowcfg = np.zeros((gp, 7), np.float32)
+                rowcfg[:, 0] = np.arange(self.num_slots,
+                                         self.num_slots + gp)
+                rowcfg[:, 4:] = (1.0, 40, 0.9)
+                (self.cache, firsts, self._cur_tokens,
+                 self._rng_dev) = fn(
                     self.params, self.cache,
-                    jnp.zeros((gp, b), jnp.int32),
-                    jnp.zeros((gp,), jnp.int32),
-                    jnp.arange(self.num_slots, self.num_slots + gp,
-                               dtype=jnp.int32),
-                    jnp.zeros((gp,), jnp.int32),
-                    jnp.zeros((gp,), bool),
-                    self._put(np.ones((gp,), np.float32)),
-                    self._put(np.full((gp,), 40, np.int32)),
-                    self._put(np.full((gp,), 0.9, np.float32)),
-                    self._rng_dev)
+                    self._arg(np.zeros((gp, b), np.int32)),
+                    self._arg(rowcfg), self._cur_tokens, self._rng_dev)
                 jax.block_until_ready(firsts)
             if level == "full" or b == long_bucket:
                 # Single-slot long-prompt path: writes land in slot 0's
                 # region, unclaimed at warmup time (kv_written stays 0,
                 # so nothing ever trusts them). Its first-token sample
-                # uses the STANDALONE jitted sample_tokens — warm it from
-                # this fn's own logits so the compiled executable keys on
-                # the exact aval/sharding the serving path will pass.
+                # runs the same jitted sample-and-place program the
+                # serving path uses (slot index out of range: the
+                # current-token scatter drops).
                 fn = self._get_prefill_fn(b)
                 self.cache, last = fn(self.params, self.cache,
-                                      jnp.zeros((b,), jnp.int32),
-                                      jnp.int32(0), jnp.int32(0),
-                                      jnp.int32(b - 1))
-                jax.block_until_ready(sample_tokens(
-                    last[None, :], self._next_rng(),
-                    jnp.ones((1,), jnp.float32),
-                    jnp.full((1,), 40, jnp.int32),
-                    jnp.full((1,), 0.9, jnp.float32)))
+                                      self._arg(np.zeros((b,), np.int32)),
+                                      np.int32(0), np.int32(0),
+                                      np.int32(b - 1))
+                cfg_row = np.array([self.num_slots, 1.0, 40, 0.9],
+                                   np.float32)
+                first, self._cur_tokens, self._rng_dev = \
+                    self._get_sample_place_fn()(
+                        last, self._cur_tokens, self._rng_dev,
+                        self._arg(cfg_row))
+                jax.block_until_ready(first)
         jax.block_until_ready(self.cache.k)
         log.info(f"warmup({level}) compiled "
                  f"{len(self._decode_fns) + len(self._prefill_fns)} "
@@ -468,6 +489,14 @@ class TPUEngine(EngineBase):
 
         return jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec()))
 
+    def _arg(self, arr):
+        """Host array destined to be a jitted-call argument. Without a
+        mesh the numpy array is passed as-is — the call's own transfer
+        is one dispatch, where an explicit device_put costs a separate
+        ~ms-scale round trip per array on relayed devices. With a mesh,
+        explicit replicated placement is required."""
+        return arr if self.mesh is None else self._put(arr)
+
     def _get_decode_fn(self, kv_len: int):
         """K decode steps in one jitted call (K = steps_per_call).
 
@@ -501,7 +530,8 @@ class TPUEngine(EngineBase):
                     KVCache(sk, sv), pos, write_mask=act,
                     pallas_decode=use_pallas,
                     pallas_int8=self.use_pallas_int8)
-                nxt = sample_tokens(logits[:, -1], sub, temps, topks, topps)
+                nxt = sample_tokens(logits[:, -1], sub, temps, topks, topps,
+                                    method=self.sampling_method)
                 pos = pos + act.astype(pos.dtype)
                 return (small.k, small.v, nxt, pos, key), nxt
 
@@ -555,6 +585,14 @@ class TPUEngine(EngineBase):
         offsets, scatters the region back. Padding rows carry
         write_mask=False and an out-of-range slot index, so their
         scatter is dropped.
+
+        The per-row scalars travel in ONE packed f32 array (rowcfg
+        [group, 7]: slot, start, last_idx, mask, temp, top_k, top_p —
+        all exactly representable) and the sampled first tokens are
+        scattered into the decode chain's current-token vector inside
+        the same program: on relayed devices every extra transfer or
+        eager op costs a fixed multi-ms turnaround, so the whole burst
+        is one host→device call.
         """
         key = (chunk, group, ctx)
         fn = self._prefill_fns.get(key)
@@ -562,9 +600,15 @@ class TPUEngine(EngineBase):
             return fn
 
         @partial(jax.jit, donate_argnums=(1,))
-        def batched_prefill(params, cache: KVCache, tokens, starts,
-                            slot_idx, last_idx, mask, temps, topks, topps,
-                            rng):
+        def batched_prefill(params, cache: KVCache, tokens, rowcfg,
+                            cur, rng):
+            slot_idx = rowcfg[:, 0].astype(jnp.int32)
+            starts = rowcfg[:, 1].astype(jnp.int32)
+            last_idx = rowcfg[:, 2].astype(jnp.int32)
+            mask = rowcfg[:, 3] > 0.5
+            temps, topks, topps = (rowcfg[:, 4],
+                                   rowcfg[:, 5].astype(jnp.int32),
+                                   rowcfg[:, 6])
             gk = cache.k[:, slot_idx, :ctx]  # [L, group, ctx, Kv, H]
             gv = cache.v[:, slot_idx, :ctx]
             positions = starts[:, None] + jnp.arange(chunk)[None, :]
@@ -580,15 +624,53 @@ class TPUEngine(EngineBase):
             # First-token sampling fused into the same call: one device
             # round-trip per burst instead of two (TTFT-critical).
             rng, sub = jax.random.split(rng)
-            firsts = sample_tokens(last, sub, temps, topks, topps)
-            return KVCache(new_k, new_v), firsts, rng
+            firsts = sample_tokens(last, sub, temps, topks, topps,
+                                   method=self.sampling_method)
+            new_cur = cur.at[slot_idx].set(firsts, mode="drop")
+            return KVCache(new_k, new_v), firsts, new_cur, rng
 
         self._prefill_fns[key] = batched_prefill
         return batched_prefill
 
-    def _next_rng(self) -> jax.Array:
-        self._step += 1
-        return jax.random.fold_in(self._base_key, self._step)
+    def _get_patch_fn(self):
+        """One jitted program applying all dirty-slot mirror changes:
+        packed [S, 6] = (dirty, position, active, temp, top_k, top_p).
+        Composes with in-flight calls (it consumes the latest chained
+        arrays) without draining the pipeline, and costs one transfer +
+        one program instead of per-field eager scatters."""
+        if self._patch_fn is None:
+            @jax.jit
+            def apply_patch(packed, pos, active, temps, topks, topps):
+                dirty = packed[:, 0] > 0.5
+                pos = jnp.where(dirty, packed[:, 1].astype(pos.dtype), pos)
+                active = jnp.where(dirty, packed[:, 2] > 0.5, active)
+                temps = jnp.where(dirty, packed[:, 3], temps)
+                topks = jnp.where(dirty, packed[:, 4].astype(topks.dtype),
+                                  topks)
+                topps = jnp.where(dirty, packed[:, 5], topps)
+                return pos, active, temps, topks, topps
+
+            self._patch_fn = apply_patch
+        return self._patch_fn
+
+    def _get_sample_place_fn(self):
+        """Jitted completion of a single-slot long prefill: split the
+        rng, sample the first token from the chunk's last logits and
+        scatter it into the current-token vector — one program, no
+        eager ops."""
+        if self._sample_place_fn is None:
+            @jax.jit
+            def sample_place(last_logits, cur, rng, cfg_row):
+                slot = cfg_row[0].astype(jnp.int32)
+                rng, sub = jax.random.split(rng)
+                first = sample_tokens(
+                    last_logits[None, :], sub, cfg_row[1][None],
+                    cfg_row[2].astype(jnp.int32)[None], cfg_row[3][None],
+                    method=self.sampling_method)
+                return first, cur.at[slot].set(first[0], mode="drop"), rng
+
+            self._sample_place_fn = sample_place
+        return self._sample_place_fn
 
     # ---------------- engine thread ----------------
 
@@ -599,7 +681,7 @@ class TPUEngine(EngineBase):
         try:
             while True:
                 idle = not self._running and not self._inflight \
-                    and not self._prefilling
+                    and not self._prefilling and not self._pending_firsts
                 if not self._drain_commands(block=idle):
                     break
                 if self._waiting:
@@ -612,16 +694,25 @@ class TPUEngine(EngineBase):
                     # reserved slots and are ordered behind in-flight
                     # calls by the cache data dependency.
                     self._advance_prefill()
+                if self._pending_firsts:
+                    # Emit any first tokens whose async fetch has landed;
+                    # only block when nothing else would make progress.
+                    self._drain_firsts(block=not self._running
+                                       and not self._inflight)
                 if self._running:
-                    if self._dirty:
-                        self._flush_pipeline()
-                        self._upload_slot_state()
-                    if self._running:
+                    if self._should_dispatch():
                         self._dispatch_decode()
                         if len(self._inflight) >= self.pipeline_depth:
                             self._retire_oldest()
+                    elif self._inflight:
+                        self._retire_oldest()
                 elif self._inflight:
-                    self._flush_pipeline()
+                    # Retire ONE call per iteration, not the whole
+                    # pipeline: a new request arriving while the tail of
+                    # a finished generation drains would otherwise wait
+                    # pipeline_depth × call-time before admission (the
+                    # command queue is only read between iterations).
+                    self._retire_oldest()
                 self._m_active.set(len(self._running))
                 self._m_queue.set(len(self._waiting)
                                   + len(self._prefilling))
@@ -647,6 +738,7 @@ class TPUEngine(EngineBase):
         self._prefilling.clear()
         self._running.clear()
         self._inflight.clear()
+        self._pending_firsts.clear()
 
     def _drain_commands(self, block: bool) -> bool:
         """Process queued commands. Returns False on stop."""
@@ -699,6 +791,13 @@ class TPUEngine(EngineBase):
             slot = self.slots.acquire(req.session_id)
             if slot is None:
                 break  # all slots actively decoding
+            # Re-acquiring a slot still visible in an in-flight call is
+            # safe without draining: the donated cache chains every call,
+            # so the old call's garbage writes (all at positions >= the
+            # kept length > the reused prefix) execute strictly before
+            # this slot's fresh prefill, whose writes then win; the old
+            # call's tokens are dropped at retirement by the snapshot
+            # ownership check.
             self._waiting.pop(i)
             # Reserve immediately: activation is deferred to after the
             # batched prefill, and an unreserved slot would be fair game
@@ -763,10 +862,12 @@ class TPUEngine(EngineBase):
             padded = np.zeros((bucket,), np.int32)
             padded[:take] = chunk
             fn = self._get_prefill_fn(bucket)
+            # numpy scalars, not jnp ones: each eager jnp scalar is its
+            # own device round trip on relayed backends.
             self.cache, st.last_logits = fn(
-                self.params, self.cache, jnp.asarray(padded),
-                jnp.int32(st.start), jnp.int32(slot.index),
-                jnp.int32(take - 1))
+                self.params, self.cache, self._arg(padded),
+                np.int32(st.start), np.int32(slot.index),
+                np.int32(take - 1))
             slot.tokens.extend(chunk)
             st.start += take
             slot.kv_written = st.start
@@ -775,12 +876,15 @@ class TPUEngine(EngineBase):
                 return  # next chunk on a later iteration
             self._prefilling.pop(0)
             self._m_prefill.observe((time.monotonic() - st.t0) * 1000)
-            first = sample_tokens(
-                st.last_logits[None, :], self._next_rng(),
-                jnp.full((1,), req.params.temperature, jnp.float32),
-                jnp.full((1,), req.params.top_k, jnp.int32),
-                jnp.full((1,), req.params.top_p, jnp.float32))
-            self._activate(req, slot, int(first[0]))
+            cfg_row = np.array([slot.index, req.params.temperature,
+                                req.params.top_k, req.params.top_p],
+                               np.float32)
+            first, self._cur_tokens, self._rng_dev = \
+                self._get_sample_place_fn()(
+                    st.last_logits, self._cur_tokens, self._rng_dev,
+                    self._arg(cfg_row))
+            self._activate(req, slot)
+            self._defer_first(first, [(0, slot.index, req)])
         except Exception as e:
             log.error(f"prefill failed for {req.request_id}: {e}",
                       exc_info=True)
@@ -826,70 +930,139 @@ class TPUEngine(EngineBase):
         # compiling per burst size.
         gp = 1 if g == 1 else self.num_slots
         tokens = np.zeros((gp, bucket), np.int32)
-        starts = np.zeros((gp,), np.int32)
+        rowcfg = np.zeros((gp, 7), np.float32)
         # Padding rows scatter out of range (mode="drop"); each gets a
         # distinct index so unique_indices holds.
-        slot_idx = np.arange(self.num_slots,
-                             self.num_slots + gp, dtype=np.int32)
-        last_idx = np.zeros((gp,), np.int32)
-        mask = np.zeros((gp,), bool)
-        temps = np.ones((gp,), np.float32)
-        topks = np.zeros((gp,), np.int32)
-        topps = np.ones((gp,), np.float32)
+        rowcfg[:, 0] = np.arange(self.num_slots,
+                                 self.num_slots + gp, dtype=np.float32)
         for j, (req, slot, start, todo) in enumerate(sub):
             tokens[j, :len(todo)] = todo
-            starts[j] = start
-            slot_idx[j] = slot.index
-            last_idx[j] = len(todo) - 1
-            mask[j] = True
-            temps[j] = req.params.temperature
-            topks[j] = req.params.top_k
-            topps[j] = req.params.top_p
+            rowcfg[j] = (slot.index, start, len(todo) - 1, 1.0,
+                         req.params.temperature, req.params.top_k,
+                         req.params.top_p)
         # Gather only as much of each slot row as this chunk can touch,
         # rounded to a KV bucket so the shape set stays small.
-        need = int(starts.max()) + bucket
+        need = int(rowcfg[:, 1].max()) + bucket
         ctx = next((b for b in _KV_BUCKETS
                     if b >= need and b <= self.max_len), self.max_len)
         fn = self._get_batched_prefill_fn(bucket, gp, ctx)
-        self.cache, firsts_dev, self._rng_dev = fn(
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(starts), jnp.asarray(slot_idx),
-            jnp.asarray(last_idx), jnp.asarray(mask),
-            self._put(temps), self._put(topks), self._put(topps),
-            self._rng_dev)
-        firsts = np.asarray(firsts_dev)  # one sync for the whole burst
+        # First tokens stay on device: the program scatters them into
+        # the decode chain's current-token vector, and the host copy is
+        # async — the engine thread dispatches the first decode call
+        # without waiting for the round trip; text is emitted when the
+        # fetch lands.
+        self.cache, firsts_dev, self._cur_tokens, self._rng_dev = fn(
+            self.params, self.cache, self._arg(tokens), self._arg(rowcfg),
+            self._cur_tokens, self._rng_dev)
+        entries = []
         for j, (req, slot, start, todo) in enumerate(sub):
             slot.tokens.extend(todo)
             slot.kv_written = start + len(todo)
-            self._activate(req, slot, int(firsts[j]))
+            self._activate(req, slot)
+            entries.append((j, slot.index, req))
+        self._defer_first(firsts_dev, entries)
 
-    def _activate(self, req: _Request, slot: Slot, first_id: int) -> None:
-        """Mark a freshly prefilled slot as decoding and emit its first
-        sampled token."""
+    def _should_dispatch(self) -> bool:
+        """Dispatch another K-step call only if some running request can
+        still use tokens beyond what in-flight calls already promise it.
+
+        Without this cap the dispatcher runs pipeline_depth calls past
+        every generation's end; those stale calls hold the (in-order)
+        device queue and the NEXT request's prefill — and therefore its
+        first token — waits behind all of them. A length-capped
+        generation now finishes with an empty pipeline."""
+        promised: dict[int, int] = {}
+        for _, snap in self._inflight:
+            for _, req in snap:
+                promised[id(req)] = (promised.get(id(req), 0)
+                                     + self.steps_per_call)
+        # A first token whose fetch hasn't landed is not yet counted in
+        # req.generated but will be — ignoring it over-dispatches one
+        # whole stale call at exact-budget boundaries.
+        return any(
+            req.params.max_tokens - req.generated
+            - (1 if req.first_pending else 0) > promised.get(id(req), 0)
+            for req in self._running.values())
+
+    def _activate(self, req: _Request, slot: Slot) -> None:
+        """Mark a freshly prefilled slot as decoding. The first sampled
+        token is already on the device (scattered into the decode
+        chain's current-token vector by the caller); its text is emitted
+        by _drain_firsts when the async fetch lands."""
         s = slot.index
         slot.active = True
         req.slot = slot
         self._running[s] = req
-        self._cur_tokens = self._cur_tokens.at[s].set(first_id)
         self._positions[s] = len(slot.tokens)
         self._active_mask[s] = True
         self._temps[s] = req.params.temperature
         self._topks[s] = req.params.top_k
         self._topps[s] = req.params.top_p
-        self._dirty = True
-        self._consume_token(req, first_id)
+        self._dirty_slots.add(s)
 
-    def _upload_slot_state(self) -> None:
-        """Push host mirrors to the device after a slot-set change."""
-        self._positions_dev = self._put(self._positions)
-        self._active_dev = self._put(self._active_mask)
-        self._temps_dev = self._put(self._temps)
-        self._topks_dev = self._put(self._topks)
-        self._topps_dev = self._put(self._topps)
-        self._dirty = False
+    def _defer_first(self, firsts_dev: Any, entries: list) -> None:
+        """Queue first sampled tokens for emission once their
+        device→host copy completes."""
+        try:
+            firsts_dev.copy_to_host_async()
+        except AttributeError:
+            pass
+        for _, _, req in entries:
+            req.first_pending = True
+        self._pending_firsts.append((firsts_dev, entries))
+
+    def _drain_firsts(self, block: bool) -> None:
+        """Emit first tokens whose fetch has landed (all of them when
+        ``block``). Entry guards mirror _retire_oldest: a request that
+        finished (cancel, error) before its first token arrived drops
+        it."""
+        while self._pending_firsts:
+            arr_dev, entries = self._pending_firsts[0]
+            if not block:
+                try:
+                    if not arr_dev.is_ready():
+                        return
+                except AttributeError:
+                    # No readiness probe on this array type: never turn
+                    # the non-blocking poll into a device round trip —
+                    # the blocking sites guarantee eventual emission.
+                    return
+            self._pending_firsts.popleft()
+            arr = np.asarray(arr_dev)
+            for j, s, req in entries:
+                req.first_pending = False
+                if req.finished or self._running.get(s) is not req:
+                    continue
+                self._consume_token(req, int(arr[j]))
+                self._flush_emit(req)
+
+    def _patch_slot_state(self) -> None:
+        """Apply dirty host mirrors onto the chained device arrays via
+        one jitted program and one packed transfer.
+
+        In-flight calls are untouched — safe because their snapshots
+        drop tokens of finished requests at retirement, and a freed
+        slot's fresh prefill is ordered after any in-flight garbage
+        writes by the donated-cache data dependency (see _admit).
+        Every later dispatch sees the patched state. This replaces the
+        old flush-the-pipeline-and-reupload on every slot-set change,
+        which serialised admission behind up to pipeline_depth decode
+        calls."""
+        if not self._dirty_slots:
+            return
+        packed = np.zeros((self.num_slots, 6), np.float32)
+        for s in self._dirty_slots:
+            packed[s] = (1.0, self._positions[s], self._active_mask[s],
+                         self._temps[s], self._topks[s], self._topps[s])
+        self._dirty_slots.clear()
+        (self._positions_dev, self._active_dev, self._temps_dev,
+         self._topks_dev, self._topps_dev) = self._get_patch_fn()(
+            self._arg(packed), self._positions_dev, self._active_dev,
+            self._temps_dev, self._topks_dev, self._topps_dev)
 
     def _dispatch_decode(self) -> None:
         """Launch one K-step decode call; does not wait for results."""
+        self._patch_slot_state()
         active = list(self._running)
         snapshot = list(self._running.items())
         # Device positions lead the host mirrors by one K-step call per
@@ -905,11 +1078,23 @@ class TPUEngine(EngineBase):
             self.params, self.cache, self._cur_tokens, self._positions_dev,
             self._active_dev, self._temps_dev, self._topks_dev,
             self._topps_dev, self._rng_dev)
+        try:
+            # Start the device→host copy immediately: retirement then
+            # costs ~0 instead of a full round trip (the dominant cost
+            # per call on relayed devices).
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
         self._inflight.append((toks, snapshot))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
         toks_dev, snapshot = self._inflight.popleft()
+        if any(req.first_pending for _, req in snapshot):
+            # A request in this call still awaits its first token:
+            # emit firsts before any of its decode tokens (the firsts
+            # copy was issued earlier, so this wait is bounded).
+            self._drain_firsts(block=True)
         t0 = time.monotonic()
         toks = np.asarray(toks_dev)  # [K, S] — sync point
         self._m_step.observe((time.monotonic() - t0) * 1000)
@@ -921,10 +1106,8 @@ class TPUEngine(EngineBase):
                     continue
                 self._positions[s] += 1
                 self._consume_token(req, int(toks[k, s]))
-
-    def _flush_pipeline(self) -> None:
-        while self._inflight:
-            self._retire_oldest()
+        for _, req in snapshot:
+            self._flush_emit(req)
 
     def _consume_token(self, req: _Request, token_id: int) -> None:
         """Handle one newly sampled token for a request (host side)."""
@@ -958,16 +1141,13 @@ class TPUEngine(EngineBase):
         stops = req.params.stop
         req.pending_text += delta
         if not stops:
-            emit_now, req.pending_text = req.pending_text, ""
-            if emit_now:
-                self._emit(req, {"type": "token", "text": emit_now})
+            req.emit_buf += req.pending_text
+            req.pending_text = ""
             return
         for stop in stops:
             idx = req.pending_text.find(stop)
             if idx >= 0:
-                text = req.pending_text[:idx]
-                if text:
-                    self._emit(req, {"type": "token", "text": text})
+                req.emit_buf += req.pending_text[:idx]
                 req.pending_text = ""
                 self._finish(req, "stop", suppress_flush=True)
                 return
@@ -980,7 +1160,7 @@ class TPUEngine(EngineBase):
         cut = len(req.pending_text) - hold
         emit_now, req.pending_text = req.pending_text[:cut], req.pending_text[cut:]
         if emit_now:
-            self._emit(req, {"type": "token", "text": emit_now})
+            req.emit_buf += emit_now
 
     def _finish(self, req: _Request, reason: str, error: str | None = None,
                 suppress_flush: bool = False) -> None:
@@ -1007,7 +1187,7 @@ class TPUEngine(EngineBase):
             # Host positions mirror is authoritative again (the device
             # copy may have speculatively advanced past the kept length).
             self._positions[slot.index] = slot.length
-            self._dirty = True
+            self._dirty_slots.add(slot.index)
             sid = slot.session_id
             if sid is not None and sid in self._release_after:
                 self._release_after.discard(sid)
@@ -1026,9 +1206,9 @@ class TPUEngine(EngineBase):
                 if idx >= 0:
                     text = text[:idx]
                     reason = "stop"
-            if text:
-                self._emit(req, {"type": "token", "text": text})
+            req.emit_buf += text
         req.pending_text = ""
+        self._flush_emit(req)
 
         if error is not None:
             self._emit(req, {"type": "error", "error": error,
@@ -1049,6 +1229,16 @@ class TPUEngine(EngineBase):
                 "prompt_tokens": len(req.prompt_tokens),
             },
         })
+
+    def _flush_emit(self, req: _Request) -> None:
+        """Send the text batched during one retirement as a single token
+        event. At full batch this collapses steps_per_call × num_slots
+        queue crossings per call into one per request — the host-side
+        per-token cost (call_soon_threadsafe + event-loop wakeup) was a
+        measurable slice of aggregate throughput."""
+        if req.emit_buf:
+            text, req.emit_buf = req.emit_buf, ""
+            self._emit(req, {"type": "token", "text": text})
 
     def _emit(self, req: _Request, event: dict) -> None:
         try:

@@ -212,5 +212,6 @@ def build_engine(cfg: Config) -> EngineBase:
         mesh=mesh, use_pallas_attention=cfg.use_pallas_attention,
         use_pallas_int8=cfg.use_pallas_int8,
         steps_per_call=cfg.decode_steps_per_call,
-        pipeline_depth=cfg.pipeline_depth)
+        pipeline_depth=cfg.pipeline_depth,
+        sampling_method=cfg.sampling)
     return engine

