@@ -696,9 +696,14 @@ class TPUEngine(EngineBase):
                     self._advance_prefill()
                 if self._pending_firsts:
                     # Emit any first tokens whose async fetch has landed;
-                    # only block when nothing else would make progress.
-                    self._drain_firsts(block=not self._running
-                                       and not self._inflight)
+                    # block when nothing else would make progress — which
+                    # includes running requests whose whole remaining
+                    # budget IS the pending first token (max_tokens=1):
+                    # no decode call will ever be dispatched for those,
+                    # so a non-blocking poll here would spin forever.
+                    idle_wait = not self._inflight and not (
+                        self._running and self._should_dispatch())
+                    self._drain_firsts(block=idle_wait)
                 if self._running:
                     if self._should_dispatch():
                         self._dispatch_decode()

@@ -1,0 +1,106 @@
+"""Multi-process DCN initialisation test (SURVEY.md §2 comm backend).
+
+Spawns two REAL OS processes that form a jax.distributed cluster over
+the loopback "DCN" (the exact code path a multi-host TPU pod uses,
+minus the hardware): each worker runs parallel.distributed
+.maybe_initialize() from the env-var configuration, builds a global
+("dp","sp","tp") mesh spanning both processes' devices via
+parallel.mesh.make_mesh, and runs a psum across it — proving
+initialize() composes with mesh construction and cross-process
+collectives, which VERDICT r1 flagged as dead-until-proven.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["FASTTALK_REPO"])
+
+    from fasttalk_tpu.parallel.distributed import (maybe_initialize,
+                                                   process_info)
+
+    assert maybe_initialize(), "maybe_initialize returned False"
+    info = process_info()
+    assert info["process_count"] == 2, info
+    assert info["global_device_count"] == 8, info
+    assert info["local_device_count"] == 4, info
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fasttalk_tpu.parallel.mesh import MeshSpec, best_mesh_shape, \\
+        make_mesh
+
+    # dp spans the two processes (DCN); tp stays within each process.
+    mesh = make_mesh(MeshSpec(dp=2, sp=1, tp=4))
+
+    @jax.jit
+    def allsum(x):
+        return jax.shard_map(
+            lambda v: jax.lax.psum(jax.lax.psum(v, "tp"), "dp"),
+            mesh=mesh, in_specs=P("dp", "tp"), out_specs=P())(x)
+
+    # Each process contributes its local shard of a global [2, 4] array
+    # whose entries are 1..8 -> the cross-DCN psum must see 36.
+    pid = info["process_index"]
+    local = np.arange(1, 9, dtype=np.float32).reshape(2, 4)[pid][None, :]
+    sharding = NamedSharding(mesh, P("dp", "tp"))
+    gx = jax.make_array_from_process_local_data(sharding, local, (2, 4))
+    # out_specs=P() -> fully replicated: every process holds the value.
+    total = float(np.asarray(allsum(gx)))
+    assert total == 36.0, total
+
+    # best_mesh_shape stays consistent with the global device count.
+    spec = best_mesh_shape(len(jax.devices()))
+    assert spec.size <= 8
+    print(f"WORKER_OK pid={pid} total={total}", flush=True)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_cluster(tmp_path):
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
+                             "TPU_COORDINATOR_ADDR", "TPU_NUM_PROCESSES",
+                             "TPU_PROCESS_ID")}
+    procs = []
+    for pid in range(2):
+        env = dict(env_base,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                   TPU_COORDINATOR_ADDR=f"127.0.0.1:{port}",
+                   TPU_NUM_PROCESSES="2",
+                   TPU_PROCESS_ID=str(pid),
+                   FASTTALK_REPO=REPO)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert "WORKER_OK" in out, out
+    assert "total=36.0" in outs[0]

@@ -132,6 +132,17 @@ class TestTPUEngine:
         assert stats["ttft_ms"] > 0
         assert stats["prompt_tokens"] > 0
 
+    def test_single_token_budget_completes(self, engine):
+        """max_tokens=1: the whole budget is the prefill's first token —
+        no decode call is ever dispatched, so the engine must block on
+        the pending first-token fetch instead of polling forever."""
+        events = _collect(engine, "r-one", "s-one",
+                          [{"role": "user", "content": "one token"}],
+                          GenerationParams(max_tokens=1, **GREEDY))
+        assert events[-1]["type"] == "done"
+        assert events[-1]["stats"]["tokens_generated"] == 1
+        assert events[-1]["finish_reason"] == "length"
+
     def test_deterministic_greedy(self, engine):
         msgs = [{"role": "user", "content": "determinism"}]
         p = GenerationParams(max_tokens=6, **GREEDY)
