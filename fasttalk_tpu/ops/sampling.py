@@ -76,8 +76,11 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
     """
     b = logits.shape[0]
     max_candidates = min(max_candidates, logits.shape[-1])
-    logits = logits.astype(jnp.float32)
+    # Candidate selection runs on the raw dtype (bf16 from the lm_head):
+    # same ordering, half the bytes through the vocab-wide reductions.
+    # Only the surviving candidates are cast to f32 for the softmax.
     top_vals, top_idx = _select_candidates(logits, max_candidates, method)
+    top_vals = top_vals.astype(jnp.float32)
 
     # Per-slot top-k mask inside the candidate set.
     ranks = jnp.arange(max_candidates)[None, :]

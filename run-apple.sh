@@ -1,0 +1,32 @@
+#!/bin/bash
+# Run the FastTalk-TPU gateway natively on an Apple Silicon host against
+# a locally running Ollama (`brew install ollama && ollama serve`), which
+# uses Metal for acceleration — the parity analogue of the reference's
+# run-apple.sh MPS path (reference: run-apple.sh:17-25). The gateway
+# itself runs on the JAX CPU backend (no Metal needed host-side).
+set -e
+
+cd "$(dirname "$0")"
+
+if [ "$(uname -s)" != "Darwin" ] || [ "$(uname -m)" != "arm64" ]; then
+    echo "warning: not an Apple Silicon host ($(uname -sm)); continuing" >&2
+fi
+
+if [ ! -d ".venv" ]; then
+    python3 -m venv .venv
+fi
+# shellcheck disable=SC1091
+source .venv/bin/activate
+
+if ! python -c "import fasttalk_tpu" 2>/dev/null; then
+    pip install --quiet --upgrade pip
+    pip install --quiet -e .
+fi
+
+export JAX_PLATFORMS=cpu
+export COMPUTE_DEVICE=cpu
+export LLM_PROVIDER="${LLM_PROVIDER:-ollama}"
+export OLLAMA_BASE_URL="${OLLAMA_BASE_URL:-http://127.0.0.1:11434}"
+export LLM_MODEL="${LLM_MODEL:-llama3.2:1b}"
+
+exec python main.py websocket "$@"

@@ -12,7 +12,7 @@ fi
 # shellcheck disable=SC1091
 source .venv/bin/activate
 
-if ! python -c "import jax" 2>/dev/null; then
+if ! python -c "import fasttalk_tpu" 2>/dev/null; then
     pip install --quiet --upgrade pip
     pip install --quiet -e .
 fi

@@ -16,7 +16,7 @@ fi
 # shellcheck disable=SC1091
 source .venv/bin/activate
 
-if ! python -c "import jax" 2>/dev/null; then
+if ! python -c "import fasttalk_tpu" 2>/dev/null; then
     echo "Installing dependencies (jax[tpu] + pyproject deps)..."
     pip install --quiet --upgrade pip
     pip install --quiet "jax[tpu]" \
