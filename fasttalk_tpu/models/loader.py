@@ -139,15 +139,15 @@ def load_params(cfg: ModelConfig, ckpt_dir: str,
 def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
                        mesh=None, quantize: bool = False,
                        seed: int = 0) -> Params:
-    """Architecture-faithful random init generated ON the device(s) in
-    ONE jitted program — zero host->device weight transfer, which
-    matters both for multi-chip placement (each leaf materialises
+    """Architecture-faithful random init generated ON the device(s),
+    one jitted program per leaf — zero host->device weight transfer,
+    which matters both for multi-chip placement (each leaf materialises
     directly in its TP shards) and for weight-free benchmarking over a
     slow host link (host-initialising an 8B model ships gigabytes
     through the relay; this ships one RNG key). ``quantize``
-    int8-quantizes matmul leaves inside the same program; each bf16
-    copy is a transient XLA buffer (freed by liveness analysis once its
-    quantize consumes it), not a committed allocation.
+    int8-quantizes matmul leaves inside the same per-leaf program,
+    layer by layer, so the f32 generation buffer never exceeds one
+    layer slice (see the peak-memory note below).
     """
     import zlib
 
@@ -156,65 +156,130 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
     shapes = jax.eval_shape(
         lambda: init_params(cfg, jax.random.PRNGKey(seed), dtype))
 
-    def build(base_key):
-        # The whole pytree — RNG, scaling, dtype cast, and int8
-        # quantization — is generated inside ONE jitted program. Leaf-by-
-        # leaf init costs a compile + dispatch round-trip per leaf, which
-        # over a relay-attached chip dominated engine startup (~7s x 11
-        # leaves for the 1B); one fused program is one round-trip.
-        def gen(path, sds):
-            name = str(getattr(path[-1], "key", path[-1]))
-            shape = sds.shape
-            if "norm" in name:
-                return jnp.ones(shape, dtype)
-            if name in ("bq", "bk", "bv"):
-                return jnp.zeros(shape, dtype)
-            fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
-            # crc32, not hash(): Python's hash is salted per process,
-            # which would give each host of a multi-host slice different
-            # weights for the same leaf (and break same-seed
-            # reproducibility).
-            full = "/".join(str(getattr(k, "key", k)) for k in path)
-            key = jax.random.fold_in(base_key,
-                                     zlib.crc32(full.encode()) & 0x7FFFFFFF)
-            leaf = (jax.random.normal(key, shape, jnp.float32)
-                    * fan_in ** -0.5).astype(dtype)
-            if quantize and name in QUANTIZED_LEAVES:
-                # Same math as ops/quant.py _quantize_leaf, fused here so
-                # the bf16 copy is a transient XLA buffer, never a
-                # committed allocation.
-                wf = leaf.astype(jnp.float32)
-                s = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2) / 127.0, 1e-8)
-                return {"q": jnp.round(wf / s[..., None, :]).astype(jnp.int8),
-                        "s": s}
-            return leaf
+    # One jitted program PER LEAF, with layer-stacked leaves filled by a
+    # fori_loop writing into a donated accumulator. A single all-leaves
+    # program (the previous design) let XLA schedule several leaves'
+    # f32 generation buffers live at once — for an 8B model one stacked
+    # MLP leaf alone is a 7.5 GB f32 temporary, and the combined peak
+    # OOMed a 16 GiB chip before serving ever started. Per-leaf programs
+    # bound the peak to (committed leaves so far) + one layer slice;
+    # rbg keys keep each compile small, repeated shapes hit the jit
+    # cache, and dispatches are async so the relay round trip is paid
+    # ~once, not per leaf.
+    def _gen_leaf(base_key, crc, *, kind, shape, leaf_quantize):
+        if kind == "ones":
+            return jnp.ones(shape, dtype)
+        if kind == "zeros":
+            return jnp.zeros(shape, dtype)
+        key = jax.random.fold_in(base_key, crc)
+        fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+        scale = fan_in ** -0.5
 
-        return jax.tree_util.tree_map_with_path(gen, shapes)
+        def make_slice(k, sl_shape):
+            return jax.random.normal(k, sl_shape, jnp.float32) * scale
 
-    # "rbg" (XLA RngBitGenerator), not threefry: the init program is
-    # compile-time-bound, and threefry over 10^9 elements compiles ~4x
-    # slower (threefry lowers to a long fused integer pipeline; rbg is
-    # one hardware op per leaf). rbg is also the JAX-recommended impl
+        def quantize_f32(wf):
+            # Same math as ops/quant.py _quantize_leaf.
+            s = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2) / 127.0, 1e-8)
+            return jnp.round(wf / s[..., None, :]).astype(jnp.int8), s
+
+        if len(shape) == 3:
+            # Layer-stacked: generate one [in, out] f32 slice per layer
+            # and write it into the accumulator in place.
+            num_layers = shape[0]
+            if leaf_quantize:
+                def body(layer, acc):
+                    accq, accs = acc
+                    sl = make_slice(jax.random.fold_in(key, layer),
+                                    shape[1:])
+                    q, s = quantize_f32(sl)
+                    return (accq.at[layer].set(q), accs.at[layer].set(s))
+
+                accq, accs = jax.lax.fori_loop(
+                    0, num_layers, body,
+                    (jnp.zeros(shape, jnp.int8),
+                     jnp.zeros((shape[0], shape[2]), jnp.float32)))
+                return {"q": accq, "s": accs}
+
+            def body(layer, acc):
+                sl = make_slice(jax.random.fold_in(key, layer), shape[1:])
+                return acc.at[layer].set(sl.astype(dtype))
+
+            return jax.lax.fori_loop(0, num_layers, body,
+                                     jnp.zeros(shape, dtype))
+
+        wf = make_slice(key, shape)
+        if leaf_quantize:
+            q, s = quantize_f32(wf)
+            return {"q": q, "s": s}
+        return wf.astype(dtype)
+
+    gen_leaf = jax.jit(_gen_leaf,
+                       static_argnames=("kind", "shape", "leaf_quantize"))
+
+    # "rbg" (XLA RngBitGenerator), not threefry: threefry over 10^9
+    # elements compiles ~4x slower. rbg is also the JAX-recommended impl
     # for sharded generation (no cross-device communication). Weight-
     # free init only feeds tests and benchmarks, so RNG quality is not
     # load-bearing.
     base_key = jax.random.key(seed, impl="rbg")
 
-    out_shardings = None
-    if mesh is not None:
-        from jax.sharding import NamedSharding
+    # Mesh-path jit wrappers memoized by their output sharding: a fresh
+    # jax.jit per leaf would re-trace/re-compile repeated shapes (the
+    # seven layer-stacked leaves mostly share them).
+    _sharded_fns: dict[Any, Any] = {}
 
-        from fasttalk_tpu.parallel.sharding import (_leaf_name, _parent_name,
-                                                    _spec_for)
+    def _sharded_gen(out_sh):
+        key = (tuple(sorted(out_sh.items())) if isinstance(out_sh, dict)
+               else out_sh)
+        fn = _sharded_fns.get(key)
+        if fn is None:
+            fn = jax.jit(_gen_leaf,
+                         static_argnames=("kind", "shape", "leaf_quantize"),
+                         out_shardings=out_sh)
+            _sharded_fns[key] = fn
+        return fn
 
-        out_shapes = jax.eval_shape(build, base_key)
-        out_shardings = jax.tree_util.tree_map_with_path(
-            lambda path, sds: NamedSharding(
-                mesh, _spec_for(_leaf_name(path), sds.ndim, sds.shape,
-                                parent=_parent_name(path))),
-            out_shapes)
+    def gen(path, sds):
+        name = str(getattr(path[-1], "key", path[-1]))
+        shape = sds.shape
+        if "norm" in name:
+            kind = "ones"
+        elif name in ("bq", "bk", "bv"):
+            kind = "zeros"
+        else:
+            kind = "normal"
+        leaf_quantize = (quantize and kind == "normal"
+                         and name in QUANTIZED_LEAVES)
+        # crc32, not hash(): Python's hash is salted per process, which
+        # would give each host of a multi-host slice different weights
+        # for the same leaf (and break same-seed reproducibility).
+        full = "/".join(str(getattr(k, "key", k)) for k in path)
+        crc = zlib.crc32(full.encode()) & 0x7FFFFFFF
+        fn = gen_leaf
+        if mesh is not None:
+            from jax.sharding import NamedSharding
 
-    params = jax.jit(build, out_shardings=out_shardings)(base_key)
+            from fasttalk_tpu.parallel.sharding import (_parent_name,
+                                                        _spec_for)
+
+            if leaf_quantize:
+                s_shape = shape[:-2] + shape[-1:]
+                out_sh = {
+                    "q": NamedSharding(mesh, _spec_for(
+                        "q", len(shape), shape, parent=name)),
+                    "s": NamedSharding(mesh, _spec_for(
+                        "s", len(s_shape), s_shape, parent=name)),
+                }
+            else:
+                out_sh = NamedSharding(
+                    mesh, _spec_for(name, len(shape), shape,
+                                    parent=_parent_name(path)))
+            fn = _sharded_gen(out_sh)
+        return fn(base_key, crc, kind=kind, shape=shape,
+                  leaf_quantize=leaf_quantize)
+
+    params = jax.tree_util.tree_map_with_path(gen, shapes)
     log.info(f"Random-initialised {cfg.name} on device "
              f"({'int8' if quantize else jnp.dtype(dtype).name}"
              f"{', sharded' if mesh is not None else ''})")

@@ -12,7 +12,10 @@ fi
 # shellcheck disable=SC1091
 source .venv/bin/activate
 
-if ! python -c "import fasttalk_tpu" 2>/dev/null; then
+# jax probes the deps; pip show probes the (editable) package install
+# itself — `import fasttalk_tpu` alone succeeds from the repo root CWD
+# even with nothing installed.
+if ! python -c "import jax" 2>/dev/null || ! pip show --quiet fasttalk-tpu 2>/dev/null; then
     pip install --quiet --upgrade pip
     pip install --quiet -e .
 fi
