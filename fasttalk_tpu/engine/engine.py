@@ -185,9 +185,7 @@ class TPUEngine(EngineBase):
         self.use_pallas_attention = use_pallas_attention and mesh is None
         self.use_pallas_int8 = use_pallas_int8 and mesh is None
 
-        if mesh is None:
-            self.cache = init_cache(model_cfg, num_slots, self.max_len, dtype)
-        else:
+        if mesh is not None:
             # Tensor-parallel serving: weights and KV sharded over ICI;
             # GSPMD turns the row-parallel matmuls into all-reduces.
             # (The reference's only TP story was forwarding
@@ -195,10 +193,7 @@ class TPUEngine(EngineBase):
             # docker-compose.vllm.yml:42.) The cache is created directly
             # in its shards; params are re-placed (a no-op when the
             # loader already put them with parallel.sharding.param_put).
-            from jax.sharding import NamedSharding
-
-            from fasttalk_tpu.parallel.sharding import (cache_pspecs,
-                                                        shard_params,
+            from fasttalk_tpu.parallel.sharding import (shard_params,
                                                         validate_mesh)
             validate_mesh(mesh, num_kv_heads=model_cfg.num_kv_heads,
                           num_heads=model_cfg.num_heads,
@@ -207,44 +202,13 @@ class TPUEngine(EngineBase):
                           vocab=model_cfg.vocab_size,
                           num_slots=num_slots, max_len=self.max_len)
             self.params = shard_params(params, mesh)
-            self.cache = init_cache(
-                model_cfg, num_slots, self.max_len, dtype,
-                device=NamedSharding(mesh, cache_pspecs().k))
+        self.cache = self._make_cache()
+        self.seed = seed
         self.slots = SlotManager(num_slots, self.max_len)
         self.steps_per_call = max(1, steps_per_call)
         self.pipeline_depth = max(1, pipeline_depth)
         self.sampling_method = sampling_method
-        # Host mirrors of the per-slot decode state. The authoritative
-        # copies live on the device and chain through decode calls; slot
-        # changes are scattered onto them with _patch_slot_state.
-        self._positions = np.zeros((num_slots,), np.int32)
-        self._active_mask = np.zeros((num_slots,), bool)
-        self._temps = np.zeros((num_slots,), np.float32)
-        self._topks = np.zeros((num_slots,), np.int32)
-        self._topps = np.ones((num_slots,), np.float32)
-        self._cur_tokens = self._put(np.zeros((num_slots,), np.int32))
-        self._positions_dev = self._put(self._positions)
-        self._active_dev = self._put(self._active_mask)
-        self._temps_dev = self._put(self._temps)
-        self._topks_dev = self._put(self._topks)
-        self._topps_dev = self._put(self._topps)
-        self._rng_dev = self._put(jax.random.PRNGKey(seed))
-        # Slots whose host mirrors changed since the last device patch.
-        # Changes are SCATTERED onto the chained device arrays instead of
-        # draining the pipeline and re-uploading everything — admission
-        # and completion never stall in-flight decode calls.
-        self._dirty_slots: set[int] = set()
-        # In-flight decode calls: (tokens_device_array [K, S], the
-        # (slot index, request) pairs running at dispatch time). Tokens
-        # are attributed to the dispatch-time request, never to whoever
-        # occupies the slot at retirement — a slot can be re-admitted to
-        # a new request while an older call is still in flight.
-        self._inflight: deque[tuple[Any, list[tuple[int, _Request]]]] = deque()
-        # First sampled tokens whose device→host copy is still in
-        # flight: (device_array, [(row, slot_index, request), ...]).
-        # Admission emits the first token only when the fetch lands, so
-        # prefill never blocks the engine thread on a device round trip.
-        self._pending_firsts: deque[tuple[Any, list]] = deque()
+        self._reset_decode_state()
 
         self._commands: queue.Queue = queue.Queue()
         self._waiting: list[_Request] = []
@@ -255,6 +219,12 @@ class TPUEngine(EngineBase):
         self._thread: threading.Thread | None = None
         self._stopped = threading.Event()
         self._started = False
+        # Serializes shutdown vs. supervised restart: without it a
+        # restart running on an executor thread could observe
+        # _started=False mid-shutdown and spawn a fresh engine thread
+        # after the process believes the engine is down.
+        self._lifecycle_lock = threading.Lock()
+        self._closed = False
         self._decode_fns: dict[int, Any] = {}
         self._prefill_fns: dict[int, Any] = {}
         self._patch_fn: Any = None
@@ -279,6 +249,52 @@ class TPUEngine(EngineBase):
         self._m_prefix = m.counter("engine_prefix_tokens_reused_total",
                                    "prompt tokens served from resident KV")
 
+    def _make_cache(self) -> KVCache:
+        if self.mesh is None:
+            return init_cache(self.cfg, self.num_slots, self.max_len,
+                              self.dtype)
+        from jax.sharding import NamedSharding
+
+        from fasttalk_tpu.parallel.sharding import cache_pspecs
+
+        return init_cache(self.cfg, self.num_slots, self.max_len, self.dtype,
+                          device=NamedSharding(self.mesh, cache_pspecs().k))
+
+    def _reset_decode_state(self) -> None:
+        """(Re)build the host mirrors and device-resident decode state."""
+        num_slots = self.num_slots
+        # Host mirrors of the per-slot decode state. The authoritative
+        # copies live on the device and chain through decode calls; slot
+        # changes are scattered onto them with _patch_slot_state.
+        self._positions = np.zeros((num_slots,), np.int32)
+        self._active_mask = np.zeros((num_slots,), bool)
+        self._temps = np.zeros((num_slots,), np.float32)
+        self._topks = np.zeros((num_slots,), np.int32)
+        self._topps = np.ones((num_slots,), np.float32)
+        self._cur_tokens = self._put(np.zeros((num_slots,), np.int32))
+        self._positions_dev = self._put(self._positions)
+        self._active_dev = self._put(self._active_mask)
+        self._temps_dev = self._put(self._temps)
+        self._topks_dev = self._put(self._topks)
+        self._topps_dev = self._put(self._topps)
+        self._rng_dev = self._put(jax.random.PRNGKey(self.seed))
+        # Slots whose host mirrors changed since the last device patch.
+        # Changes are SCATTERED onto the chained device arrays instead of
+        # draining the pipeline and re-uploading everything — admission
+        # and completion never stall in-flight decode calls.
+        self._dirty_slots: set[int] = set()
+        # In-flight decode calls: (tokens_device_array [K, S], the
+        # (slot index, request) pairs running at dispatch time). Tokens
+        # are attributed to the dispatch-time request, never to whoever
+        # occupies the slot at retirement — a slot can be re-admitted to
+        # a new request while an older call is still in flight.
+        self._inflight: deque[tuple[Any, list[tuple[int, _Request]]]] = deque()
+        # First sampled tokens whose device→host copy is still in
+        # flight: (device_array, [(row, slot_index, request), ...]).
+        # Admission emits the first token only when the fetch lands, so
+        # prefill never blocks the engine thread on a device round trip.
+        self._pending_firsts: deque[tuple[Any, list]] = deque()
+
     # ---------------- public (asyncio side) ----------------
 
     def start(self) -> None:
@@ -291,11 +307,50 @@ class TPUEngine(EngineBase):
         self._thread.start()
 
     def shutdown(self) -> None:
-        if not self._started:
-            return
-        self._commands.put(("stop", None))
-        self._stopped.wait(timeout=30)
-        self._started = False
+        with self._lifecycle_lock:
+            self._closed = True
+            if not self._started:
+                return
+            self._commands.put(("stop", None))
+            self._stopped.wait(timeout=30)
+            self._started = False
+
+    def restart(self) -> bool:
+        """Recover from an engine-thread crash: rebuild the device-side
+        decode state (the crash may have struck mid-call, leaving the
+        donated cache buffer consumed or poisoned) and start a fresh
+        thread on the SAME command queue, so requests submitted during
+        the outage are served rather than lost. Session KV residency is
+        dropped — a session's next turn re-prefills — but the process
+        keeps serving, where the reference's only recovery was a
+        container restart (docker restart: unless-stopped,
+        docker-compose.vllm.yml:14). Compiled executables are kept:
+        weights are intact, so nothing needs recompiling."""
+        with self._lifecycle_lock:
+            if self._closed:
+                return False  # shutdown won; never resurrect past it
+            if self.check_connection():
+                return True
+            if self._thread is not None and self._thread.is_alive():
+                return False  # still tearing down; try again later
+            log.warning("engine restart: rebuilding device decode state")
+            self._waiting.clear()
+            self._prefilling.clear()
+            self._running.clear()
+            self._release_after.clear()
+            # Keep registrations of requests submitted in the crash race
+            # window (registered after _abort_all's sweep): their queued
+            # submit commands survive on the shared command queue and the
+            # new thread will admit them — dropping the registration
+            # would strand cancel() for those ids.
+            self._by_id = {rid: r for rid, r in self._by_id.items()
+                           if not r.finished}
+            self.slots = SlotManager(self.num_slots, self.max_len)
+            self.cache = self._make_cache()
+            self._reset_decode_state()
+            self._started = False
+            self.start()
+            return self.check_connection()
 
     def warmup(self, level: str = "fast") -> None:
         """Compile hot shapes before serving traffic, so the first users
@@ -756,7 +811,12 @@ class TPUEngine(EngineBase):
             if cmd == "stop":
                 return False
             if cmd == "submit":
-                if arg.cancelled:  # cancelled before the drain saw it
+                if arg.finished:
+                    # Already terminal (errored by _abort_all during a
+                    # crash before this drain saw it): admitting it
+                    # would leak a slot on a request nobody consumes.
+                    pass
+                elif arg.cancelled:  # cancelled before the drain saw it
                     self._finish(arg, "cancelled")
                 else:
                     self._waiting.append(arg)

@@ -44,6 +44,36 @@ class ServerLauncher:
         self.agent = build_agent(config, self.engine)
         self.server = WebSocketLLMServer(config, self.engine, self.agent)
         self._stop = asyncio.Event()
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        self._m_restarts = get_metrics().counter(
+            "engine_restarts_total",
+            "supervised engine restarts after a crash")
+
+    async def _watchdog(self, interval: float = 5.0) -> None:
+        """Supervised in-process recovery: if the engine thread dies,
+        rebuild its device state and restart it (the reference's only
+        recovery at this layer was docker `restart: unless-stopped`).
+        In-flight requests already received terminal error events from
+        the crash; new requests are served after the restart."""
+        while not self._stop.is_set():
+            await asyncio.sleep(interval)
+            if self._stop.is_set() or self.engine.check_connection():
+                continue
+            restart = getattr(self.engine, "restart", None)
+            if restart is None or not self.config.engine_auto_restart:
+                continue
+            log.error("engine thread is down; attempting restart")
+            try:
+                ok = await asyncio.get_running_loop().run_in_executor(
+                    None, restart)
+            except Exception as e:
+                log.error(f"engine restart raised: {e}", exc_info=True)
+                ok = False
+            if ok:
+                self._m_restarts.inc()
+            (log.info if ok else log.error)(
+                f"engine restart {'succeeded' if ok else 'failed'}")
 
     def verify_backend(self) -> None:
         """Pre-flight: refuse to serve if the engine isn't healthy
@@ -81,10 +111,12 @@ class ServerLauncher:
         log.info(f"Monitoring on http://{self.config.monitoring_host}:"
                  f"{self.config.monitoring_port}/health")
 
+        watchdog = asyncio.create_task(self._watchdog())
         try:
             await self._stop.wait()
         finally:
             log.info("shutting down")
+            watchdog.cancel()
             await main_runner.cleanup()
             await mon_runner.cleanup()
             self.engine.shutdown()

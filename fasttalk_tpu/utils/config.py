@@ -166,6 +166,10 @@ class Config:
 
     # Session (reference: config.py:149-152)
     session_timeout: int = field(default_factory=lambda: _env_int("SESSION_TIMEOUT", 3600))
+    # Supervised in-process engine restart after a crash (the in-tree
+    # analogue of the reference's docker `restart: unless-stopped`).
+    engine_auto_restart: bool = field(
+        default_factory=lambda: _env_bool("ENGINE_AUTO_RESTART", True))
     max_history_length: int = field(default_factory=lambda: _env_int("MAX_HISTORY_LENGTH", 50))
     log_path: str = field(default_factory=lambda: _env_str("LOG_PATH", "./logs"))
 
