@@ -1,0 +1,28 @@
+@echo off
+REM Run the FastTalk-TPU gateway on a CUDA Windows host against a local
+REM Ollama (mirror of run-gpu.sh; reference shipped run-gpu.bat the
+REM same way). The gateway needs no GPU; compute happens inside Ollama.
+cd /d "%~dp0"
+
+if not exist ".venv" (
+    python -m venv .venv
+)
+call .venv\Scripts\activate.bat
+
+python -c "import jax" 2>NUL
+if errorlevel 1 goto install
+pip show --quiet fasttalk-tpu 2>NUL
+if errorlevel 1 goto install
+goto run
+:install
+pip install --quiet --upgrade pip
+pip install --quiet -e .
+:run
+
+set JAX_PLATFORMS=cpu
+set COMPUTE_DEVICE=cpu
+if "%LLM_PROVIDER%"=="" set LLM_PROVIDER=ollama
+if "%OLLAMA_BASE_URL%"=="" set OLLAMA_BASE_URL=http://127.0.0.1:11434
+if "%LLM_MODEL%"=="" set LLM_MODEL=llama3.2:1b
+
+python main.py websocket %*

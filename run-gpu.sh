@@ -1,0 +1,31 @@
+#!/bin/bash
+# Run the FastTalk-TPU gateway natively on a CUDA host against a local
+# Ollama (`ollama serve` with GPU) — the parity analogue of the
+# reference's run-gpu.sh legacy path. The gateway itself needs no GPU;
+# compute happens inside Ollama. For the containerised equivalent use
+# docker-compose.gpu.yml.
+set -e
+
+cd "$(dirname "$0")"
+
+if [ ! -d ".venv" ]; then
+    python3 -m venv .venv
+fi
+# shellcheck disable=SC1091
+source .venv/bin/activate
+
+# jax probes the deps; pip show probes the (editable) package install
+# itself — `import fasttalk_tpu` alone succeeds from the repo root CWD
+# even with nothing installed.
+if ! python -c "import jax" 2>/dev/null || ! pip show --quiet fasttalk-tpu 2>/dev/null; then
+    pip install --quiet --upgrade pip
+    pip install --quiet -e .
+fi
+
+export JAX_PLATFORMS=cpu
+export COMPUTE_DEVICE=cpu
+export LLM_PROVIDER="${LLM_PROVIDER:-ollama}"
+export OLLAMA_BASE_URL="${OLLAMA_BASE_URL:-http://127.0.0.1:11434}"
+export LLM_MODEL="${LLM_MODEL:-llama3.2:1b}"
+
+exec python main.py websocket "$@"
