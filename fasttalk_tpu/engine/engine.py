@@ -78,6 +78,18 @@ class GenerationParams:
     top_p: float = 0.9
     max_tokens: int = 2048
     stop: list[str] = field(default_factory=list)
+    # Text-completion mode (/v1/completions): the prompt is the joined
+    # message content, tokenized verbatim (BOS + bytes, no chat
+    # template). Out of band on purpose — an in-band role sentinel
+    # would let chat clients bypass the template.
+    raw_prompt: bool = False
+
+
+def raw_prompt_text(messages: list[dict]) -> str:
+    """The raw completion prompt for ``raw_prompt=True``: joined message
+    content. One definition for every backend (tpu/vllm/ollama must
+    produce the same prompt for the same request)."""
+    return "".join(str(m.get("content") or "") for m in messages)
 
 
 @dataclass
@@ -466,7 +478,13 @@ class TPUEngine(EngineBase):
             raise LLMServiceError("Engine is not running (call start())",
                                   category=ErrorCategory.CONNECTION,
                                   recoverable=True)
-        prompt = self.tokenizer.apply_chat_template(messages)
+        if params.raw_prompt:
+            # Raw text-completion path (/v1/completions): BOS + verbatim
+            # tokens, no chat template (matching vLLM's completions
+            # endpoint, which prepends BOS by default).
+            prompt = self.tokenizer.encode_prompt(raw_prompt_text(messages))
+        else:
+            prompt = self.tokenizer.apply_chat_template(messages)
         if len(prompt) >= self.usable_len:
             raise LLMServiceError(
                 f"Prompt of {len(prompt)} tokens exceeds context window "

@@ -18,7 +18,8 @@ from typing import Any, AsyncGenerator
 
 import aiohttp
 
-from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.engine.engine import (EngineBase, GenerationParams,
+                                        raw_prompt_text)
 from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
 from fasttalk_tpu.utils.logger import get_logger
 
@@ -104,12 +105,19 @@ class VLLMRemoteEngine(_RemoteEngine):
         client = await self._client()
         body = {
             "model": self.model,
-            "messages": messages,
             "temperature": params.temperature,
             "top_p": params.top_p,
             "max_tokens": params.max_tokens,
             "stream": True,
         }
+        if params.raw_prompt:
+            # /v1/completions passthrough: raw prompt, upstream's own
+            # legacy endpoint (no chat template anywhere).
+            url = f"{self.base_url}/completions"
+            body["prompt"] = raw_prompt_text(messages)
+        else:
+            url = f"{self.base_url}/chat/completions"
+            body["messages"] = messages
         if params.stop:
             body["stop"] = params.stop
         started = time.monotonic()
@@ -118,7 +126,7 @@ class VLLMRemoteEngine(_RemoteEngine):
         finish = "stop"
         try:
             async with client.post(
-                    f"{self.base_url}/chat/completions", json=body,
+                    url, json=body,
                     headers={"Authorization": f"Bearer {self.api_key}"},
                     ) as resp:
                 if resp.status != 200:
@@ -147,11 +155,13 @@ class VLLMRemoteEngine(_RemoteEngine):
                     choices = obj.get("choices") or []
                     if not choices:
                         continue
-                    delta = choices[0].get("delta", {})
                     fr = choices[0].get("finish_reason")
                     if fr:
                         finish = fr
-                    content = delta.get("content")
+                    # chat streams deltas; completions streams text
+                    content = (choices[0].get("text") if params.raw_prompt
+                               else choices[0].get("delta", {})
+                               .get("content"))
                     if content:
                         tokens += 1
                         if ttft is None:
@@ -206,7 +216,6 @@ class OllamaRemoteEngine(_RemoteEngine):
         client = await self._client()
         body = {
             "model": self.model,
-            "messages": messages,
             "stream": True,
             "keep_alive": self.keep_alive,
             "options": {
@@ -216,14 +225,21 @@ class OllamaRemoteEngine(_RemoteEngine):
                 "num_predict": params.max_tokens,
             },
         }
+        if params.raw_prompt:
+            # /api/generate with raw=true: Ollama's untemplated path.
+            url = f"{self.base_url}/api/generate"
+            body["prompt"] = raw_prompt_text(messages)
+            body["raw"] = True
+        else:
+            url = f"{self.base_url}/api/chat"
+            body["messages"] = messages
         if params.stop:
             body["options"]["stop"] = params.stop
         started = time.monotonic()
         ttft = None
         tokens = 0
         try:
-            async with client.post(f"{self.base_url}/api/chat",
-                                   json=body) as resp:
+            async with client.post(url, json=body) as resp:
                 if resp.status != 200:
                     text = await resp.text()
                     raise LLMServiceError(
@@ -244,7 +260,10 @@ class OllamaRemoteEngine(_RemoteEngine):
                         obj = json.loads(line)
                     except json.JSONDecodeError:
                         continue
-                    content = (obj.get("message") or {}).get("content")
+                    # /api/chat nests under message; /api/generate is flat
+                    content = (obj.get("response") if params.raw_prompt
+                               else (obj.get("message") or {})
+                               .get("content"))
                     if content:
                         tokens += 1
                         if ttft is None:

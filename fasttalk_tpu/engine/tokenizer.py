@@ -23,6 +23,8 @@ class Tokenizer(Protocol):
 
     def encode(self, text: str) -> list[int]: ...
 
+    def encode_prompt(self, text: str) -> list[int]: ...
+
     def decode(self, ids: Sequence[int]) -> str: ...
 
     def apply_chat_template(self, messages: Sequence[Message],
@@ -58,6 +60,10 @@ class ByteTokenizer:
 
     def encode(self, text: str) -> list[int]:
         return list(text.encode("utf-8"))
+
+    def encode_prompt(self, text: str) -> list[int]:
+        """Raw completion prompt: BOS + verbatim tokens (no template)."""
+        return [self.BOS] + self.encode(text)
 
     def decode(self, ids: Sequence[int]) -> str:
         """Bytes decode to text; specials decode to nothing; ids beyond
@@ -154,6 +160,11 @@ def render_mistral(messages: Sequence[Message],
 
 _TEMPLATES = {"llama3": render_llama3, "chatml": render_chatml,
               "mistral": render_mistral}
+# BOS text per template family, for raw (untemplated) completion
+# prompts — vLLM's /v1/completions prepends BOS by default, so parity
+# requires it here (ChatML models have no BOS).
+_BOS_TEXT = {"llama3": "<|begin_of_text|>", "chatml": "",
+             "mistral": "<s>"}
 
 
 class HFTokenizer:
@@ -167,6 +178,9 @@ class HFTokenizer:
 
         self._tok = RustTokenizer.from_file(tokenizer_file)
         self._render = _TEMPLATES.get(template, render_llama3)
+        # Fallback mirrors the template fallback: an unknown template
+        # name renders llama3, so its raw prompts must get llama3's BOS.
+        self._bos_text = _BOS_TEXT.get(template, _BOS_TEXT["llama3"])
         self.vocab_size = self._tok.get_vocab_size()
         eos = set()
         for name in ("<|eot_id|>", "<|end_of_text|>", "</s>", "<|eom_id|>",
@@ -180,6 +194,11 @@ class HFTokenizer:
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False).ids
+
+    def encode_prompt(self, text: str) -> list[int]:
+        """Raw completion prompt: template-family BOS + verbatim tokens
+        (the same textual-special-token path the chat templates use)."""
+        return self.encode(self._bos_text + text)
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)

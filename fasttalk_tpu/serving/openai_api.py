@@ -152,6 +152,16 @@ def _inject_tools_prompt(messages: list[dict], specs: list[dict],
     return inject_tools_section(messages, section)
 
 
+def _unwrap_agent(engine):
+    """Route around the native agent's tool loop for surfaces where the
+    CLIENT (or nobody) drives tools. Explicit isinstance: any other
+    wrapper that happens to hold an inner .engine must NOT be
+    bypassed."""
+    from fasttalk_tpu.agents.voice_agent import VoiceAgent
+
+    return engine.engine if isinstance(engine, VoiceAgent) else engine
+
+
 def _oai_tool_call(call, index: int) -> dict:
     return {
         "index": index,
@@ -201,6 +211,104 @@ def register_openai_routes(app: web.Application,
             stop=[s for s in stop if isinstance(s, str) and s],
         )
 
+    def _breaker_503() -> web.Response | None:
+        if breaker is None:
+            return None
+        try:
+            breaker.check()
+            return None
+        except CircuitBreakerOpen as e:
+            return web.json_response(
+                {"error": {"message": e.message,
+                           "type": "server_error",
+                           "retry_after": e.retry_after}}, status=503)
+
+    async def _stream_events(resp, engine, completion_id, session_id,
+                             messages, params, handle_token, finalize,
+                             write_finish) -> None:
+        """The SSE event loop both completion surfaces share: token
+        routing, terminal mapping, the error frame (a failed stream ends
+        on the error frame + [DONE] with no normal finish chunk, so SDK
+        clients can't mistake it for success), breaker accounting, and
+        slot release."""
+        try:
+            finish_reason = "stop"
+            failed = False
+            async for event in engine.generate(completion_id, session_id,
+                                               messages, params):
+                if event["type"] == "token":
+                    await handle_token(event["text"])
+                elif event["type"] in ("done", "cancelled"):
+                    finish_reason = _oai_finish(
+                        event.get("finish_reason", "stop"))
+                elif event["type"] == "error":
+                    failed = True
+                    await resp.write(
+                        f"data: {json.dumps({'error': event.get('error')})}\n\n"
+                        .encode())
+                    break
+            if not failed:
+                finish_reason = await finalize(finish_reason)
+            if breaker is not None:
+                (breaker.record_failure if failed
+                 else breaker.record_success)()
+            if not failed:
+                await write_finish(finish_reason)
+            await resp.write(b"data: [DONE]\n\n")
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        finally:
+            engine.release_session(session_id)
+
+    async def _collect_events(engine, completion_id, session_id, messages,
+                              params, on_token):
+        """Non-streaming accumulation both surfaces share. Returns
+        (stats, finish_reason, error_response_or_None)."""
+        stats: dict[str, Any] = {}
+        finish_reason = "stop"
+        try:
+            async for event in engine.generate(completion_id, session_id,
+                                               messages, params):
+                if event["type"] == "token":
+                    on_token(event["text"])
+                elif event["type"] in ("done", "cancelled"):
+                    stats = event.get("stats", {})
+                    finish_reason = _oai_finish(
+                        event.get("finish_reason", "stop"))
+                elif event["type"] == "error":
+                    if breaker is not None:
+                        breaker.record_failure()
+                    return stats, finish_reason, web.json_response(
+                        {"error": {"message": str(event.get("error")),
+                                   "type": "server_error"}}, status=500)
+            if breaker is not None:
+                breaker.record_success()
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        finally:
+            engine.release_session(session_id)
+        return stats, finish_reason, None
+
+    def _usage(stats: dict) -> dict:
+        prompt_tokens = int(stats.get("prompt_tokens", 0))
+        completion_tokens = int(stats.get("tokens_generated", 0))
+        return {"prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens}
+
+    async def _sse_response(request: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        })
+        await resp.prepare(request)
+        return resp
+
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -237,26 +345,13 @@ def register_openai_routes(app: web.Application,
             # against the server-side registry before this route's parser
             # ever saw them. Explicit isinstance: any other wrapper that
             # happens to hold an inner .engine must NOT be bypassed.
-            from fasttalk_tpu.agents.voice_agent import VoiceAgent
-
-            if isinstance(engine, VoiceAgent):
-                engine = engine.engine
-        if breaker is not None:
-            try:
-                breaker.check()
-            except CircuitBreakerOpen as e:
-                return web.json_response(
-                    {"error": {"message": e.message,
-                               "type": "server_error",
-                               "retry_after": e.retry_after}}, status=503)
+            engine = _unwrap_agent(engine)
+        denied = _breaker_503()
+        if denied is not None:
+            return denied
 
         if body.get("stream"):
-            resp = web.StreamResponse(headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-            })
-            await resp.prepare(request)
+            resp = await _sse_response(request)
 
             def chunk(delta: dict, finish: str | None = None) -> bytes:
                 payload = {
@@ -267,93 +362,59 @@ def register_openai_routes(app: web.Application,
                 }
                 return f"data: {json.dumps(payload)}\n\n".encode()
 
-            try:
-                await resp.write(chunk({"role": "assistant"}))
-                finish_reason = "stop"
-                failed = False
-                n_calls = 0
-                async for event in engine.generate(completion_id, session_id,
-                                                   messages, params):
-                    if event["type"] == "token":
-                        if parser is None:
-                            await resp.write(chunk({"content":
-                                                    event["text"]}))
-                            continue
-                        text, calls = parser.feed(event["text"])
-                        if text:
-                            await resp.write(chunk({"content": text}))
-                        for call in calls:
-                            if not call.name:
-                                continue  # malformed markup: drop
-                            await resp.write(chunk({"tool_calls": [
-                                _oai_tool_call(call, n_calls)]}))
-                            n_calls += 1
-                    elif event["type"] in ("done", "cancelled"):
-                        finish_reason = _oai_finish(
-                            event.get("finish_reason", "stop"))
-                    elif event["type"] == "error":
-                        failed = True
-                        await resp.write(
-                            f"data: {json.dumps({'error': event.get('error')})}\n\n"
-                            .encode())
-                        break
-                if parser is not None and not failed:
+            await resp.write(chunk({"role": "assistant"}))
+            n_calls = 0
+
+            async def handle_token(text: str) -> None:
+                nonlocal n_calls
+                if parser is None:
+                    await resp.write(chunk({"content": text}))
+                    return
+                text, calls = parser.feed(text)
+                if text:
+                    await resp.write(chunk({"content": text}))
+                for call in calls:
+                    if not call.name:
+                        continue  # malformed markup: drop
+                    await resp.write(chunk({"tool_calls": [
+                        _oai_tool_call(call, n_calls)]}))
+                    n_calls += 1
+
+            async def finalize(finish_reason: str) -> str:
+                if parser is not None:
                     tail = parser.flush()
                     if tail:
                         await resp.write(chunk({"content": tail}))
                     if n_calls:
-                        finish_reason = "tool_calls"
-                if breaker is not None:
-                    (breaker.record_failure if failed
-                     else breaker.record_success)()
-                if not failed:
-                    # A failed stream ends on the error frame + [DONE];
-                    # emitting a normal finish chunk would make the turn
-                    # look successfully completed to SDK clients.
-                    await resp.write(chunk({}, finish=finish_reason))
-                await resp.write(b"data: [DONE]\n\n")
-            except Exception:
-                if breaker is not None:
-                    breaker.record_failure()
-                raise
-            finally:
-                engine.release_session(session_id)
+                        return "tool_calls"
+                return finish_reason
+
+            async def write_finish(finish_reason: str) -> None:
+                await resp.write(chunk({}, finish=finish_reason))
+
+            await _stream_events(resp, engine, completion_id, session_id,
+                                 messages, params, handle_token, finalize,
+                                 write_finish)
             return resp
 
         # Non-streaming
         text = ""
         tool_calls: list[dict] = []
-        stats: dict[str, Any] = {}
-        finish_reason = "stop"
-        try:
-            async for event in engine.generate(completion_id, session_id,
-                                               messages, params):
-                if event["type"] == "token":
-                    if parser is None:
-                        text += event["text"]
-                        continue
-                    t, calls = parser.feed(event["text"])
-                    text += t
-                    tool_calls.extend(_oai_tool_call(c, len(tool_calls))
-                                      for c in calls if c.name)
-                elif event["type"] in ("done", "cancelled"):
-                    stats = event.get("stats", {})
-                    finish_reason = _oai_finish(
-                        event.get("finish_reason", "stop"))
-                elif event["type"] == "error":
-                    if breaker is not None:
-                        breaker.record_failure()
-                    return web.json_response(
-                        {"error": {"message": str(event.get("error")),
-                                   "type": "server_error"}}, status=500)
-            if breaker is not None:
-                breaker.record_success()
-        except Exception:
-            if breaker is not None:
-                breaker.record_failure()
-            raise
-        finally:
-            engine.release_session(session_id)
+
+        def on_token(t: str) -> None:
+            nonlocal text
+            if parser is None:
+                text += t
+                return
+            piece, calls = parser.feed(t)
+            text += piece
+            tool_calls.extend(_oai_tool_call(c, len(tool_calls))
+                              for c in calls if c.name)
+
+        stats, finish_reason, err = await _collect_events(
+            engine, completion_id, session_id, messages, params, on_token)
+        if err is not None:
+            return err
         if parser is not None:
             text += parser.flush()
             if tool_calls:
@@ -362,8 +423,6 @@ def register_openai_routes(app: web.Application,
                                    "content": text or None}
         if tool_calls:
             message["tool_calls"] = tool_calls
-        prompt_tokens = int(stats.get("prompt_tokens", 0))
-        completion_tokens = int(stats.get("tokens_generated", 0))
         return web.json_response({
             "id": completion_id,
             "object": "chat.completion",
@@ -374,15 +433,104 @@ def register_openai_routes(app: web.Application,
                 "message": message,
                 "finish_reason": finish_reason,
             }],
-            "usage": {
-                "prompt_tokens": prompt_tokens,
-                "completion_tokens": completion_tokens,
-                "total_tokens": prompt_tokens + completion_tokens,
-            },
+            "usage": _usage(stats),
+        })
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        """Legacy text completions (/v1/completions): raw prompt, no
+        chat template, no tools — vLLM served both surfaces and some
+        ecosystem tooling still speaks this one."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body",
+                           "type": "invalid_request_error"}}, status=400)
+        prompt = body.get("prompt")
+        if isinstance(prompt, list):
+            if len(prompt) != 1 or not isinstance(prompt[0], str):
+                return web.json_response(
+                    {"error": {"message": "prompt must be a string (or a "
+                               "single-element list of strings)",
+                               "type": "invalid_request_error"}}, status=400)
+            prompt = prompt[0]
+        if not isinstance(prompt, str) or not prompt:
+            return web.json_response(
+                {"error": {"message": "prompt must be a non-empty string",
+                           "type": "invalid_request_error"}}, status=400)
+        try:
+            params = _params(body)
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}}, status=400)
+        params.raw_prompt = True  # out-of-band: no template, BOS + bytes
+        if (body.get("max_tokens") is None
+                and body.get("max_completion_tokens") is None):
+            # The legacy endpoint's spec default is 16 (vLLM matches);
+            # inheriting the chat default (2048) would surprise clients
+            # migrating from a vLLM deployment.
+            params.max_tokens = 16
+        completion_id = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = _now()
+        session_id = body.get("user") or f"oai-{completion_id}"
+        req_model = body.get("model", get_name())
+        # The raw path never goes through an agent's tool loop.
+        engine = _unwrap_agent(get_backend())
+        messages = [{"role": "user", "content": prompt}]
+        denied = _breaker_503()
+        if denied is not None:
+            return denied
+
+        if body.get("stream"):
+            resp = await _sse_response(request)
+
+            def chunk(text: str, finish: str | None = None) -> bytes:
+                payload = {
+                    "id": completion_id, "object": "text_completion",
+                    "created": created, "model": req_model,
+                    "choices": [{"index": 0, "text": text,
+                                 "finish_reason": finish}],
+                }
+                return f"data: {json.dumps(payload)}\n\n".encode()
+
+            async def handle_token(text: str) -> None:
+                await resp.write(chunk(text))
+
+            async def finalize(finish_reason: str) -> str:
+                return finish_reason
+
+            async def write_finish(finish_reason: str) -> None:
+                await resp.write(chunk("", finish=finish_reason))
+
+            await _stream_events(resp, engine, completion_id, session_id,
+                                 messages, params, handle_token, finalize,
+                                 write_finish)
+            return resp
+
+        text = ""
+
+        def on_token(t: str) -> None:
+            nonlocal text
+            text += t
+
+        stats, finish_reason, err = await _collect_events(
+            engine, completion_id, session_id, messages, params, on_token)
+        if err is not None:
+            return err
+        return web.json_response({
+            "id": completion_id,
+            "object": "text_completion",
+            "created": created,
+            "model": req_model,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": finish_reason}],
+            "usage": _usage(stats),
         })
 
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
 
 
 def _oai_finish(reason: str) -> str:

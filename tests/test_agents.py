@@ -131,7 +131,7 @@ class ScriptedEngine(EngineBase):
         pass
 
     async def generate(self, request_id, session_id, messages, params):
-        self.calls.append({"messages": messages})
+        self.calls.append({"messages": messages, "params": params})
         text = self.responses.pop(0)
         for i in range(0, len(text), 7):  # stream in small chunks
             yield {"type": "token", "text": text[i:i + 7]}
@@ -650,5 +650,103 @@ class TestOpenAIToolCalling:
             assert not any(
                 p.get("choices", [{}])[0].get("finish_reason")
                 for p in payloads if "choices" in p)
+        finally:
+            await client.close()
+
+
+class TestCompletionsEndpoint:
+    """Legacy /v1/completions: raw prompt, no chat template, no tools."""
+
+    async def _client(self, responses):
+        from aiohttp import web
+
+        from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+        eng = ScriptedEngine(responses)
+        app = web.Application()
+        register_openai_routes(app, eng, "test-model")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client, eng
+
+    async def test_non_streaming(self):
+        client, eng = await self._client(["Once upon a time."])
+        try:
+            r = await client.post("/v1/completions", json={
+                "model": "test-model", "prompt": "Story:", "max_tokens": 16,
+            })
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "text_completion"
+            assert body["choices"][0]["text"] == "Once upon a time."
+            assert body["usage"]["completion_tokens"] > 0
+            # raw path: out-of-band flag, untouched user message
+            assert eng.calls[0]["params"].raw_prompt is True
+            seen = eng.calls[0]["messages"]
+            assert seen == [{"role": "user", "content": "Story:"}]
+        finally:
+            await client.close()
+
+    async def test_streaming(self):
+        client, _ = await self._client(["stream me"])
+        try:
+            r = await client.post("/v1/completions", json={
+                "prompt": "x", "stream": True,
+            })
+            raw = await r.text()
+            lines = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+            assert lines[-1] == "data: [DONE]"
+            chunks = [json.loads(ln[5:]) for ln in lines[:-1]]
+            text = "".join(c["choices"][0]["text"] for c in chunks)
+            assert text == "stream me"
+            assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        finally:
+            await client.close()
+
+    async def test_single_element_list_prompt(self):
+        client, _ = await self._client(["ok"])
+        try:
+            r = await client.post("/v1/completions",
+                                  json={"prompt": ["only one"]})
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    async def test_validation(self):
+        client, _ = await self._client(["ok"] * 3)
+        try:
+            for bad in ({}, {"prompt": ""}, {"prompt": ["a", "b"]},
+                        {"prompt": 42}):
+                r = await client.post("/v1/completions", json=bad)
+                assert r.status == 400, bad
+        finally:
+            await client.close()
+
+    async def test_agent_backend_unwrapped(self):
+        from aiohttp import web
+
+        from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+        eng = ScriptedEngine(["plain"])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        app = web.Application()
+        register_openai_routes(app, agent, "test-model")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/completions", json={"prompt": "p"})
+            assert r.status == 200
+            # bare engine, no tool prompt injection, raw flag set
+            assert eng.calls[0]["params"].raw_prompt is True
+            assert eng.calls[0]["messages"] == [{"role": "user",
+                                                 "content": "p"}]
+        finally:
+            await client.close()
+
+    async def test_default_max_tokens_is_16(self):
+        client, eng = await self._client(["a b c"])
+        try:
+            await client.post("/v1/completions", json={"prompt": "p"})
+            assert eng.calls[0]["params"].max_tokens == 16
         finally:
             await client.close()

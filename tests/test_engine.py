@@ -863,7 +863,10 @@ class TestEngineRestart:
                           GenerationParams(max_tokens=8, **GREEDY))
         assert events[-1]["type"] == "error"
         assert "crash" in events[-1]["error"]
+        # _stopped is set in the thread's finally while the thread is
+        # still unwinding; join before asserting it reads as down.
         assert eng._stopped.wait(timeout=10)
+        eng._thread.join(timeout=10)
         assert not eng.check_connection()
         eng._dispatch_decode = orig
 
@@ -918,3 +921,15 @@ class TestEngineRestart:
             assert events[-1]["type"] == "done"
         finally:
             eng.shutdown()
+
+
+def test_raw_prompt_bypasses_chat_template(engine):
+    """/v1/completions path: params.raw_prompt tokenizes the prompt as
+    BOS + verbatim bytes (no role/template tokens), so prompt_tokens is
+    exactly 1 + len(text) on the byte tokenizer."""
+    events = _collect(engine, "r-raw", "s-raw",
+                      [{"role": "user", "content": "abcdef"}],
+                      GenerationParams(max_tokens=4, raw_prompt=True,
+                                       **GREEDY))
+    assert events[-1]["type"] == "done"
+    assert events[-1]["stats"]["prompt_tokens"] == 7

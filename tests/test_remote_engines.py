@@ -126,3 +126,83 @@ class TestOllamaRemote:
             eng.shutdown()
         finally:
             await server.close()
+
+
+async def test_vllm_raw_completions_passthrough():
+    """params.raw_prompt routes to the upstream /v1/completions with a
+    raw prompt (no chat messages) and parses text chunks."""
+    app = web.Application()
+    seen = {}
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        seen.update(body)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        for word in ["raw ", "text"]:
+            chunk = {"choices": [{"text": word, "finish_reason": None}]}
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        done = {"choices": [{"text": "", "finish_reason": "stop"}]}
+        await resp.write(f"data: {json.dumps(done)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    app.router.add_post("/v1/completions", completions)
+    server = TestServer(app)
+    await server.start_server()
+    eng = VLLMRemoteEngine(f"http://127.0.0.1:{server.port}/v1", "m1")
+    eng.start()
+    try:
+        text = ""
+        async for ev in eng.generate(
+                "r1", "s1", [{"role": "user", "content": "Once upon"}],
+                GenerationParams(max_tokens=8, raw_prompt=True)):
+            if ev["type"] == "token":
+                text += ev["text"]
+            else:
+                assert ev["type"] == "done"
+        assert text == "raw text"
+        assert seen["prompt"] == "Once upon"
+        assert "messages" not in seen
+    finally:
+        eng.shutdown()
+        await server.close()
+
+
+async def test_ollama_raw_generate_passthrough():
+    """params.raw_prompt routes to /api/generate with raw=true."""
+    app = web.Application()
+    seen = {}
+
+    async def generate(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        seen.update(body)
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        for word in ["un", "templated"]:
+            await resp.write((json.dumps(
+                {"response": word, "done": False}) + "\n").encode())
+        await resp.write((json.dumps(
+            {"response": "", "done": True}) + "\n").encode())
+        return resp
+
+    app.router.add_post("/api/generate", generate)
+    server = TestServer(app)
+    await server.start_server()
+    eng = OllamaRemoteEngine(f"http://127.0.0.1:{server.port}", "m1")
+    eng.start()
+    try:
+        text = ""
+        async for ev in eng.generate(
+                "r1", "s1", [{"role": "user", "content": "2+2="}],
+                GenerationParams(max_tokens=8, raw_prompt=True)):
+            if ev["type"] == "token":
+                text += ev["text"]
+        assert text == "untemplated"
+        assert seen["prompt"] == "2+2="
+        assert seen["raw"] is True
+        assert "messages" not in seen
+    finally:
+        eng.shutdown()
+        await server.close()
