@@ -59,7 +59,8 @@ import numpy as np
 from fasttalk_tpu.engine.slots import Slot, SlotManager
 from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
 from fasttalk_tpu.models.configs import ModelConfig
-from fasttalk_tpu.models.llama import KVCache, forward, init_cache
+from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
+                                       init_cache)
 from fasttalk_tpu.ops.sampling import sample_tokens
 from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
 from fasttalk_tpu.utils.logger import get_logger
@@ -196,6 +197,18 @@ class TPUEngine(EngineBase):
         # int8-matmul kernels gate independently.
         self.use_pallas_attention = use_pallas_attention and mesh is None
         self.use_pallas_int8 = use_pallas_int8 and mesh is None
+        # Single-device decode uses models.llama.forward_decode: the
+        # whole cache rides the step scan's CARRY (carries alias inside
+        # a program), each step scatter-writes only the new K/V column,
+        # and attention reads a slice bounded by the KV bucket. The r2
+        # design sliced the bucket out of the cache and scattered it
+        # back around every K-step call; together with the scan-ys
+        # recycling inside forward() those copies traced at ~40% of
+        # decode wall time on a v5e-1 (measured best structure of five:
+        # 3.96 ms/step vs 4.99 classic, llama.py forward_decode note).
+        # The mesh path keeps forward(): its cache is "sp"-sharded and
+        # per-layer dynamic slices would break GSPMD's even sharding.
+        self._scatter_decode = mesh is None
 
         if mesh is not None:
             # Tensor-parallel serving: weights and KV sharded over ICI;
@@ -584,19 +597,40 @@ class TPUEngine(EngineBase):
         if fn is not None:
             return fn
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
+        scatter = self._scatter_decode and not use_pallas
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode_call(params, cache: KVCache, cur_tokens, positions,
                         active, temps, topks, topps, rng):
+            if scatter:
+                def step(carry, _):
+                    ck, cv, cur, pos, key = carry
+                    key, sub = jax.random.split(key)
+                    # A slot that finished mid-pipeline keeps "decoding"
+                    # until the host reconciles; clamp it off the
+                    # attention horizon so its garbage writes can never
+                    # clobber live rows.
+                    act = jnp.logical_and(active, pos < kv_len)
+                    logits, newc = forward_decode(
+                        params, self.cfg, cur, pos, KVCache(ck, cv), act,
+                        attn_len=kv_len,
+                        pallas_int8=self.use_pallas_int8)
+                    nxt = sample_tokens(logits, sub, temps, topks, topps,
+                                        method=self.sampling_method)
+                    pos = pos + act.astype(pos.dtype)
+                    return (newc.k, newc.v, nxt, pos, key), nxt
+
+                (ck, cv, cur, pos, rng), toks = jax.lax.scan(
+                    step, (cache.k, cache.v, cur_tokens, positions, rng),
+                    None, length=self.steps_per_call)
+                return KVCache(ck, cv), toks, cur, pos, rng
+
             ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
             cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
 
             def step(carry, _):
                 sk, sv, cur, pos, key = carry
                 key, sub = jax.random.split(key)
-                # A slot that finished mid-pipeline keeps "decoding" until
-                # the host reconciles; clamp it off the cache edge so its
-                # garbage writes can never clobber live rows.
                 act = jnp.logical_and(active, pos < kv_len)
                 logits, small = forward(
                     params, self.cfg, cur[:, None], pos[:, None],
@@ -636,14 +670,14 @@ class TPUEngine(EngineBase):
             positions = start + jnp.arange(chunk)[None, :]
             logits, updated = forward(
                 params, self.cfg, tokens[None, :], positions,
-                KVCache(lk, lv), start[None], blockwise=True)
+                KVCache(lk, lv), start[None], blockwise=True,
+                pallas_int8=self.use_pallas_int8,
+                logits_indices=last_index[None])
             new_k = jax.lax.dynamic_update_slice(
                 cache.k, updated.k, (0, slot, 0, 0, 0))
             new_v = jax.lax.dynamic_update_slice(
                 cache.v, updated.v, (0, slot, 0, 0, 0))
-            last = jax.lax.dynamic_slice(
-                logits, (0, last_index, 0), (1, 1, logits.shape[-1]))[0, 0]
-            return KVCache(new_k, new_v), last
+            return KVCache(new_k, new_v), logits[0, 0]
 
         self._prefill_fns[chunk] = prefill_step
         return prefill_step
@@ -687,17 +721,17 @@ class TPUEngine(EngineBase):
             positions = starts[:, None] + jnp.arange(chunk)[None, :]
             logits, upd = forward(
                 params, self.cfg, tokens, positions, KVCache(gk, gv),
-                starts, blockwise=True, write_mask=mask)
+                starts, blockwise=True, write_mask=mask,
+                pallas_int8=self.use_pallas_int8,
+                logits_indices=last_idx)
             new_k = cache.k.at[:, slot_idx, :ctx].set(
                 upd.k, mode="drop", unique_indices=True)
             new_v = cache.v.at[:, slot_idx, :ctx].set(
                 upd.v, mode="drop", unique_indices=True)
-            last = jnp.take_along_axis(
-                logits, last_idx[:, None, None], axis=1)[:, 0]
             # First-token sampling fused into the same call: one device
             # round-trip per burst instead of two (TTFT-critical).
             rng, sub = jax.random.split(rng)
-            firsts = sample_tokens(last, sub, temps, topks, topps,
+            firsts = sample_tokens(logits[:, 0], sub, temps, topks, topps,
                                    method=self.sampling_method)
             new_cur = cur.at[slot_idx].set(firsts, mode="drop")
             return KVCache(new_k, new_v), firsts, new_cur, rng

@@ -61,12 +61,13 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
     # 1/tp size underestimates per-device bytes near the budget edge.
     norm_params = (2 * m.num_layers + 1) * m.hidden_size
     if cfg.quantize == "int8":
-        # Only matmul weights quantize (ops/quant.py QUANTIZED_LEAVES);
-        # the embedding, norms and biases stay at the engine dtype, and
-        # every quantized tensor gains a float32 per-output-channel
-        # scale row. Row-parallel (wo/w_down) scales replicate; the
-        # rest shard — all are KiB-scale, so count them all replicated
-        # (conservative).
+        # Matmul weights AND the embedding quantize (ops/quant.py
+        # QUANTIZED_LEAVES + EMBED_LEAF); norms and biases stay at the
+        # engine dtype. Every quantized tensor gains a float32 scale
+        # vector (per output channel; per vocab row for the embedding).
+        # Row-parallel (wo/w_down) and embed scales replicate; the rest
+        # shard — all are KiB-to-half-MiB scale, so count them all
+        # replicated (conservative).
         matmul_per_layer = (m.hidden_size * m.q_dim
                             + 2 * m.hidden_size * m.kv_dim
                             + m.q_dim * m.hidden_size
@@ -75,6 +76,8 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
                             + 2 * m.intermediate_size + m.hidden_size)
         matmul = m.num_layers * matmul_per_layer
         scales = m.num_layers * scales_per_layer
+        matmul += m.hidden_size * m.vocab_size  # embedding (row-quant)
+        scales += m.vocab_size
         if not m.tie_embeddings:
             matmul += m.hidden_size * m.vocab_size
             scales += m.vocab_size
@@ -125,6 +128,11 @@ def build_engine(cfg: Config) -> EngineBase:
         return OllamaRemoteEngine(cfg.ollama_base_url, cfg.model_name,
                                   keep_alive=cfg.ollama_keep_alive,
                                   timeout_s=cfg.ollama_timeout)
+    # Persistent compilation cache before the first compile: warmup's
+    # executables reload from disk on repeat starts of the same config.
+    from fasttalk_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(cfg.compile_cache, cfg.model_path)
     # Multi-host: bring up the JAX distributed runtime (DCN) before any
     # device use so meshes can span every host. No-op outside a
     # configured/pod environment. Lives here (not in the CLI) so bench,

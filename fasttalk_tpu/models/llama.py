@@ -27,6 +27,7 @@ import numpy as np
 
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.quant import embed_lookup, matmul_tied
 from fasttalk_tpu.ops.quant import matmul as qmm
 from fasttalk_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -124,6 +125,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             write_mask: jnp.ndarray | None = None,
             pallas_decode: bool = False,
             pallas_int8: bool = False,
+            logits_indices: jnp.ndarray | None = None,
             ) -> tuple[jnp.ndarray, KVCache]:
     """Run the transformer over ``tokens`` [B, T], updating the cache.
 
@@ -135,11 +137,22 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     chunks, ``pallas_decode`` the length-pruning Pallas kernel for T=1
     (single-device only — see ops/pallas_attention.py).
 
-    Returns (logits [B, T, vocab], updated cache).
+    ``logits_indices`` [B] (optional): project the lm_head for ONE
+    position per row instead of the whole chunk. Prefill only consumes
+    the last token's logits, and skipping the rest avoids both the
+    [B, T, vocab] logits buffer and — for int8 tied embeddings — an XLA
+    dequant that would materialise the full bf16 table per chunk; the
+    returned logits are [B, 1, vocab].
+
+    Returns (logits [B, T, vocab], updated cache). (The decode hot path
+    is ``forward_decode`` below — scatter cache writes + bounded
+    attention reads; this function serves prefill, training, and the
+    TP/mesh decode.)
     """
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
                                             cfg.rope_scaling))
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_lookup(params["embed"], tokens,
+                     params["final_norm"].dtype)
     b, t = tokens.shape
     # The int8 dequant-fused matmul kernel applies in the single-device
     # T=1 decode regime; its gate (pallas_int8) is independent of the
@@ -178,11 +191,94 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    pok_head = pok
+    if logits_indices is not None:
+        x = jnp.take_along_axis(
+            x, logits_indices.astype(jnp.int32)[:, None, None], axis=1)
+        pok_head = pallas_int8  # single row: the T=1 kernels apply
     if cfg.tie_embeddings:
-        logits = (x @ params["embed"].T).astype(jnp.float32)
+        logits = matmul_tied(x, params["embed"],
+                             pok_head).astype(jnp.float32)
     else:
-        logits = qmm(x, params["lm_head"], pok).astype(jnp.float32)
+        logits = qmm(x, params["lm_head"], pok_head).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v)
+
+
+def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
+                   positions: jnp.ndarray, cache: KVCache,
+                   write_mask: jnp.ndarray, *, attn_len: int,
+                   pallas_int8: bool = False,
+                   ) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step [B] -> logits [B, V], cache updated IN PLACE.
+
+    The throughput-critical specialisation of ``forward`` for T=1.
+    ``forward``'s layer scan threads the cache as scan xs/ys, and XLA
+    materialises the stacked ys every call — a full read+write of the
+    attention region per step (~1.1 GB/step at a 512 bucket for the 1B
+    model), plus the engine's outer bucket slice/scatter (traced at
+    14.8 ms per 8-step call on v5e-1). Here the WHOLE cache rides the
+    layer scan's carry (carries alias under donation), each layer
+    scatter-writes only the new token's K/V column ([B, Kv, H] — KiB,
+    not the bucket), and attention reads a per-layer dynamic-slice
+    bounded by the static ``attn_len``. Per-step HBM traffic drops to
+    weights + the keys attention actually needs.
+
+    positions [B]: current absolute position per slot. write_mask [B]:
+    rows with False neither write the cache nor advance (their scatter
+    is clamped out of range and dropped). attn_len: static attention
+    horizon (engine KV bucket).
+    """
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                            cfg.rope_scaling))
+    x = embed_lookup(params["embed"], cur[:, None],
+                     params["final_norm"].dtype)  # [B, 1, D]
+    b = cur.shape[0]
+    s_total = cache.max_len
+    pos2 = positions[:, None]
+    rows = jnp.arange(b)
+    # Masked rows scatter out of range -> dropped (mode="drop").
+    write_pos = jnp.where(write_mask, positions, s_total)
+
+    def layer(carry, lp):
+        x, ck_all, cv_all, li = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        pok = pallas_int8
+        q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
+                   qmm(h, lp["wv"], pok))
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, 1, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, 1, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, 1, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, pos2, inv_freq)
+        k = apply_rope(k, pos2, inv_freq)
+        ck_all = ck_all.at[li, rows, write_pos].set(
+            k[:, 0], mode="drop", unique_indices=True)
+        cv_all = cv_all.at[li, rows, write_pos].set(
+            v[:, 0], mode="drop", unique_indices=True)
+        ak = jax.lax.dynamic_slice(
+            ck_all, (li, 0, 0, 0, 0),
+            (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+        av = jax.lax.dynamic_slice(
+            cv_all, (li, 0, 0, 0, 0),
+            (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+        o = attend(q, ak, av, pos2)
+        x = x + qmm(o.reshape(b, 1, cfg.q_dim), lp["wo"], pok)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
+        up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
+        return (x, ck_all, cv_all, li + 1), None
+
+    (x, new_k, new_v, _), _ = jax.lax.scan(
+        layer, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if cfg.tie_embeddings:
+        logits = matmul_tied(x, params["embed"],
+                             pallas_int8).astype(jnp.float32)
+    else:
+        logits = qmm(x, params["lm_head"], pallas_int8).astype(jnp.float32)
+    return logits[:, 0], KVCache(k=new_k, v=new_v)
 
 
 def param_count(params: Params) -> int:

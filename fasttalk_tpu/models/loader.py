@@ -167,6 +167,8 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
     # cache, and dispatches are async so the relay round trip is paid
     # ~once, not per leaf.
     def _gen_leaf(base_key, crc, *, kind, shape, leaf_quantize):
+        # leaf_quantize: False | "out" (per-output-channel, matmul
+        # weights) | "row" (per-row, the embedding — ops/quant.py).
         if kind == "ones":
             return jnp.ones(shape, dtype)
         if kind == "zeros":
@@ -179,9 +181,14 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
             return jax.random.normal(k, sl_shape, jnp.float32) * scale
 
         def quantize_f32(wf):
-            # Same math as ops/quant.py _quantize_leaf.
-            s = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2) / 127.0, 1e-8)
-            return jnp.round(wf / s[..., None, :]).astype(jnp.int8), s
+            # Shared math with ops/quant.py so generated and
+            # checkpoint-quantized tables are bit-identical.
+            from fasttalk_tpu.ops.quant import (quantize_math_out,
+                                                quantize_math_row)
+
+            if leaf_quantize == "row":
+                return quantize_math_row(wf)
+            return quantize_math_out(wf)
 
         if len(shape) == 3:
             # Layer-stacked: generate one [in, out] f32 slice per layer
@@ -249,8 +256,12 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
             kind = "zeros"
         else:
             kind = "normal"
-        leaf_quantize = (quantize and kind == "normal"
-                         and name in QUANTIZED_LEAVES)
+        leaf_quantize: bool | str = False
+        if quantize and kind == "normal":
+            if name in QUANTIZED_LEAVES:
+                leaf_quantize = "out"
+            elif name == "embed":
+                leaf_quantize = "row"
         # crc32, not hash(): Python's hash is salted per process, which
         # would give each host of a multi-host slice different weights
         # for the same leaf (and break same-seed reproducibility).
@@ -264,7 +275,8 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
                                                         _spec_for)
 
             if leaf_quantize:
-                s_shape = shape[:-2] + shape[-1:]
+                s_shape = (shape[:-1] if leaf_quantize == "row"
+                           else shape[:-2] + shape[-1:])
                 out_sh = {
                     "q": NamedSharding(mesh, _spec_for(
                         "q", len(shape), shape, parent=name)),

@@ -49,7 +49,10 @@ def checkpoint_fingerprint(ckpt_dir: str | None) -> list | None:
 def cache_meta(cfg: ModelConfig, dtype, quantize: bool, mesh,
                ckpt_dir: str | None = None) -> dict:
     return {
-        "format": 1,
+        # 2: int8 now also row-quantizes the embedding (ops/quant.py
+        # EMBED_LEAF) — format bump invalidates r2-era caches whose
+        # pytree lacks the embed {q, s} dict.
+        "format": 2,
         "model": cfg.name,
         "dtype": jnp.dtype(dtype).name,
         "quantize": "int8" if quantize else "none",
@@ -99,6 +102,11 @@ def abstract_params(cfg: ModelConfig, dtype, quantize: bool, mesh) -> Any:
             return {
                 "q": with_sharding(sds.shape, jnp.int8, "q", name),
                 "s": with_sharding(lead + (out,), jnp.float32, "s", name),
+            }
+        if quantize and name == "embed":
+            return {
+                "q": with_sharding(sds.shape, jnp.int8, "q", name),
+                "s": with_sharding(sds.shape[:-1], jnp.float32, "s", name),
             }
         return with_sharding(sds.shape, sds.dtype, name, parent)
 

@@ -76,6 +76,12 @@ def _spec_for(name: str, ndim: int, shape=None, parent: str = "") -> P:
         if base is not None:
             if name == "q":
                 spec = base
+            elif parent == "embed":
+                # Embedding quantizes per ROW (ops/quant.py): the scale
+                # indexes the replicated vocab axis, not the TP-sharded
+                # hidden axis — and at [V] f32 it is small enough to
+                # replicate outright.
+                spec = P(None)
             else:  # scale: leading stacked-layer axis (if any) + out axis
                 spec = P(*base[:ndim - 1], base[-1])
             if len(spec) != ndim:

@@ -39,7 +39,9 @@ def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray,
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     b, t = inputs.shape
     positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
-    kv_dtype = params["embed"].dtype  # K/V written from activations
+    # K/V written from activations; final_norm is never quantized, so
+    # its dtype is the activation dtype even when embed is a {q, s} dict.
+    kv_dtype = params["final_norm"].dtype
     empty = KVCache(
         k=jnp.zeros((cfg.num_layers, b, t, cfg.num_kv_heads, cfg.head_dim),
                     kv_dtype),

@@ -183,8 +183,15 @@ class Config:
     tp_size: int = field(default_factory=lambda: _env_int("TPU_TP_SIZE", 1))
     dp_size: int = field(default_factory=lambda: _env_int("TPU_DP_SIZE", 1))
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
+    # The length-pruning Pallas decode-attention kernel. Off by default:
+    # profiled on v5e-1 its per-grid-cell cost (8 statically unrolled
+    # tiny GQA matmuls) makes it ~2x SLOWER than the XLA attention over
+    # a bucketed view at chat-scale lengths — it was the hidden reason
+    # r2's int8 measured equal to bf16. Worth enabling only for very
+    # long contexts with short active lengths, where block-level pruning
+    # beats reading the whole bucket.
     use_pallas_attention: bool = field(
-        default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", True))
+        default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", False))
     # Int8 dequant-fused matmul kernel (single-device decode); gates
     # independently of the attention kernel.
     use_pallas_int8: bool = field(
@@ -192,10 +199,15 @@ class Config:
     # Tokens decoded per device call (lax.scan inside one jitted step) and
     # number of calls kept in flight. Together these amortise and overlap
     # per-call host/dispatch latency — the dominant cost when the chip is
-    # reached over a relay, and still a measurable one locally.
+    # reached over a relay, and still a measurable one locally. 32:
+    # donated-buffer aliasing is unavailable on the relayed attach path
+    # (measured: a 1-element update of a donated 1 GiB cache costs a
+    # full-buffer copy), so every decode call pays a KV-cache
+    # boundary copy — more steps per call amortise it. Cost: cancel
+    # granularity coarsens to one call (~130 ms at 32 steps).
     decode_steps_per_call: int = field(
-        default_factory=lambda: _env_int("TPU_DECODE_STEPS", 16))
-    # At 16 steps/call one call's compute already covers the token-fetch
+        default_factory=lambda: _env_int("TPU_DECODE_STEPS", 32))
+    # At 32 steps/call one call's compute already covers the token-fetch
     # round trip, so depth 2 reaches full throughput while keeping the
     # stale-call tail (which delays the NEXT request's first token on the
     # in-order device queue) as short as possible.
@@ -211,6 +223,12 @@ class Config:
     # symmetric, in-tree replacement for the reference's external AWQ
     # engine config, .env.vllm.example:21).
     quantize: str = field(default_factory=lambda: _env_str("TPU_QUANTIZE", "none"))
+    # Persistent XLA compilation cache: "" = on at the default location
+    # (MODEL_PATH/.xla_cache or a per-user tmp dir), a path = on there,
+    # "off" = disabled. Makes warmup a one-time cost per configuration
+    # instead of per process (utils/compile_cache.py).
+    compile_cache: str = field(
+        default_factory=lambda: _env_str("TPU_COMPILE_CACHE", ""))
     # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
     # in-tree replacement for the reference's 300s engine-container
     # health start_period (docker-compose.vllm.yml:62-67). Empty means

@@ -1,0 +1,262 @@
+"""Kernel-level decode profile: bf16 vs int8(XLA) vs int8(Pallas).
+
+Answers VERDICT r2 weak #1/#6 with measurements instead of estimates:
+  - per-call and per-step cost of the K-step decode call at several
+    steps_per_call values (separates fixed per-call cost from marginal
+    per-step cost);
+  - whether the Pallas int8 matmul actually beats the XLA int8 lowering
+    and bf16 on the stacked layer weights (isolated streaming bench);
+  - the cost split: layer scan vs lm_head matmul vs sampling.
+
+Run on the bench host: python scripts/profile_decode.py [model]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fasttalk_tpu.models.configs import get_model_config
+from fasttalk_tpu.models.llama import KVCache, forward, init_cache
+from fasttalk_tpu.models.loader import init_params_device
+from fasttalk_tpu.ops.quant import quantize_params
+from fasttalk_tpu.ops.sampling import sample_tokens
+from fasttalk_tpu.utils.compile_cache import enable_compilation_cache
+
+SLOTS = 16
+KV_LEN = 512
+REPS = 10
+RT = 0.0  # measured relay round-trip latency, set in main()
+
+
+def measure_rt():
+    """One-way dispatch + tiny-fetch round trip of the attach path."""
+    global RT
+    one = jnp.ones((8,), jnp.int32)
+    f = jax.jit(lambda a: a + 1)
+    a = f(one)
+    np.asarray(a)
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        a = f(a)
+        np.asarray(a)
+        ts.append(time.perf_counter() - t0)
+    RT = float(np.median(ts))
+    print(f"relay round trip (tiny jit + fetch): {RT * 1e3:.1f} ms",
+          flush=True)
+
+
+def timeit(fn, *args, reps=REPS, donate_idx=None):
+    """Median wall time of fn(*args); handles donated args by
+    regenerating them per rep (cheap: donated cache buffer reuse)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), out
+
+
+def bench_weight_stream(cfg, params, label):
+    """Stream every layer's w_gate/w_up/w_down through a matmul under a
+    scan — the shape of the decode hot loop, minus attention/sampling."""
+    from fasttalk_tpu.ops.quant import matmul as qmm
+
+    x = jnp.ones((SLOTS, 1, cfg.hidden_size), jnp.bfloat16)
+
+    def body(x, lp):
+        h = qmm(x, lp["w_gate"], True)
+        u = qmm(x, lp["w_up"], True)
+        y = qmm((h * u).astype(x.dtype), lp["w_down"], True)
+        return (x + y).astype(x.dtype), ()
+
+    @jax.jit
+    def run(x, layers):
+        y, _ = jax.lax.scan(body, x, layers)
+        return y
+
+    layers = {k: params["layers"][k] for k in ("w_gate", "w_up", "w_down")}
+
+    def nbytes(t):
+        return sum(v.nbytes for v in jax.tree.leaves(t))
+
+    # Chain the output into the next call's input: identical (program,
+    # args) pairs can be served from a cache on relayed backends, which
+    # would report impossible bandwidth numbers.
+    # np.asarray (real host fetch) is the only reliable sync on the
+    # relayed backend — block_until_ready returns early there.
+    # Chained dispatch, ONE trailing fetch: per-call time is total/REPS
+    # minus the single ~100ms relay round trip — exactly how the
+    # pipelined engine experiences the device.
+    x = run(x, layers)
+    np.asarray(x)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        x = run(x, layers)
+    np.asarray(x)
+    dt = (time.perf_counter() - t0 - RT) / REPS
+    gb = nbytes(layers) / 1e9
+    print(f"  mlp-stream {label:12s}: {dt * 1e3:7.2f} ms "
+          f"({gb:.2f} GB -> {gb / dt:.0f} GB/s)")
+    return dt
+
+
+def make_decode_call(cfg, steps, pallas_int8, sampling="fast"):
+    # params is an ARGUMENT (as in the engine) — closing over it would
+    # capture 2.5GB of constants into the lowered program.
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode_call(params, cache, cur, pos, active, temps, topks, topps,
+                    rng):
+        ck = jax.lax.slice_in_dim(cache.k, 0, KV_LEN, axis=2)
+        cv = jax.lax.slice_in_dim(cache.v, 0, KV_LEN, axis=2)
+
+        def step(carry, _):
+            sk, sv, cur, pos, key = carry
+            key, sub = jax.random.split(key)
+            act = jnp.logical_and(active, pos < KV_LEN)
+            logits, small = forward(params, cfg, cur[:, None], pos[:, None],
+                                    KVCache(sk, sv), pos, write_mask=act,
+                                    pallas_int8=pallas_int8)
+            nxt = sample_tokens(logits[:, -1], sub, temps, topks, topps,
+                                method=sampling)
+            pos = pos + act.astype(pos.dtype)
+            return (sk, sv, nxt, pos, key), nxt
+
+        (ck, cv, cur, pos, rng), toks = jax.lax.scan(
+            step, (ck, cv, cur, pos, rng), None, length=steps)
+        nk = jax.lax.dynamic_update_slice_in_dim(cache.k, ck, 0, axis=2)
+        nv = jax.lax.dynamic_update_slice_in_dim(cache.v, cv, 0, axis=2)
+        return KVCache(nk, nv), toks
+
+    return decode_call
+
+
+def profile_variant(cfg, params, label, pallas_int8):
+    cache = init_cache(cfg, SLOTS, 2048, jnp.bfloat16)
+    cur = jnp.zeros((SLOTS,), jnp.int32)
+    pos = jnp.full((SLOTS,), 100, jnp.int32)
+    active = jnp.ones((SLOTS,), bool)
+    temps = jnp.full((SLOTS,), 0.7, jnp.float32)
+    topks = jnp.full((SLOTS,), 40, jnp.int32)
+    topps = jnp.full((SLOTS,), 0.9, jnp.float32)
+    rng = jax.random.PRNGKey(0)
+
+    results = {}
+    for steps in (8, 32):
+        fn = make_decode_call(cfg, steps, pallas_int8)
+        # warm compile; chain cur/rng through calls so no two calls have
+        # identical inputs (relay-cache defeat), exactly as the engine
+        # chains its decode state.
+        cache, toks = fn(params, cache, cur, pos, active, temps, topks,
+                         topps, rng)
+        np.asarray(toks)
+        cur = toks[-1]
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            cache, toks = fn(params, cache, cur, pos, active, temps,
+                             topks, topps, rng)
+            cur = toks[-1]
+        np.asarray(toks)
+        dt = (time.perf_counter() - t0 - RT) / REPS
+        results[steps] = dt
+        print(f"  {label:14s} steps={steps:3d}: {dt * 1e3:7.2f} ms/call "
+              f"= {dt / steps * 1e3:6.2f} ms/step "
+              f"({SLOTS * steps / dt:6.0f} agg tok/s)")
+    # fixed-cost estimate from the 8->32 line
+    per_step = (results[32] - results[8]) / 24
+    fixed = results[8] - 8 * per_step
+    print(f"  {label:14s} marginal {per_step * 1e3:.2f} ms/step, "
+          f"fixed {fixed * 1e3:.2f} ms/call")
+    del cache
+    return results
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "llama3.2:1b"
+    section = sys.argv[2] if len(sys.argv) > 2 else "all"
+    enable_compilation_cache("", None)
+    cfg = get_model_config(name)
+    measure_rt()
+    print(f"devices: {jax.devices()}  model: {cfg.name} "
+          f"({cfg.param_count() / 1e9:.2f}B)")
+
+    if section in ("all", "bf16"):
+        print("== bf16 ==", flush=True)
+        params = init_params_device(cfg, jnp.bfloat16)
+        jax.block_until_ready(params)
+        bench_weight_stream(cfg, params, "bf16")
+        profile_variant(cfg, params, "bf16", pallas_int8=False)
+        return
+
+    params = init_params_device(cfg, jnp.bfloat16)
+    qparams = quantize_params(params)
+    jax.block_until_ready(qparams)
+    del params
+    if section in ("all", "int8xla"):
+        print("== int8 (XLA dequant) ==", flush=True)
+        bench_weight_stream(cfg, qparams, "int8-xla")
+        profile_variant(cfg, qparams, "int8-xla", pallas_int8=False)
+        if section != "all": return
+    if section in ("all", "int8pallas"):
+        print("== int8 (Pallas kernel) ==", flush=True)
+        profile_variant(cfg, qparams, "int8-pallas", pallas_int8=True)
+        if section != "all": return
+
+    if section not in ("all", "pieces"):
+        return
+    # Cost split: lm_head + sampling
+    print("== pieces ==", flush=True)
+    x = jnp.ones((SLOTS, cfg.hidden_size), jnp.bfloat16)
+    emb = qparams.get("lm_head", qparams["embed"])
+
+    @jax.jit
+    def lm_head(x, emb):
+        if isinstance(emb, dict):
+            return (x @ emb["q"].astype(x.dtype).T
+                    if emb["q"].shape[0] == cfg.vocab_size
+                    else x @ emb["q"].astype(x.dtype)) * 1.0
+        w = emb.T if emb.shape[0] == cfg.vocab_size else emb
+        return (x @ w).astype(jnp.float32)
+
+    logits = lm_head(x, emb)
+    np.asarray(logits[:, :8])
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        logits = lm_head(x, emb)
+        np.asarray(logits[:, :8])
+        x = (x + logits[:, :cfg.hidden_size].astype(x.dtype) * 1e-6)
+        ts.append(time.perf_counter() - t0)
+    dt = float(np.median(ts))
+    w0 = jax.tree.leaves(emb)[0]
+    print(f"  lm_head matmul: {dt * 1e3:.2f} ms "
+          f"({np.prod(w0.shape) * w0.dtype.itemsize / 1e9 / dt:.0f} GB/s)")
+    lg = jnp.asarray(np.random.randn(SLOTS, cfg.vocab_size), jnp.bfloat16)
+    for m in ("fast", "exact"):
+        fn = jax.jit(partial(sample_tokens, method=m))
+        args = (jax.random.PRNGKey(0), jnp.full((SLOTS,), .7),
+                jnp.full((SLOTS,), 40, jnp.int32), jnp.full((SLOTS,), .9))
+        t = fn(lg, *args)
+        np.asarray(t)
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            t = fn(lg, *args)
+            np.asarray(t)
+            lg = lg.at[0, 0].add(1e-3)
+            ts.append(time.perf_counter() - t0)
+        dt = float(np.median(ts))
+        print(f"  sampling {m:5s}: {dt * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

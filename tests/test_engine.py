@@ -510,7 +510,12 @@ def test_quantizing_put_places_int8_before_device(tmp_path):
     assert is_quantized(params)
     assert params["layers"]["wq"]["q"].dtype == jnp.int8
     assert params["layers"]["wq"]["s"].dtype == jnp.float32
-    assert params["embed"].dtype == jnp.bfloat16  # not quantized
+    # embedding row-quantizes too (per-vocab-row scale, ops/quant.py
+    # EMBED_LEAF): the tied lm_head read halves and the gather dequant
+    # is per looked-up row.
+    assert params["embed"]["q"].dtype == jnp.int8
+    assert params["embed"]["s"].dtype == jnp.float32
+    assert params["embed"]["s"].shape == (TINY.vocab_size,)
 
     eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
                     max_len=128, prefill_chunk=32)

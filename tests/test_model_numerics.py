@@ -68,6 +68,73 @@ class TestForward:
                                    np.asarray(full_logits[0, -1]),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_forward_decode_matches_forward(self):
+        """The scatter-write decode specialisation (llama.py
+        forward_decode, the engine's single-device hot path) must agree
+        with forward()'s T=1 path: same logits, same cache contents,
+        and masked rows untouched."""
+        from fasttalk_tpu.models.llama import forward_decode
+
+        params = make_params(TINY)
+        b, t = 3, 6
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (b, t), 0,
+                                    TINY.vocab_size)
+        positions = jnp.tile(jnp.arange(t), (b, 1))
+        cache = init_cache(TINY, b, 32, jnp.float32)
+        _, cache = forward(params, TINY, tokens, positions, cache,
+                           jnp.zeros(b, jnp.int32))
+        cur = jnp.array([4, 9, 2])
+        pos = jnp.full((b,), t, jnp.int32)
+        mask = jnp.array([True, True, False])
+
+        ref_logits, ref_cache = forward(
+            params, TINY, cur[:, None], pos[:, None],
+            KVCache(cache.k.copy(), cache.v.copy()), pos, write_mask=mask)
+        got_logits, got_cache = forward_decode(
+            params, TINY, cur, pos,
+            KVCache(cache.k.copy(), cache.v.copy()), mask,
+            attn_len=32)
+        np.testing.assert_allclose(np.asarray(got_logits),
+                                   np.asarray(ref_logits[:, 0]),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(got_cache.k),
+                                   np.asarray(ref_cache.k), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_cache.v),
+                                   np.asarray(ref_cache.v), atol=1e-6)
+        # masked row wrote nothing at position t
+        assert bool(jnp.all(got_cache.k[:, 2, t] == 0))
+
+    def test_forward_decode_attn_len_bound(self):
+        """attn_len is the real read horizon: a bound above the live key
+        count changes nothing, one below it hides keys (diverges)."""
+        from fasttalk_tpu.models.llama import forward_decode
+
+        params = make_params(TINY)
+        t = 12
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (1, t), 0,
+                                    TINY.vocab_size)
+        cache = init_cache(TINY, 1, 32, jnp.float32)
+        _, cache = forward(params, TINY, tokens,
+                           jnp.arange(t)[None, :], cache,
+                           jnp.zeros(1, jnp.int32))
+        cur = jnp.array([5])
+        pos = jnp.full((1,), t, jnp.int32)
+        full, _ = forward_decode(params, TINY, cur, pos,
+                                 KVCache(cache.k.copy(), cache.v.copy()),
+                                 jnp.array([True]), attn_len=32)
+        loose, _ = forward_decode(params, TINY, cur, pos,
+                                  KVCache(cache.k.copy(), cache.v.copy()),
+                                  jnp.array([True]), attn_len=16)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(loose),
+                                   atol=1e-6)
+        # attn_len=8 hides keys 8..12 (including the current token):
+        # logits MUST diverge, or the bound is not actually applied.
+        clipped, _ = forward_decode(params, TINY, cur, pos,
+                                    KVCache(cache.k.copy(), cache.v.copy()),
+                                    jnp.array([True]), attn_len=8)
+        assert not np.allclose(np.asarray(full), np.asarray(clipped),
+                               atol=1e-4)
+
     def test_per_row_write_offsets(self):
         """Slots writing at different cache offsets don't interfere."""
         params = make_params(TINY)

@@ -41,9 +41,42 @@ def test_odd_batch_and_bf16():
 
 def test_supports_blocking_constraints():
     assert supports((16, 2048), (2048, 8192))
-    assert supports((16, 2048), (2048, 128256))  # llama3 lm_head
-    assert not supports((16, 100), (100, 8192))  # K not 128-divisible
+    # Full-N accumulator for a 128k-wide untied lm_head blows the 16 MiB
+    # scoped-VMEM limit — that shape falls back to the XLA dequant.
+    assert not supports((16, 2048), (2048, 128256))
+    assert not supports((16, 100), (100, 8192))  # K not power-of-two-block
     assert not supports((16,), (2048, 8192))
+
+
+def test_transposed_kernel_matches_reference():
+    """int8_matmul_t: the tied-embedding lm_head ([V, D] row-quantized,
+    contracted over D) — the decode path's single largest weight read."""
+    from fasttalk_tpu.ops.pallas_int8 import int8_matmul_t, supports_t
+
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, 256), jnp.float32)
+    emb = jax.random.normal(jax.random.PRNGKey(7), (1024, 256), jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(emb), axis=1) / 127.0, 1e-8)
+    q = jnp.round(emb / s[:, None]).astype(jnp.int8)
+    assert supports_t(x.shape, q.shape)
+    ref = x @ (q.astype(jnp.float32) * s[:, None]).T
+    got = int8_matmul_t(x, q, s, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    # llama3 tied-1B shape is in range for the kernel
+    assert supports_t((16, 2048), (128256, 2048))
+
+
+def test_matmul_tied_dispatch_matches_xla():
+    from fasttalk_tpu.ops.quant import matmul_tied
+
+    x = jax.random.normal(jax.random.PRNGKey(8), (4, 1, 256), jnp.float32)
+    emb = jax.random.normal(jax.random.PRNGKey(9), (512, 256), jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(emb), axis=1) / 127.0, 1e-8)
+    leaf = {"q": jnp.round(emb / s[:, None]).astype(jnp.int8), "s": s}
+    ref = matmul_tied(x, leaf, pallas_ok=False)
+    got = matmul_tied(x, leaf, pallas_ok=True)  # interpret auto on CPU
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_quant_matmul_dispatches_to_kernel():
