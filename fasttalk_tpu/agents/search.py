@@ -136,6 +136,21 @@ class DuckDuckGoSearchBackend(WebSearchBackend):
         loop = asyncio.get_running_loop()
         if (self._session is None or self._session.closed
                 or self._loop is not loop):
+            old, old_loop = self._session, self._loop
+            if old is not None and not old.closed:
+                # Close the superseded session instead of abandoning it
+                # (FD leak + "Unclosed client session" warnings,
+                # ADVICE r2). Its loop may be gone — best effort on
+                # whichever loop still runs.
+                try:
+                    if old_loop is not None and old_loop.is_running() \
+                            and old_loop is not loop:
+                        old_loop.call_soon_threadsafe(
+                            lambda: asyncio.ensure_future(old.close()))
+                    else:
+                        loop.create_task(old.close())
+                except RuntimeError:
+                    pass
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=self.timeout_s),
                 headers={"User-Agent": "Mozilla/5.0 (fasttalk-tpu agent)"})

@@ -49,9 +49,26 @@ class Tool:
 class ToolRegistry:
     def __init__(self) -> None:
         self._tools: dict[str, Tool] = {}
+        # Resources (e.g. the search backend's HTTP session) that must
+        # be released on shutdown — long-lived processes leak FDs
+        # otherwise (ADVICE r2).
+        self._closeables: list[Any] = []
 
     def register(self, tool: Tool) -> None:
         self._tools[tool.name] = tool
+
+    def add_closeable(self, obj: Any) -> None:
+        self._closeables.append(obj)
+
+    async def aclose(self) -> None:
+        for obj in self._closeables:
+            close = getattr(obj, "aclose", None)
+            if close is None:
+                continue
+            try:
+                await close()
+            except Exception as e:  # shutdown must not raise
+                log.warning(f"closing {type(obj).__name__} failed: {e}")
 
     def get(self, name: str) -> Tool | None:
         return self._tools.get(name)
@@ -164,6 +181,7 @@ def build_default_registry(
         parameters={}, fn=get_session_info))
     if enable_web_search:
         backend = search_backend or OfflineSearchBackend()
+        reg.add_closeable(backend)
         limiter = RateLimiter(search_rate_limit_s)
 
         async def web_search(query: str, max_results: int = 5) -> str:

@@ -20,6 +20,7 @@ treats agent and bare engine identically.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Any, AsyncGenerator
 
@@ -114,16 +115,26 @@ class VoiceAgent:
                 etype = event["type"]
                 if etype == "token":
                     raw_text += event["text"]
+                    had_calls = bool(calls_this_round)
                     text, calls = parser.feed(event["text"])
+                    # Keep consuming after a completed call: models may
+                    # emit SEVERAL <tool_call>s in one turn, and all of
+                    # them must execute (the reference accumulated every
+                    # streamed call before executing,
+                    # vllm_handler.py:389-412; r2 ran only the first).
+                    # Stop once prose resumes after the call block — and
+                    # do NOT emit that prose: the round is aborted and
+                    # regenerated with the tool results, so yielding it
+                    # would duplicate a stray fragment in the client
+                    # stream.
+                    if had_calls and text and text.strip():
+                        break
                     if text:
                         assistant_text += text
                         if ttft is None:
                             ttft = (time.monotonic() - started) * 1000
                         yield {"type": "token", "text": text}
                     calls_this_round.extend(calls)
-                    if calls:
-                        # Stop consuming this round: execute, then resume.
-                        break
                 elif etype in ("done", "cancelled", "error"):
                     terminal = event
                     st = event.get("stats", {})
@@ -155,21 +166,28 @@ class VoiceAgent:
                     agg_stats, started, ttft)
                 return
 
-            # Execute the first completed call (one call per round keeps
-            # the protocol simple and matches hermes-style models).
-            call = calls_this_round[0]
-            self._m_calls.inc()
-            yield {"type": "tool_call", "tool": call.name,
-                   "arguments": call.arguments}
-            result = await self.registry.execute(call.name, call.arguments,
-                                                 context=context)
-            log.info(f"[{session_id}] tool {call.name} -> "
-                     f"{result[:120]}")
-            msgs = msgs + [
-                {"role": "assistant", "content": raw_text},
-                {"role": "tool",
-                 "content": format_tool_result(call.name, result)},
-            ]
+            # Execute EVERY completed call of the round, concurrently
+            # (tools are independent: read-only lookups or idempotent
+            # fetches; the registry serialises rate-limited ones
+            # itself), then append all results before resuming —
+            # matching the reference's accumulate-then-execute-all
+            # (vllm_handler.py:389-412).
+            for call in calls_this_round:
+                self._m_calls.inc()
+                yield {"type": "tool_call", "tool": call.name,
+                       "arguments": call.arguments}
+            results = await asyncio.gather(
+                *(self.registry.execute(c.name, c.arguments,
+                                        context=context)
+                  for c in calls_this_round))
+            msgs = msgs + [{"role": "assistant", "content": raw_text}]
+            for call, result in zip(calls_this_round, results):
+                log.info(f"[{session_id}] tool {call.name} -> "
+                         f"{result[:120]}")
+                msgs = msgs + [
+                    {"role": "tool",
+                     "content": format_tool_result(call.name, result)},
+                ]
 
         yield self._final({"type": "done", "finish_reason": "tool_rounds"},
                           agg_stats, started, ttft)
@@ -189,6 +207,10 @@ class VoiceAgent:
                 "prompt_tokens": agg.get("prompt_tokens", 0),
             },
         }
+
+    async def aclose(self) -> None:
+        """Release tool resources (search backend HTTP session)."""
+        await self.registry.aclose()
 
     # Engine-seam passthroughs so the agent is substitutable wherever an
     # EngineBase is expected (WS server, OpenAI route).

@@ -231,6 +231,13 @@ class TPUEngine(EngineBase):
         self.seed = seed
         self.slots = SlotManager(num_slots, self.max_len)
         self.steps_per_call = max(1, steps_per_call)
+        # Burst-mode call length: while admissions or prefills are
+        # pending, dispatch SHORT calls so a new arrival's prefill waits
+        # behind ~30 ms of in-order device queue instead of
+        # pipeline_depth x ~100 ms (long calls amortise the per-call
+        # cache boundary copy, which is what steady-state wants; TTFT
+        # under concurrent load wants the opposite).
+        self.steps_burst = min(8, self.steps_per_call)
         self.pipeline_depth = max(1, pipeline_depth)
         self.sampling_method = sampling_method
         self._reset_decode_state()
@@ -367,10 +374,23 @@ class TPUEngine(EngineBase):
             # window (registered after _abort_all's sweep): their queued
             # submit commands survive on the shared command queue and the
             # new thread will admit them — dropping the registration
-            # would strand cancel() for those ids.
-            self._by_id = {rid: r for rid, r in self._by_id.items()
-                           if not r.finished}
+            # would strand cancel() for those ids. Prune IN PLACE (not a
+            # dict rebuild): generate() on the event loop can insert a
+            # registration concurrently, and a rebuild would silently
+            # drop it (ADVICE r2) — per-key pops never lose an insert.
+            for rid in [rid for rid, r in self._by_id.items()
+                        if r.finished]:
+                self._by_id.pop(rid, None)
             self.slots = SlotManager(self.num_slots, self.max_len)
+            # Release the old KV cache (and the in-flight refs pinning
+            # decode-state arrays) BEFORE allocating the fresh one: on
+            # host-side crashes the donated buffer was never consumed,
+            # and holding both copies transiently doubles KV HBM — on
+            # memory-tight configs the recovery path itself would OOM
+            # and the watchdog would re-OOM every probe (ADVICE r2).
+            self.cache = None
+            self._inflight.clear()
+            self._pending_firsts.clear()
             self.cache = self._make_cache()
             self._reset_decode_state()
             self._started = False
@@ -418,12 +438,13 @@ class TPUEngine(EngineBase):
 
         inactive = self._put(np.zeros((self.num_slots,), bool))
         for b in decode_buckets:
-            fn = self._get_decode_fn(b)
-            self.cache, toks, _, _, _ = fn(
-                self.params, self.cache, self._cur_tokens,
-                self._positions_dev, inactive, self._temps_dev,
-                self._topks_dev, self._topps_dev, self._rng_dev)
-            jax.block_until_ready(toks)
+            for steps in sorted({self.steps_burst, self.steps_per_call}):
+                fn = self._get_decode_fn(b, steps)
+                self.cache, toks, _, _, _ = fn(
+                    self.params, self.cache, self._cur_tokens,
+                    self._positions_dev, inactive, self._temps_dev,
+                    self._topks_dev, self._topps_dev, self._rng_dev)
+                jax.block_until_ready(toks)
         # The admission-path helper programs (slot-state patch; they are
         # tiny but a first-request compile is still seconds).
         nopatch = np.zeros((self.num_slots, 6), np.float32)
@@ -583,8 +604,10 @@ class TPUEngine(EngineBase):
         explicit replicated placement is required."""
         return arr if self.mesh is None else self._put(arr)
 
-    def _get_decode_fn(self, kv_len: int):
-        """K decode steps in one jitted call (K = steps_per_call).
+    def _get_decode_fn(self, kv_len: int, steps: int | None = None):
+        """K decode steps in one jitted call (K = ``steps``, default
+        steps_per_call; the dispatcher also compiles the short
+        ``steps_burst`` variant for admission-latency-sensitive moments).
 
         The whole per-slot decode state is threaded through the call so
         nothing round-trips to the host between steps: carry = (sliced
@@ -593,7 +616,8 @@ class TPUEngine(EngineBase):
         part #3 — the naive per-step blocking get this replaces
         serialised device and host work).
         """
-        fn = self._decode_fns.get(kv_len)
+        steps = self.steps_per_call if steps is None else steps
+        fn = self._decode_fns.get((kv_len, steps))
         if fn is not None:
             return fn
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
@@ -622,7 +646,7 @@ class TPUEngine(EngineBase):
 
                 (ck, cv, cur, pos, rng), toks = jax.lax.scan(
                     step, (cache.k, cache.v, cur_tokens, positions, rng),
-                    None, length=self.steps_per_call)
+                    None, length=steps)
                 return KVCache(ck, cv), toks, cur, pos, rng
 
             ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
@@ -644,14 +668,14 @@ class TPUEngine(EngineBase):
 
             (ck, cv, cur, pos, rng), toks = jax.lax.scan(
                 step, (ck, cv, cur_tokens, positions, rng), None,
-                length=self.steps_per_call)
+                length=steps)
             new_k = jax.lax.dynamic_update_slice_in_dim(
                 cache.k, ck, 0, axis=2)
             new_v = jax.lax.dynamic_update_slice_in_dim(
                 cache.v, cv, 0, axis=2)
             return KVCache(new_k, new_v), toks, cur, pos, rng
 
-        self._decode_fns[kv_len] = decode_call
+        self._decode_fns[(kv_len, steps)] = decode_call
         return decode_call
 
     def _get_prefill_fn(self, chunk: int):
@@ -792,6 +816,24 @@ class TPUEngine(EngineBase):
                 if not self._drain_commands(block=idle):
                     break
                 if self._waiting:
+                    if not self._running and not self._inflight \
+                            and not self._prefilling:
+                        # Burst coalescing: from idle, the first request
+                        # of a concurrent burst arrives a few ms before
+                        # the rest, and admitting it alone would queue a
+                        # full decode call ahead of everyone else's
+                        # prefill (traced: +387 ms first-token for the
+                        # stragglers). A 3 ms grace drains the rest of
+                        # the burst into ONE admission group; a solo
+                        # request pays +3 ms TTFT.
+                        stop = False
+                        for _ in range(2):
+                            time.sleep(0.003)
+                            if not self._drain_commands(block=False):
+                                stop = True
+                                break
+                        if stop:
+                            break
                     self._admit()
                 if self._prefilling:
                     # One chunk per iteration: long prompts interleave
@@ -1089,10 +1131,10 @@ class TPUEngine(EngineBase):
         first token — waits behind all of them. A length-capped
         generation now finishes with an empty pipeline."""
         promised: dict[int, int] = {}
-        for _, snap in self._inflight:
+        for toks, snap in self._inflight:
             for _, req in snap:
                 promised[id(req)] = (promised.get(id(req), 0)
-                                     + self.steps_per_call)
+                                     + int(toks.shape[0]))
         # A first token whose fetch hasn't landed is not yet counted in
         # req.generated but will be — ignoring it over-dispatches one
         # whole stale call at exact-budget boundaries.
@@ -1182,14 +1224,20 @@ class TPUEngine(EngineBase):
         self._patch_slot_state()
         active = list(self._running)
         snapshot = list(self._running.items())
-        # Device positions lead the host mirrors by one K-step call per
-        # in-flight dispatch; size the KV bucket for where the device
-        # will be at the END of this call.
+        # Short calls while admissions/prefills are pending (the next
+        # arrival's first token waits behind the in-order device queue);
+        # long calls in steady state (amortise the per-call cache
+        # boundary copy).
+        steps = (self.steps_burst if self._waiting or self._prefilling
+                 else self.steps_per_call)
+        # Device positions lead the host mirrors by the in-flight calls'
+        # step counts; size the KV bucket for where the device will be
+        # at the END of this call.
         max_pos = int(self._positions[active].max()) \
-            + (len(self._inflight) + 1) * self.steps_per_call
+            + sum(int(t.shape[0]) for t, _ in self._inflight) + steps
         kv_len = next((b for b in _KV_BUCKETS
                        if b >= max_pos and b <= self.max_len), self.max_len)
-        fn = self._get_decode_fn(kv_len)
+        fn = self._get_decode_fn(kv_len, steps)
         (self.cache, toks, self._cur_tokens, self._positions_dev,
          self._rng_dev) = fn(
             self.params, self.cache, self._cur_tokens, self._positions_dev,
@@ -1215,6 +1263,15 @@ class TPUEngine(EngineBase):
         t0 = time.monotonic()
         toks = np.asarray(toks_dev)  # [K, S] — sync point
         self._m_step.observe((time.monotonic() - t0) * 1000)
+        # The block above gave every pending firsts-copy >= one call's
+        # wall time to land: emit whatever arrived NOW. Without this, a
+        # request admitted after call N dispatched waits for call N+1's
+        # retirement (whose snapshot it is in) — burst admissions saw
+        # their first tokens staggered one ~140 ms retirement per
+        # admission group (measured: WS-burst p50 TTFT 412 ms engine-side
+        # vs 166 ms when all requests land in one group).
+        if self._pending_firsts:
+            self._drain_firsts(block=False)
         for k in range(toks.shape[0]):
             for s, req in snapshot:
                 if req.finished or self._running.get(s) is not req:

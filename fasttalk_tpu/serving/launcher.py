@@ -119,6 +119,10 @@ class ServerLauncher:
             watchdog.cancel()
             await main_runner.cleanup()
             await mon_runner.cleanup()
+            if self.agent is not None:
+                # Release tool resources (search backend HTTP session) —
+                # otherwise every shutdown leaks its FDs (ADVICE r2).
+                await self.agent.aclose()
             self.engine.shutdown()
 
     def start(self) -> None:

@@ -32,6 +32,7 @@ from fasttalk_tpu.agents.hermes import (
     tools_system_prompt,
 )
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.engine.remote import _RemoteEngine
 from fasttalk_tpu.utils.errors import CircuitBreaker, CircuitBreakerOpen
 from fasttalk_tpu.utils.logger import get_logger
 
@@ -324,14 +325,10 @@ def register_openai_routes(app: web.Application,
         try:
             params = _params(body)
             specs, forced = _parse_tools(body)
-            messages = _hermes_messages(messages)
         except (_BadRequest, TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": str(e),
                            "type": "invalid_request_error"}}, status=400)
-        if specs:
-            messages = _inject_tools_prompt(messages, specs, forced)
-        parser = HermesStreamParser() if specs else None
         completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = _now()
         session_id = body.get("user") or f"oai-{completion_id}"
@@ -346,6 +343,24 @@ def register_openai_routes(app: web.Application,
             # ever saw them. Explicit isinstance: any other wrapper that
             # happens to hold an inner .engine must NOT be bypassed.
             engine = _unwrap_agent(engine)
+        # Passthrough (remote OpenAI/Ollama) backends get the messages
+        # VERBATIM: rewriting role-"tool" turns into hermes markup would
+        # drop tool_call_id, and strict OpenAI-schema upstreams reject
+        # multi-turn tool conversations without it (ADVICE r2). Only the
+        # in-tree engine needs the hermes form its templates render.
+        # Detect on the UNWRAPPED backend: with no tools declared this
+        # turn, `engine` may still be the agent wrapping a remote.
+        if not isinstance(_unwrap_agent(engine), _RemoteEngine):
+            try:
+                messages = _hermes_messages(messages)
+            except (_BadRequest, TypeError, ValueError) as e:
+                return web.json_response(
+                    {"error": {"message": str(e),
+                               "type": "invalid_request_error"}},
+                    status=400)
+        if specs:
+            messages = _inject_tools_prompt(messages, specs, forced)
+        parser = HermesStreamParser() if specs else None
         denied = _breaker_503()
         if denied is not None:
             return denied

@@ -200,6 +200,31 @@ class TestVoiceAgent:
         assert msgs2[-1]["role"] == "tool"
         assert "tool_response" in msgs2[-1]["content"]
 
+    def test_multiple_tool_calls_in_one_round_all_execute(self):
+        """Two <tool_call>s in one assistant turn: BOTH execute and both
+        results are appended before the resume (reference accumulated
+        every streamed call, vllm_handler.py:389-412; r2 dropped the
+        second)."""
+        eng = ScriptedEngine([
+            '<tool_call>{"name": "get_current_time", "arguments": {}}'
+            '</tool_call><tool_call>{"name": "get_session_info", '
+            '"arguments": {}}</tool_call>',
+            "Both done.",
+        ])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        events = run_agent(agent, [{"role": "user", "content": "both"}])
+        tool_events = [e for e in events if e["type"] == "tool_call"]
+        assert [e["tool"] for e in tool_events] == [
+            "get_current_time", "get_session_info"]
+        msgs2 = eng.calls[1]["messages"]
+        tool_msgs = [m for m in msgs2 if m["role"] == "tool"]
+        assert len(tool_msgs) == 2
+        assert "get_current_time" in tool_msgs[0]["content"]
+        assert "get_session_info" in tool_msgs[1]["content"]
+        text = "".join(e.get("text", "") for e in events
+                       if e["type"] == "token")
+        assert "Both done." in text
+
     def test_tool_round_limit(self):
         looping = ('<tool_call>{"name": "get_current_time", '
                    '"arguments": {}}</tool_call>')

@@ -206,3 +206,62 @@ async def test_ollama_raw_generate_passthrough():
     finally:
         eng.shutdown()
         await server.close()
+
+
+async def test_openai_route_passthrough_preserves_tool_call_id():
+    """Second turn of a client-driven tool loop through the /v1 route with
+    a REMOTE backend: the upstream must receive the OpenAI-shaped
+    messages verbatim — assistant `tool_calls` and the role-"tool"
+    result's tool_call_id intact (strict OpenAI-schema upstreams reject
+    the turn without it; ADVICE r2). In-tree engines get the hermes
+    rewrite instead."""
+    from aiohttp.test_utils import TestClient
+
+    from fasttalk_tpu.serving.openai_api import register_openai_routes
+
+    seen = {}
+    app = web.Application()
+
+    async def chat(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        seen["messages"] = body["messages"]
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        chunk = {"choices": [{"delta": {"content": "4pm."},
+                              "finish_reason": "stop"}]}
+        await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    app.router.add_post("/v1/chat/completions", chat)
+    upstream = TestServer(app)
+    await upstream.start_server()
+
+    gateway = web.Application()
+    eng = VLLMRemoteEngine(f"http://127.0.0.1:{upstream.port}/v1", "m1")
+    eng.start()
+    register_openai_routes(gateway, eng, "m1")
+    client = TestClient(TestServer(gateway))
+    await client.start_server()
+    try:
+        convo = [
+            {"role": "user", "content": "time?"},
+            {"role": "assistant", "content": None, "tool_calls": [
+                {"id": "call_abc123", "type": "function",
+                 "function": {"name": "get_current_time",
+                              "arguments": "{}"}}]},
+            {"role": "tool", "tool_call_id": "call_abc123",
+             "content": "16:00"},
+        ]
+        r = await client.post("/v1/chat/completions", json={
+            "model": "m1", "messages": convo, "stream": False})
+        assert r.status == 200
+        body = await r.json()
+        assert body["choices"][0]["message"]["content"] == "4pm."
+        # upstream saw the conversation VERBATIM
+        assert seen["messages"] == convo
+    finally:
+        await client.close()
+        eng.shutdown()
+        await upstream.close()
