@@ -1,0 +1,64 @@
+"""Run the reference's literal PydanticAI agent against THIS engine.
+
+The reference's production agent is a `pydantic_ai.Agent` over vLLM's
+OpenAI endpoint (reference: app/agents/voice_agent.py:85-344, model
+wiring :127-139). This framework serves the same OpenAI surface
+(`/v1/chat/completions` with `tools`/`tool_choice`, hermes parsing
+in-tree), so the identical PydanticAI code runs against the TPU engine —
+BASELINE config #4 demonstrated with the real library, not a
+shape-compatible imitation.
+
+Usage (needs `pip install fasttalk-tpu[agents]`):
+
+    # terminal 1: the server (any provider; tpu with real weights,
+    # or fake for a wiring check)
+    LLM_PROVIDER=tpu python main.py websocket
+
+    # terminal 2:
+    python examples/pydantic_ai_demo.py [--base-url http://127.0.0.1:8000/v1]
+
+The agent registers a local tool; the model calls it through the served
+tools surface and the final streamed answer incorporates the result —
+the full client-driven loop: stream → tool_calls → execute client-side →
+resume → final text.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import datetime
+
+
+async def main(base_url: str, model: str) -> None:
+    from pydantic_ai import Agent
+    from pydantic_ai.models.openai import OpenAIChatModel
+    from pydantic_ai.providers.openai import OpenAIProvider
+
+    agent = Agent(
+        OpenAIChatModel(
+            model,
+            provider=OpenAIProvider(base_url=base_url,
+                                    api_key="not-needed"),
+        ),
+        system_prompt=("You are a concise voice assistant. Use tools "
+                       "when they help."),
+    )
+
+    @agent.tool_plain
+    def get_current_time() -> str:
+        """Get the current date and time (UTC)."""
+        return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+    async with agent.run_stream("What time is it right now?") as result:
+        async for delta in result.stream_text(delta=True):
+            print(delta, end="", flush=True)
+    print()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-url", default="http://127.0.0.1:8000/v1")
+    ap.add_argument("--model", default="llama3.2:1b")
+    args = ap.parse_args()
+    asyncio.run(main(args.base_url, args.model))

@@ -1,0 +1,70 @@
+"""fetch_model script: offline paths (layout, filtering, loader handoff).
+
+Network fetching is a thin wrapper over huggingface_hub/HTTPS; what must
+be correct in-tree is the destination layout (it has to be exactly what
+models/loader.find_checkpoint_dir resolves) and the file filter.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "fetch_model", os.path.join(os.path.dirname(__file__), "..",
+                                "scripts", "fetch_model.py"))
+fetch_model = importlib.util.module_from_spec(_SPEC)
+sys.modules["fetch_model"] = fetch_model
+_SPEC.loader.exec_module(fetch_model)
+
+
+def test_wanted_filter():
+    assert fetch_model.wanted("model.safetensors")
+    assert fetch_model.wanted("model-00001-of-00002.safetensors")
+    assert fetch_model.wanted("model.safetensors.index.json")
+    assert fetch_model.wanted("tokenizer.json")
+    assert fetch_model.wanted("tokenizer_config.json")
+    assert fetch_model.wanted("config.json")
+    assert not fetch_model.wanted("pytorch_model.bin")
+    assert not fetch_model.wanted("README.md")
+    assert not fetch_model.wanted("model.gguf")
+
+
+def test_default_repos_cover_served_families():
+    from fasttalk_tpu.models.configs import list_models
+
+    served = [m for m in list_models() if not m.startswith("test-")]
+    missing = [m for m in served if m not in fetch_model.DEFAULT_REPOS]
+    assert not missing, f"no default HF repo for {missing}"
+
+
+def test_from_dir_links_into_loader_layout(tmp_path):
+    from fasttalk_tpu.models.loader import find_checkpoint_dir
+
+    src = tmp_path / "downloaded"
+    src.mkdir()
+    (src / "model.safetensors").write_bytes(b"\0" * 64)
+    (src / "config.json").write_text(json.dumps({"model_type": "llama"}))
+    (src / "tokenizer.json").write_text("{}")
+    (src / "training_args.bin").write_bytes(b"junk")  # filtered out
+
+    dest = tmp_path / "models"
+    dst = fetch_model.dest_dir(str(dest), "llama3.2:1b")
+    placed = fetch_model.link_from_dir(str(src), dst)
+    assert placed == ["config.json", "model.safetensors", "tokenizer.json"]
+    assert not os.path.exists(os.path.join(dst, "training_args.bin"))
+    # the loader resolves exactly this layout
+    assert find_checkpoint_dir(str(dest), "llama3.2:1b") == dst
+    # hardlinked (same inode), not copied, when on one filesystem
+    assert os.stat(os.path.join(dst, "model.safetensors")).st_ino \
+        == os.stat(src / "model.safetensors").st_ino
+
+
+def test_from_dir_without_safetensors_fails(tmp_path):
+    src = tmp_path / "empty"
+    src.mkdir()
+    (src / "config.json").write_text("{}")
+    with pytest.raises(SystemExit):
+        fetch_model.link_from_dir(str(src), str(tmp_path / "out"))

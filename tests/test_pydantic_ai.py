@@ -1,0 +1,68 @@
+"""The LITERAL pydantic_ai library against the served /v1 endpoint.
+
+Skipped when pydantic-ai isn't installed (`pip install .[agents]`) —
+the hosting image has no egress, so CI here exercises the SDK-shaped
+wire tests in test_agents.py instead; on any host with the extra
+installed this file proves BASELINE config #4 with the real library
+(reference: app/agents/voice_agent.py:85-344).
+"""
+
+import asyncio
+import datetime
+
+import pytest
+
+pydantic_ai = pytest.importorskip("pydantic_ai")
+
+from aiohttp import web  # noqa: E402
+from aiohttp.test_utils import TestServer  # noqa: E402
+
+from fasttalk_tpu.engine.fake import FakeEngine  # noqa: E402
+from fasttalk_tpu.serving.openai_api import register_openai_routes  # noqa: E402
+
+
+def test_agent_run_stream_with_tool_against_served_v1():
+    async def go():
+        from pydantic_ai import Agent
+        from pydantic_ai.models.openai import OpenAIChatModel
+        from pydantic_ai.providers.openai import OpenAIProvider
+
+        # Scripted engine: first turn emits a hermes tool call, second
+        # turn answers with the tool result in context.
+        eng = FakeEngine(script=[
+            '<tool_call>{"name": "get_current_time", "arguments": {}}'
+            "</tool_call>",
+            "It is exactly noon UTC.",
+        ])
+        eng.start()
+        app = web.Application()
+        register_openai_routes(app, eng, "fake-model")
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            agent = Agent(OpenAIChatModel(
+                "fake-model",
+                provider=OpenAIProvider(
+                    base_url=f"http://127.0.0.1:{server.port}/v1",
+                    api_key="not-needed")))
+
+            calls = []
+
+            @agent.tool_plain
+            def get_current_time() -> str:
+                """Current UTC time."""
+                calls.append(1)
+                return datetime.datetime.now(
+                    datetime.timezone.utc).isoformat()
+
+            out = ""
+            async with agent.run_stream("time?") as result:
+                async for delta in result.stream_text(delta=True):
+                    out += delta
+            assert calls, "the client-side tool never executed"
+            assert "noon" in out
+        finally:
+            await server.close()
+            eng.shutdown()
+
+    asyncio.run(go())
