@@ -140,16 +140,29 @@ class DuckDuckGoSearchBackend(WebSearchBackend):
             if old is not None and not old.closed:
                 # Close the superseded session instead of abandoning it
                 # (FD leak + "Unclosed client session" warnings,
-                # ADVICE r2). Its loop may be gone — best effort on
-                # whichever loop still runs.
+                # ADVICE r2). A session must be closed on its OWN loop;
+                # when that loop is gone, detach the connector and close
+                # it synchronously — never awaited cross-loop, and any
+                # close error is swallowed rather than surfacing as an
+                # unhandled-task exception (ADVICE r3).
+                async def _close_quietly(s=old):
+                    try:
+                        await s.close()
+                    except Exception:
+                        pass
+
                 try:
-                    if old_loop is not None and old_loop.is_running() \
-                            and old_loop is not loop:
+                    if old_loop is loop:
+                        loop.create_task(_close_quietly())
+                    elif old_loop is not None and old_loop.is_running():
                         old_loop.call_soon_threadsafe(
-                            lambda: asyncio.ensure_future(old.close()))
+                            lambda: asyncio.ensure_future(_close_quietly()))
                     else:
-                        loop.create_task(old.close())
-                except RuntimeError:
+                        connector = getattr(old, "_connector", None)
+                        old.detach()
+                        if connector is not None:
+                            connector.close()  # sync FD teardown
+                except Exception:
                     pass
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=self.timeout_s),

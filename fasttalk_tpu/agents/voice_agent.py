@@ -115,33 +115,42 @@ class VoiceAgent:
                 etype = event["type"]
                 if etype == "token":
                     raw_text += event["text"]
-                    had_calls = bool(calls_this_round)
                     text, calls = parser.feed(event["text"])
-                    # Keep consuming after a completed call: models may
-                    # emit SEVERAL <tool_call>s in one turn, and all of
-                    # them must execute (the reference accumulated every
-                    # streamed call before executing,
-                    # vllm_handler.py:389-412; r2 ran only the first).
-                    # Stop once prose resumes after the call block — and
-                    # do NOT emit that prose: the round is aborted and
-                    # regenerated with the tool results, so yielding it
-                    # would duplicate a stray fragment in the client
-                    # stream.
-                    if had_calls and text and text.strip():
-                        break
+                    # Collect THIS feed's calls before judging its text:
+                    # a chunk can both complete an additional <tool_call>
+                    # and carry trailing prose, and deciding on the text
+                    # first silently dropped that call (ADVICE r3). All
+                    # completed calls must execute (the reference
+                    # accumulated every streamed call before executing,
+                    # vllm_handler.py:389-412).
+                    calls_this_round.extend(calls)
+                    if calls_this_round:
+                        # Once a tool block exists, no text is forwarded
+                        # to the client: the round is aborted and
+                        # regenerated with the tool results, so any
+                        # surrounding prose would show up as a stray
+                        # duplicated fragment. Prose in a LATER chunk
+                        # (one that completed no call itself) means the
+                        # model moved on past the block — stop the
+                        # round and execute what we have.
+                        if text and text.strip() and not calls:
+                            break
+                        continue
                     if text:
                         assistant_text += text
                         if ttft is None:
                             ttft = (time.monotonic() - started) * 1000
                         yield {"type": "token", "text": text}
-                    calls_this_round.extend(calls)
                 elif etype in ("done", "cancelled", "error"):
                     terminal = event
                     st = event.get("stats", {})
+                    # `or 0`: remote backends report None when the
+                    # upstream gave no usage accounting.
                     agg_stats["tokens_generated"] += st.get(
-                        "tokens_generated", 0)
-                    agg_stats["prompt_tokens"] = st.get(
-                        "prompt_tokens", agg_stats["prompt_tokens"])
+                        "tokens_generated") or 0
+                    agg_stats["prompt_tokens"] = (
+                        st.get("prompt_tokens")
+                        or agg_stats["prompt_tokens"])
 
             if terminal is None:
                 # Broke out on a tool call mid-stream: close the stream,
@@ -149,7 +158,12 @@ class VoiceAgent:
                 await agen.aclose()
             else:
                 tail = parser.flush()
-                if tail:
+                if tail and not calls_this_round:
+                    # With calls pending the round is aborted and
+                    # regenerated — a flushed fragment (e.g. a lone "<"
+                    # that looked like a tag opener) must not leak to
+                    # the client, same policy as the in-stream
+                    # suppression above.
                     assistant_text += tail
                     yield {"type": "token", "text": tail}
                 if terminal["type"] in ("cancelled", "error"):

@@ -48,6 +48,7 @@ import queue
 import threading
 import time
 from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, AsyncGenerator
@@ -229,6 +230,15 @@ class TPUEngine(EngineBase):
             self.params = shard_params(params, mesh)
         self.cache = self._make_cache()
         self.seed = seed
+        # Sampling is restricted to ids the tokenizer can decode: with a
+        # real checkpoint the two vocabs match and this is a no-op, but
+        # weight-free serving pairs random-init weights (model vocab,
+        # e.g. 128256) with the bundled 32k tokenizer — unclamped
+        # sampling then emits ~75% undecodable ids, whose empty text
+        # deltas hold first-token frames back a whole decode call.
+        self.sample_vocab = min(model_cfg.vocab_size,
+                                getattr(tokenizer, "vocab_size",
+                                        model_cfg.vocab_size))
         self.slots = SlotManager(num_slots, self.max_len)
         self.steps_per_call = max(1, steps_per_call)
         # Burst-mode call length: while admissions or prefills are
@@ -240,6 +250,20 @@ class TPUEngine(EngineBase):
         self.steps_burst = min(8, self.steps_per_call)
         self.pipeline_depth = max(1, pipeline_depth)
         self.sampling_method = sampling_method
+        # Device→host copies run on a small worker pool, submitted at
+        # dispatch time, so fetches overlap both each other and later
+        # calls' compute. On relayed devices every fetch REQUEST costs a
+        # full link round trip when it is issued (measured ~105 ms RTT
+        # with copy_to_host_async a no-op — serial retirement capped the
+        # whole engine at one K-step call per RTT), but concurrent
+        # fetches share the trip (8 parallel fetches ≈ 1 RTT,
+        # scripts/profile_prefill.py), so retirement only ever waits on
+        # the oldest outstanding copy. Workers only read result arrays
+        # the engine never mutates; all dispatch stays on the engine
+        # thread.
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=max(4, self.pipeline_depth + 2),
+            thread_name_prefix="tpu-fetch")
         self._reset_decode_state()
 
         self._commands: queue.Queue = queue.Queue()
@@ -315,17 +339,19 @@ class TPUEngine(EngineBase):
         # draining the pipeline and re-uploading everything — admission
         # and completion never stall in-flight decode calls.
         self._dirty_slots: set[int] = set()
-        # In-flight decode calls: (tokens_device_array [K, S], the
-        # (slot index, request) pairs running at dispatch time). Tokens
-        # are attributed to the dispatch-time request, never to whoever
-        # occupies the slot at retirement — a slot can be re-admitted to
-        # a new request while an older call is still in flight.
-        self._inflight: deque[tuple[Any, list[tuple[int, _Request]]]] = deque()
+        # In-flight decode calls: (host-copy Future of the [K, S] token
+        # array, K, the (slot index, request) pairs running at dispatch
+        # time). Tokens are attributed to the dispatch-time request,
+        # never to whoever occupies the slot at retirement — a slot can
+        # be re-admitted to a new request while an older call is still
+        # in flight.
+        self._inflight: deque[
+            tuple[Future, int, list[tuple[int, _Request]]]] = deque()
         # First sampled tokens whose device→host copy is still in
-        # flight: (device_array, [(row, slot_index, request), ...]).
+        # flight: (host-copy Future, [(row, slot_index, request), ...]).
         # Admission emits the first token only when the fetch lands, so
         # prefill never blocks the engine thread on a device round trip.
-        self._pending_firsts: deque[tuple[Any, list]] = deque()
+        self._pending_firsts: deque[tuple[Future, list]] = deque()
 
     # ---------------- public (asyncio side) ----------------
 
@@ -341,11 +367,11 @@ class TPUEngine(EngineBase):
     def shutdown(self) -> None:
         with self._lifecycle_lock:
             self._closed = True
-            if not self._started:
-                return
-            self._commands.put(("stop", None))
-            self._stopped.wait(timeout=30)
-            self._started = False
+            if self._started:
+                self._commands.put(("stop", None))
+                self._stopped.wait(timeout=30)
+                self._started = False
+            self._fetch_pool.shutdown(wait=False, cancel_futures=True)
 
     def restart(self) -> bool:
         """Recover from an engine-thread crash: rebuild the device-side
@@ -639,7 +665,8 @@ class TPUEngine(EngineBase):
                         params, self.cfg, cur, pos, KVCache(ck, cv), act,
                         attn_len=kv_len,
                         pallas_int8=self.use_pallas_int8)
-                    nxt = sample_tokens(logits, sub, temps, topks, topps,
+                    nxt = sample_tokens(logits[:, :self.sample_vocab],
+                                        sub, temps, topks, topps,
                                         method=self.sampling_method)
                     pos = pos + act.astype(pos.dtype)
                     return (newc.k, newc.v, nxt, pos, key), nxt
@@ -661,7 +688,8 @@ class TPUEngine(EngineBase):
                     KVCache(sk, sv), pos, write_mask=act,
                     pallas_decode=use_pallas,
                     pallas_int8=self.use_pallas_int8)
-                nxt = sample_tokens(logits[:, -1], sub, temps, topks, topps,
+                nxt = sample_tokens(logits[:, -1, :self.sample_vocab],
+                                    sub, temps, topks, topps,
                                     method=self.sampling_method)
                 pos = pos + act.astype(pos.dtype)
                 return (small.k, small.v, nxt, pos, key), nxt
@@ -755,7 +783,8 @@ class TPUEngine(EngineBase):
             # First-token sampling fused into the same call: one device
             # round-trip per burst instead of two (TTFT-critical).
             rng, sub = jax.random.split(rng)
-            firsts = sample_tokens(logits[:, 0], sub, temps, topks, topps,
+            firsts = sample_tokens(logits[:, 0, :self.sample_vocab], sub,
+                                   temps, topks, topps,
                                    method=self.sampling_method)
             new_cur = cur.at[slot_idx].set(firsts, mode="drop")
             return KVCache(new_k, new_v), firsts, new_cur, rng
@@ -795,7 +824,8 @@ class TPUEngine(EngineBase):
                 slot = cfg_row[0].astype(jnp.int32)
                 rng, sub = jax.random.split(rng)
                 first = sample_tokens(
-                    last_logits[None, :], sub, cfg_row[1][None],
+                    last_logits[None, :self.sample_vocab], sub,
+                    cfg_row[1][None],
                     cfg_row[2].astype(jnp.int32)[None], cfg_row[3][None],
                     method=self.sampling_method)
                 return first, cur.at[slot].set(first[0], mode="drop"), rng
@@ -1130,11 +1160,22 @@ class TPUEngine(EngineBase):
         device queue and the NEXT request's prefill — and therefore its
         first token — waits behind all of them. A length-capped
         generation now finishes with an empty pipeline."""
+        if self._pending_firsts and self._running and all(
+                req.first_pending for req in self._running.values()):
+            # Pure admission burst: EVERY running request is still
+            # waiting for its prefill-sampled first token. A decode
+            # dispatch now would enter the in-order device stream ahead
+            # of the firsts fetch and push first-token latency a whole
+            # call's compute later (traced: +150 ms at 32 steps on the
+            # relayed attach, scripts/profile_ttft.py). Hold off; the
+            # loop blocks on the fetch and decode follows one link
+            # round trip later. Steady state is untouched — any request
+            # past its first token makes this condition false.
+            return False
         promised: dict[int, int] = {}
-        for toks, snap in self._inflight:
+        for _, steps, snap in self._inflight:
             for _, req in snap:
-                promised[id(req)] = (promised.get(id(req), 0)
-                                     + int(toks.shape[0]))
+                promised[id(req)] = promised.get(id(req), 0) + steps
         # A first token whose fetch hasn't landed is not yet counted in
         # req.generated but will be — ignoring it over-dispatches one
         # whole stale call at exact-budget boundaries.
@@ -1161,14 +1202,11 @@ class TPUEngine(EngineBase):
 
     def _defer_first(self, firsts_dev: Any, entries: list) -> None:
         """Queue first sampled tokens for emission once their
-        device→host copy completes."""
-        try:
-            firsts_dev.copy_to_host_async()
-        except AttributeError:
-            pass
+        device→host copy (started here, on a worker) completes."""
         for _, _, req in entries:
             req.first_pending = True
-        self._pending_firsts.append((firsts_dev, entries))
+        self._pending_firsts.append(
+            (self._fetch_pool.submit(np.asarray, firsts_dev), entries))
 
     def _drain_firsts(self, block: bool) -> None:
         """Emit first tokens whose fetch has landed (all of them when
@@ -1176,18 +1214,11 @@ class TPUEngine(EngineBase):
         finished (cancel, error) before its first token arrived drops
         it."""
         while self._pending_firsts:
-            arr_dev, entries = self._pending_firsts[0]
-            if not block:
-                try:
-                    if not arr_dev.is_ready():
-                        return
-                except AttributeError:
-                    # No readiness probe on this array type: never turn
-                    # the non-blocking poll into a device round trip —
-                    # the blocking sites guarantee eventual emission.
-                    return
+            fut, entries = self._pending_firsts[0]
+            if not block and not fut.done():
+                return
             self._pending_firsts.popleft()
-            arr = np.asarray(arr_dev)
+            arr = fut.result()
             for j, s, req in entries:
                 req.first_pending = False
                 if req.finished or self._running.get(s) is not req:
@@ -1224,17 +1255,19 @@ class TPUEngine(EngineBase):
         self._patch_slot_state()
         active = list(self._running)
         snapshot = list(self._running.items())
-        # Short calls while admissions/prefills are pending (the next
-        # arrival's first token waits behind the in-order device queue);
-        # long calls in steady state (amortise the per-call cache
-        # boundary copy).
+        # Short calls while admissions/prefills are pending or a first
+        # token's fetch is still in flight (anything TTFT-critical waits
+        # behind the in-order device queue); long calls in steady state
+        # (amortise the per-call cache boundary copy).
         steps = (self.steps_burst if self._waiting or self._prefilling
+                 or any(req.first_pending
+                        for req in self._running.values())
                  else self.steps_per_call)
         # Device positions lead the host mirrors by the in-flight calls'
         # step counts; size the KV bucket for where the device will be
         # at the END of this call.
         max_pos = int(self._positions[active].max()) \
-            + sum(int(t.shape[0]) for t, _ in self._inflight) + steps
+            + sum(k for _, k, _ in self._inflight) + steps
         kv_len = next((b for b in _KV_BUCKETS
                        if b >= max_pos and b <= self.max_len), self.max_len)
         fn = self._get_decode_fn(kv_len, steps)
@@ -1243,25 +1276,24 @@ class TPUEngine(EngineBase):
             self.params, self.cache, self._cur_tokens, self._positions_dev,
             self._active_dev, self._temps_dev, self._topks_dev,
             self._topps_dev, self._rng_dev)
-        try:
-            # Start the device→host copy immediately: retirement then
-            # costs ~0 instead of a full round trip (the dominant cost
-            # per call on relayed devices).
-            toks.copy_to_host_async()
-        except AttributeError:
-            pass
-        self._inflight.append((toks, snapshot))
+        # Start the device→host copy NOW on a worker thread: by
+        # retirement time it has been in flight for a whole call's
+        # compute, and later calls' fetches overlap it (see the
+        # _fetch_pool note in __init__).
+        self._inflight.append(
+            (self._fetch_pool.submit(np.asarray, toks), steps, snapshot))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
-        toks_dev, snapshot = self._inflight.popleft()
+        fut, _, snapshot = self._inflight.popleft()
         if any(req.first_pending for _, req in snapshot):
             # A request in this call still awaits its first token:
             # emit firsts before any of its decode tokens (the firsts
-            # copy was issued earlier, so this wait is bounded).
+            # copy was issued earlier and overlaps this call's fetch on
+            # the worker pool, so this wait is bounded).
             self._drain_firsts(block=True)
         t0 = time.monotonic()
-        toks = np.asarray(toks_dev)  # [K, S] — sync point
+        toks = fut.result()  # [K, S] — sync point
         self._m_step.observe((time.monotonic() - t0) * 1000)
         # The block above gave every pending firsts-copy >= one call's
         # wall time to land: emit whatever arrived NOW. Without this, a

@@ -140,7 +140,7 @@ def build_engine(cfg: Config) -> EngineBase:
     from fasttalk_tpu.parallel.distributed import maybe_initialize
 
     maybe_initialize()
-    model_cfg = get_model_config(cfg.model_name)
+    model_cfg = get_model_config(cfg.model_name, cfg.model_path)
     dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
     acct = check_hbm_budget(model_cfg, cfg, dtype,
                             n_devices=max(1, cfg.tp_size * cfg.dp_size))
@@ -205,6 +205,27 @@ def build_engine(cfg: Config) -> EngineBase:
     tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
                                cfg.tokenizer_path,
                                template=model_cfg.chat_template)
+    if not loaded and getattr(tokenizer, "vocab_size", 0) <= 512:
+        # WEIGHT-FREE serving only (never when real weights loaded — a
+        # checkpoint missing its tokenizer.json must not be silently
+        # paired with an unrelated vocab): with no checkpoint tokenizer
+        # the byte fallback inflates an English prompt ~6x (1
+        # token/byte), which pushed weight-free benches into prefill
+        # buckets real deployments never hit — burst TTFT then measured
+        # tokenizer inflation, not the serving path
+        # (scripts/profile_ttft.py). Prefer the bundled real 32k BPE
+        # (scripts/make_bench_tokenizer.py) when the model vocab can
+        # hold it.
+        import os
+
+        from fasttalk_tpu.engine.tokenizer import HFTokenizer
+
+        bundled = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "assets", "bench_tokenizer.json")
+        if os.path.isfile(bundled):
+            cand = HFTokenizer(bundled, template=model_cfg.chat_template)
+            if cand.vocab_size <= model_cfg.vocab_size:
+                tokenizer = cand
     log.info(
         f"Building TPU engine: model={model_cfg.name} "
         f"({model_cfg.param_count() / 1e9:.2f}B params, "

@@ -77,13 +77,27 @@ class _RemoteEngine(EngineBase):
         r.raise_for_status()
         return r
 
-    def _finish_stats(self, tokens: int, started: float,
-                      ttft: float | None, prompt_tokens: int = 0) -> dict:
+    def _finish_stats(self, chunks: int, started: float,
+                      ttft: float | None,
+                      prompt_tokens: int | None = None,
+                      completion_tokens: int | None = None) -> dict:
+        """Terminal stats for a remote stream.
+
+        A stream CHUNK is not a token (the reference conflated the two —
+        SURVEY.md §5 metrics gap, explicitly on the don't-copy list), so
+        ``tokens_generated``/``tokens_per_second`` are reported only when
+        the backend supplied its own authoritative token counts (vLLM
+        usage via stream_options, Ollama eval_count); otherwise they are
+        None and ``chunks_generated`` carries the honestly-labelled
+        chunk count."""
         dur = time.monotonic() - started
         return {
-            "tokens_generated": tokens,
+            "chunks_generated": chunks,
+            "tokens_generated": completion_tokens,
             "processing_time_ms": dur * 1000,
-            "tokens_per_second": tokens / dur if dur > 0 else 0.0,
+            "tokens_per_second": (completion_tokens / dur
+                                  if completion_tokens is not None
+                                  and dur > 0 else None),
             "ttft_ms": ttft,
             "prompt_tokens": prompt_tokens,
         }
@@ -98,6 +112,10 @@ class VLLMRemoteEngine(_RemoteEngine):
         super().__init__(base_url, timeout_s)
         self.model = model
         self.api_key = api_key
+        # Set after a backend 400s on stream_options (pre-0.4.3 vLLM,
+        # strict OpenAI-compatible proxies): dropped for the engine's
+        # lifetime; stats then fall back to chunk counting.
+        self._no_stream_options = False
 
     async def generate(self, request_id: str, session_id: str,
                        messages: list[dict], params: GenerationParams,
@@ -110,6 +128,12 @@ class VLLMRemoteEngine(_RemoteEngine):
             "max_tokens": params.max_tokens,
             "stream": True,
         }
+        if not self._no_stream_options:
+            # Ask the backend for its own token accounting (an OpenAI /
+            # vLLM-supported option): the final chunk then carries
+            # usage.completion_tokens, the only true token count a
+            # remote client can get (chunk != token, SURVEY.md §5).
+            body["stream_options"] = {"include_usage": True}
         if params.raw_prompt:
             # /v1/completions passthrough: raw prompt, upstream's own
             # legacy endpoint (no chat template anywhere).
@@ -122,53 +146,83 @@ class VLLMRemoteEngine(_RemoteEngine):
             body["stop"] = params.stop
         started = time.monotonic()
         ttft = None
-        tokens = 0
+        chunks = 0
+        prompt_toks: int | None = None
+        completion_toks: int | None = None
         finish = "stop"
         try:
-            async with client.post(
-                    url, json=body,
-                    headers={"Authorization": f"Bearer {self.api_key}"},
-                    ) as resp:
-                if resp.status != 200:
-                    text = await resp.text()
-                    raise LLMServiceError(
-                        f"vLLM backend error {resp.status}: {text[:200]}",
-                        category=ErrorCategory.CONNECTION)
-                async for raw in resp.content:
-                    if request_id in self._cancelled:
-                        self._cancelled.discard(request_id)
-                        yield {"type": "cancelled",
-                               "finish_reason": "cancelled",
-                               "stats": self._finish_stats(tokens, started,
-                                                           ttft)}
-                        return
-                    line = raw.decode("utf-8", "replace").strip()
-                    if not line.startswith("data:"):
-                        continue
-                    payload = line[5:].strip()
-                    if payload == "[DONE]":
-                        break
-                    try:
-                        obj = json.loads(payload)
-                    except json.JSONDecodeError:
-                        continue
-                    choices = obj.get("choices") or []
-                    if not choices:
-                        continue
-                    fr = choices[0].get("finish_reason")
-                    if fr:
-                        finish = fr
-                    # chat streams deltas; completions streams text
-                    content = (choices[0].get("text") if params.raw_prompt
-                               else choices[0].get("delta", {})
-                               .get("content"))
-                    if content:
-                        tokens += 1
-                        if ttft is None:
-                            ttft = (time.monotonic() - started) * 1000
-                        yield {"type": "token", "text": content}
+            for _attempt in range(2):
+                async with client.post(
+                        url, json=body,
+                        headers={"Authorization": f"Bearer {self.api_key}"},
+                        ) as resp:
+                    if resp.status != 200:
+                        text = await resp.text()
+                        if resp.status == 400 \
+                                and "stream_options" in body \
+                                and "stream_options" in text:
+                            # The backend names stream_options in its
+                            # 400 (pre-0.4.3 vLLM, strict proxies):
+                            # drop the parameter for this engine's
+                            # lifetime and retry once (stats degrade to
+                            # honest chunk counts). Any OTHER 400 —
+                            # context overflow, bad params — surfaces
+                            # unretried below.
+                            self._no_stream_options = True
+                            del body["stream_options"]
+                            continue
+                        raise LLMServiceError(
+                            f"vLLM backend error {resp.status}: "
+                            f"{text[:200]}",
+                            category=ErrorCategory.CONNECTION)
+                    async for raw in resp.content:
+                        if request_id in self._cancelled:
+                            self._cancelled.discard(request_id)
+                            yield {"type": "cancelled",
+                                   "finish_reason": "cancelled",
+                                   "stats": self._finish_stats(
+                                       chunks, started, ttft, prompt_toks,
+                                       completion_toks)}
+                            return
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            break
+                        try:
+                            obj = json.loads(payload)
+                        except json.JSONDecodeError:
+                            continue
+                        usage = obj.get("usage")
+                        if usage:
+                            # include_usage final chunk (empty choices):
+                            # backend-authoritative token counts.
+                            prompt_toks = usage.get("prompt_tokens",
+                                                    prompt_toks)
+                            completion_toks = usage.get(
+                                "completion_tokens", completion_toks)
+                        choices = obj.get("choices") or []
+                        if not choices:
+                            continue
+                        fr = choices[0].get("finish_reason")
+                        if fr:
+                            finish = fr
+                        # chat streams deltas; completions streams text
+                        content = (choices[0].get("text")
+                                   if params.raw_prompt
+                                   else choices[0].get("delta", {})
+                                   .get("content"))
+                        if content:
+                            chunks += 1
+                            if ttft is None:
+                                ttft = (time.monotonic() - started) * 1000
+                            yield {"type": "token", "text": content}
+                break  # stream consumed; no retry
             yield {"type": "done", "finish_reason": finish,
-                   "stats": self._finish_stats(tokens, started, ttft)}
+                   "stats": self._finish_stats(chunks, started, ttft,
+                                               prompt_toks,
+                                               completion_toks)}
         except aiohttp.ClientError as e:
             raise LLMServiceError(f"vLLM connection failed: {e}",
                                   category=ErrorCategory.CONNECTION) from e
@@ -237,7 +291,9 @@ class OllamaRemoteEngine(_RemoteEngine):
             body["options"]["stop"] = params.stop
         started = time.monotonic()
         ttft = None
-        tokens = 0
+        chunks = 0
+        prompt_toks: int | None = None
+        completion_toks: int | None = None
         try:
             async with client.post(url, json=body) as resp:
                 if resp.status != 200:
@@ -250,8 +306,9 @@ class OllamaRemoteEngine(_RemoteEngine):
                         self._cancelled.discard(request_id)
                         yield {"type": "cancelled",
                                "finish_reason": "cancelled",
-                               "stats": self._finish_stats(tokens, started,
-                                                           ttft)}
+                               "stats": self._finish_stats(
+                                   chunks, started, ttft, prompt_toks,
+                                   completion_toks)}
                         return
                     line = raw.decode("utf-8", "replace").strip()
                     if not line:
@@ -265,14 +322,23 @@ class OllamaRemoteEngine(_RemoteEngine):
                                else (obj.get("message") or {})
                                .get("content"))
                     if content:
-                        tokens += 1
+                        chunks += 1
                         if ttft is None:
                             ttft = (time.monotonic() - started) * 1000
                         yield {"type": "token", "text": content}
                     if obj.get("done"):
+                        # Final NDJSON object carries Ollama's own token
+                        # accounting (the reference threw these away and
+                        # counted chunks, ollama_handler.py:233-339).
+                        prompt_toks = obj.get("prompt_eval_count",
+                                              prompt_toks)
+                        completion_toks = obj.get("eval_count",
+                                                  completion_toks)
                         break
             yield {"type": "done", "finish_reason": "stop",
-                   "stats": self._finish_stats(tokens, started, ttft)}
+                   "stats": self._finish_stats(chunks, started, ttft,
+                                               prompt_toks,
+                                               completion_toks)}
         except aiohttp.ClientError as e:
             raise LLMServiceError(f"Ollama connection failed: {e}",
                                   category=ErrorCategory.CONNECTION) from e

@@ -168,23 +168,39 @@ _BOS_TEXT = {"llama3": "<|begin_of_text|>", "chatml": "",
 
 
 class HFTokenizer:
-    """Wraps a HuggingFace fast tokenizer (tokenizer.json) with the chat
-    template rendered in-tree (templates are not fetchable in a
-    zero-egress deployment, and the formats are fixed per family —
-    models/configs.py names which one each model uses)."""
+    """Wraps a HuggingFace fast tokenizer (tokenizer.json).
 
-    def __init__(self, tokenizer_file: str, template: str = "llama3"):
+    Chat rendering prefers the CHECKPOINT'S OWN template
+    (tokenizer_config.json ``chat_template`` / chat_template.jinja,
+    rendered by engine/chat_template.py exactly as HF/vLLM render it) —
+    so a new instruct checkpoint serves its trained format with zero
+    code edits, matching what the reference got from its engines
+    (docker-compose.vllm.yml:38-53). Checkpoints that ship no template
+    fall back to the in-tree family renderer named by
+    models/configs.py."""
+
+    def __init__(self, tokenizer_file: str, template: str = "llama3",
+                 ckpt_template: Any = None):
         from tokenizers import Tokenizer as RustTokenizer
 
         self._tok = RustTokenizer.from_file(tokenizer_file)
+        self._ckpt_template = ckpt_template
         self._render = _TEMPLATES.get(template, render_llama3)
         # Fallback mirrors the template fallback: an unknown template
         # name renders llama3, so its raw prompts must get llama3's BOS.
         self._bos_text = _BOS_TEXT.get(template, _BOS_TEXT["llama3"])
+        if ckpt_template is not None and \
+                ckpt_template.special_tokens.get("bos_token"):
+            self._bos_text = ckpt_template.special_tokens["bos_token"]
         self.vocab_size = self._tok.get_vocab_size()
         eos = set()
-        for name in ("<|eot_id|>", "<|end_of_text|>", "</s>", "<|eom_id|>",
-                     "<|im_end|>", "<|endoftext|>"):
+        eos_names = ["<|eot_id|>", "<|end_of_text|>", "</s>", "<|eom_id|>",
+                     "<|im_end|>", "<|endoftext|>"]
+        if ckpt_template is not None and \
+                ckpt_template.special_tokens.get("eos_token"):
+            # The checkpoint's declared EOS, whatever it is named.
+            eos_names.append(ckpt_template.special_tokens["eos_token"])
+        for name in eos_names:
             tid = self._tok.token_to_id(name)
             if tid is not None:
                 eos.add(tid)
@@ -205,7 +221,25 @@ class HFTokenizer:
 
     def apply_chat_template(self, messages: Sequence[Message],
                             add_generation_prompt: bool = True) -> list[int]:
-        text = self._render(messages, add_generation_prompt)
+        if self._ckpt_template is not None:
+            try:
+                text = self._ckpt_template.render(
+                    messages, add_generation_prompt=add_generation_prompt)
+            except Exception:
+                # Render-time failure (e.g. a strict-alternation template
+                # hitting the agent's role-"tool" turns, where stock
+                # templates call raise_exception): fall back to the
+                # family renderer — one failed render must not error
+                # every request and trip the breaker.
+                import logging
+
+                logging.getLogger("fasttalk.engine.tokenizer").warning(
+                    "checkpoint chat template failed to render; using "
+                    "the %s family fallback", self._render.__name__,
+                    exc_info=True)
+                text = self._render(messages, add_generation_prompt)
+        else:
+            text = self._render(messages, add_generation_prompt)
         return self._tok.encode(text, add_special_tokens=False).ids
 
 
@@ -294,9 +328,17 @@ def find_tokenizer_file(model_path: str, model_name: str) -> str | None:
 def load_tokenizer(model_path: str, model_name: str,
                    tokenizer_path: str = "",
                    template: str = "llama3") -> Tokenizer:
-    """HF tokenizer if files are present, else the byte fallback."""
+    """HF tokenizer if files are present, else the byte fallback.
+
+    When the checkpoint directory ships its own chat template
+    (tokenizer_config.json / chat_template.jinja), that template wins
+    over the ``template`` family name (engine/chat_template.py)."""
     tf = tokenizer_path if tokenizer_path and os.path.isfile(tokenizer_path) \
         else find_tokenizer_file(model_path, model_name)
     if tf:
-        return HFTokenizer(tf, template=template)
+        from fasttalk_tpu.engine.chat_template import load_chat_template
+
+        return HFTokenizer(tf, template=template,
+                           ckpt_template=load_chat_template(
+                               os.path.dirname(os.path.abspath(tf))))
     return ByteTokenizer()

@@ -51,6 +51,10 @@ def find_checkpoint_dir(model_path: str, model_name: str) -> str | None:
         model_path,
         os.path.join(model_path, model_name.replace(":", "_")),
         os.path.join(model_path, model_name.replace(":", "-")),
+        # HF-style org/name: flattened (scripts/fetch_model.py layout)
+        # or nested as-is.
+        os.path.join(model_path,
+                     model_name.replace(":", "_").replace("/", "_")),
         os.path.join(model_path, model_name),
     ]
     for c in candidates:
@@ -168,7 +172,9 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
     # ~once, not per leaf.
     def _gen_leaf(base_key, crc, *, kind, shape, leaf_quantize):
         # leaf_quantize: False | "out" (per-output-channel, matmul
-        # weights) | "row" (per-row, the embedding — ops/quant.py).
+        # weights) | "row" (per-row, the embedding) | "out_t" (the
+        # untied lm_head, stored transposed — ops/quant.py
+        # _quantize_head_t; same scale math, kernel-streamable layout).
         if kind == "ones":
             return jnp.ones(shape, dtype)
         if kind == "zeros":
@@ -216,6 +222,9 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
                                      jnp.zeros(shape, dtype))
 
         wf = make_slice(key, shape)
+        if leaf_quantize == "out_t":
+            q, s = quantize_f32(wf)  # per-output-channel on [D, V]
+            return {"qt": q.T, "s": s}  # identical values, [V, D] layout
         if leaf_quantize:
             q, s = quantize_f32(wf)
             return {"q": q, "s": s}
@@ -258,7 +267,9 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
             kind = "normal"
         leaf_quantize: bool | str = False
         if quantize and kind == "normal":
-            if name in QUANTIZED_LEAVES:
+            if name == "lm_head":
+                leaf_quantize = "out_t"
+            elif name in QUANTIZED_LEAVES:
                 leaf_quantize = "out"
             elif name == "embed":
                 leaf_quantize = "row"
@@ -277,9 +288,12 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
             if leaf_quantize:
                 s_shape = (shape[:-1] if leaf_quantize == "row"
                            else shape[:-2] + shape[-1:])
+                qname = "qt" if leaf_quantize == "out_t" else "q"
+                qshape = (shape[::-1] if leaf_quantize == "out_t"
+                          else shape)
                 out_sh = {
-                    "q": NamedSharding(mesh, _spec_for(
-                        "q", len(shape), shape, parent=name)),
+                    qname: NamedSharding(mesh, _spec_for(
+                        qname, len(qshape), qshape, parent=name)),
                     "s": NamedSharding(mesh, _spec_for(
                         "s", len(s_shape), s_shape, parent=name)),
                 }

@@ -96,6 +96,16 @@ def abstract_params(cfg: ModelConfig, dtype, quantize: bool, mesh) -> Any:
                                     parent=leaf_parent))
             return jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
 
+        if quantize and name == "lm_head":
+            # Untied head is stored TRANSPOSED row-quantized
+            # ({"qt": int8[V, D], "s": f32[V]} — ops/quant.py
+            # _quantize_head_t); the restore target must match or every
+            # restart silently repays the full load.
+            d, v = sds.shape
+            return {
+                "qt": with_sharding((v, d), jnp.int8, "qt", name),
+                "s": with_sharding((v,), jnp.float32, "s", name),
+            }
         if quantize and name in QUANTIZED_LEAVES:
             out = sds.shape[-1]
             lead = sds.shape[:-2]

@@ -68,6 +68,20 @@ def _quantize_embed(w: jax.Array) -> dict[str, jax.Array]:
     return {"q": q, "s": s}
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _quantize_head_t(w: jax.Array) -> dict[str, jax.Array]:
+    """The untied lm_head [D, V], stored TRANSPOSED: ``{"qt": int8[V, D],
+    "s": f32[V]}``. Scale math is identical to per-output-channel on
+    [D, V] (the max runs over D either way), so this is a pure layout
+    change — but it is the layout the contiguous row-block kernel
+    (ops/pallas_int8.py int8_matmul_t) can stream: the [D, V] layout
+    needs a full-V f32 accumulator that busts VMEM, which silently sent
+    large-vocab untied heads back to the XLA dequant path on the single
+    biggest decode matmul (ADVICE r3)."""
+    q, s = quantize_math_row(w.T.astype(jnp.float32))
+    return {"qt": q, "s": s}
+
+
 def quantize_params(params: Any) -> Any:
     """Quantize the matmul weights of a (possibly sharded) param pytree.
 
@@ -83,7 +97,7 @@ def quantize_params(params: Any) -> Any:
         if name in QUANTIZED_LEAVES:
             out["layers"][name] = _quantize_leaf(out["layers"][name])
     if "lm_head" in out:
-        out["lm_head"] = _quantize_leaf(out["lm_head"])
+        out["lm_head"] = _quantize_head_t(out["lm_head"])
     out["embed"] = _quantize_embed(out["embed"])
     return out
 
@@ -97,6 +111,22 @@ def matmul(x: jax.Array, w: Any, pallas_ok: bool = False) -> jax.Array:
     accumulator, avoiding XLA's per-step weight re-materialisation.
     """
     if isinstance(w, dict):
+        if "qt" in w:
+            # Transposed untied lm_head {"qt": [V, D], "s": [V]}: the
+            # same contiguous row-block kernel as the tied embedding
+            # streams it at HBM rate (ADVICE r3 — the [D, V] layout's
+            # full-V accumulator busted VMEM and forced XLA dequant).
+            if pallas_ok and x.ndim == 3 and x.shape[1] == 1:
+                from fasttalk_tpu.ops.pallas_int8 import (int8_matmul_t,
+                                                          supports_t)
+
+                if supports_t((x.shape[0], x.shape[2]), w["qt"].shape,
+                              jnp.dtype(x.dtype).itemsize):
+                    return int8_matmul_t(x[:, 0], w["qt"], w["s"])[:, None]
+            out = jax.lax.dot_general(
+                x, w["qt"].astype(x.dtype),
+                (((x.ndim - 1,), (1,)), ((), ())))
+            return out * w["s"].astype(x.dtype)
         if pallas_ok and x.ndim == 3 and x.shape[1] == 1:
             from fasttalk_tpu.ops.pallas_int8 import int8_matmul, supports
 
@@ -154,6 +184,26 @@ def quantizing_put(inner_put, raw_put):
     def put(arr, path: str):
         name = path.split("/")[-1]
         a = np.asarray(arr)
+        if name == "lm_head" and a.ndim == 2:
+            # Untied head stored transposed (see _quantize_head_t).
+            # ``a`` arrives [D, V] — the loader's ``.T`` view of the
+            # [V, D] tensor safetensors delivered — so quantize in
+            # column blocks straight off that view: peak extra host
+            # memory is one small f32 block, not a full contiguous f32
+            # transpose of a 128k-vocab head (~2 GB for 8B).
+            d, v = a.shape
+            q = np.empty((v, d), np.int8)
+            s = np.empty((v,), np.float32)
+            step = max(1, (4 << 20) // max(1, d))  # ~16 MB f32 blocks
+            for j in range(0, v, step):
+                blk = np.asarray(a[:, j:j + step], np.float32)
+                sb = np.maximum(np.max(np.abs(blk), axis=0) / 127.0,
+                                1e-8)
+                q[j:j + step] = np.round(blk / sb[None, :]).astype(
+                    np.int8).T
+                s[j:j + step] = sb
+            return {"qt": raw_put(q, f"{path}/qt"),
+                    "s": raw_put(s, f"{path}/s")}
         if name == EMBED_LEAF and a.ndim == 2:
             s = np.maximum(
                 np.max(np.abs(a.astype(np.float32)), axis=-1) / 127.0, 1e-8)

@@ -71,10 +71,15 @@ def _spec_for(name: str, ndim: int, shape=None, parent: str = "") -> P:
     the weight's name: "q" shards exactly like the original weight; the
     per-output-channel scale "s" shards like the weight's last axis.
     """
-    if name in ("q", "s") and parent:
+    if name in ("q", "qt", "s") and parent:
         base = _TOP_RULES.get(parent) or _LAYER_RULES.get(parent)
         if base is not None:
-            if name == "q":
+            if name == "qt":
+                # Transposed untied lm_head [V, D] (ops/quant.py
+                # _quantize_head_t): vocab axis stays TP-sharded,
+                # now leading.
+                spec = P(base[-1], *base[:-1])
+            elif name == "q":
                 spec = base
             elif parent == "embed":
                 # Embedding quantizes per ROW (ops/quant.py): the scale

@@ -295,8 +295,10 @@ def register_openai_routes(app: web.Application,
         return stats, finish_reason, None
 
     def _usage(stats: dict) -> dict:
-        prompt_tokens = int(stats.get("prompt_tokens", 0))
-        completion_tokens = int(stats.get("tokens_generated", 0))
+        # `or 0`: remote backends report None when the upstream gave no
+        # usage accounting (chunks are never passed off as tokens).
+        prompt_tokens = int(stats.get("prompt_tokens") or 0)
+        completion_tokens = int(stats.get("tokens_generated") or 0)
         return {"prompt_tokens": prompt_tokens,
                 "completion_tokens": completion_tokens,
                 "total_tokens": prompt_tokens + completion_tokens}

@@ -410,7 +410,11 @@ class WebSocketLLMServer:
                 await self._send(session_id, ws, {
                     "type": "token", "data": tts_buffer, "speakable": True})
             self.breaker.record_success()
-            tokens = int(stats.get("tokens_generated", 0))
+            # Remote backends report tokens_generated=None when the
+            # upstream supplied no usage accounting (chunks are not
+            # tokens — SURVEY.md §5); counters then record 0 rather
+            # than a wrong-unit chunk count.
+            tokens = int(stats.get("tokens_generated") or 0)
             self.conversation_manager.add_assistant_message(
                 session_id, full_text, tokens_generated=tokens)
             self.connection_manager.record_tokens_generated(session_id,
@@ -422,10 +426,19 @@ class WebSocketLLMServer:
             await self._send(session_id, ws, {
                 "type": "response_complete",
                 "stats": {
-                    "tokens_generated": tokens,
+                    "tokens_generated": stats.get("tokens_generated",
+                                                  tokens),
+                    **({"chunks_generated": stats["chunks_generated"]}
+                       if "chunks_generated" in stats else {}),
                     "processing_time_ms": stats.get(
                         "processing_time_ms", duration * 1000),
-                    "tokens_per_second": stats.get("tokens_per_second", 0.0),
+                    # `or 0.0`: remote stats carry None when the
+                    # upstream gave no usage accounting, but this field
+                    # has always been numeric on the reference protocol
+                    # (clients format it); chunks_generated carries the
+                    # honest count.
+                    "tokens_per_second":
+                        stats.get("tokens_per_second") or 0.0,
                     "ttft_ms": stats.get("ttft_ms"),
                     "prompt_tokens": stats.get("prompt_tokens"),
                     "finish_reason": "cancelled" if cancelled

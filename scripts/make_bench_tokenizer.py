@@ -1,0 +1,100 @@
+"""Train the real BPE tokenizer the weight-free bench serves with.
+
+Why this exists: without a checkpoint the engine fell back to the
+byte-level tokenizer, which inflates an English prompt ~6x (1 token per
+byte vs ~4 bytes/token for a 32k BPE). That pushed the bench's 16-way
+burst prefill from the 64-token bucket into the 512-token bucket —
+~8k prompt tokens of pure MXU work per burst — and TTFT measured that
+inflation, not the serving path (scripts/profile_ttft.py, round 4). The
+reference never had this problem because its engines always shipped a
+real tokenizer (vLLM HF cache volume, docker-compose.vllm.yml:58-59).
+
+Trains a ByteLevel BPE (llama/GPT-2 style) on the English-heavy text
+available offline in the image (repo docs + library docstrings), with
+the llama3 + ChatML special tokens used by the in-tree chat templates.
+Output: fasttalk_tpu/assets/bench_tokenizer.json (committed; training
+is reproducible with this script but needs no network either way).
+
+Usage: python scripts/make_bench_tokenizer.py [--vocab 32000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPECIALS = [
+    "<unk>",
+    # llama3 family (engine/tokenizer.py render_llama3)
+    "<|begin_of_text|>", "<|end_of_text|>", "<|start_header_id|>",
+    "<|end_header_id|>", "<|eot_id|>", "<|eom_id|>",
+    "<|finetune_right_pad_id|>",
+    # ChatML family (render_chatml)
+    "<|im_start|>", "<|im_end|>", "<|endoftext|>",
+    # Mistral family (render_mistral)
+    "<s>", "</s>",
+]
+
+
+def corpus_files(max_mb: int = 24) -> list[str]:
+    pats = [
+        os.path.join(REPO, "*.md"),
+        "/opt/skills/guides/*.md",
+        "/opt/venv/lib/python3.12/site-packages/transformers/**/*.py",
+        "/opt/venv/lib/python3.12/site-packages/jax/**/*.py",
+    ]
+    files: list[str] = []
+    total = 0
+    for pat in pats:
+        for f in sorted(glob.glob(pat, recursive=True)):
+            sz = os.path.getsize(f)
+            if total + sz > max_mb * 2**20:
+                return files
+            files.append(f)
+            total += sz
+    return files
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "fasttalk_tpu", "assets", "bench_tokenizer.json"))
+    args = ap.parse_args()
+
+    from tokenizers import Tokenizer, decoders, pre_tokenizers, processors
+    from tokenizers.models import BPE
+    from tokenizers.trainers import BpeTrainer
+
+    tok = Tokenizer(BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    tok.post_processor = processors.ByteLevel(trim_offsets=False)
+
+    files = corpus_files()
+    print(f"training BPE vocab={args.vocab} on {len(files)} files...",
+          file=sys.stderr)
+    trainer = BpeTrainer(vocab_size=args.vocab, special_tokens=SPECIALS,
+                         show_progress=False)
+    tok.train(files, trainer)
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    tok.save(args.out)
+    # Smoke: ratio + specials survive a round trip as single ids.
+    sample = ("You are a concise assistant for a realtime voice app. "
+              "Explain how a systolic array multiplies matrices.")
+    ids = tok.encode(sample, add_special_tokens=False).ids
+    print(f"saved {args.out}: vocab={tok.get_vocab_size()}, "
+          f"sample {len(sample)} chars -> {len(ids)} tokens "
+          f"({len(sample) / len(ids):.1f} chars/token)", file=sys.stderr)
+    for s in SPECIALS:
+        assert tok.token_to_id(s) is not None, s
+        assert len(tok.encode(s, add_special_tokens=False).ids) == 1, s
+
+
+if __name__ == "__main__":
+    main()

@@ -221,9 +221,10 @@ def main():
     @jax.jit
     def lm_head(x, emb):
         if isinstance(emb, dict):
-            return (x @ emb["q"].astype(x.dtype).T
-                    if emb["q"].shape[0] == cfg.vocab_size
-                    else x @ emb["q"].astype(x.dtype)) * 1.0
+            q = emb.get("qt", emb.get("q"))  # untied head stores [V, D]
+            return (x @ q.astype(x.dtype).T
+                    if q.shape[0] == cfg.vocab_size
+                    else x @ q.astype(x.dtype)) * 1.0
         w = emb.T if emb.shape[0] == cfg.vocab_size else emb
         return (x @ w).astype(jnp.float32)
 

@@ -1,0 +1,326 @@
+"""TTFT breakdown: where the milliseconds go between a WebSocket
+user_message and the first token frame (VERDICT r3 #1).
+
+Runs the real server + engine on the real device, instruments the hops
+by wrapping the product code (no product changes), and prints a
+per-stage breakdown for 1 session and a concurrent burst:
+
+  client_send -> server_recv   WS read + event-loop dispatch
+  server_recv -> gen_entry     history build, task spawn
+  gen_entry   -> submitted     tokenization + command enqueue
+  submitted   -> admitted      engine-thread drain + burst coalescing
+  admitted    -> prefill_disp  prefill group build + device dispatch
+  prefill_disp-> first_ready   device prefill + first-token fetch land
+  first_ready -> ws_sent       engine->loop queue hop + WS write
+  ws_sent     -> client_recv   loopback + client read
+
+Usage:  python scripts/profile_ttft.py [sessions] [--no-coalesce]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PORT = int(os.environ.get("BENCH_PORT", "18641"))
+SESSIONS = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() \
+    else 16
+PROMPT = ("You are a concise assistant for a realtime voice app. "
+          "Explain, in plain language, how a systolic array multiplies "
+          "matrices and why that favours large batched matmuls.")
+
+# request_id -> {stage: t}
+MARKS: dict[str, dict[str, float]] = {}
+TRACE: list[tuple[float, str, str]] = []  # engine-thread event trace
+# session_id -> request_id (first token emitted flag)
+_FIRST_SENT: set[str] = set()
+
+
+def mark(rid: str, stage: str) -> None:
+    MARKS.setdefault(rid, {})[stage] = time.monotonic()
+
+
+def instrument(engine, server_mod) -> None:
+    from fasttalk_tpu.engine import engine as eng_mod
+
+    real_generate = engine.generate
+
+    async def generate(request_id, session_id, messages, params):
+        mark(request_id, "gen_entry")
+        agen = real_generate(request_id, session_id, messages, params)
+        first = True
+        async for ev in agen:
+            if first and ev["type"] == "token":
+                mark(request_id, "loop_got_token")
+                first = False
+            yield ev
+
+    engine.generate = generate
+
+    real_put = engine._commands.put
+
+    def put(item):
+        if isinstance(item, tuple) and item[0] == "submit":
+            mark(item[1].request_id, "submitted")
+        real_put(item)
+
+    engine._commands.put = put
+
+    real_group = engine._prefill_group
+
+    def prefill_group(bucket, sub):
+        t = time.monotonic()
+        for req, _, _, _ in sub:
+            MARKS.setdefault(req.request_id, {})["admitted"] = t
+        out = real_group(bucket, sub)
+        t = time.monotonic()
+        for req, _, _, _ in sub:
+            MARKS.setdefault(req.request_id, {})["prefill_disp"] = t
+        TRACE.append((t, "prefill_returned", f"bucket={bucket} "
+                      f"n={len(sub)}"))
+        return out
+
+    engine._prefill_group = prefill_group
+
+    real_defer = engine._defer_first
+
+    def defer(firsts_dev, entries):
+        t_submit = time.monotonic()
+
+        def fetch():
+            t_start = time.monotonic()
+            out = __import__("numpy").asarray(firsts_dev)
+            t_end = time.monotonic()
+            TRACE.append((t_end, "worker-fetch",
+                          f"queued={(t_start - t_submit) * 1000:.1f}ms "
+                          f"fetch={(t_end - t_start) * 1000:.1f}ms"))
+            return out
+
+        for _, _, req in entries:
+            req.first_pending = True
+        engine._pending_firsts.append(
+            (engine._fetch_pool.submit(fetch), entries))
+
+    engine._defer_first = defer
+
+    real_consume = engine._consume_token
+
+    def consume(req, tok):
+        if req.first_token_at is None:
+            mark(req.request_id, "first_ready")
+        real_consume(req, tok)
+
+    engine._consume_token = consume
+
+    # Trace the firsts-drain mechanics: does is_ready() exist / when do
+    # polls succeed / when does the blocking fetch start and end?
+    real_drain = engine._drain_firsts
+
+    def drain(block):
+        if engine._pending_firsts:
+            arr_dev, entries = engine._pending_firsts[0]
+            rids = [r.request_id for _, _, r in entries]
+            probe = getattr(arr_dev, "is_ready", None)
+            state = "no-probe" if probe is None else \
+                ("ready" if probe() else "pending")
+            t0 = time.monotonic()
+            real_drain(block)
+            dt = (time.monotonic() - t0) * 1000
+            if block or state != "pending" or dt > 1:
+                TRACE.append((time.monotonic(), "drain",
+                              f"block={block} state={state} "
+                              f"dt={dt:.1f}ms n={len(rids)}"))
+        else:
+            real_drain(block)
+
+    engine._drain_firsts = drain
+
+    real_retire = engine._retire_oldest
+
+    def retire():
+        t0 = time.monotonic()
+        real_retire()
+        TRACE.append((time.monotonic(), "retire",
+                      f"dt={(time.monotonic() - t0) * 1000:.1f}ms"))
+
+    engine._retire_oldest = retire
+
+    real_dispatch = engine._dispatch_decode
+
+    def dispatch():
+        real_dispatch()
+        TRACE.append((time.monotonic(), "dispatch_decode", ""))
+
+    engine._dispatch_decode = dispatch
+
+    if "--block-firsts" in sys.argv:
+        # Experiment: emit first tokens synchronously at the end of the
+        # prefill (before any decode dispatch can hit the device queue),
+        # with a probe fetch first to split compute from fetch channel.
+        real_defer = engine._defer_first
+
+        def defer(firsts_dev, entries):
+            import numpy as _np
+
+            t0 = time.monotonic()
+            _np.asarray(engine._cur_tokens)  # data-dep on same prefill
+            t1 = time.monotonic()
+            _np.asarray(firsts_dev)
+            t2 = time.monotonic()
+            _np.asarray(firsts_dev)
+            t3 = time.monotonic()
+            TRACE.append((t3, "defer-block",
+                          f"probe={(t1 - t0) * 1000:.1f}ms "
+                          f"firsts={(t2 - t1) * 1000:.1f}ms "
+                          f"refetch={(t3 - t2) * 1000:.1f}ms"))
+            real_defer(firsts_dev, entries)
+            engine._drain_firsts(block=True)
+
+        engine._defer_first = defer
+
+
+def patch_server(server) -> None:
+    real_send = server._send
+
+    async def send(session_id, ws, payload):
+        await real_send(session_id, ws, payload)
+        if payload.get("type") == "token" and session_id not in _FIRST_SENT:
+            _FIRST_SENT.add(session_id)
+            rid = server._cur_request.get(session_id)
+            if rid:
+                mark(rid, "ws_sent")
+                MARKS[rid]["session_id"] = session_id  # type: ignore
+
+    server._send = send
+
+    real_user = server._handle_user_message
+
+    async def handle_user(session_id, message, ws):
+        MARKS.setdefault(f"sess:{session_id}", {})[
+            "server_recv"] = time.monotonic()
+        await real_user(session_id, message, ws)
+
+    server._handle_user_message = handle_user
+
+
+async def ws_session(http, i: int, max_tokens: int = 16) -> dict:
+    async with http.ws_connect(f"ws://127.0.0.1:{PORT}/ws/llm") as ws:
+        msg = json.loads((await ws.receive()).data)
+        session_id = msg["session_id"]
+        await ws.send_json({"type": "start_session",
+                            "config": {"max_tokens": max_tokens}})
+        await ws.receive()
+        t0 = time.monotonic()
+        await ws.send_json({"type": "user_message",
+                            "text": f"[session {i}] {PROMPT}"})
+        ttft = None
+        while True:
+            frame = json.loads((await ws.receive()).data)
+            if frame["type"] == "token" and ttft is None:
+                ttft = time.monotonic()
+            elif frame["type"] == "response_complete":
+                break
+            elif frame["type"] == "error":
+                raise RuntimeError(frame)
+        await ws.send_json({"type": "end_session"})
+        await ws.receive()
+    return {"session_id": session_id, "client_send": t0,
+            "client_recv": ttft}
+
+
+STAGES = ["client_send", "server_recv", "gen_entry", "submitted",
+          "admitted", "prefill_disp", "first_ready", "ws_sent",
+          "client_recv"]
+
+
+def report(label: str, rows: list[dict]) -> None:
+    print(f"\n== {label} ({len(rows)} sessions) ==")
+    deltas: dict[str, list[float]] = {}
+    totals = []
+    for r in rows:
+        sid = r["session_id"]
+        rid = next((k for k, v in MARKS.items()
+                    if v.get("session_id") == sid), None)
+        m = dict(MARKS.get(rid, {}))
+        m.update(MARKS.get(f"sess:{sid}", {}))
+        m["client_send"], m["client_recv"] = r["client_send"], r["client_recv"]
+        prev_stage = None
+        for st in STAGES:
+            if st not in m:
+                continue
+            if prev_stage is not None:
+                deltas.setdefault(f"{prev_stage:>12} -> {st}", []).append(
+                    (m[st] - m[prev_stage]) * 1000)
+            prev_stage = st
+        totals.append((m["client_recv"] - m["client_send"]) * 1000)
+    for name, vals in deltas.items():
+        print(f"  {name:34s} p50 {statistics.median(vals):7.1f} ms   "
+              f"max {max(vals):7.1f} ms")
+    print(f"  {'TOTAL client TTFT':34s} p50 {statistics.median(totals):7.1f}"
+          f" ms   max {max(totals):7.1f} ms")
+
+
+async def main() -> None:
+    import aiohttp
+    from aiohttp import web
+
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.serving import server as server_mod
+    from fasttalk_tpu.serving.server import WebSocketLLMServer
+    from fasttalk_tpu.utils.config import Config
+
+    cfg = Config(llm_provider="tpu", model_name="llama3.2:1b",
+                 decode_slots=SESSIONS, max_model_len=2048,
+                 default_context_window=2048, prefill_chunk=512,
+                 dtype="bfloat16", port=PORT, monitoring_port=PORT + 1,
+                 enable_agent=False, quantize="int8")
+    engine = build_engine(cfg)
+    engine.warmup(cfg.warmup)
+    engine.start()
+    instrument(engine, server_mod)
+    server = WebSocketLLMServer(cfg, engine, None)
+    patch_server(server)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", PORT).start()
+    print("server up; warming protocol...", file=sys.stderr)
+
+    try:
+        async with aiohttp.ClientSession() as http:
+            await ws_session(http, 990, 8)
+            await asyncio.gather(*(ws_session(http, 900 + i, 8)
+                                   for i in range(SESSIONS)))
+            MARKS.clear()
+            _FIRST_SENT.clear()
+
+            singles = []
+            for rep in range(5):
+                singles.append(await ws_session(http, 100 + rep, 16))
+            report("single session x5", singles)
+
+            MARKS.clear()
+            _FIRST_SENT.clear()
+            TRACE.clear()
+            await asyncio.sleep(2)  # let stale in-flight work fully drain
+            t_burst = time.monotonic()
+            burst = await asyncio.gather(
+                *(ws_session(http, i, 16) for i in range(SESSIONS)))
+            report(f"burst {SESSIONS}", list(burst))
+            print("\n== engine-thread trace (burst, first 400ms) ==")
+            for t, kind, detail in TRACE:
+                dt = (t - t_burst) * 1000
+                if dt < 400:
+                    print(f"  +{dt:7.1f}ms {kind:16s} {detail}")
+    finally:
+        await runner.cleanup()
+        engine.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

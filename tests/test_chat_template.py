@@ -1,0 +1,234 @@
+"""Checkpoint-defined chat templates (VERDICT r3 #5).
+
+Parity oracle: transformers' own jinja renderer (the code path HF and
+vLLM use to render ``tokenizer_config.json``'s ``chat_template``) must
+produce byte-identical text for the same template + context. Plus:
+loading precedence, special-token extraction, HFTokenizer integration,
+and ModelConfig-from-config.json for names outside the registry.
+"""
+
+import json
+
+import pytest
+
+from fasttalk_tpu.engine.chat_template import (CheckpointChatTemplate,
+                                               load_chat_template)
+
+MESSAGES = [
+    {"role": "system", "content": "Be brief."},
+    {"role": "user", "content": "What's a systolic array?\n"},
+    {"role": "assistant", "content": "A grid of MACs."},
+    {"role": "user", "content": "Shorter."},
+]
+
+# Representative real-world template shapes (whitespace control, loops,
+# conditionals, raise_exception, filters, loop controls, generation tag).
+LLAMA3ISH = (
+    "{{ bos_token }}{% for message in messages %}"
+    "{% if message['role'] not in ['system', 'user', 'assistant'] %}"
+    "{{ raise_exception('Unknown role: ' + message['role']) }}"
+    "{% endif %}"
+    "<|start_header_id|>{{ message['role'] }}<|end_header_id|>\n\n"
+    "{{ message['content'] | trim }}<|eot_id|>{% endfor %}"
+    "{% if add_generation_prompt %}"
+    "<|start_header_id|>assistant<|end_header_id|>\n\n{% endif %}")
+
+CHATMLISH = """{%- for message in messages %}
+    {%- if loop.first and message.role != 'system' %}
+        {{- '<|im_start|>system\\nDefault.<|im_end|>\\n' }}
+    {%- endif %}
+    {{- '<|im_start|>' + message.role + '\\n' + message.content
+        + '<|im_end|>' + '\\n' }}
+{%- endfor %}
+{%- if add_generation_prompt %}
+    {{- '<|im_start|>assistant\\n' }}
+{%- endif %}"""
+
+FANCY = (
+    "{% for m in messages %}{% if loop.index0 > 2 %}{% break %}{% endif %}"
+    "{{ m | tojson }}|{% endfor %}"
+    "{% generation %}gen-span{% endgeneration %}")
+
+
+def _hf_render(template: str, **ctx):
+    from transformers.utils.chat_template_utils import \
+        _compile_jinja_template
+
+    return _compile_jinja_template(template).render(**ctx)
+
+
+@pytest.mark.parametrize("template", [LLAMA3ISH, CHATMLISH, FANCY],
+                         ids=["llama3ish", "chatmlish", "fancy"])
+def test_render_parity_with_transformers(template):
+    specials = {"bos_token": "<|begin_of_text|>", "eos_token": "<|eot_id|>"}
+    ours = CheckpointChatTemplate(template, specials).render(
+        MESSAGES, add_generation_prompt=True)
+    theirs = _hf_render(template, messages=MESSAGES,
+                        add_generation_prompt=True, tools=None, **specials)
+    assert ours == theirs
+    assert ours  # non-empty — the oracle itself rendered something
+
+
+def test_raise_exception_surfaces():
+    t = CheckpointChatTemplate(LLAMA3ISH, {"bos_token": ""})
+    with pytest.raises(Exception, match="Unknown role"):
+        t.render([{"role": "tool", "content": "x"}])
+
+
+def test_load_from_tokenizer_config(tmp_path):
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({
+        "chat_template": CHATMLISH,
+        "bos_token": None,
+        "eos_token": {"content": "<|im_end|>", "lstrip": False},
+        "pad_token": "<|endoftext|>",
+    }))
+    t = load_chat_template(str(tmp_path))
+    assert t is not None
+    assert t.special_tokens == {"eos_token": "<|im_end|>",
+                                "pad_token": "<|endoftext|>"}
+    out = t.render([{"role": "user", "content": "hi"}])
+    assert out.startswith("<|im_start|>system")
+    assert out.endswith("<|im_start|>assistant\n")
+
+
+def test_load_named_template_list_prefers_default(tmp_path):
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({
+        "chat_template": [
+            {"name": "tool_use", "template": "TOOLS"},
+            {"name": "default", "template": "DEFAULT {{ messages | length }}"},
+        ]}))
+    t = load_chat_template(str(tmp_path))
+    assert t.render(MESSAGES) == "DEFAULT 4"
+
+
+def test_load_jinja_file_wins_over_config(tmp_path):
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({
+        "chat_template": "FROM-CONFIG", "eos_token": "</s>"}))
+    (tmp_path / "chat_template.jinja").write_text("FROM-FILE {{ eos_token }}")
+    t = load_chat_template(str(tmp_path))
+    assert t.render([]) == "FROM-FILE </s>"
+
+
+def test_no_template_returns_none(tmp_path):
+    assert load_chat_template(str(tmp_path)) is None
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps(
+        {"eos_token": "</s>"}))
+    assert load_chat_template(str(tmp_path)) is None
+
+
+def test_malformed_template_falls_back_to_none(tmp_path):
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps(
+        {"chat_template": "{% if unclosed %}"}))
+    assert load_chat_template(str(tmp_path)) is None
+
+
+# ---------------- HFTokenizer integration ----------------
+
+def _write_tiny_tokenizer(ckpt_dir) -> None:
+    """A real tokenizer.json (WordLevel over a closed vocab) with ChatML
+    special tokens, built offline via the `tokenizers` library."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    words = ["hi", "there", "ok", "user", "system", "assistant", "Default."]
+    specials = ["<unk>", "<|im_start|>", "<|im_end|>", "<|endoftext|>"]
+    vocab = {w: i for i, w in enumerate(specials + words)}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    tok.add_special_tokens(specials)
+    tok.save(str(ckpt_dir / "tokenizer.json"))
+
+
+def test_hftokenizer_uses_checkpoint_template(tmp_path):
+    from fasttalk_tpu.engine.tokenizer import load_tokenizer
+
+    _write_tiny_tokenizer(tmp_path)
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({
+        "chat_template": CHATMLISH, "eos_token": "<|im_end|>"}))
+    # Family template says llama3; the checkpoint's own ChatML must win.
+    tok = load_tokenizer(str(tmp_path), "some-model", template="llama3")
+    ids = tok.apply_chat_template([{"role": "user", "content": "hi there"}])
+    text_ids = tok._tok.encode(
+        "<|im_start|>system Default. <|im_end|> <|im_start|>user hi there "
+        "<|im_end|> <|im_start|>assistant",
+        add_special_tokens=False).ids
+    assert ids == text_ids
+    # The checkpoint's declared EOS is in eos_ids even though <|im_end|>
+    # is also on the built-in name list; and the declared-but-unlisted
+    # case works too:
+    assert tok._tok.token_to_id("<|im_end|>") in tok.eos_ids
+
+
+def test_hftokenizer_declared_eos_outside_builtin_list(tmp_path):
+    from fasttalk_tpu.engine.tokenizer import HFTokenizer
+    from fasttalk_tpu.engine.chat_template import load_chat_template
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<unk>": 0, "<|custom_stop|>": 1, "x": 2}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    tok.add_special_tokens(["<unk>", "<|custom_stop|>"])
+    tok.save(str(tmp_path / "tokenizer.json"))
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({
+        "chat_template": "{{ messages[0].content }}",
+        "eos_token": "<|custom_stop|>"}))
+    hf = HFTokenizer(str(tmp_path / "tokenizer.json"),
+                     ckpt_template=load_chat_template(str(tmp_path)))
+    assert 1 in hf.eos_ids
+
+
+def test_hftokenizer_without_checkpoint_template_uses_family(tmp_path):
+    from fasttalk_tpu.engine.tokenizer import load_tokenizer
+
+    _write_tiny_tokenizer(tmp_path)
+    tok = load_tokenizer(str(tmp_path), "some-model", template="chatml")
+    ids = tok.apply_chat_template([{"role": "user", "content": "hi"}])
+    assert tok._tok.token_to_id("<|im_start|>") in ids
+
+
+# ---------------- ModelConfig from config.json ----------------
+
+def test_model_config_from_checkpoint_config_json(tmp_path):
+    from fasttalk_tpu.models.configs import get_model_config
+
+    ckpt = tmp_path / "acme_TinyChat"
+    ckpt.mkdir()
+    (ckpt / "model.safetensors").write_bytes(b"")  # find_checkpoint_dir key
+    (ckpt / "config.json").write_text(json.dumps({
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 1000, "hidden_size": 64, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 16,
+        "rope_theta": 10000.0, "rms_norm_eps": 1e-6,
+        "tie_word_embeddings": True, "max_position_embeddings": 2048,
+        "rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                         "original_max_position_embeddings": 1024},
+    }))
+    cfg = get_model_config("acme/TinyChat", str(tmp_path))
+    assert cfg.hidden_size == 64 and cfg.num_kv_heads == 2
+    assert cfg.tie_embeddings and cfg.qkv_bias is False
+    assert cfg.rope_scaling.factor == 8.0
+    assert cfg.chat_template == "llama3"
+
+    (ckpt / "config.json").write_text(json.dumps({
+        "architectures": ["Qwen2ForCausalLM"],
+        "vocab_size": 1000, "hidden_size": 64, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+    }))
+    qcfg = get_model_config("acme/TinyChat", str(tmp_path))
+    assert qcfg.qkv_bias is True and qcfg.chat_template == "chatml"
+    assert qcfg.head_dim == 16  # hidden // heads fallback
+
+    with pytest.raises(KeyError, match="Unknown model"):
+        get_model_config("acme/Absent", str(tmp_path))
+
+    (ckpt / "config.json").write_text(json.dumps({
+        "architectures": ["MambaForCausalLM"], "vocab_size": 10,
+        "hidden_size": 8, "intermediate_size": 16,
+        "num_hidden_layers": 1, "num_attention_heads": 1}))
+    with pytest.raises(KeyError, match="Unsupported architecture"):
+        get_model_config("acme/TinyChat", str(tmp_path))

@@ -506,6 +506,56 @@ class TestPreparedCache:
             np.asarray(params["layers"]["wq"]["q"]),
             np.asarray(restored["layers"]["wq"]["q"]))
 
+    def test_roundtrip_quantized_untied_head(self, tmp_path):
+        """An UNTIED lm_head quantizes to the transposed {"qt", "s"}
+        layout (ops/quant.py _quantize_head_t); the restore target must
+        match it or every restart silently repays the full load (the
+        tied-only roundtrips above never exercise the lm_head leaf)."""
+        import jax as _jax
+        import torch
+        from safetensors.torch import save_file
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        from fasttalk_tpu.models.configs import with_overrides
+        from fasttalk_tpu.models.loader import load_params
+        from fasttalk_tpu.models.prepared_cache import (cache_meta,
+                                                        load_prepared,
+                                                        save_prepared)
+        from fasttalk_tpu.ops.quant import quantizing_put
+
+        untied = with_overrides(TINY, name="test-tiny-untied",
+                                tie_embeddings=False)
+        hf_cfg = LlamaConfig(
+            vocab_size=untied.vocab_size, hidden_size=untied.hidden_size,
+            intermediate_size=untied.intermediate_size,
+            num_hidden_layers=untied.num_layers,
+            num_attention_heads=untied.num_heads,
+            num_key_value_heads=untied.num_kv_heads,
+            head_dim=untied.head_dim, tie_word_embeddings=False)
+        torch.manual_seed(7)
+        model = LlamaForCausalLM(hf_cfg)
+        save_file({k: v.contiguous()
+                   for k, v in model.state_dict().items()},
+                  str(tmp_path / "model.safetensors"))
+
+        inner = lambda arr, path: _jax.device_put(  # noqa: E731
+            jnp.asarray(arr, jnp.bfloat16))
+        raw = lambda arr, path: _jax.device_put(jnp.asarray(arr))  # noqa: E731
+        params = load_params(untied, str(tmp_path),
+                             put=quantizing_put(inner, raw))
+        assert set(params["lm_head"]) == {"qt", "s"}
+        v, d = untied.vocab_size, untied.hidden_size
+        assert params["lm_head"]["qt"].shape == (v, d)
+
+        meta = cache_meta(untied, jnp.bfloat16, True, None)
+        save_prepared(params, str(tmp_path), meta, block=True)
+        restored = load_prepared(untied, str(tmp_path), jnp.bfloat16,
+                                 True, None)
+        assert restored is not None, "untied-head restore target mismatch"
+        np.testing.assert_array_equal(
+            np.asarray(params["lm_head"]["qt"]),
+            np.asarray(restored["lm_head"]["qt"]))
+
     def test_mismatched_meta_ignored(self, tmp_path):
         from fasttalk_tpu.models.loader import load_params
         from fasttalk_tpu.models.prepared_cache import (cache_meta,

@@ -79,6 +79,40 @@ def test_matmul_tied_dispatch_matches_xla():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_untied_head_transposed_layout_stays_on_kernel():
+    """ADVICE r3: the untied lm_head is stored transposed ({"qt": [V, D],
+    "s": [V]}) so its decode matmul rides the contiguous row-block
+    kernel — supports_t accepts the 8B head shape the [D, V] layout's
+    full-V accumulator rejected — and quant.matmul's qt path matches the
+    dequant reference on both dispatch arms."""
+    from fasttalk_tpu.ops.pallas_int8 import supports, supports_t
+    from fasttalk_tpu.ops.quant import matmul, quantize_params
+
+    # The exact 8B/70B untied shape: old layout rejected, new accepted.
+    assert not supports((16, 4096), (4096, 128256))
+    assert supports_t((16, 4096), (128256, 4096))
+
+    params = {"layers": {"wq": jax.random.normal(
+        jax.random.PRNGKey(10), (2, 64, 128), jnp.float32)},
+        "embed": jax.random.normal(jax.random.PRNGKey(11), (512, 256),
+                                   jnp.float32),
+        "lm_head": jax.random.normal(jax.random.PRNGKey(12), (256, 512),
+                                     jnp.float32)}
+    qp = quantize_params(params)
+    assert set(qp["lm_head"]) == {"qt", "s"}
+    assert qp["lm_head"]["qt"].shape == (512, 256)
+
+    x = jax.random.normal(jax.random.PRNGKey(13), (4, 1, 256), jnp.float32)
+    ref = x[:, 0] @ (qp["lm_head"]["qt"].astype(jnp.float32)
+                     * qp["lm_head"]["s"][:, None]).T
+    xla = matmul(x, qp["lm_head"], pallas_ok=False)
+    kern = matmul(x, qp["lm_head"], pallas_ok=True)  # interpret on CPU
+    np.testing.assert_allclose(np.asarray(xla[:, 0]), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(kern[:, 0]), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_quant_matmul_dispatches_to_kernel():
     """quant.matmul uses the kernel for T=1 + pallas_ok and matches the
     XLA dequant path."""

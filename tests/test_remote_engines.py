@@ -17,6 +17,10 @@ async def make_fake_vllm():
     async def chat(request: web.Request) -> web.StreamResponse:
         body = await request.json()
         assert body["stream"] is True
+        # The engine must ask for backend token accounting (chunk !=
+        # token, SURVEY.md §5): vLLM/OpenAI send the usage chunk only
+        # when stream_options.include_usage is set.
+        assert body["stream_options"] == {"include_usage": True}
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream"})
         await resp.prepare(request)
@@ -26,6 +30,10 @@ async def make_fake_vllm():
             await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
         done = {"choices": [{"delta": {}, "finish_reason": "stop"}]}
         await resp.write(f"data: {json.dumps(done)}\n\n".encode())
+        # Real tokenization differs from chunking: 3 chunks, 5 tokens.
+        usage = {"choices": [], "usage": {"prompt_tokens": 11,
+                                          "completion_tokens": 5}}
+        await resp.write(f"data: {json.dumps(usage)}\n\n".encode())
         await resp.write(b"data: [DONE]\n\n")
         return resp
 
@@ -52,8 +60,11 @@ async def make_fake_ollama():
         for word in ["Old", " school", " NDJSON"]:
             line = {"message": {"content": word}, "done": False}
             await resp.write((json.dumps(line) + "\n").encode())
+        # Ollama's terminal object carries its own token accounting.
         await resp.write((json.dumps({"message": {"content": ""},
-                                      "done": True}) + "\n").encode())
+                                      "done": True, "eval_count": 4,
+                                      "prompt_eval_count": 9,
+                                      }) + "\n").encode())
         return resp
 
     async def root(request):
@@ -86,7 +97,127 @@ class TestVLLMRemote:
                            if e["type"] == "token")
             assert text == "Streaming works."
             assert events[-1]["type"] == "done"
-            assert events[-1]["stats"]["tokens_generated"] == 3
+            # tokens from the backend's usage accounting, chunks counted
+            # locally — distinct values, distinct stats (SURVEY.md §5:
+            # chunk-count-as-token-count is on the don't-copy list).
+            stats = events[-1]["stats"]
+            assert stats["tokens_generated"] == 5
+            assert stats["chunks_generated"] == 3
+            assert stats["prompt_tokens"] == 11
+            assert stats["tokens_per_second"] > 0
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_no_usage_reports_chunks_not_tokens(self):
+        """An upstream that never sends usage (ignores stream_options):
+        token stats are None, never a wrong-unit chunk count."""
+        app = web.Application()
+
+        async def chat(request: web.Request) -> web.StreamResponse:
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for word in ["a", "b"]:
+                chunk = {"choices": [{"delta": {"content": word},
+                                      "finish_reason": None}]}
+                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1")
+            eng.start()
+            events = [ev async for ev in eng.generate(
+                "r1", "s1", [{"role": "user", "content": "x"}],
+                GenerationParams())]
+            stats = events[-1]["stats"]
+            assert stats["chunks_generated"] == 2
+            assert stats["tokens_generated"] is None
+            assert stats["tokens_per_second"] is None
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_stream_options_rejected_falls_back(self):
+        """A backend that 400s on stream_options (pre-0.4.3 vLLM, strict
+        proxies) still streams: the engine retries without it and
+        remembers for later requests."""
+        app = web.Application()
+        calls = []
+
+        async def chat(request: web.Request) -> web.StreamResponse:
+            body = await request.json()
+            calls.append("stream_options" in body)
+            if "stream_options" in body:
+                return web.json_response(
+                    {"error": "Unrecognized request argument: "
+                              "stream_options"}, status=400)
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            chunk = {"choices": [{"delta": {"content": "ok"},
+                                  "finish_reason": "stop"}]}
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1")
+            eng.start()
+            msgs = [{"role": "user", "content": "x"}]
+            events = [ev async for ev in eng.generate(
+                "r1", "s1", msgs, GenerationParams())]
+            assert [e["type"] for e in events] == ["token", "done"]
+            assert events[-1]["stats"]["tokens_generated"] is None
+            assert events[-1]["stats"]["chunks_generated"] == 1
+            # Second request skips stream_options outright.
+            [ev async for ev in eng.generate("r2", "s2", msgs,
+                                             GenerationParams())]
+            assert calls == [True, False, False]
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_unrelated_400_not_misattributed(self):
+        """A 400 that does NOT name stream_options (context overflow,
+        bad params) surfaces unretried and does not latch the
+        no-stream-options downgrade."""
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        app = web.Application()
+        calls = []
+
+        async def chat(request: web.Request):
+            calls.append(1)
+            return web.json_response(
+                {"error": "maximum context length exceeded"}, status=400)
+
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1")
+            eng.start()
+            try:
+                async for _ in eng.generate(
+                        "r1", "s1", [{"role": "user", "content": "x"}],
+                        GenerationParams()):
+                    pass
+                raise AssertionError("expected LLMServiceError")
+            except LLMServiceError as e:
+                assert "maximum context" in str(e)
+            assert calls == [1]  # no replay of the failing request
+            assert eng._no_stream_options is False
             eng.shutdown()
         finally:
             await server.close()
@@ -123,6 +254,10 @@ class TestOllamaRemote:
                            if e["type"] == "token")
             assert text == "Old school NDJSON"
             assert events[-1]["type"] == "done"
+            stats = events[-1]["stats"]
+            assert stats["tokens_generated"] == 4  # eval_count, not chunks
+            assert stats["chunks_generated"] == 3
+            assert stats["prompt_tokens"] == 9
             eng.shutdown()
         finally:
             await server.close()
