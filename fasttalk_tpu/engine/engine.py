@@ -178,7 +178,8 @@ class TPUEngine(EngineBase):
                  use_pallas_attention: bool = False,
                  use_pallas_int8: bool = True,
                  steps_per_call: int = 8, pipeline_depth: int = 2,
-                 sampling_method: str = "fast"):
+                 sampling_method: str = "fast",
+                 spec_decode: str = "off", spec_draft_len: int = 7):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -210,6 +211,22 @@ class TPUEngine(EngineBase):
         # The mesh path keeps forward(): its cache is "sp"-sharded and
         # per-layer dynamic slices would break GSPMD's even sharding.
         self._scatter_decode = mesh is None
+        # Self-drafting speculative decoding (engine-owned, no second
+        # model): drafts come from the slot's own token history via
+        # on-device prompt-lookup, a verify block of draft+1 positions
+        # runs through forward_decode_multi, and the longest
+        # sampled-equal prefix is accepted — exactly
+        # distribution-preserving for deterministic drafts (sampling
+        # t~p and accepting while t == draft emits accept-prob p(d) and
+        # the residual distribution on mismatch). Device-side drafting
+        # keeps the call pipeline intact: the host is never in the
+        # draft loop, so spec calls pipeline exactly like plain ones.
+        self.spec_draft = (max(1, spec_draft_len)
+                           if spec_decode == "ngram"
+                           and self._scatter_decode else 0)
+        # EMA of tokens emitted per verify block, used to right-size the
+        # dispatcher's token promises (see _dispatch_decode).
+        self._spec_ema = 1.0
 
         if mesh is not None:
             # Tensor-parallel serving: weights and KV sharded over ICI;
@@ -283,7 +300,9 @@ class TPUEngine(EngineBase):
         self._closed = False
         self._decode_fns: dict[int, Any] = {}
         self._prefill_fns: dict[int, Any] = {}
+        self._spec_fns: dict[tuple, Any] = {}
         self._patch_fn: Any = None
+        self._hist_patch_fn: Any = None
         self._sample_place_fn: Any = None
 
         m = get_metrics()
@@ -304,6 +323,11 @@ class TPUEngine(EngineBase):
         self._m_queue = m.gauge("engine_queue_depth", "requests waiting")
         self._m_prefix = m.counter("engine_prefix_tokens_reused_total",
                                    "prompt tokens served from resident KV")
+        self._m_spec = m.histogram(
+            "engine_spec_tokens_per_verify",
+            "tokens emitted per speculative verify block (accepted "
+            "drafts + 1); 1 means no draft accepted",
+            buckets=tuple(range(1, max(2, self.spec_draft + 2))))
 
     def _make_cache(self) -> KVCache:
         if self.mesh is None:
@@ -334,19 +358,30 @@ class TPUEngine(EngineBase):
         self._topks_dev = self._put(self._topks)
         self._topps_dev = self._put(self._topps)
         self._rng_dev = self._put(jax.random.PRNGKey(self.seed))
+        # Speculative decoding's device-resident token history
+        # [S, max_len]: the draft source. Chained through spec calls
+        # (accepted tokens appended in-program); prompt tokens are
+        # uploaded at admission via _patch_slot_state. int32, ~KBs.
+        self._history_dev = (self._put(
+            np.zeros((num_slots, self.max_len), np.int32))
+            if self.spec_draft else None)
+        # slot index -> prompt token list awaiting history upload.
+        self._dirty_history: dict[int, list[int]] = {}
         # Slots whose host mirrors changed since the last device patch.
         # Changes are SCATTERED onto the chained device arrays instead of
         # draining the pipeline and re-uploading everything — admission
         # and completion never stall in-flight decode calls.
         self._dirty_slots: set[int] = set()
-        # In-flight decode calls: (host-copy Future of the [K, S] token
-        # array, K, the (slot index, request) pairs running at dispatch
-        # time). Tokens are attributed to the dispatch-time request,
-        # never to whoever occupies the slot at retirement — a slot can
-        # be re-admitted to a new request while an older call is still
-        # in flight.
+        # In-flight decode calls: (host-copy Future, min tokens the call
+        # will emit per request, max positions it can advance, the
+        # (slot index, request) pairs running at dispatch time). Plain
+        # calls emit exactly K tokens (min == max == K); speculative
+        # calls emit K..K*(G+1). Tokens are attributed to the
+        # dispatch-time request, never to whoever occupies the slot at
+        # retirement — a slot can be re-admitted to a new request while
+        # an older call is still in flight.
         self._inflight: deque[
-            tuple[Future, int, list[tuple[int, _Request]]]] = deque()
+            tuple[Future, int, int, list[tuple[int, _Request]]]] = deque()
         # First sampled tokens whose device→host copy is still in
         # flight: (host-copy Future, [(row, slot_index, request), ...]).
         # Admission emits the first token only when the fetch lands, so
@@ -471,6 +506,27 @@ class TPUEngine(EngineBase):
                     self._positions_dev, inactive, self._temps_dev,
                     self._topks_dev, self._topps_dev, self._rng_dev)
                 jax.block_until_ready(toks)
+                if self.spec_draft and \
+                        steps * (self.spec_draft + 1) <= self.max_len:
+                    # All-inactive spec warmup: every write masks out.
+                    sfn = self._get_spec_decode_fn(b, steps)
+                    (self.cache, self._history_dev, toks, _, _,
+                     _) = sfn(
+                        self.params, self.cache, self._history_dev,
+                        self._cur_tokens, self._positions_dev, inactive,
+                        self._temps_dev, self._topks_dev,
+                        self._topps_dev, self._rng_dev)
+                    jax.block_until_ready(toks)
+        if self.spec_draft:
+            # The admission-path history upload (slot indices out of
+            # range: every row drops).
+            self._history_dev = self._get_hist_patch_fn()(
+                self._history_dev,
+                self._arg(np.zeros((self.num_slots, self.max_len),
+                                   np.int32)),
+                self._arg(np.full((self.num_slots,), self.num_slots,
+                                  np.int32)))
+            jax.block_until_ready(self._history_dev)
         # The admission-path helper programs (slot-state patch; they are
         # tiny but a first-request compile is still seconds).
         nopatch = np.zeros((self.num_slots, 6), np.float32)
@@ -705,6 +761,103 @@ class TPUEngine(EngineBase):
 
         self._decode_fns[(kv_len, steps)] = decode_call
         return decode_call
+
+    def _get_spec_decode_fn(self, kv_len: int, steps: int):
+        """K speculative steps in one jitted call (single-device scatter
+        path). Each step, entirely on device:
+
+        1. maintain the history invariant ``history[s, pos] = cur``;
+        2. DRAFT via prompt-lookup: find the most recent prior
+           occurrence of the current token in the slot's history and
+           propose the G tokens that followed it;
+        3. VERIFY current + draft (T = G+1 positions) in one
+           ``forward_decode_multi`` block — same weight-streaming cost
+           as ~one plain step at small batch, since decode is
+           weight-bound;
+        4. ACCEPT: sample every position; keep the longest prefix where
+           the sample equals the draft; emit accepted+1 tokens (the
+           first mismatch IS the residual-distribution sample, so the
+           output distribution is exactly the plain-decode one);
+        5. append the emitted tokens to the history, advance positions
+           by n_out.
+
+        Rejected positions' KV is garbage but unreachable: attention
+        masks to each query's absolute position, and the next block's
+        writes start at the accepted length, overwriting it first.
+        Returns per-step (tokens [K, S, T], n_out [K, S]); the host
+        consumes the first n_out tokens per row.
+        """
+        key = (kv_len, steps)
+        fn = self._spec_fns.get(key)
+        if fn is not None:
+            return fn
+        from fasttalk_tpu.models.llama import forward_decode_multi
+
+        G = self.spec_draft
+        T = G + 1
+        S = self.num_slots
+        max_len = self.max_len
+        sv = self.sample_vocab
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def spec_call(params, cache: KVCache, history, cur_tokens,
+                      positions, active, temps, topks, topps, rng):
+            rows = jnp.arange(S)
+
+            def step(carry, _):
+                ck, cv, hist, cur, pos, key = carry
+                # Need T columns of cache headroom inside this bucket;
+                # slots without it sit the step out (the dispatcher
+                # falls back to plain decode before this can starve a
+                # request — see _dispatch_decode).
+                act = jnp.logical_and(active, pos + T <= kv_len)
+                wp = jnp.where(act, pos, max_len)
+                hist = hist.at[rows, wp].set(cur, mode="drop",
+                                             unique_indices=True)
+                idx = jnp.arange(max_len)
+                m = jnp.logical_and(hist == cur[:, None],
+                                    idx[None, :] < pos[:, None])
+                j = jnp.max(jnp.where(m, idx[None, :], -1), axis=1)
+                start = jnp.clip(j + 1, 0, max_len - 1)
+                didx = jnp.clip(start[:, None] + jnp.arange(G)[None, :],
+                                0, max_len - 1)
+                drafts = jnp.take_along_axis(hist, didx, axis=1)  # [S, G]
+                tokens_in = jnp.concatenate([cur[:, None], drafts], 1)
+                logits, newc = forward_decode_multi(
+                    params, self.cfg, tokens_in, pos, KVCache(ck, cv),
+                    act, attn_len=kv_len,
+                    pallas_int8=self.use_pallas_int8)
+                key, sub = jax.random.split(key)
+                flat = logits[..., :sv].reshape(S * T, sv)
+                t_samp = sample_tokens(
+                    flat, sub, jnp.repeat(temps, T),
+                    jnp.repeat(topks, T), jnp.repeat(topps, T),
+                    method=self.sampling_method).reshape(S, T)
+                match = (t_samp[:, :-1] == drafts).astype(jnp.int32)
+                a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # 0..G
+                n_out = jnp.where(act, a + 1, 0)
+                new_cur = jnp.where(
+                    act, jnp.take_along_axis(t_samp, a[:, None], 1)[:, 0],
+                    cur)
+                out_idx = pos[:, None] + 1 + jnp.arange(T)[None, :]
+                keep = jnp.arange(T)[None, :] < n_out[:, None]
+                hist = hist.at[
+                    rows[:, None], jnp.where(keep, out_idx, max_len)].set(
+                    t_samp, mode="drop")
+                pos = pos + n_out
+                # n_out packed as a trailing column: ONE host fetch per
+                # call (a tuple fetch costs two serial link round trips
+                # on relayed attach paths).
+                packed = jnp.concatenate([t_samp, n_out[:, None]], axis=1)
+                return (newc.k, newc.v, hist, new_cur, pos, key), packed
+
+            (ck, cv, hist, cur, pos, rng), toks = jax.lax.scan(
+                step, (cache.k, cache.v, history, cur_tokens, positions,
+                       rng), None, length=steps)
+            return (KVCache(ck, cv), hist, toks, cur, pos, rng)
+
+        self._spec_fns[key] = spec_call
+        return spec_call
 
     def _get_prefill_fn(self, chunk: int):
         fn = self._prefill_fns.get(chunk)
@@ -1173,9 +1326,9 @@ class TPUEngine(EngineBase):
             # past its first token makes this condition false.
             return False
         promised: dict[int, int] = {}
-        for _, steps, snap in self._inflight:
+        for _, min_toks, _, snap in self._inflight:
             for _, req in snap:
-                promised[id(req)] = promised.get(id(req), 0) + steps
+                promised[id(req)] = promised.get(id(req), 0) + min_toks
         # A first token whose fetch hasn't landed is not yet counted in
         # req.generated but will be — ignoring it over-dispatches one
         # whole stale call at exact-budget boundaries.
@@ -1199,6 +1352,8 @@ class TPUEngine(EngineBase):
         self._topks[s] = req.params.top_k
         self._topps[s] = req.params.top_p
         self._dirty_slots.add(s)
+        if self.spec_draft:
+            self._dirty_history[s] = list(slot.tokens)
 
     def _defer_first(self, firsts_dev: Any, entries: list) -> None:
         """Queue first sampled tokens for emission once their
@@ -1226,6 +1381,19 @@ class TPUEngine(EngineBase):
                 self._consume_token(req, int(arr[j]))
                 self._flush_emit(req)
 
+    def _get_hist_patch_fn(self):
+        """Jitted history-row upload for speculative decoding: rows of
+        freshly admitted slots replace their history rows wholesale
+        (out-of-range slot indices in the padded batch drop)."""
+        if self._hist_patch_fn is None:
+            @partial(jax.jit, donate_argnums=(0,))
+            def apply_hist(hist, rows, slots):
+                return hist.at[slots].set(rows, mode="drop",
+                                          unique_indices=True)
+
+            self._hist_patch_fn = apply_hist
+        return self._hist_patch_fn
+
     def _patch_slot_state(self) -> None:
         """Apply dirty host mirrors onto the chained device arrays via
         one jitted program and one packed transfer.
@@ -1238,6 +1406,18 @@ class TPUEngine(EngineBase):
         old flush-the-pipeline-and-reupload on every slot-set change,
         which serialised admission behind up to pipeline_depth decode
         calls."""
+        if self.spec_draft and self._dirty_history:
+            # Prompt tokens of freshly admitted slots -> device history
+            # (one padded [S, max_len] upload + one program; the
+            # sampled tokens appended later are maintained in-program).
+            rows = np.zeros((self.num_slots, self.max_len), np.int32)
+            slots = np.full((self.num_slots,), self.num_slots, np.int32)
+            for i, (s, tokens) in enumerate(self._dirty_history.items()):
+                rows[i, :len(tokens)] = tokens[:self.max_len]
+                slots[i] = s
+            self._dirty_history.clear()
+            self._history_dev = self._get_hist_patch_fn()(
+                self._history_dev, self._arg(rows), self._arg(slots))
         if not self._dirty_slots:
             return
         packed = np.zeros((self.num_slots, 6), np.float32)
@@ -1264,10 +1444,54 @@ class TPUEngine(EngineBase):
                         for req in self._running.values())
                  else self.steps_per_call)
         # Device positions lead the host mirrors by the in-flight calls'
-        # step counts; size the KV bucket for where the device will be
-        # at the END of this call.
-        max_pos = int(self._positions[active].max()) \
-            + sum(k for _, k, _ in self._inflight) + steps
+        # maximum advances; size the KV bucket for where the device can
+        # be at the END of this call.
+        base = int(self._positions[active].max()) \
+            + sum(adv for _, _, adv, _ in self._inflight)
+        T = self.spec_draft + 1
+        if self.spec_draft:
+            # Size the KV bucket by the EMA-EXPECTED advance (+1 block
+            # of headroom), not the K*T worst case: worst-case sizing
+            # jumped to the next bucket immediately — a mid-stream
+            # compile (~0.4 s traced) and doubled attention reads for
+            # advances that almost never happen. Underestimates are
+            # SAFE: the in-call act gate (pos + T <= kv_len) makes a
+            # slot sit out steps that would overflow the bucket, the
+            # under-delivery shows up in the retired n_out, and the
+            # host's position mirrors re-size the next call.
+            exp_adv = int(steps * min(float(T),
+                                      max(1.0, self._spec_ema) + 1.0))
+            # The bucket must leave at least one FULL verify block of
+            # headroom past every slot's worst-case position, or the
+            # in-call act gate masks every step and the call makes no
+            # progress — with mirrors never advancing, the identical
+            # no-op call would be re-dispatched forever (livelock;
+            # reachable when T > exp_adv near a bucket edge).
+            need = base + max(exp_adv, T)
+            if need <= self.max_len:
+                kv_len = next((b for b in _KV_BUCKETS
+                               if b >= need and b <= self.max_len),
+                              self.max_len)
+                fn = self._get_spec_decode_fn(kv_len, steps)
+                (self.cache, self._history_dev, toks,
+                 self._cur_tokens, self._positions_dev,
+                 self._rng_dev) = fn(
+                    self.params, self.cache, self._history_dev,
+                    self._cur_tokens, self._positions_dev,
+                    self._active_dev, self._temps_dev, self._topks_dev,
+                    self._topps_dev, self._rng_dev)
+                # Promise the EMA-expected tokens, not the minimum:
+                # spec calls deliver K..K*T, and promising K made the
+                # dispatcher queue up to T× too many calls — a
+                # stale-call tail holding the in-order device queue for
+                # seconds (traced).
+                promise = steps * min(float(T),
+                                      max(1.0, self._spec_ema))
+                self._inflight.append(
+                    (self._fetch_pool.submit(np.asarray, toks), promise,
+                     exp_adv, snapshot))
+                return
+        max_pos = base + steps
         kv_len = next((b for b in _KV_BUCKETS
                        if b >= max_pos and b <= self.max_len), self.max_len)
         fn = self._get_decode_fn(kv_len, steps)
@@ -1281,11 +1505,12 @@ class TPUEngine(EngineBase):
         # compute, and later calls' fetches overlap it (see the
         # _fetch_pool note in __init__).
         self._inflight.append(
-            (self._fetch_pool.submit(np.asarray, toks), steps, snapshot))
+            (self._fetch_pool.submit(np.asarray, toks), steps, steps,
+             snapshot))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
-        fut, _, snapshot = self._inflight.popleft()
+        fut, _, _, snapshot = self._inflight.popleft()
         if any(req.first_pending for _, req in snapshot):
             # A request in this call still awaits its first token:
             # emit firsts before any of its decode tokens (the firsts
@@ -1293,7 +1518,7 @@ class TPUEngine(EngineBase):
             # the worker pool, so this wait is bounded).
             self._drain_firsts(block=True)
         t0 = time.monotonic()
-        toks = fut.result()  # [K, S] — sync point
+        res = fut.result()  # sync point
         self._m_step.observe((time.monotonic() - t0) * 1000)
         # The block above gave every pending firsts-copy >= one call's
         # wall time to land: emit whatever arrived NOW. Without this, a
@@ -1304,14 +1529,36 @@ class TPUEngine(EngineBase):
         # vs 166 ms when all requests land in one group).
         if self._pending_firsts:
             self._drain_firsts(block=False)
-        for k in range(toks.shape[0]):
-            for s, req in snapshot:
-                if req.finished or self._running.get(s) is not req:
-                    # Request ended earlier in this call, or the slot was
-                    # re-admitted to a newer request: drop the token.
-                    continue
-                self._positions[s] += 1
-                self._consume_token(req, int(toks[k, s]))
+        if res.ndim == 3:
+            # Speculative call [K, S, T+1]: per row, columns :T are the
+            # sampled tokens and column T is n_out; the first n_out
+            # tokens are real (accepted drafts + the residual sample).
+            # Positions advance one per token, same as plain decode.
+            for k in range(res.shape[0]):
+                for s, req in snapshot:
+                    if req.finished or self._running.get(s) is not req:
+                        continue
+                    n = int(res[k, s, -1])
+                    if n:
+                        self._m_spec.observe(n)
+                        self._spec_ema = (0.9 * self._spec_ema
+                                          + 0.1 * n)
+                    for i in range(n):
+                        if req.finished \
+                                or self._running.get(s) is not req:
+                            break
+                        self._positions[s] += 1
+                        self._consume_token(req, int(res[k, s, i]))
+        else:
+            for k in range(res.shape[0]):
+                for s, req in snapshot:
+                    if req.finished or self._running.get(s) is not req:
+                        # Request ended earlier in this call, or the
+                        # slot was re-admitted to a newer request: drop
+                        # the token.
+                        continue
+                    self._positions[s] += 1
+                    self._consume_token(req, int(res[k, s]))
         for _, req in snapshot:
             self._flush_emit(req)
 

@@ -242,5 +242,7 @@ def build_engine(cfg: Config) -> EngineBase:
         use_pallas_int8=cfg.use_pallas_int8,
         steps_per_call=cfg.decode_steps_per_call,
         pipeline_depth=cfg.pipeline_depth,
-        sampling_method=cfg.sampling)
+        sampling_method=cfg.sampling,
+        spec_decode=cfg.spec_decode,
+        spec_draft_len=cfg.spec_draft_len)
     return engine

@@ -126,6 +126,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             pallas_decode: bool = False,
             pallas_int8: bool = False,
             logits_indices: jnp.ndarray | None = None,
+            attn_override: Any = None,
             ) -> tuple[jnp.ndarray, KVCache]:
     """Run the transformer over ``tokens`` [B, T], updating the cache.
 
@@ -143,6 +144,14 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     [B, T, vocab] logits buffer and — for int8 tied embeddings — an XLA
     dequant that would materialise the full bf16 table per chunk; the
     returned logits are [B, 1, vocab].
+
+    ``attn_override`` (optional): ``fn(q, k, v, positions) -> o`` over
+    the freshly computed q/k/v of the whole block, replacing the
+    cache-read attention — the full-self-attention training regime
+    (T == the whole sequence, cache unused). This is how
+    parallel/ring_attention.py plugs in: K/V rotate over the "sp" ICI
+    ring instead of being all-gathered, so per-chip sequence memory is
+    O(T/sp). Cache writes are skipped (the override owns the K/V).
 
     Returns (logits [B, T, vocab], updated cache). (The decode hot path
     is ``forward_decode`` below — scatter cache writes + bounded
@@ -172,15 +181,19 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        ck = _write_kv(ck, k, write_start, write_mask)
-        cv = _write_kv(cv, v, write_start, write_mask)
-        if pallas_decode and t == 1:
-            from fasttalk_tpu.ops.pallas_attention import decode_attend
-
-            o = decode_attend(q[:, 0], ck, cv, positions[:, 0] + 1)[:, None]
+        if attn_override is not None:
+            o = attn_override(q, k, v, positions)
         else:
-            attn_fn = attend_blockwise if blockwise else attend
-            o = attn_fn(q, ck, cv, positions)
+            ck = _write_kv(ck, k, write_start, write_mask)
+            cv = _write_kv(cv, v, write_start, write_mask)
+            if pallas_decode and t == 1:
+                from fasttalk_tpu.ops.pallas_attention import decode_attend
+
+                o = decode_attend(q[:, 0], ck, cv,
+                                  positions[:, 0] + 1)[:, None]
+            else:
+                attn_fn = attend_blockwise if blockwise else attend
+                o = attn_fn(q, ck, cv, positions)
         x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
@@ -204,40 +217,37 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return logits, KVCache(k=new_k, v=new_v)
 
 
-def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
-                   positions: jnp.ndarray, cache: KVCache,
-                   write_mask: jnp.ndarray, *, attn_len: int,
-                   pallas_int8: bool = False,
-                   ) -> tuple[jnp.ndarray, KVCache]:
-    """One decode step [B] -> logits [B, V], cache updated IN PLACE.
+def forward_decode_multi(params: Params, cfg: ModelConfig,
+                         tokens: jnp.ndarray, positions: jnp.ndarray,
+                         cache: KVCache, write_mask: jnp.ndarray, *,
+                         attn_len: int, pallas_int8: bool = False,
+                         ) -> tuple[jnp.ndarray, KVCache]:
+    """Scatter-write decode over a short block: tokens [B, T] ->
+    logits [B, T, V], cache updated IN PLACE.
 
-    The throughput-critical specialisation of ``forward`` for T=1.
-    ``forward``'s layer scan threads the cache as scan xs/ys, and XLA
-    materialises the stacked ys every call — a full read+write of the
-    attention region per step (~1.1 GB/step at a 512 bucket for the 1B
-    model), plus the engine's outer bucket slice/scatter (traced at
-    14.8 ms per 8-step call on v5e-1). Here the WHOLE cache rides the
-    layer scan's carry (carries alias under donation), each layer
-    scatter-writes only the new token's K/V column ([B, Kv, H] — KiB,
-    not the bucket), and attention reads a per-layer dynamic-slice
-    bounded by the static ``attn_len``. Per-step HBM traffic drops to
-    weights + the keys attention actually needs.
+    The whole cache rides the layer scan's carry (carries alias under
+    donation), each layer scatter-writes only the block's K/V columns
+    ([B, T, Kv, H] — KiB, not the bucket), and attention reads a
+    per-layer dynamic-slice bounded by the static ``attn_len``. T=1 is
+    the plain decode step (``forward_decode`` below); T>1 is the
+    speculative-decoding verify block (engine/spec: current token +
+    draft), causal within the block via absolute-position masking.
 
-    positions [B]: current absolute position per slot. write_mask [B]:
-    rows with False neither write the cache nor advance (their scatter
-    is clamped out of range and dropped). attn_len: static attention
-    horizon (engine KV bucket).
+    positions [B]: absolute position of tokens[:, 0] per slot (the
+    block occupies positions..positions+T-1). write_mask [B]: rows with
+    False neither write the cache nor advance (their scatter is clamped
+    out of range and dropped).
     """
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
                                             cfg.rope_scaling))
-    x = embed_lookup(params["embed"], cur[:, None],
-                     params["final_norm"].dtype)  # [B, 1, D]
-    b = cur.shape[0]
+    x = embed_lookup(params["embed"], tokens,
+                     params["final_norm"].dtype)  # [B, T, D]
+    b, t = tokens.shape
     s_total = cache.max_len
-    pos2 = positions[:, None]
+    pos_mat = positions[:, None] + jnp.arange(t)[None, :]  # [B, T]
     rows = jnp.arange(b)
     # Masked rows scatter out of range -> dropped (mode="drop").
-    write_pos = jnp.where(write_mask, positions, s_total)
+    write_cols = jnp.where(write_mask[:, None], pos_mat, s_total)
 
     def layer(carry, lp):
         x, ck_all, cv_all, li = carry
@@ -247,23 +257,23 @@ def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
                    qmm(h, lp["wv"], pok))
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = q.reshape(b, 1, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(b, 1, cfg.num_kv_heads, cfg.head_dim)
-        v = v.reshape(b, 1, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, pos2, inv_freq)
-        k = apply_rope(k, pos2, inv_freq)
-        ck_all = ck_all.at[li, rows, write_pos].set(
-            k[:, 0], mode="drop", unique_indices=True)
-        cv_all = cv_all.at[li, rows, write_pos].set(
-            v[:, 0], mode="drop", unique_indices=True)
+        q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, pos_mat, inv_freq)
+        k = apply_rope(k, pos_mat, inv_freq)
+        ck_all = ck_all.at[li, rows[:, None], write_cols].set(
+            k, mode="drop", unique_indices=True)
+        cv_all = cv_all.at[li, rows[:, None], write_cols].set(
+            v, mode="drop", unique_indices=True)
         ak = jax.lax.dynamic_slice(
             ck_all, (li, 0, 0, 0, 0),
             (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
         av = jax.lax.dynamic_slice(
             cv_all, (li, 0, 0, 0, 0),
             (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
-        o = attend(q, ak, av, pos2)
-        x = x + qmm(o.reshape(b, 1, cfg.q_dim), lp["wo"], pok)
+        o = attend(q, ak, av, pos_mat)
+        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
         up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
@@ -273,12 +283,35 @@ def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
     (x, new_k, new_v, _), _ = jax.lax.scan(
         layer, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    # The T=1 int8 kernels gate themselves on shape inside qmm/
+    # matmul_tied (x.shape[1] == 1), so the verify block transparently
+    # takes the XLA dequant path for its head matmul.
     if cfg.tie_embeddings:
         logits = matmul_tied(x, params["embed"],
                              pallas_int8).astype(jnp.float32)
     else:
         logits = qmm(x, params["lm_head"], pallas_int8).astype(jnp.float32)
-    return logits[:, 0], KVCache(k=new_k, v=new_v)
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
+                   positions: jnp.ndarray, cache: KVCache,
+                   write_mask: jnp.ndarray, *, attn_len: int,
+                   pallas_int8: bool = False,
+                   ) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step [B] -> logits [B, V], cache updated IN PLACE.
+
+    The throughput-critical specialisation of ``forward`` for T=1 — see
+    ``forward_decode_multi`` for the mechanics. (``forward``'s layer
+    scan threads the cache as scan xs/ys, and XLA materialises the
+    stacked ys every call — a full read+write of the attention region
+    per step, ~1.1 GB/step at a 512 bucket for the 1B model; the
+    scatter form traced at 3.96 vs 4.99 ms/step on v5e-1.)
+    """
+    logits, new_cache = forward_decode_multi(
+        params, cfg, cur[:, None], positions, cache, write_mask,
+        attn_len=attn_len, pallas_int8=pallas_int8)
+    return logits[:, 0], new_cache
 
 
 def param_count(params: Params) -> int:

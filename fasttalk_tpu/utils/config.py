@@ -213,6 +213,19 @@ class Config:
     # in-order device queue) as short as possible.
     pipeline_depth: int = field(
         default_factory=lambda: _env_int("TPU_PIPELINE_DEPTH", 2))
+    # Speculative decoding: "off" | "ngram" (self-drafting prompt-lookup
+    # — draft from the slot's own token history on-device, verify
+    # draft+1 positions in one scatter-decode block, accept the longest
+    # sampled-equal prefix; exactly distribution-preserving, see
+    # engine/engine.py _get_spec_decode_fn). Worthwhile on repetitive /
+    # structured generations (code, extraction, long-form with entity
+    # reuse); neutral-to-slightly-negative on incompressible text, so
+    # opt-in. Single-device scatter path only.
+    spec_decode: str = field(
+        default_factory=lambda: _env_str("TPU_SPEC_DECODE", "off"))
+    # Draft tokens proposed per verify block (block = draft + 1).
+    spec_draft_len: int = field(
+        default_factory=lambda: _env_int("TPU_SPEC_DRAFT", 7))
     # Token sampling candidate preselection: "fast" (block-max, the
     # approx_max_k algorithm — greedy rows stay exact, measured 2.4x
     # cheaper than the full-vocab sort which was ~54% of a decode step)
@@ -271,6 +284,11 @@ class Config:
             errs.append("tp_size and dp_size must be >= 1")
         if self.decode_steps_per_call <= 0:
             errs.append("decode_steps_per_call must be >= 1")
+        if self.spec_decode not in ("off", "ngram"):
+            errs.append(
+                f"spec_decode must be off|ngram, got {self.spec_decode!r}")
+        if self.spec_decode != "off" and not 1 <= self.spec_draft_len <= 31:
+            errs.append("spec_draft_len must be in 1..31")
         if self.pipeline_depth <= 0:
             errs.append("pipeline_depth must be >= 1")
         if self.sampling not in ("fast", "exact"):

@@ -1,0 +1,182 @@
+"""Self-drafting speculative decoding (engine TPU_SPEC_DECODE=ngram).
+
+Correctness bar: spec decode must be a pure throughput transform — the
+emitted token stream is identical to plain decode under greedy
+sampling, token accounting (positions, budgets, stop reasons) is
+unchanged, and the engine falls back to plain decode when the cache
+lacks verify-block headroom.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+
+from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+from fasttalk_tpu.models.configs import get_model_config
+from fasttalk_tpu.models.llama import init_params
+from fasttalk_tpu.utils.metrics import get_metrics
+
+TINY = get_model_config("test-tiny")
+GREEDY = dict(temperature=0.0, top_k=0, top_p=1.0)
+
+
+def _generate(engine, prompt: str, max_tokens: int,
+              request_id: str = "r1") -> tuple[str, dict]:
+    async def run():
+        text, final = "", {}
+        async for ev in engine.generate(
+                request_id, f"s-{request_id}",
+                [{"role": "user", "content": prompt}],
+                GenerationParams(max_tokens=max_tokens, **GREEDY)):
+            if ev["type"] == "token":
+                text += ev["text"]
+            else:
+                final = ev
+        return text, final
+
+    return asyncio.run(run())
+
+
+def _engine(params, spec: str, **kw) -> TPUEngine:
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=4,
+                    max_len=512, prefill_chunk=64, seed=0,
+                    spec_decode=spec, spec_draft_len=7, **kw)
+    eng.start()
+    return eng
+
+
+def test_greedy_stream_identical_to_plain_decode():
+    """The acceptance rule is exact: under greedy sampling the spec
+    stream must equal the plain stream token for token."""
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    plain = _engine(params, "off")
+    try:
+        ref_text, ref_final = _generate(plain, "the quick brown fox", 48)
+    finally:
+        plain.shutdown()
+    spec = _engine(params, "ngram")
+    try:
+        got_text, got_final = _generate(spec, "the quick brown fox", 48)
+    finally:
+        spec.shutdown()
+    assert got_text == ref_text
+    assert got_final["stats"]["tokens_generated"] == \
+        ref_final["stats"]["tokens_generated"]
+    assert got_final["finish_reason"] == ref_final["finish_reason"]
+
+
+def test_full_acceptance_on_degenerate_loop():
+    """All-zero weights make greedy decode emit one constant token, so
+    prompt-lookup drafts are always right: every verify block must
+    accept its whole draft (tokens-per-verify == draft+1)."""
+    params = jax.tree.map(jnp.zeros_like,
+                          init_params(TINY, jax.random.PRNGKey(0)))
+    eng = _engine(params, "ngram")
+    try:
+        text, final = _generate(eng, "abc", 64)
+        assert final["stats"]["tokens_generated"] == 64
+        hist = get_metrics().histogram(
+            "engine_spec_tokens_per_verify").summary()
+        # After the loop is established, every block accepts G+1 = 8;
+        # only the very first block (no prior occurrence) emits 1.
+        assert hist["count"] >= 8
+        assert hist["mean"] > 6.0, hist
+    finally:
+        eng.shutdown()
+
+
+def test_spec_respects_max_tokens_and_eos_semantics():
+    """Budget overshoot inside an accepted run is dropped: exactly
+    max_tokens are emitted with finish_reason=length."""
+    params = jax.tree.map(jnp.zeros_like,
+                          init_params(TINY, jax.random.PRNGKey(0)))
+    eng = _engine(params, "ngram")
+    try:
+        _, final = _generate(eng, "abc", 13)  # not a multiple of T
+        assert final["stats"]["tokens_generated"] == 13
+        assert final["finish_reason"] == "length"
+    finally:
+        eng.shutdown()
+
+
+def test_context_end_falls_back_to_plain_decode():
+    """Near the end of the cache there is no room for a verify block;
+    the dispatcher must fall back to plain decode and the request must
+    still finish at the context limit (not hang)."""
+    params = jax.tree.map(jnp.zeros_like,
+                          init_params(TINY, jax.random.PRNGKey(0)))
+    eng = _engine(params, "ngram")
+    try:
+        # max_len 512: generate to the end of context.
+        text, final = _generate(eng, "xy", 2048)
+        assert final["finish_reason"] == "length"
+        used = final["stats"]["prompt_tokens"] + \
+            final["stats"]["tokens_generated"]
+        assert used >= 511, final
+    finally:
+        eng.shutdown()
+
+
+def test_no_livelock_when_block_exceeds_expected_advance():
+    """Regression: with T > steps*ema near a bucket edge (e.g. steps=2,
+    draft=7), EMA-sized buckets could leave less than one verify block
+    of headroom — the act gate then masked every step, mirrors never
+    advanced, and the identical no-op call re-dispatched forever. The
+    bucket must always cover at least one full block, and the request
+    must run to the context end."""
+    params = jax.tree.map(jnp.zeros_like,
+                          init_params(TINY, jax.random.PRNGKey(0)))
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                    max_len=512, prefill_chunk=64, seed=0,
+                    spec_decode="ngram", spec_draft_len=7,
+                    steps_per_call=2)
+    eng.start()
+
+    async def run():
+        final = {}
+        async for ev in eng.generate(
+                "r1", "s1", [{"role": "user", "content": "xy"}],
+                GenerationParams(max_tokens=2048, **GREEDY)):
+            if ev["type"] != "token":
+                final = ev
+        return final
+
+    try:
+        final = asyncio.run(asyncio.wait_for(run(), timeout=180))
+        assert final["finish_reason"] == "length"
+        used = final["stats"]["prompt_tokens"] + \
+            final["stats"]["tokens_generated"]
+        assert used >= 511, final
+    finally:
+        eng.shutdown()
+
+
+def test_multi_session_spec_concurrent():
+    """Several concurrent spec sessions stream to completion with the
+    right per-request budgets (variable per-slot acceptance must never
+    cross-attribute tokens)."""
+    params = init_params(TINY, jax.random.PRNGKey(5))
+    eng = _engine(params, "ngram")
+
+    async def one(i):
+        n = 0
+        async for ev in eng.generate(
+                f"r{i}", f"s{i}", [{"role": "user",
+                                    "content": f"prompt number {i}"}],
+                GenerationParams(max_tokens=16 + i, **GREEDY)):
+            if ev["type"] == "token":
+                pass
+            elif ev["type"] == "done":
+                n = ev["stats"]["tokens_generated"]
+        return n
+
+    async def run():
+        return await asyncio.gather(*(one(i) for i in range(4)))
+
+    try:
+        counts = asyncio.run(run())
+        assert counts == [16, 17, 18, 19]
+    finally:
+        eng.shutdown()
