@@ -372,16 +372,22 @@ class TPUEngine(EngineBase):
         # draining the pipeline and re-uploading everything — admission
         # and completion never stall in-flight decode calls.
         self._dirty_slots: set[int] = set()
-        # In-flight decode calls: (host-copy Future, min tokens the call
-        # will emit per request, max positions it can advance, the
-        # (slot index, request) pairs running at dispatch time). Plain
-        # calls emit exactly K tokens (min == max == K); speculative
-        # calls emit K..K*(G+1). Tokens are attributed to the
+        # In-flight decode calls: (host-copy Future, EXPECTED tokens the
+        # call will emit per request, EXPECTED positions it advances,
+        # the (slot index, request) pairs running at dispatch time).
+        # Plain calls emit exactly K tokens (both fields == K);
+        # speculative calls emit K..K*(G+1) and both fields are
+        # EMA-based estimates — the dispatcher's base/bucket math may
+        # therefore transiently under- or over-estimate device
+        # positions, which is safe: the in-call act gate masks steps
+        # that would overflow the chosen bucket, and retirement re-syncs
+        # the host mirrors (one under-productive call worst case; never
+        # a correctness issue). Tokens are attributed to the
         # dispatch-time request, never to whoever occupies the slot at
         # retirement — a slot can be re-admitted to a new request while
         # an older call is still in flight.
         self._inflight: deque[
-            tuple[Future, int, int, list[tuple[int, _Request]]]] = deque()
+            tuple[Future, float, int, list[tuple[int, _Request]]]] = deque()
         # First sampled tokens whose device→host copy is still in
         # flight: (host-copy Future, [(row, slot_index, request), ...]).
         # Admission emits the first token only when the fetch lands, so
@@ -506,9 +512,13 @@ class TPUEngine(EngineBase):
                     self._positions_dev, inactive, self._temps_dev,
                     self._topks_dev, self._topps_dev, self._rng_dev)
                 jax.block_until_ready(toks)
-                if self.spec_draft and \
-                        steps * (self.spec_draft + 1) <= self.max_len:
+                if self.spec_draft:
                     # All-inactive spec warmup: every write masks out.
+                    # No eligibility gate here — dispatch eligibility
+                    # depends on runtime positions (EMA-sized need),
+                    # so any gate that skips a (bucket, steps) pair
+                    # warmup-time can still see it requested mid-stream
+                    # and pay the compile under traffic.
                     sfn = self._get_spec_decode_fn(b, steps)
                     (self.cache, self._history_dev, toks, _, _,
                      _) = sfn(
@@ -581,6 +591,14 @@ class TPUEngine(EngineBase):
                         self._arg(cfg_row))
                 jax.block_until_ready(first)
         jax.block_until_ready(self.cache.k)
+        # Warm every fetch worker's first device→host copy: on relayed
+        # attach paths a thread's FIRST fetch pays one-time client
+        # setup well beyond the steady RTT, and without this the first
+        # real generation absorbed it as multi-second TTFT.
+        futs = [self._fetch_pool.submit(np.asarray, self._cur_tokens)
+                for _ in range(self._fetch_pool._max_workers)]
+        for f in futs:
+            f.result()
         log.info(f"warmup({level}) compiled "
                  f"{len(self._decode_fns) + len(self._prefill_fns)} "
                  f"executables in {time.monotonic() - t0:.1f}s")
