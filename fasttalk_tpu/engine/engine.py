@@ -179,7 +179,8 @@ class TPUEngine(EngineBase):
                  use_pallas_int8: bool = True,
                  steps_per_call: int = 8, pipeline_depth: int = 2,
                  sampling_method: str = "fast",
-                 spec_decode: str = "off", spec_draft_len: int = 7):
+                 spec_decode: str = "off", spec_draft_len: int = 7,
+                 shared_prefix: bool = True):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -227,6 +228,15 @@ class TPUEngine(EngineBase):
         # EMA of tokens emitted per verify block, used to right-size the
         # dispatcher's token promises (see _dispatch_decode).
         self._spec_ema = 1.0
+        # Cross-session shared-prefix KV: a fresh admission whose prompt
+        # starts with rows already resident in ANOTHER slot (the
+        # common-system-prompt fleet case) copies those rows in HBM
+        # instead of re-prefilling them — a [L, plen, Kv, H] device
+        # copy is ~free next to recomputing the prefix through the
+        # model. Single-device only: on a mesh the slot axis is
+        # "dp"-sharded and a cross-slot dynamic slice would bounce
+        # through collectives.
+        self.shared_prefix = shared_prefix and mesh is None
 
         if mesh is not None:
             # Tensor-parallel serving: weights and KV sharded over ICI;
@@ -323,6 +333,10 @@ class TPUEngine(EngineBase):
         self._m_queue = m.gauge("engine_queue_depth", "requests waiting")
         self._m_prefix = m.counter("engine_prefix_tokens_reused_total",
                                    "prompt tokens served from resident KV")
+        self._m_shared = m.counter(
+            "engine_shared_prefix_tokens_total",
+            "prompt tokens served by cross-slot KV copy instead of "
+            "prefill")
         self._m_spec = m.histogram(
             "engine_spec_tokens_per_verify",
             "tokens emitted per speculative verify block (accepted "
@@ -877,6 +891,31 @@ class TPUEngine(EngineBase):
         self._spec_fns[key] = spec_call
         return spec_call
 
+    def _get_prefix_copy_fn(self, plen: int):
+        """Copy one slot's leading ``plen`` KV rows onto another slot —
+        the shared-prefix stamp. Pure HBM traffic (2·L·plen·Kv·H
+        elements), ordered against prefills and decode calls by the
+        donated-cache chain like every other cache op."""
+        key = ("pcopy", plen)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        shape = (self.cfg.num_layers, 1, plen, self.cfg.num_kv_heads,
+                 self.cfg.head_dim)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def prefix_copy(cache: KVCache, src, dst):
+            rk = jax.lax.dynamic_slice(cache.k, (0, src, 0, 0, 0), shape)
+            rv = jax.lax.dynamic_slice(cache.v, (0, src, 0, 0, 0), shape)
+            return KVCache(
+                jax.lax.dynamic_update_slice(cache.k, rk,
+                                             (0, dst, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(cache.v, rv,
+                                             (0, dst, 0, 0, 0)))
+
+        self._prefill_fns[key] = prefix_copy
+        return prefix_copy
+
     def _get_prefill_fn(self, chunk: int):
         fn = self._prefill_fns.get(chunk)
         if fn is not None:
@@ -1168,6 +1207,24 @@ class TPUEngine(EngineBase):
             reused = self.slots.reuse_prefix(slot, prompt)
             if reused:
                 self._m_prefix.inc(reused)
+            elif self.shared_prefix:
+                # Fresh slot: stamp the longest prefix resident in any
+                # OTHER slot (common system prompt across sessions)
+                # instead of re-prefilling it. Rounded down to a
+                # 16-token granule so the copy executable set stays
+                # tiny (one length per deployment in practice). The
+                # source's rows [0:share) are stable: its own writes
+                # only ever target positions >= its kept length.
+                src, share = self.slots.best_shared_prefix(slot, prompt)
+                share = (share // 16) * 16
+                if src is not None and share >= 16:
+                    self.cache = self._get_prefix_copy_fn(share)(
+                        self.cache, np.int32(src.index),
+                        np.int32(slot.index))
+                    slot.tokens = list(prompt[:share])
+                    slot.kv_written = share
+                    reused = share
+                    self._m_shared.inc(share)
             todo = prompt[reused:]
             if reused + len(todo) > self.usable_len:
                 self._finish(req, "error",

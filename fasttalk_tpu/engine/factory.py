@@ -244,5 +244,6 @@ def build_engine(cfg: Config) -> EngineBase:
         pipeline_depth=cfg.pipeline_depth,
         sampling_method=cfg.sampling,
         spec_decode=cfg.spec_decode,
-        spec_draft_len=cfg.spec_draft_len)
+        spec_draft_len=cfg.spec_draft_len,
+        shared_prefix=cfg.shared_prefix)
     return engine

@@ -19,6 +19,25 @@ import time
 from dataclasses import dataclass, field
 
 
+def _lcp(a: list[int], b: list[int], limit: int) -> int:
+    """Length of the longest common prefix of a[:limit] and b[:limit].
+    Slice-equality blocks keep the comparison at C speed — a per-token
+    Python loop over multi-thousand-token resident histories runs on
+    the engine thread inside admission and costs TTFT."""
+    n = 0
+    step = 256
+    while n < limit:
+        m = min(step, limit - n)
+        if a[n:n + m] == b[n:n + m]:
+            n += m
+            continue
+        for i in range(n, n + m):
+            if a[i] != b[i]:
+                return i
+        return n + m
+    return n
+
+
 @dataclass
 class Slot:
     index: int
@@ -102,14 +121,40 @@ class SlotManager:
         """
         cached = slot.tokens
         limit = min(len(cached), len(prompt_tokens) - 1, slot.kv_written)
-        n = 0
-        while n < limit and cached[n] == prompt_tokens[n]:
-            n += 1
+        n = _lcp(cached, prompt_tokens, limit)
         if n < len(cached):
             # Divergence: the cache beyond n is for a different history.
-            # Positions beyond n will be overwritten by the new prefill.
+            # Positions beyond n will be overwritten by the new prefill —
+            # and until then nothing may trust them, so the watermark
+            # drops too (best_shared_prefix reads other slots' tokens up
+            # to kv_written; a stale watermark past len(tokens) crashed
+            # the engine thread).
             slot.tokens = cached[:n]
+            slot.kv_written = min(slot.kv_written, n)
         return n
+
+    def best_shared_prefix(self, slot: Slot, prompt_tokens: list[int],
+                           min_len: int = 16) -> tuple[Slot | None, int]:
+        """Longest common prefix between this prompt and any OTHER
+        slot's written KV — the cross-session case (a fleet of sessions
+        sharing one system prompt re-prefilled it once per slot; the
+        engine can copy the resident rows instead, engine.py
+        shared-prefix path). Capped at the source's kv_written
+        watermark and len(prompt) - 1; returns (None, 0) below
+        ``min_len`` (a copy dispatch isn't worth a handful of rows)."""
+        best, best_n = None, min_len - 1
+        cap = len(prompt_tokens) - 1
+        for other in self.slots:
+            if other is slot or other.kv_written == 0:
+                continue
+            ot = other.tokens
+            limit = min(other.kv_written, len(ot), cap)
+            n = _lcp(ot, prompt_tokens, limit)
+            if n > best_n:
+                best, best_n = other, n
+                if best_n >= cap:
+                    break  # nothing longer is possible
+        return best, (best_n if best is not None else 0)
 
     def active_slots(self) -> list[Slot]:
         return [s for s in self.slots if s.active]

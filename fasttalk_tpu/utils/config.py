@@ -213,6 +213,12 @@ class Config:
     # in-order device queue) as short as possible.
     pipeline_depth: int = field(
         default_factory=lambda: _env_int("TPU_PIPELINE_DEPTH", 2))
+    # Cross-session shared-prefix KV: a fresh session whose prompt
+    # starts with rows resident in another slot (common system prompt)
+    # gets them by device copy instead of re-prefill — cuts TTFT and
+    # prefill load at high concurrency (single-device path).
+    shared_prefix: bool = field(
+        default_factory=lambda: _env_bool("TPU_SHARED_PREFIX", True))
     # Speculative decoding: "off" | "ngram" (self-drafting prompt-lookup
     # — draft from the slot's own token history on-device, verify
     # draft+1 positions in one scatter-decode block, accept the longest
