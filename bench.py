@@ -141,25 +141,39 @@ async def bench_ws(cfg) -> dict:
                                    for i in range(NUM_SESSIONS)))
             log(f"protocol warmup done in {time.monotonic() - t2:.1f}s")
 
-            log("single-session run...")
-            single = await ws_session(http, 0, MAX_TOKENS)
-            single_tps = single["tokens"] / single["wall_s"]
-            log(f"  1 session: {single['tokens']} tok in "
-                f"{single['wall_s']:.2f}s = {single_tps:.1f} tok/s, "
-                f"TTFT {single['ttft_ms']:.0f}ms")
+            # Median of 3 measurement passes per phase: the relayed
+            # chip attach's round-trip latency varies run to run
+            # (observed 40→250 ms across sessions, docs/PROFILE_TTFT.md)
+            # and a single pass measures relay weather as much as the
+            # engine. Medians are still one warmup + real passes —
+            # nothing is cherry-picked.
+            singles = []
+            for rep in range(3):
+                s = await ws_session(http, 100 + rep, MAX_TOKENS)
+                singles.append((s["tokens"] / s["wall_s"], s["ttft_ms"]))
+                log(f"  1 session (pass {rep + 1}): "
+                    f"{singles[-1][0]:.1f} tok/s, "
+                    f"TTFT {singles[-1][1]:.0f}ms")
+            single_tps = statistics.median(t for t, _ in singles)
+            single_ttft = statistics.median(t for _, t in singles)
 
-            log(f"{NUM_SESSIONS} concurrent sessions...")
-            t3 = time.monotonic()
-            results = await asyncio.gather(
-                *(ws_session(http, i, MAX_TOKENS)
-                  for i in range(NUM_SESSIONS)))
-            wall = time.monotonic() - t3
-            total_tokens = sum(r["tokens"] for r in results)
-            agg_tps = total_tokens / wall
-            p50_ttft = statistics.median(r["ttft_ms"] for r in results)
-            log(f"  {NUM_SESSIONS} sessions: {total_tokens} tok in "
-                f"{wall:.2f}s = {agg_tps:.1f} tok/s aggregate, "
-                f"p50 TTFT {p50_ttft:.0f}ms")
+            aggs = []
+            for rep in range(3):
+                await asyncio.sleep(1)  # drain stale pipeline tails
+                t3 = time.monotonic()
+                results = await asyncio.gather(
+                    *(ws_session(http, 1000 * rep + i, MAX_TOKENS)
+                      for i in range(NUM_SESSIONS)))
+                wall = time.monotonic() - t3
+                total_tokens = sum(r["tokens"] for r in results)
+                aggs.append((total_tokens / wall, statistics.median(
+                    r["ttft_ms"] for r in results)))
+                log(f"  {NUM_SESSIONS} sessions (pass {rep + 1}): "
+                    f"{total_tokens} tok in {wall:.2f}s = "
+                    f"{aggs[-1][0]:.1f} tok/s aggregate, "
+                    f"p50 TTFT {aggs[-1][1]:.0f}ms")
+            agg_tps = statistics.median(a for a, _ in aggs)
+            p50_ttft = statistics.median(t for _, t in aggs)
             if os.environ.get("BENCH_DUMP_METRICS"):
                 from fasttalk_tpu.utils.metrics import get_metrics
 
@@ -171,7 +185,7 @@ async def bench_ws(cfg) -> dict:
         await runner.cleanup()
         engine.shutdown()
 
-    return {"single_tps": single_tps, "single_ttft_ms": single["ttft_ms"],
+    return {"single_tps": single_tps, "single_ttft_ms": single_ttft,
             "agg_tps": agg_tps, "p50_ttft_ms": p50_ttft}
 
 

@@ -1179,6 +1179,19 @@ class TPUEngine(EngineBase):
         serialised engine-side prefills the same way it serialised
         everything: one HTTP request at a time).
         """
+        # The batched path normally caps prompts at prefill_chunk so a
+        # long prefill cannot stall running sessions (chunked path
+        # interleaves instead). From IDLE there is nobody to stall, and
+        # the chunked path would serialize a cold burst of long prompts
+        # at one link round trip per chunk (measured: 16 × ~600-token
+        # personas took 5 s p50 TTFT through it) — so allow one batched
+        # call up to the 1024 bucket, which also lets intra-batch
+        # prefix sharing engage on exactly the long-persona bursts
+        # where it pays.
+        idle = not self._running and not self._inflight \
+            and not self._prefilling
+        allowed = max(self.prefill_chunk, 1024) if idle \
+            else self.prefill_chunk
         batch: list[tuple[_Request, Slot, int, list[int]]] = []
         i = 0
         while i < len(self._waiting):
@@ -1233,7 +1246,7 @@ class TPUEngine(EngineBase):
                 continue
             bucket = next((b for b in _PREFILL_BUCKETS if b >= len(todo)),
                           None)
-            if bucket is not None and len(todo) <= self.prefill_chunk \
+            if bucket is not None and len(todo) <= allowed \
                     and reused + bucket <= self.max_len:
                 batch.append((req, slot, reused, todo))
             else:
@@ -1241,7 +1254,10 @@ class TPUEngine(EngineBase):
                     _PrefillState(req=req, slot=slot, start=reused,
                                   todo=todo))
         if batch:
-            self._prefill_batched(batch)
+            if self.shared_prefix and len(batch) >= 2:
+                self._prefill_batched_shared(batch)
+            else:
+                self._prefill_batched(batch)
 
     def _advance_prefill(self) -> None:
         """Run ONE chunk of the oldest in-progress long prefill."""
@@ -1308,6 +1324,82 @@ class TPUEngine(EngineBase):
             if self._prefilling and self._prefilling[0] is st:
                 self._prefilling.pop(0)
             self._finish(req, "error", error=str(e))
+
+    # Intra-batch sharing engages only when the common prefix is at
+    # least this long: below it, the extra prefill wave + copy
+    # dispatches cost more than the recompute they save (a share has to
+    # move the delta into a SMALLER prefill bucket to win).
+    _INTRA_SHARE_MIN = 64
+
+    def _prefill_batched_shared(
+            self, batch: list[tuple[_Request, Slot, int, list[int]]]) -> None:
+        """Intra-batch shared prefix: when several FRESH admissions of
+        one burst share a long leading prefix (a fleet of sessions with
+        one system prompt arriving together), prefill the longest-
+        prompt leader in a first wave, stamp the shared rows onto the
+        other slots by device copy, and batch-prefill only their
+        deltas — burst prefill compute drops from N×full toward
+        1×full + N×delta."""
+        from fasttalk_tpu.engine.slots import _lcp
+
+        fresh = [item for item in batch if item[2] == 0]
+        members: list[tuple[tuple, int]] = []
+        if len(fresh) >= 2:
+            leader = max(fresh, key=lambda it: len(it[0].prompt_tokens))
+            lp = leader[0].prompt_tokens
+            for item in fresh:
+                if item is leader:
+                    continue
+                pt = item[0].prompt_tokens
+                share = _lcp(lp, pt, min(len(lp), len(pt) - 1))
+                share = (share // 16) * 16
+                if share < self._INTRA_SHARE_MIN:
+                    continue
+                # Sharing must actually shrink the member's prefill
+                # bucket (else two serialized waves + copies are
+                # strictly slower than the one batched wave), and the
+                # delta bucket must still fit the cache at its new
+                # start (the admission guard checked start=0; a clamped
+                # out-of-range write start would silently corrupt KV).
+                full_b = next(b for b in _PREFILL_BUCKETS
+                              if b >= len(pt))
+                delta_b = next(b for b in _PREFILL_BUCKETS
+                               if b >= max(1, len(pt) - share))
+                if delta_b < full_b and share + delta_b <= self.max_len:
+                    members.append((item, share))
+        if not members:
+            self._prefill_batched(batch)
+            return
+        member_ids = {id(it) for it, _ in members}
+        self._prefill_batched([it for it in batch
+                               if id(it) not in member_ids])
+        lreq, lslot = leader[0], leader[1]
+        second: list[tuple[_Request, Slot, int, list[int]]] = []
+        for (req, slot, _reused, _todo), share in members:
+            if req.finished:
+                continue
+            # Re-clamp against what the leader actually wrote (its
+            # prefill may have errored and finished the request) — and
+            # re-check the delta-bucket fit, since a SMALLER share
+            # means a LARGER delta whose bucket may no longer fit at
+            # the new start.
+            share = min(share, lslot.kv_written) // 16 * 16
+            delta_b = next(
+                (b for b in _PREFILL_BUCKETS
+                 if b >= max(1, len(req.prompt_tokens) - share)), None)
+            if lreq.finished or share < self._INTRA_SHARE_MIN \
+                    or delta_b is None \
+                    or share + delta_b > self.max_len:
+                second.append((req, slot, 0, req.prompt_tokens))
+                continue
+            self.cache = self._get_prefix_copy_fn(share)(
+                self.cache, np.int32(lslot.index), np.int32(slot.index))
+            slot.tokens = list(req.prompt_tokens[:share])
+            slot.kv_written = share
+            self._m_shared.inc(share)
+            second.append((req, slot, share, req.prompt_tokens[share:]))
+        if second:
+            self._prefill_batched(second)
 
     def _prefill_batched(
             self, batch: list[tuple[_Request, Slot, int, list[int]]]) -> None:

@@ -108,6 +108,103 @@ def test_shared_prefix_while_source_decoding():
     assert got == ref
 
 
+def test_intra_batch_burst_shares_leader_prefix():
+    """A cold-start burst of sessions with one long system prompt:
+    the leader prefills fully, the rest get the prefix stamped by
+    device copy — greedy streams identical to a no-sharing engine."""
+    params = init_params(TINY, jax.random.PRNGKey(6))
+    long_system = SYSTEM * 3  # ~370 byte-tokens: well past the 64 gate
+
+    async def burst(eng):
+        texts = {}
+
+        async def one(i):
+            out = ""
+            async for ev in eng.generate(
+                    f"r{i}", f"s{i}",
+                    [{"role": "system", "content": long_system},
+                     {"role": "user", "content": f"question {i}"}],
+                    GenerationParams(max_tokens=16, **GREEDY)):
+                if ev["type"] == "token":
+                    out += ev["text"]
+                elif ev["type"] == "error":
+                    raise AssertionError(ev)
+            texts[i] = out
+        await asyncio.gather(*(one(i) for i in range(4)))
+        return texts
+
+    cold = TPUEngine(TINY, params, ByteTokenizer(), num_slots=4,
+                     max_len=1024, prefill_chunk=512, seed=0,
+                     shared_prefix=False)
+    cold.start()
+    try:
+        ref = asyncio.run(burst(cold))
+    finally:
+        cold.shutdown()
+
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=4,
+                    max_len=1024, prefill_chunk=512, seed=0,
+                    shared_prefix=True)
+    eng.start()
+    before = get_metrics().counter(
+        "engine_shared_prefix_tokens_total").value
+    try:
+        got = asyncio.run(burst(eng))
+        shared = get_metrics().counter(
+            "engine_shared_prefix_tokens_total").value - before
+    finally:
+        eng.shutdown()
+    assert got == ref
+    # Delta, not the cumulative global counter: earlier tests in this
+    # module also increment it, which would mask a regression here.
+    assert shared >= 3 * 64  # three members stamped a long prefix
+
+
+def test_share_skipped_when_it_cannot_shrink_the_bucket():
+    """Regression (review): two ~1000-token prompts sharing only a
+    short prefix in a max_len=1024 engine — stamping would put a
+    1024-bucket delta at a non-zero start (silent KV corruption via the
+    clamped write) and save nothing (same bucket). The gate must skip
+    sharing and both streams must match a cold engine."""
+    params = init_params(TINY, jax.random.PRNGKey(7))
+    common = "C" * 100
+    prompts = [common + ch * 860 for ch in "ab"]
+
+    async def burst(eng):
+        outs = {}
+
+        async def one(i):
+            txt = ""
+            async for ev in eng.generate(
+                    f"r{i}", f"s{i}",
+                    [{"role": "user", "content": prompts[i]}],
+                    GenerationParams(max_tokens=8, **GREEDY)):
+                if ev["type"] == "token":
+                    txt += ev["text"]
+                elif ev["type"] == "error":
+                    raise AssertionError(ev)
+            outs[i] = txt
+        await asyncio.gather(one(0), one(1))
+        return outs
+
+    results = {}
+    for shared in (False, True):
+        eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                        max_len=1024, prefill_chunk=512, seed=0,
+                        shared_prefix=shared)
+        eng.start()
+        before = get_metrics().counter(
+            "engine_shared_prefix_tokens_total").value
+        try:
+            results[shared] = asyncio.run(burst(eng))
+            results[f"count{shared}"] = get_metrics().counter(
+                "engine_shared_prefix_tokens_total").value - before
+        finally:
+            eng.shutdown()
+    assert results[True] == results[False]
+    assert results["countTrue"] == 0  # gate declined the useless share
+
+
 def test_best_shared_prefix_safe_after_divergence_truncation():
     """Regression: reuse_prefix truncates a slot's tokens on divergence;
     if kv_written stayed above len(tokens), best_shared_prefix's scan
