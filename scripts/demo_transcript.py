@@ -36,18 +36,10 @@ TURNS = [
 
 async def record(cfg) -> list[dict]:
     import aiohttp
-    from aiohttp import web
 
-    from fasttalk_tpu.engine.factory import build_engine
-    from fasttalk_tpu.serving.server import WebSocketLLMServer
+    from fasttalk_tpu.serving.local import start_local_server
 
-    engine = build_engine(cfg)
-    engine.warmup(cfg.warmup or "fast")
-    engine.start()
-    server = WebSocketLLMServer(cfg, engine, None)
-    runner = web.AppRunner(server.app)
-    await runner.setup()
-    await web.TCPSite(runner, "127.0.0.1", PORT).start()
+    engine, runner = await start_local_server(cfg, with_agent=False)
     frames: list[dict] = []
 
     def note(direction: str, payload: dict) -> None:

@@ -1,0 +1,197 @@
+"""Churn soak: hammer the real WS server with adversarial session
+behavior and verify nothing wedges, leaks, or crashes.
+
+Mix per client, repeatedly: connect → start_session (sometimes with a
+shared persona, sometimes unique) → user_message (short or long) → then
+one of: consume fully / cancel mid-stream / abort the TCP transport
+mid-stream / update_config mid-session / end_session cleanly. At the
+end: zero client-observed errors, zero ERROR/CRITICAL log records,
+/health healthy, engine queues drained (with a settle window for
+in-flight cleanup), and a clean request still serves end to end.
+
+Usage: python scripts/soak.py [seconds] (default 120)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PORT = int(os.environ.get("BENCH_PORT", "18663"))
+DURATION = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+CLIENTS = 12
+PERSONA = ("You are a terse ops assistant. Answer in one sentence. " * 30)
+
+STATS = {"completed": 0, "cancelled": 0, "aborted": 0, "closed": 0,
+         "errors": 0, "config_updates": 0, "tokens": 0}
+
+
+class _ErrorCounter(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.ERROR)
+        self.records: list[str] = []
+
+    def emit(self, record):
+        self.records.append(record.getMessage())
+
+
+def _abort_transport(ws) -> None:
+    """Kill the TCP transport without a close handshake — a genuinely
+    abrupt disconnect (raising out of `async with ws_connect` performs
+    a GRACEFUL close in __aexit__, which is a different server path).
+    Reaches into aiohttp internals; falls back to a plain close."""
+    try:
+        ws._response.connection.transport.abort()
+    except Exception:
+        pass
+
+
+async def client_loop(http, cid: int, deadline: float) -> None:
+    rng = random.Random(cid)
+    while time.monotonic() < deadline:
+        try:
+            async with http.ws_connect(
+                    f"ws://127.0.0.1:{PORT}/ws/llm",
+                    heartbeat=30) as ws:
+                msg = json.loads((await ws.receive()).data)
+                assert msg["type"] == "session_started", msg
+                cfg = {"max_tokens": rng.choice([4, 16, 48, 96]),
+                       "temperature": rng.choice([0.0, 0.7, 1.2])}
+                if rng.random() < 0.5:
+                    cfg["system_prompt"] = PERSONA
+                await ws.send_json({"type": "start_session",
+                                    "config": cfg})
+                await ws.receive()  # session_configured
+                for _turn in range(rng.randint(1, 3)):
+                    if time.monotonic() >= deadline:
+                        break
+                    text = ("tell me everything about everything " *
+                            rng.choice([1, 1, 1, 40]))
+                    await ws.send_json({"type": "user_message",
+                                        "text": f"[{cid}] {text}"})
+                    fate = rng.random()
+                    tokens = 0
+                    while True:
+                        frame = await asyncio.wait_for(ws.receive(),
+                                                       timeout=120)
+                        if frame.type.name in ("CLOSE", "CLOSING",
+                                               "CLOSED", "ERROR"):
+                            STATS["closed"] += 1
+                            raise ConnectionResetError
+                        m = json.loads(frame.data)
+                        if m["type"] == "token":
+                            tokens += 1
+                            STATS["tokens"] += 1
+                            if fate < 0.2 and tokens >= 2:
+                                await ws.send_json({"type": "cancel"})
+                                fate = 1.0  # only cancel once
+                            elif fate < 0.3 and tokens >= 2:
+                                STATS["aborted"] += 1
+                                _abort_transport(ws)
+                                raise ConnectionResetError
+                        elif m["type"] == "response_complete":
+                            if m["stats"].get("finish_reason") == \
+                                    "cancelled":
+                                STATS["cancelled"] += 1
+                            else:
+                                STATS["completed"] += 1
+                            break
+                        elif m["type"] == "cancelled":
+                            pass  # ack frame; completion still follows
+                        elif m["type"] == "error":
+                            STATS["errors"] += 1
+                            break
+                    if rng.random() < 0.2:
+                        await ws.send_json({
+                            "type": "update_config",
+                            "config": {"temperature": 0.5}})
+                        await ws.receive()  # config_updated
+                        STATS["config_updates"] += 1
+                if rng.random() < 0.7:
+                    await ws.send_json({"type": "end_session"})
+                    await asyncio.wait_for(ws.receive(), timeout=30)
+        except (ConnectionResetError, asyncio.TimeoutError):
+            continue
+        except Exception as e:  # noqa: BLE001 — tally, keep soaking
+            STATS["errors"] += 1
+            print(f"client {cid}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
+async def main() -> None:
+    import aiohttp
+
+    from fasttalk_tpu.serving.local import start_local_server
+    from fasttalk_tpu.utils.config import Config
+
+    errors = _ErrorCounter()
+    logging.getLogger().addHandler(errors)
+
+    cfg = Config(llm_provider="tpu",
+                 model_name=os.environ.get("LLM_MODEL", "llama3.2:1b"),
+                 decode_slots=16, max_model_len=2048,
+                 default_context_window=2048, port=PORT,
+                 monitoring_port=PORT + 1,
+                 quantize=os.environ.get("TPU_QUANTIZE", "int8"))
+    engine, runner = await start_local_server(cfg, warmup="fast")
+    print(f"soaking {DURATION:.0f}s with {CLIENTS} churning clients...",
+          file=sys.stderr)
+    deadline = time.monotonic() + DURATION
+    try:
+        async with aiohttp.ClientSession() as http:
+            await asyncio.gather(*(client_loop(http, i, deadline)
+                                   for i in range(CLIENTS)))
+            # Post-churn invariants. Cleanup of vanished clients is
+            # asynchronous (server finally blocks + engine command
+            # queue), so give the queues a settle window.
+            for _ in range(40):
+                async with http.get(
+                        f"http://127.0.0.1:{PORT}/stats") as r:
+                    stats = await r.json()
+                if stats["engine"].get("waiting", 0) == 0 and \
+                        stats["engine"].get("running", 0) == 0:
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                raise AssertionError(
+                    f"engine queues never drained: {stats['engine']}")
+            async with http.get(
+                    f"http://127.0.0.1:{PORT}/health") as r:
+                health = await r.json()
+            assert health["status"] == "healthy", health
+            # A clean request still serves end to end.
+            async with http.ws_connect(
+                    f"ws://127.0.0.1:{PORT}/ws/llm") as ws:
+                await ws.receive()
+                await ws.send_json({"type": "start_session",
+                                    "config": {"max_tokens": 8}})
+                await ws.receive()
+                await ws.send_json({"type": "user_message",
+                                    "text": "final sanity"})
+                got_tokens = 0
+                while True:
+                    m = json.loads((await asyncio.wait_for(
+                        ws.receive(), timeout=60)).data)
+                    if m["type"] == "token":
+                        got_tokens += 1
+                    elif m["type"] == "response_complete":
+                        break
+                assert got_tokens > 0
+    finally:
+        await runner.cleanup()
+        engine.shutdown()
+    assert STATS["completed"] > 0, STATS
+    assert STATS["errors"] == 0, STATS
+    assert not errors.records, errors.records[:5]
+    print(f"SOAK OK: {json.dumps(STATS)}")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
