@@ -9,6 +9,12 @@ end: zero client-observed errors, zero ERROR/CRITICAL log records,
 /health healthy, engine queues drained (with a settle window for
 in-flight cleanup), and a clean request still serves end to end.
 
+Assumes device-class generation speed (the client mix is sized for a
+real chip): on the ~0.5 tok/s virtual CPU mesh the offered load
+saturates every slot, the circuit breaker opens — correctly — and the
+no-backoff clients tally its rejections as errors. Use
+tests/test_parallel.py + the mesh concurrency checks for that path.
+
 Usage: python scripts/soak.py [seconds] (default 120)
 """
 
