@@ -39,6 +39,14 @@ Architecture (JetStream-style, XLA-first):
   completed, SURVEY.md §3.6).
 - **KV residency across turns.** Sessions pin slots (engine/slots.py);
   a follow-up turn prefills only the token delta after prefix matching.
+- **Shared-prefix KV.** A fresh session whose prompt starts with rows
+  resident in ANOTHER slot (common system prompt) gets them by device
+  copy — cross-session at admission, and intra-batch for cold bursts
+  (leader prefills, members stamp; see _prefill_batched_shared).
+- **Speculative decoding** (opt-in): on-device prompt-lookup drafts
+  verified as multi-token scatter-decode blocks, exactly
+  distribution-preserving (see _get_spec_decode_fn and
+  docs/SPEC_DECODE.md).
 """
 
 from __future__ import annotations
