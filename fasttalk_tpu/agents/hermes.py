@@ -36,14 +36,28 @@ class HermesStreamParser:
 
     def feed(self, delta: str) -> tuple[str, list[ToolCall]]:
         """Consume a text delta; return (emittable_text, completed_calls)."""
+        pre, calls, post = self.feed_split(delta)
+        return pre + post, calls
+
+    def feed_split(self, delta: str,
+                   ) -> tuple[str, list[ToolCall], str]:
+        """Consume a text delta; return ``(pre, completed_calls, post)``
+        where ``pre`` is the text that streamed BEFORE the first call
+        completed in this feed and ``post`` the text after it. When no
+        call completes, everything is ``pre``. Callers that suppress
+        text once a call exists (the agent loop) need the split —
+        chunk boundaries are arbitrary, so prose preceding a call can
+        arrive in the very chunk that completes it (ADVICE r4)."""
         self._buf += delta
-        out: list[str] = []
+        pre: list[str] = []
+        post: list[str] = []
         calls: list[ToolCall] = []
         while True:
+            out = post if calls else pre
             if self._in_call:
                 end = self._buf.find(CLOSE_TAG)
                 if end < 0:
-                    return "".join(out), calls  # wait for more
+                    return "".join(pre), calls, "".join(post)
                 raw = self._buf[:end]
                 self._buf = self._buf[end + len(CLOSE_TAG):]
                 self._in_call = False
@@ -64,7 +78,7 @@ class HermesStreamParser:
                 cut = len(self._buf) - hold
                 out.append(self._buf[:cut])
                 self._buf = self._buf[cut:]
-                return "".join(out), calls
+                return "".join(pre), calls, "".join(post)
 
     def flush(self) -> str:
         """End of stream: release held-back text (an unterminated tool

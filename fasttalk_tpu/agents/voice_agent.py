@@ -104,7 +104,6 @@ class VoiceAgent:
                           ) -> AsyncGenerator[dict, None]:
         for round_no in range(self.max_tool_rounds + 1):
             parser = HermesStreamParser()
-            assistant_text = ""
             raw_text = ""
             calls_this_round = []
             terminal = None
@@ -115,32 +114,34 @@ class VoiceAgent:
                 etype = event["type"]
                 if etype == "token":
                     raw_text += event["text"]
-                    text, calls = parser.feed(event["text"])
-                    # Collect THIS feed's calls before judging its text:
-                    # a chunk can both complete an additional <tool_call>
-                    # and carry trailing prose, and deciding on the text
-                    # first silently dropped that call (ADVICE r3). All
-                    # completed calls must execute (the reference
-                    # accumulated every streamed call before executing,
-                    # vllm_handler.py:389-412).
+                    # Split around the first completed call: collect THIS
+                    # feed's calls before judging its text (a chunk can
+                    # both complete a <tool_call> and carry prose,
+                    # ADVICE r3), and stream the prose that PRECEDED the
+                    # round's first call even when it arrives in the same
+                    # chunk that completes it — chunk boundaries are
+                    # arbitrary (ADVICE r4). All completed calls execute
+                    # (the reference accumulated every streamed call
+                    # before executing, vllm_handler.py:389-412).
+                    pre, calls, post = parser.feed_split(event["text"])
+                    had_calls = bool(calls_this_round)
                     calls_this_round.extend(calls)
+                    if not had_calls and pre:
+                        if ttft is None:
+                            ttft = (time.monotonic() - started) * 1000
+                        yield {"type": "token", "text": pre}
                     if calls_this_round:
-                        # Once a tool block exists, no text is forwarded
-                        # to the client: the round is aborted and
-                        # regenerated with the tool results, so any
-                        # surrounding prose would show up as a stray
+                        # Once a tool block exists, no FURTHER text is
+                        # forwarded to the client: the round is aborted
+                        # and regenerated with the tool results, so
+                        # trailing prose would show up as a stray
                         # duplicated fragment. Prose in a LATER chunk
                         # (one that completed no call itself) means the
                         # model moved on past the block — stop the
                         # round and execute what we have.
-                        if text and text.strip() and not calls:
+                        if had_calls and not calls and pre.strip():
                             break
                         continue
-                    if text:
-                        assistant_text += text
-                        if ttft is None:
-                            ttft = (time.monotonic() - started) * 1000
-                        yield {"type": "token", "text": text}
                 elif etype in ("done", "cancelled", "error"):
                     terminal = event
                     st = event.get("stats", {})
@@ -164,7 +165,6 @@ class VoiceAgent:
                     # that looked like a tag opener) must not leak to
                     # the client, same policy as the in-stream
                     # suppression above.
-                    assistant_text += tail
                     yield {"type": "token", "text": tail}
                 if terminal["type"] in ("cancelled", "error"):
                     yield self._final(terminal, agg_stats, started, ttft)

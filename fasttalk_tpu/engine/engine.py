@@ -899,6 +899,21 @@ class TPUEngine(EngineBase):
         self._spec_fns[key] = spec_call
         return spec_call
 
+    @staticmethod
+    def _share_granule(share: int) -> int:
+        """Round a shared-prefix length down to a power of two (min 16).
+
+        The copy executable set is keyed on length; a 16-token granule
+        compiled one executable per distinct share length — an
+        unpredictable synchronous compile stall on the TTFT-critical
+        admission path for heterogeneous system prompts, and up to
+        max_len/16 executables (ADVICE r4). Powers of two bound the set
+        at log2(max_len) ≈ 11 while keeping at least half of any share.
+        """
+        if share < 16:
+            return 0
+        return 1 << (share.bit_length() - 1)
+
     def _get_prefix_copy_fn(self, plen: int):
         """Copy one slot's leading ``plen`` KV rows onto another slot —
         the shared-prefix stamp. Pure HBM traffic (2·L·plen·Kv·H
@@ -1232,12 +1247,12 @@ class TPUEngine(EngineBase):
                 # Fresh slot: stamp the longest prefix resident in any
                 # OTHER slot (common system prompt across sessions)
                 # instead of re-prefilling it. Rounded down to a
-                # 16-token granule so the copy executable set stays
-                # tiny (one length per deployment in practice). The
-                # source's rows [0:share) are stable: its own writes
-                # only ever target positions >= its kept length.
+                # power-of-two granule so the copy executable set stays
+                # bounded (_share_granule). The source's rows [0:share)
+                # are stable: its own writes only ever target positions
+                # >= its kept length.
                 src, share = self.slots.best_shared_prefix(slot, prompt)
-                share = (share // 16) * 16
+                share = self._share_granule(share)
                 if src is not None and share >= 16:
                     self.cache = self._get_prefix_copy_fn(share)(
                         self.cache, np.int32(src.index),
@@ -1360,7 +1375,7 @@ class TPUEngine(EngineBase):
                     continue
                 pt = item[0].prompt_tokens
                 share = _lcp(lp, pt, min(len(lp), len(pt) - 1))
-                share = (share // 16) * 16
+                share = self._share_granule(share)
                 if share < self._INTRA_SHARE_MIN:
                     continue
                 # Sharing must actually shrink the member's prefill
@@ -1391,7 +1406,7 @@ class TPUEngine(EngineBase):
             # re-check the delta-bucket fit, since a SMALLER share
             # means a LARGER delta whose bucket may no longer fit at
             # the new start.
-            share = min(share, lslot.kv_written) // 16 * 16
+            share = self._share_granule(min(share, lslot.kv_written))
             delta_b = next(
                 (b for b in _PREFILL_BUCKETS
                  if b >= max(1, len(req.prompt_tokens) - share)), None)

@@ -180,14 +180,26 @@ def config_from_hf(hf: dict, name: str) -> ModelConfig:
         extra["qkv_bias"] = bool(hf["attention_bias"])
     rs = None
     raw = hf.get("rope_scaling")
-    if isinstance(raw, dict) and \
-            raw.get("rope_type", raw.get("type")) == "llama3":
-        rs = RopeScaling(
-            factor=float(raw.get("factor", 32.0)),
-            low_freq_factor=float(raw.get("low_freq_factor", 1.0)),
-            high_freq_factor=float(raw.get("high_freq_factor", 4.0)),
-            original_max_position=int(
-                raw.get("original_max_position_embeddings", 8192)))
+    if isinstance(raw, dict):
+        rope_type = raw.get("rope_type", raw.get("type"))
+        if rope_type == "llama3":
+            rs = RopeScaling(
+                factor=float(raw.get("factor", 32.0)),
+                low_freq_factor=float(raw.get("low_freq_factor", 1.0)),
+                high_freq_factor=float(raw.get("high_freq_factor", 4.0)),
+                original_max_position=int(
+                    raw.get("original_max_position_embeddings", 8192)))
+        elif rope_type in (None, "default"):
+            pass  # explicit no-op scaling (e.g. {"type": "default"})
+        else:
+            # yarn / linear / dynamic / longrope: silently serving with
+            # unscaled RoPE would degrade long-context output while
+            # claiming the checkpoint "just works" (ADVICE r4). Fail the
+            # same way an unsupported architecture does.
+            raise KeyError(
+                f"Unsupported rope_scaling type {rope_type!r} for "
+                f"{name!r} (supported: 'llama3', 'default'); refusing "
+                "to serve with unscaled RoPE")
     heads = int(hf["num_attention_heads"])
     return ModelConfig(
         name=name,

@@ -200,6 +200,24 @@ class TestVoiceAgent:
         assert msgs2[-1]["role"] == "tool"
         assert "tool_response" in msgs2[-1]["content"]
 
+    def test_prose_before_call_in_same_chunk_streams(self):
+        """Prose preceding the first tool call must reach the client even
+        when it arrives in the same stream chunk that completes the call
+        — chunk boundaries are arbitrary (ADVICE r4). Prose AFTER the
+        call in that chunk stays suppressed."""
+        eng = ScriptedEngine([
+            'Let me check. <tool_call>{"name": "get_current_time", '
+            '"arguments": {}}</tool_call> suppressed trailer',
+            "It is noon.",
+        ])
+        agent = VoiceAgent(eng, registry=build_default_registry())
+        events = run_agent(agent, [{"role": "user", "content": "time?"}])
+        text = "".join(e.get("text", "") for e in events
+                       if e["type"] == "token")
+        assert "Let me check." in text
+        assert "suppressed trailer" not in text
+        assert "It is noon." in text
+
     def test_multiple_tool_calls_in_one_round_all_execute(self):
         """Two <tool_call>s in one assistant turn: BOTH execute and both
         results are appended before the resume (reference accumulated

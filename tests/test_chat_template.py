@@ -232,3 +232,23 @@ def test_model_config_from_checkpoint_config_json(tmp_path):
         "num_hidden_layers": 1, "num_attention_heads": 1}))
     with pytest.raises(KeyError, match="Unsupported architecture"):
         get_model_config("acme/TinyChat", str(tmp_path))
+
+
+def test_unsupported_rope_scaling_type_refused():
+    """A yarn/linear/dynamic rope_scaling checkpoint must fail loudly,
+    not serve silently with unscaled RoPE (ADVICE r4 medium)."""
+    from fasttalk_tpu.models.configs import config_from_hf
+
+    base = {
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 1000, "hidden_size": 64, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+    }
+    for rope_type in ("yarn", "linear", "dynamic", "longrope"):
+        with pytest.raises(KeyError, match="Unsupported rope_scaling"):
+            config_from_hf({**base, "rope_scaling": {"type": rope_type}},
+                           "acme/Yarned")
+    # Explicit no-op scaling is fine (some checkpoints ship it).
+    cfg = config_from_hf(
+        {**base, "rope_scaling": {"rope_type": "default"}}, "acme/Plain")
+    assert cfg.rope_scaling is None

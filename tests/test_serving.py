@@ -88,6 +88,10 @@ class TestProtocol:
             ended = await recv_json(ws)
             assert ended["type"] == "session_ended"
             assert ended["stats"]["session_id"] == sid
+            # The stats snapshot is taken AFTER the DISCONNECTING
+            # transition — session_ended must not report a live state
+            # (VERDICT r4 weak #4: the frame read "active").
+            assert ended["stats"]["state"] == "disconnecting"
             await ws.close()
         finally:
             await client.close()
