@@ -70,7 +70,8 @@ from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
                                        init_cache)
-from fasttalk_tpu.ops.sampling import sample_tokens
+from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
+                                       sample_tokens)
 from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
 from fasttalk_tpu.utils.logger import get_logger
 from fasttalk_tpu.utils.metrics import get_metrics
@@ -88,6 +89,31 @@ class GenerationParams:
     top_p: float = 0.9
     max_tokens: int = 2048
     stop: list[str] = field(default_factory=list)
+    # Penalties against the current generation's emitted tokens, applied
+    # on device by ops/sampling.apply_penalties. Neutral at the engine
+    # seam (1.0 / 0.0 / 0.0); the serving layer defaults repeat_penalty
+    # to 1.1 (Config), matching the Ollama engine-side default the
+    # reference silently relied on.
+    repeat_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+
+    def __post_init__(self) -> None:
+        # Client-reachable values: apply_penalties DIVIDES by
+        # repeat_penalty, so 0/negative/NaN would poison the whole
+        # generation with inf logits rather than erroring. Raising here
+        # surfaces as a 400 on /v1 and an error frame on the WS.
+        import math
+
+        if not (math.isfinite(self.repeat_penalty)
+                and 0.0 < self.repeat_penalty <= 2.0):
+            raise ValueError(
+                f"repeat_penalty must be in (0, 2], got "
+                f"{self.repeat_penalty}")
+        if not math.isfinite(self.presence_penalty):
+            raise ValueError("presence_penalty must be finite")
+        if not math.isfinite(self.frequency_penalty):
+            raise ValueError("frequency_penalty must be finite")
     # Text-completion mode (/v1/completions): the prompt is the joined
     # message content, tokenized verbatim (BOS + bytes, no chat
     # template). Out of band on purpose — an in-band role sentinel
@@ -373,12 +399,26 @@ class TPUEngine(EngineBase):
         self._temps = np.zeros((num_slots,), np.float32)
         self._topks = np.zeros((num_slots,), np.int32)
         self._topps = np.ones((num_slots,), np.float32)
+        self._reps = np.ones((num_slots,), np.float32)
+        self._press = np.zeros((num_slots,), np.float32)
+        self._freqs = np.zeros((num_slots,), np.float32)
         self._cur_tokens = self._put(np.zeros((num_slots,), np.int32))
         self._positions_dev = self._put(self._positions)
         self._active_dev = self._put(self._active_mask)
         self._temps_dev = self._put(self._temps)
         self._topks_dev = self._put(self._topks)
         self._topps_dev = self._put(self._topps)
+        self._reps_dev = self._put(self._reps)
+        self._press_dev = self._put(self._press)
+        self._freqs_dev = self._put(self._freqs)
+        # Per-slot emitted-token counts [S, sample_vocab] — the penalty
+        # state (ops/sampling.apply_penalties). Maintained in-program by
+        # the decode steps (each step counts the token it FEEDS, so every
+        # emitted token — including the prefill-sampled first — is
+        # counted exactly once); zeroed by the patch program when a slot
+        # is (re)admitted or finishes. At [16, 128k] int32 this is ~8 MB.
+        self._counts_dev = self._put(
+            np.zeros((num_slots, self.sample_vocab), np.int32))
         self._rng_dev = self._put(jax.random.PRNGKey(self.seed))
         # Speculative decoding's device-resident token history
         # [S, max_len]: the draft source. Chained through spec calls
@@ -529,10 +569,12 @@ class TPUEngine(EngineBase):
         for b in decode_buckets:
             for steps in sorted({self.steps_burst, self.steps_per_call}):
                 fn = self._get_decode_fn(b, steps)
-                self.cache, toks, _, _, _ = fn(
-                    self.params, self.cache, self._cur_tokens,
-                    self._positions_dev, inactive, self._temps_dev,
-                    self._topks_dev, self._topps_dev, self._rng_dev)
+                self.cache, self._counts_dev, toks, _, _, _ = fn(
+                    self.params, self.cache, self._counts_dev,
+                    self._cur_tokens, self._positions_dev, inactive,
+                    self._temps_dev, self._topks_dev, self._topps_dev,
+                    self._reps_dev, self._press_dev, self._freqs_dev,
+                    self._rng_dev)
                 jax.block_until_ready(toks)
                 if self.spec_draft:
                     # All-inactive spec warmup: every write masks out.
@@ -542,12 +584,14 @@ class TPUEngine(EngineBase):
                     # warmup-time can still see it requested mid-stream
                     # and pay the compile under traffic.
                     sfn = self._get_spec_decode_fn(b, steps)
-                    (self.cache, self._history_dev, toks, _, _,
-                     _) = sfn(
+                    (self.cache, self._history_dev, self._counts_dev,
+                     toks, _, _, _) = sfn(
                         self.params, self.cache, self._history_dev,
-                        self._cur_tokens, self._positions_dev, inactive,
+                        self._counts_dev, self._cur_tokens,
+                        self._positions_dev, inactive,
                         self._temps_dev, self._topks_dev,
-                        self._topps_dev, self._rng_dev)
+                        self._topps_dev, self._reps_dev, self._press_dev,
+                        self._freqs_dev, self._rng_dev)
                     jax.block_until_ready(toks)
         if self.spec_draft:
             # The admission-path history upload (slot indices out of
@@ -561,11 +605,15 @@ class TPUEngine(EngineBase):
             jax.block_until_ready(self._history_dev)
         # The admission-path helper programs (slot-state patch; they are
         # tiny but a first-request compile is still seconds).
-        nopatch = np.zeros((self.num_slots, 6), np.float32)
-        (self._positions_dev, self._active_dev, self._temps_dev,
-         self._topks_dev, self._topps_dev) = self._get_patch_fn()(
-            self._arg(nopatch), self._positions_dev, self._active_dev,
-            self._temps_dev, self._topks_dev, self._topps_dev)
+        nopatch = np.zeros((self.num_slots, 9), np.float32)
+        (self._counts_dev, self._positions_dev, self._active_dev,
+         self._temps_dev, self._topks_dev, self._topps_dev,
+         self._reps_dev, self._press_dev, self._freqs_dev) = \
+            self._get_patch_fn()(
+                self._arg(nopatch), self._counts_dev, self._positions_dev,
+                self._active_dev, self._temps_dev, self._topks_dev,
+                self._topps_dev, self._reps_dev, self._press_dev,
+                self._freqs_dev)
 
         # The single-slot long-prompt path buckets by the smallest
         # _PREFILL_BUCKETS entry covering a full chunk — warm exactly
@@ -744,60 +792,71 @@ class TPUEngine(EngineBase):
             return fn
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
         scatter = self._scatter_decode and not use_pallas
+        rows = jnp.arange(self.num_slots)
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_call(params, cache: KVCache, cur_tokens, positions,
-                        active, temps, topks, topps, rng):
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_call(params, cache: KVCache, counts, cur_tokens,
+                        positions, active, temps, topks, topps,
+                        reps, press, freqs, rng):
             if scatter:
                 def step(carry, _):
-                    ck, cv, cur, pos, key = carry
+                    ck, cv, cnt, cur, pos, key = carry
                     key, sub = jax.random.split(key)
                     # A slot that finished mid-pipeline keeps "decoding"
                     # until the host reconciles; clamp it off the
                     # attention horizon so its garbage writes can never
                     # clobber live rows.
                     act = jnp.logical_and(active, pos < kv_len)
+                    # Count the token being FED (it was emitted last
+                    # step or by prefill), so the penalty at sampling
+                    # time covers every emitted token exactly once.
+                    cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                                unique_indices=True)
                     logits, newc = forward_decode(
                         params, self.cfg, cur, pos, KVCache(ck, cv), act,
                         attn_len=kv_len,
                         pallas_int8=self.use_pallas_int8)
-                    nxt = sample_tokens(logits[:, :self.sample_vocab],
-                                        sub, temps, topks, topps,
+                    lg = apply_penalties(logits[:, :self.sample_vocab],
+                                         cnt, reps, press, freqs)
+                    nxt = sample_tokens(lg, sub, temps, topks, topps,
                                         method=self.sampling_method)
                     pos = pos + act.astype(pos.dtype)
-                    return (newc.k, newc.v, nxt, pos, key), nxt
+                    return (newc.k, newc.v, cnt, nxt, pos, key), nxt
 
-                (ck, cv, cur, pos, rng), toks = jax.lax.scan(
-                    step, (cache.k, cache.v, cur_tokens, positions, rng),
-                    None, length=steps)
-                return KVCache(ck, cv), toks, cur, pos, rng
+                (ck, cv, cnt, cur, pos, rng), toks = jax.lax.scan(
+                    step, (cache.k, cache.v, counts, cur_tokens,
+                           positions, rng), None, length=steps)
+                return KVCache(ck, cv), cnt, toks, cur, pos, rng
 
             ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
             cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
 
             def step(carry, _):
-                sk, sv, cur, pos, key = carry
+                sk, sv, cnt, cur, pos, key = carry
                 key, sub = jax.random.split(key)
                 act = jnp.logical_and(active, pos < kv_len)
+                cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                            unique_indices=True)
                 logits, small = forward(
                     params, self.cfg, cur[:, None], pos[:, None],
                     KVCache(sk, sv), pos, write_mask=act,
                     pallas_decode=use_pallas,
                     pallas_int8=self.use_pallas_int8)
-                nxt = sample_tokens(logits[:, -1, :self.sample_vocab],
-                                    sub, temps, topks, topps,
+                lg = apply_penalties(logits[:, -1, :self.sample_vocab],
+                                     cnt, reps, press, freqs)
+                nxt = sample_tokens(lg, sub, temps, topks, topps,
                                     method=self.sampling_method)
                 pos = pos + act.astype(pos.dtype)
-                return (small.k, small.v, nxt, pos, key), nxt
+                return (small.k, small.v, cnt, nxt, pos, key), nxt
 
-            (ck, cv, cur, pos, rng), toks = jax.lax.scan(
-                step, (ck, cv, cur_tokens, positions, rng), None,
+            (ck, cv, cnt, cur, pos, rng), toks = jax.lax.scan(
+                step, (ck, cv, counts, cur_tokens, positions, rng), None,
                 length=steps)
             new_k = jax.lax.dynamic_update_slice_in_dim(
                 cache.k, ck, 0, axis=2)
             new_v = jax.lax.dynamic_update_slice_in_dim(
                 cache.v, cv, 0, axis=2)
-            return KVCache(new_k, new_v), toks, cur, pos, rng
+            return KVCache(new_k, new_v), cnt, toks, cur, pos, rng
 
         self._decode_fns[(kv_len, steps)] = decode_call
         return decode_call
@@ -839,13 +898,14 @@ class TPUEngine(EngineBase):
         max_len = self.max_len
         sv = self.sample_vocab
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def spec_call(params, cache: KVCache, history, cur_tokens,
-                      positions, active, temps, topks, topps, rng):
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def spec_call(params, cache: KVCache, history, counts, cur_tokens,
+                      positions, active, temps, topks, topps,
+                      reps, press, freqs, rng):
             rows = jnp.arange(S)
 
             def step(carry, _):
-                ck, cv, hist, cur, pos, key = carry
+                ck, cv, hist, cnt, cur, pos, key = carry
                 # Need T columns of cache headroom inside this bucket;
                 # slots without it sit the step out (the dispatcher
                 # falls back to plain decode before this can starve a
@@ -854,6 +914,10 @@ class TPUEngine(EngineBase):
                 wp = jnp.where(act, pos, max_len)
                 hist = hist.at[rows, wp].set(cur, mode="drop",
                                              unique_indices=True)
+                # Penalty base counts: the fed token (emitted last
+                # block) counts now, same as the plain decode step.
+                cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                            unique_indices=True)
                 idx = jnp.arange(max_len)
                 m = jnp.logical_and(hist == cur[:, None],
                                     idx[None, :] < pos[:, None])
@@ -868,9 +932,51 @@ class TPUEngine(EngineBase):
                     act, attn_len=kv_len,
                     pallas_int8=self.use_pallas_int8)
                 key, sub = jax.random.split(key)
-                flat = logits[..., :sv].reshape(S * T, sv)
+                # EXACT per-position penalty counts, without vocab-wide
+                # per-position intermediates: block position j is
+                # conditioned on fed tokens cur, d_1..d_j — if position
+                # j's sample is ever emitted, those drafts were accepted
+                # (= emitted), so plain decode would have counted them.
+                # Only the <= G draft-token columns can differ from the
+                # base counts, so penalise everything against the base
+                # [S, 1, V] (broadcast, fused by XLA), then re-penalise
+                # just those entries with their within-block counts and
+                # scatter them in. Keeps speculative decoding exactly
+                # distribution-preserving under penalties.
+                lgf = logits[..., :sv].astype(jnp.float32)  # [S, T, sv]
+                r3 = reps[:, None, None]
+                p3 = press[:, None, None]
+                f3 = freqs[:, None, None]
+                lg = penalize_values(
+                    lgf, cnt[:, None, :].astype(jnp.float32), r3, p3, f3)
+                # occ[s, i, k]: occurrences of d_i among d_1..d_{k+1};
+                # extra count of token d_i at block position j is its
+                # occurrence count among the fed d_1..d_j.
+                eq = (drafts[:, :, None] == drafts[:, None, :]) \
+                    .astype(jnp.float32)                      # [S, G, G]
+                extra = jnp.concatenate(
+                    [jnp.zeros((S, G, 1), jnp.float32),
+                     jnp.cumsum(eq, axis=2)], axis=2)         # [S, G, T]
+                dcl = jnp.minimum(drafts, sv - 1)
+                dcol = jnp.broadcast_to(dcl[:, None, :], (S, T, G))
+                raw = jnp.take_along_axis(lgf, dcol, axis=2)  # [S, T, G]
+                base_c = jnp.take_along_axis(cnt, dcl, axis=1) \
+                    .astype(jnp.float32)                      # [S, G]
+                c_true = base_c[:, None, :] \
+                    + jnp.swapaxes(extra, 1, 2)               # [S, T, G]
+                corr = penalize_values(raw, c_true, r3, p3, f3)
+                # Equal drafts get equal corrected values, so the
+                # duplicate-index scatter is value-consistent;
+                # out-of-vocab draft ids (prompt tokens beyond the
+                # tokenizer vocab) drop — they can never be sampled.
+                scat = jnp.where(
+                    jnp.broadcast_to((drafts < sv)[:, None, :],
+                                     (S, T, G)), dcol, sv)
+                lg = lg.at[jnp.arange(S)[:, None, None],
+                           jnp.arange(T)[None, :, None],
+                           scat].set(corr, mode="drop")
                 t_samp = sample_tokens(
-                    flat, sub, jnp.repeat(temps, T),
+                    lg.reshape(S * T, sv), sub, jnp.repeat(temps, T),
                     jnp.repeat(topks, T), jnp.repeat(topps, T),
                     method=self.sampling_method).reshape(S, T)
                 match = (t_samp[:, :-1] == drafts).astype(jnp.int32)
@@ -884,17 +990,26 @@ class TPUEngine(EngineBase):
                 hist = hist.at[
                     rows[:, None], jnp.where(keep, out_idx, max_len)].set(
                     t_samp, mode="drop")
+                # Commit accepted drafts to the counts (they were fed
+                # AND emitted). The residual sample t_samp[:, a] is
+                # new_cur — counted when fed next block, like plain
+                # decode's sampled token.
+                add = jnp.arange(T)[None, :] < (n_out - 1)[:, None]
+                cnt = cnt.at[rows[:, None],
+                             jnp.where(add, t_samp, sv)].add(
+                    jnp.int32(1), mode="drop")
                 pos = pos + n_out
                 # n_out packed as a trailing column: ONE host fetch per
                 # call (a tuple fetch costs two serial link round trips
                 # on relayed attach paths).
                 packed = jnp.concatenate([t_samp, n_out[:, None]], axis=1)
-                return (newc.k, newc.v, hist, new_cur, pos, key), packed
+                return (newc.k, newc.v, hist, cnt, new_cur, pos, key), \
+                    packed
 
-            (ck, cv, hist, cur, pos, rng), toks = jax.lax.scan(
-                step, (cache.k, cache.v, history, cur_tokens, positions,
-                       rng), None, length=steps)
-            return (KVCache(ck, cv), hist, toks, cur, pos, rng)
+            (ck, cv, hist, cnt, cur, pos, rng), toks = jax.lax.scan(
+                step, (cache.k, cache.v, history, counts, cur_tokens,
+                       positions, rng), None, length=steps)
+            return (KVCache(ck, cv), hist, cnt, toks, cur, pos, rng)
 
         self._spec_fns[key] = spec_call
         return spec_call
@@ -1027,13 +1142,18 @@ class TPUEngine(EngineBase):
 
     def _get_patch_fn(self):
         """One jitted program applying all dirty-slot mirror changes:
-        packed [S, 6] = (dirty, position, active, temp, top_k, top_p).
-        Composes with in-flight calls (it consumes the latest chained
-        arrays) without draining the pipeline, and costs one transfer +
-        one program instead of per-field eager scatters."""
+        packed [S, 9] = (dirty, position, active, temp, top_k, top_p,
+        repeat_penalty, presence_penalty, frequency_penalty). Dirty
+        slots also get their penalty-count row zeroed (a slot goes dirty
+        exactly at (re)admission and completion — both are generation
+        boundaries, and penalties are per-generation). Composes with
+        in-flight calls (it consumes the latest chained arrays) without
+        draining the pipeline, and costs one transfer + one program
+        instead of per-field eager scatters."""
         if self._patch_fn is None:
-            @jax.jit
-            def apply_patch(packed, pos, active, temps, topks, topps):
+            @partial(jax.jit, donate_argnums=(1,))
+            def apply_patch(packed, counts, pos, active, temps, topks,
+                            topps, reps, press, freqs):
                 dirty = packed[:, 0] > 0.5
                 pos = jnp.where(dirty, packed[:, 1].astype(pos.dtype), pos)
                 active = jnp.where(dirty, packed[:, 2] > 0.5, active)
@@ -1041,7 +1161,12 @@ class TPUEngine(EngineBase):
                 topks = jnp.where(dirty, packed[:, 4].astype(topks.dtype),
                                   topks)
                 topps = jnp.where(dirty, packed[:, 5], topps)
-                return pos, active, temps, topks, topps
+                reps = jnp.where(dirty, packed[:, 6], reps)
+                press = jnp.where(dirty, packed[:, 7], press)
+                freqs = jnp.where(dirty, packed[:, 8], freqs)
+                counts = jnp.where(dirty[:, None], 0, counts)
+                return counts, pos, active, temps, topks, topps, \
+                    reps, press, freqs
 
             self._patch_fn = apply_patch
         return self._patch_fn
@@ -1541,6 +1666,9 @@ class TPUEngine(EngineBase):
         self._temps[s] = req.params.temperature
         self._topks[s] = req.params.top_k
         self._topps[s] = req.params.top_p
+        self._reps[s] = req.params.repeat_penalty
+        self._press[s] = req.params.presence_penalty
+        self._freqs[s] = req.params.frequency_penalty
         self._dirty_slots.add(s)
         if self.spec_draft:
             self._dirty_history[s] = list(slot.tokens)
@@ -1610,15 +1738,20 @@ class TPUEngine(EngineBase):
                 self._history_dev, self._arg(rows), self._arg(slots))
         if not self._dirty_slots:
             return
-        packed = np.zeros((self.num_slots, 6), np.float32)
+        packed = np.zeros((self.num_slots, 9), np.float32)
         for s in self._dirty_slots:
             packed[s] = (1.0, self._positions[s], self._active_mask[s],
-                         self._temps[s], self._topks[s], self._topps[s])
+                         self._temps[s], self._topks[s], self._topps[s],
+                         self._reps[s], self._press[s], self._freqs[s])
         self._dirty_slots.clear()
-        (self._positions_dev, self._active_dev, self._temps_dev,
-         self._topks_dev, self._topps_dev) = self._get_patch_fn()(
-            self._arg(packed), self._positions_dev, self._active_dev,
-            self._temps_dev, self._topks_dev, self._topps_dev)
+        (self._counts_dev, self._positions_dev, self._active_dev,
+         self._temps_dev, self._topks_dev, self._topps_dev,
+         self._reps_dev, self._press_dev, self._freqs_dev) = \
+            self._get_patch_fn()(
+                self._arg(packed), self._counts_dev, self._positions_dev,
+                self._active_dev, self._temps_dev, self._topks_dev,
+                self._topps_dev, self._reps_dev, self._press_dev,
+                self._freqs_dev)
 
     def _dispatch_decode(self) -> None:
         """Launch one K-step decode call; does not wait for results."""
@@ -1663,13 +1796,15 @@ class TPUEngine(EngineBase):
                                if b >= need and b <= self.max_len),
                               self.max_len)
                 fn = self._get_spec_decode_fn(kv_len, steps)
-                (self.cache, self._history_dev, toks,
+                (self.cache, self._history_dev, self._counts_dev, toks,
                  self._cur_tokens, self._positions_dev,
                  self._rng_dev) = fn(
                     self.params, self.cache, self._history_dev,
-                    self._cur_tokens, self._positions_dev,
-                    self._active_dev, self._temps_dev, self._topks_dev,
-                    self._topps_dev, self._rng_dev)
+                    self._counts_dev, self._cur_tokens,
+                    self._positions_dev, self._active_dev,
+                    self._temps_dev, self._topks_dev, self._topps_dev,
+                    self._reps_dev, self._press_dev, self._freqs_dev,
+                    self._rng_dev)
                 # Promise the EMA-expected tokens, not the minimum:
                 # spec calls deliver K..K*T, and promising K made the
                 # dispatcher queue up to T× too many calls — a
@@ -1685,11 +1820,12 @@ class TPUEngine(EngineBase):
         kv_len = next((b for b in _KV_BUCKETS
                        if b >= max_pos and b <= self.max_len), self.max_len)
         fn = self._get_decode_fn(kv_len, steps)
-        (self.cache, toks, self._cur_tokens, self._positions_dev,
-         self._rng_dev) = fn(
-            self.params, self.cache, self._cur_tokens, self._positions_dev,
-            self._active_dev, self._temps_dev, self._topks_dev,
-            self._topps_dev, self._rng_dev)
+        (self.cache, self._counts_dev, toks, self._cur_tokens,
+         self._positions_dev, self._rng_dev) = fn(
+            self.params, self.cache, self._counts_dev, self._cur_tokens,
+            self._positions_dev, self._active_dev, self._temps_dev,
+            self._topks_dev, self._topps_dev, self._reps_dev,
+            self._press_dev, self._freqs_dev, self._rng_dev)
         # Start the device→host copy NOW on a worker thread: by
         # retirement time it has been in flight for a whole call's
         # compute, and later calls' fetches overlap it (see the
@@ -1818,6 +1954,9 @@ class TPUEngine(EngineBase):
             self._running.pop(slot.index, None)
             self._active_mask[slot.index] = False
             self._temps[slot.index] = 0.0
+            self._reps[slot.index] = 1.0
+            self._press[slot.index] = 0.0
+            self._freqs[slot.index] = 0.0
             if decoding:
                 # KV rows are written only up to the position reached by
                 # *feeding* tokens; a final token kept on max_tokens/stop

@@ -116,6 +116,10 @@ class VLLMRemoteEngine(_RemoteEngine):
         # strict OpenAI-compatible proxies): dropped for the engine's
         # lifetime; stats then fall back to chunk counting.
         self._no_stream_options = False
+        # Same lifecycle for repetition_penalty: vLLM accepts it as a
+        # sampling extension, but strict OpenAI-compatible backends 400
+        # on the unknown param — drop it (not the request) and retry.
+        self._no_repetition_penalty = False
 
     async def generate(self, request_id: str, session_id: str,
                        messages: list[dict], params: GenerationParams,
@@ -127,7 +131,12 @@ class VLLMRemoteEngine(_RemoteEngine):
             "top_p": params.top_p,
             "max_tokens": params.max_tokens,
             "stream": True,
+            # OpenAI-style penalties pass straight through.
+            "presence_penalty": params.presence_penalty,
+            "frequency_penalty": params.frequency_penalty,
         }
+        if params.repeat_penalty != 1.0 and not self._no_repetition_penalty:
+            body["repetition_penalty"] = params.repeat_penalty
         if not self._no_stream_options:
             # Ask the backend for its own token accounting (an OpenAI /
             # vLLM-supported option): the final chunk then carries
@@ -151,7 +160,7 @@ class VLLMRemoteEngine(_RemoteEngine):
         completion_toks: int | None = None
         finish = "stop"
         try:
-            for _attempt in range(2):
+            for _attempt in range(3):
                 async with client.post(
                         url, json=body,
                         headers={"Authorization": f"Bearer {self.api_key}"},
@@ -170,6 +179,16 @@ class VLLMRemoteEngine(_RemoteEngine):
                             # unretried below.
                             self._no_stream_options = True
                             del body["stream_options"]
+                            continue
+                        if resp.status == 400 \
+                                and "repetition_penalty" in body \
+                                and "repetition_penalty" in text:
+                            # Strict OpenAI-compatible backend without
+                            # the vLLM sampling extension: serve without
+                            # the penalty rather than failing every
+                            # generation.
+                            self._no_repetition_penalty = True
+                            del body["repetition_penalty"]
                             continue
                         raise LLMServiceError(
                             f"vLLM backend error {resp.status}: "
@@ -277,6 +296,12 @@ class OllamaRemoteEngine(_RemoteEngine):
                 "top_p": params.top_p,
                 "top_k": params.top_k,
                 "num_predict": params.max_tokens,
+                # Explicit where the reference's gateway relied on the
+                # engine default (~1.1): the applied penalty is now in
+                # the request record, not implicit engine state.
+                "repeat_penalty": params.repeat_penalty,
+                "presence_penalty": params.presence_penalty,
+                "frequency_penalty": params.frequency_penalty,
             },
         }
         if params.raw_prompt:

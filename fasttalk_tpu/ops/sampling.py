@@ -27,6 +27,54 @@ _NEG_INF = -1e30
 _BLOCK = 128  # candidate-preselection block width (lane-aligned)
 
 
+def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
+                    repeat: jnp.ndarray, presence: jnp.ndarray,
+                    frequency: jnp.ndarray) -> jnp.ndarray:
+    """Repetition / presence / frequency penalties against per-row
+    emitted-token counts, applied to the FULL logits row (before
+    candidate preselection, so a penalised token can fall out of the
+    candidate set and greedy argmax sees penalised ordering).
+
+    logits [B, V]; counts [B, V] int — times each token has been emitted
+    this generation (maintained on device by the engine's decode steps,
+    so the penalty costs a few V-wide elementwise ops and never a host
+    round trip). repeat/presence/frequency [B]:
+
+    - repeat: llama.cpp/Ollama-style multiplicative penalty on every
+      seen token (>1 penalises; positive logits divide, negative
+      multiply). The reference's Ollama engine applied its ~1.1 default
+      to every generation even though the gateway never set one
+      (reference app/core/ollama_handler.py:144-162 passes no penalty —
+      the engine supplied it).
+    - presence: OpenAI-style flat subtraction for any seen token.
+    - frequency: OpenAI-style per-occurrence subtraction.
+
+    Divergence from Ollama, documented: no repeat_last_n window — the
+    penalty covers the whole current generation (prompt tokens are not
+    penalised; counts reset at admission).
+    """
+    return penalize_values(logits.astype(jnp.float32),
+                           counts.astype(jnp.float32),
+                           repeat[:, None], presence[:, None],
+                           frequency[:, None])
+
+
+def penalize_values(lg: jnp.ndarray, counts_f: jnp.ndarray,
+                    repeat: jnp.ndarray, presence: jnp.ndarray,
+                    frequency: jnp.ndarray) -> jnp.ndarray:
+    """The penalty formula on pre-broadcast float arrays (any ranks that
+    broadcast together; see apply_penalties for semantics). Exposed so
+    the engine's speculative verify block can penalise [S, T, V] logits
+    against [S, 1, V] base counts without materialising per-position
+    count tensors, and re-apply the exact same formula to the handful
+    of draft-token entries whose within-block counts differ."""
+    seen = counts_f > 0
+    rep = jnp.where(seen, repeat, 1.0)
+    lg = jnp.where(lg > 0, lg / rep, lg * rep)
+    return lg - presence * seen.astype(jnp.float32) \
+        - frequency * counts_f
+
+
 def _select_candidates(logits: jnp.ndarray, max_candidates: int,
                        method: str) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Top ``max_candidates`` (values, indices), sorted descending.

@@ -210,6 +210,23 @@ def register_openai_routes(app: web.Application,
                            or body.get("max_completion_tokens")
                            or defaults.get("max_tokens", 1024)),
             stop=[s for s in stop if isinstance(s, str) and s],
+            # OpenAI wire names for presence/frequency; repeat_penalty
+            # is the Ollama-compatible extension (vLLM's /v1 accepts
+            # repetition_penalty — both spellings map to it).
+            presence_penalty=float(body.get(
+                "presence_penalty",
+                defaults.get("presence_penalty", 0.0))),
+            frequency_penalty=float(body.get(
+                "frequency_penalty",
+                defaults.get("frequency_penalty", 0.0))),
+            # Key-presence defaulting (NOT an `or` chain): an explicit
+            # invalid 0 must surface as a 400 from GenerationParams
+            # validation, not be silently swapped for the default.
+            repeat_penalty=float(
+                body["repeat_penalty"] if "repeat_penalty" in body
+                else body["repetition_penalty"]
+                if "repetition_penalty" in body
+                else defaults.get("repeat_penalty", 1.0)),
         )
 
     def _breaker_503() -> web.Response | None:

@@ -95,7 +95,11 @@ class WebSocketLLMServer:
             defaults={"temperature": config.default_temperature,
                       "top_p": config.default_top_p,
                       "top_k": config.default_top_k,
-                      "max_tokens": config.default_max_tokens},
+                      "max_tokens": config.default_max_tokens,
+                      "repeat_penalty": config.default_repeat_penalty,
+                      "presence_penalty": config.default_presence_penalty,
+                      "frequency_penalty":
+                          config.default_frequency_penalty},
             breaker=self.breaker)
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
@@ -294,7 +298,8 @@ class WebSocketLLMServer:
     # Generation-config keys a client may set per session; anything else
     # in the config blob is stored for echo but never splatted inward.
     _GEN_KEYS = ("temperature", "top_p", "top_k", "max_tokens", "stop",
-                 "tts_chunking")
+                 "tts_chunking", "repeat_penalty", "presence_penalty",
+                 "frequency_penalty")
 
     @classmethod
     def _gen_overrides(cls, cfg: dict) -> dict:
@@ -354,6 +359,13 @@ class WebSocketLLMServer:
             max_tokens=int(over.get("max_tokens",
                                     self.config.default_max_tokens)),
             stop=[s for s in stop if isinstance(s, str) and s],
+            repeat_penalty=float(over.get(
+                "repeat_penalty", self.config.default_repeat_penalty)),
+            presence_penalty=float(over.get(
+                "presence_penalty", self.config.default_presence_penalty)),
+            frequency_penalty=float(over.get(
+                "frequency_penalty",
+                self.config.default_frequency_penalty)),
         )
 
     async def _generate(self, session_id: str, user_text: str,

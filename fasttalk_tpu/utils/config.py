@@ -152,6 +152,19 @@ class Config:
         default_factory=lambda: _env_int("DEFAULT_CONTEXT_WINDOW", 8192))
     default_top_p: float = field(default_factory=lambda: _env_float("DEFAULT_TOP_P", 0.9))
     default_top_k: int = field(default_factory=lambda: _env_int("DEFAULT_TOP_K", 40))
+    # Unset resolves per provider in __post_init__: 1.1 for the in-tree
+    # engine and Ollama (the engine-side default the reference silently
+    # relied on — its gateway never set a penalty, but the Ollama engine
+    # applied ~1.1 to every generation, reference app/core/
+    # ollama_handler.py:144-162); 1.0 for vllm (vLLM's own default —
+    # and strict OpenAI-compatible backends 400 on the non-standard
+    # repetition_penalty param, so it must not be emitted by default).
+    default_repeat_penalty: float = field(
+        default_factory=lambda: _env_float("DEFAULT_REPEAT_PENALTY", -1.0))
+    default_presence_penalty: float = field(
+        default_factory=lambda: _env_float("DEFAULT_PRESENCE_PENALTY", 0.0))
+    default_frequency_penalty: float = field(
+        default_factory=lambda: _env_float("DEFAULT_FREQUENCY_PENALTY", 0.0))
 
     # Server (reference: config.py:130-136)
     host: str = field(default_factory=lambda: _env_str("LLM_HOST", "0.0.0.0"))
@@ -260,6 +273,9 @@ class Config:
     def __post_init__(self) -> None:
         if not self.warmup:
             self.warmup = "fast" if self.llm_provider == "tpu" else "off"
+        if self.default_repeat_penalty < 0:  # unset: provider-resolved
+            self.default_repeat_penalty = \
+                1.0 if self.llm_provider == "vllm" else 1.1
         self._validate()
 
     def _validate(self) -> None:
@@ -276,6 +292,12 @@ class Config:
             errs.append("default_top_k must be >= 0")
         if self.default_max_tokens <= 0:
             errs.append("default_max_tokens must be > 0")
+        if not (0.0 < self.default_repeat_penalty <= 2.0):
+            errs.append("default_repeat_penalty must be in (0, 2]")
+        if not (-2.0 <= self.default_presence_penalty <= 2.0):
+            errs.append("default_presence_penalty must be in [-2, 2]")
+        if not (-2.0 <= self.default_frequency_penalty <= 2.0):
+            errs.append("default_frequency_penalty must be in [-2, 2]")
         if self.port == self.monitoring_port:
             errs.append("port and monitoring_port must differ")
         if self.max_connections <= 0:

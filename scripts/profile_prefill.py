@@ -54,11 +54,13 @@ def main() -> None:
 
     def decode_call(steps):
         fn = engine._get_decode_fn(512, steps)
-        (engine.cache, toks, engine._cur_tokens, engine._positions_dev,
-         engine._rng_dev) = fn(
-            engine.params, engine.cache, engine._cur_tokens,
-            engine._positions_dev, inactive, engine._temps_dev,
-            engine._topks_dev, engine._topps_dev, engine._rng_dev)
+        (engine.cache, engine._counts_dev, toks, engine._cur_tokens,
+         engine._positions_dev, engine._rng_dev) = fn(
+            engine.params, engine.cache, engine._counts_dev,
+            engine._cur_tokens, engine._positions_dev, inactive,
+            engine._temps_dev, engine._topks_dev, engine._topps_dev,
+            engine._reps_dev, engine._press_dev, engine._freqs_dev,
+            engine._rng_dev)
         return toks
 
     def prefill_call(bucket, gp, fetch):

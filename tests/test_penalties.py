@@ -1,0 +1,380 @@
+"""Repetition / presence / frequency penalties (VERDICT r4 #2).
+
+The reference stack always generated under a repetition penalty: its
+gateway set none, but the Ollama engine applied its ~1.1 default to
+every request (reference app/core/ollama_handler.py:144-162 passes only
+temperature/num_predict/top_p/top_k/stop — the penalty came from the
+engine). Here the penalty is explicit, per-slot, and applied on device
+(ops/sampling.apply_penalties) against device-resident emitted-token
+counts — no host round trip.
+
+Correctness bar: penalties change SAMPLING only (never token
+accounting), compose with speculative decoding without breaking its
+greedy-parity guarantee, and a huge presence penalty provably bans
+repeats (every emitted token distinct).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+from fasttalk_tpu.models.configs import get_model_config
+from fasttalk_tpu.models.llama import init_params
+from fasttalk_tpu.ops.sampling import apply_penalties
+
+TINY = get_model_config("test-tiny")
+GREEDY = dict(temperature=0.0, top_k=0, top_p=1.0)
+
+
+# ---------------- op-level ----------------
+
+class TestApplyPenalties:
+    def test_neutral_is_identity(self):
+        logits = jnp.asarray([[1.5, -2.0, 0.0, 3.0]])
+        counts = jnp.asarray([[0, 2, 1, 5]])
+        out = apply_penalties(logits, counts, jnp.asarray([1.0]),
+                              jnp.asarray([0.0]), jnp.asarray([0.0]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(logits))
+
+    def test_repeat_penalty_llama_cpp_semantics(self):
+        """Seen positive logits divide by the penalty; seen negative
+        multiply (both move toward 'less likely'); unseen untouched."""
+        logits = jnp.asarray([[2.0, -2.0, 2.0, -2.0]])
+        counts = jnp.asarray([[1, 1, 0, 0]])
+        out = np.asarray(apply_penalties(
+            logits, counts, jnp.asarray([2.0]), jnp.asarray([0.0]),
+            jnp.asarray([0.0])))[0]
+        np.testing.assert_allclose(out, [1.0, -4.0, 2.0, -2.0])
+
+    def test_presence_and_frequency(self):
+        logits = jnp.zeros((1, 3))
+        counts = jnp.asarray([[0, 1, 4]])
+        out = np.asarray(apply_penalties(
+            logits, counts, jnp.asarray([1.0]), jnp.asarray([0.5]),
+            jnp.asarray([0.25])))[0]
+        # unseen: 0; seen once: -0.5 - 0.25; seen 4x: -0.5 - 1.0
+        np.testing.assert_allclose(out, [0.0, -0.75, -1.5])
+
+    def test_per_row_params(self):
+        logits = jnp.ones((2, 2))
+        counts = jnp.asarray([[1, 0], [1, 0]])
+        out = np.asarray(apply_penalties(
+            logits, counts, jnp.asarray([2.0, 1.0]),
+            jnp.asarray([0.0, 1.0]), jnp.asarray([0.0, 0.0])))
+        np.testing.assert_allclose(out, [[0.5, 1.0], [0.0, 1.0]])
+
+    def test_greedy_ordering_changes(self):
+        """A penalised former argmax falls below the runner-up — the
+        property that breaks greedy repetition loops."""
+        from fasttalk_tpu.ops.sampling import sample_tokens
+
+        logits = jnp.asarray([[3.0, 2.9, 0.0, 0.0]])
+        counts = jnp.asarray([[3, 0, 0, 0]])
+        lg = apply_penalties(logits, counts, jnp.asarray([1.3]),
+                             jnp.asarray([0.0]), jnp.asarray([0.0]))
+        tok = sample_tokens(lg, jax.random.PRNGKey(0),
+                            jnp.asarray([0.0]), jnp.asarray([0]),
+                            jnp.asarray([1.0]))
+        assert int(tok[0]) == 1
+
+
+# ---------------- engine-level ----------------
+
+def _generate(engine, prompt: str, params: GenerationParams,
+              request_id: str = "r1") -> tuple[str, dict]:
+    async def run():
+        text, final = "", {}
+        async for ev in engine.generate(
+                request_id, f"s-{request_id}",
+                [{"role": "user", "content": prompt}], params):
+            if ev["type"] == "token":
+                text += ev["text"]
+            else:
+                final = ev
+        return text, final
+
+    return asyncio.run(run())
+
+
+def _engine(params, **kw) -> TPUEngine:
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=4,
+                    max_len=512, prefill_chunk=64, seed=0, **kw)
+    eng.start()
+    return eng
+
+
+def test_huge_presence_penalty_bans_repeats():
+    """presence_penalty >> logit range: every emitted byte-token is
+    distinct (each emission drops the token below every unseen one).
+    The deterministic proof that counts track emissions on device."""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = _engine(params)
+    try:
+        ids: list[int] = []
+        orig = eng._consume_token
+
+        def spy(req, token_id):
+            if not req.finished:
+                ids.append(token_id)
+            orig(req, token_id)
+
+        eng._consume_token = spy
+        _generate(eng, "ban repeats", GenerationParams(
+            max_tokens=40, presence_penalty=1e4, **GREEDY))
+        assert len(ids) >= 10
+        assert len(ids) == len(set(ids)), ids
+    finally:
+        eng.shutdown()
+
+
+def test_repeat_penalty_changes_greedy_loop():
+    """Random-weight greedy decode settles into a short cycle; a
+    repeat_penalty > 1 must produce a different (de-looped) stream.
+    (The trained-model de-loop demonstration lives in
+    tests/test_trained_tiny.py.)"""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = _engine(params)
+    try:
+        ids_plain: list[int] = []
+        ids_pen: list[int] = []
+        orig = eng._consume_token
+
+        def make_spy(sink):
+            def spy(req, token_id):
+                if not req.finished:
+                    sink.append(token_id)
+                orig(req, token_id)
+            return spy
+
+        eng._consume_token = make_spy(ids_plain)
+        _generate(eng, "loop a lot", GenerationParams(
+            max_tokens=48, **GREEDY), request_id="plain")
+        eng._consume_token = make_spy(ids_pen)
+        _generate(eng, "loop a lot", GenerationParams(
+            max_tokens=48, repeat_penalty=1.5, **GREEDY),
+            request_id="pen")
+        # The unpenalised greedy stream repeats (random tiny weights
+        # cycle; deterministic for this seed on the CPU backend)...
+        assert len(set(ids_plain)) < len(ids_plain)
+        # ...and the penalty produces a different stream with strictly
+        # more distinct tokens.
+        assert ids_pen != ids_plain
+        assert len(set(ids_pen)) > len(set(ids_plain))
+    finally:
+        eng.shutdown()
+
+
+def test_penalties_spec_decode_greedy_parity():
+    """Speculative decoding remains exactly distribution-preserving
+    under penalties: the per-position incremental counts inside the
+    verify block replicate what plain decode would have counted."""
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    p = GenerationParams(max_tokens=48, repeat_penalty=1.3,
+                         presence_penalty=0.4, frequency_penalty=0.1,
+                         **GREEDY)
+    plain = _engine(params)
+    try:
+        ref, _ = _generate(plain, "the quick brown fox", p)
+    finally:
+        plain.shutdown()
+    spec = _engine(params, spec_decode="ngram", spec_draft_len=7)
+    try:
+        out, _ = _generate(spec, "the quick brown fox", p)
+    finally:
+        spec.shutdown()
+    assert out == ref
+
+
+def test_counts_reset_between_requests():
+    """Penalty counts are per-generation: a second request on the SAME
+    session (same slot, prefix reuse) must not inherit the first
+    request's counts — greedy output with a fresh deterministic prompt
+    is identical whether or not another generation ran before it."""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    p = GenerationParams(max_tokens=16, repeat_penalty=1.4, **GREEDY)
+    eng = _engine(params)
+    try:
+        first, _ = _generate(eng, "alpha", p, request_id="a1")
+    finally:
+        eng.shutdown()
+    eng2 = _engine(params)
+    try:
+        _generate(eng2, "other text entirely", GenerationParams(
+            max_tokens=24, presence_penalty=2.0, **GREEDY),
+            request_id="b1")
+        again, _ = _generate(eng2, "alpha", p, request_id="b2")
+    finally:
+        eng2.shutdown()
+    assert again == first
+
+
+def test_invalid_penalty_values_rejected():
+    """apply_penalties DIVIDES by repeat_penalty — a client-supplied 0,
+    negative, or NaN must raise at params construction (→ 400 on /v1,
+    error frame on the WS), never reach the sampler as inf logits."""
+    import math
+
+    import pytest
+
+    for bad in (0.0, -0.5, 2.5, float("nan"), float("inf")):
+        with pytest.raises(ValueError):
+            GenerationParams(repeat_penalty=bad)
+    for field in ("presence_penalty", "frequency_penalty"):
+        with pytest.raises(ValueError):
+            GenerationParams(**{field: float("nan")})
+    assert math.isfinite(GenerationParams(repeat_penalty=1.3).repeat_penalty)
+
+
+def test_openai_explicit_zero_penalty_is_400_not_default():
+    """{"repeat_penalty": 0} must 400, not be silently swapped for the
+    serving default by an `or` chain."""
+    from fasttalk_tpu.engine.fake import FakeEngine
+    from fasttalk_tpu.serving.server import WebSocketLLMServer
+    from tests.test_serving import make_config, make_ws_client
+
+    async def run():
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false")
+        engine = FakeEngine(delay_s=0.001)
+        engine.start()
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        try:
+            for bad_body in ({"repeat_penalty": 0},
+                             {"repetition_penalty": -1.0}):
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json={"model": "fake", "stream": False,
+                          "max_tokens": 4,
+                          "messages": [{"role": "user", "content": "x"}],
+                          **bad_body})
+                assert resp.status == 400, await resp.text()
+                body = await resp.json()
+                assert body["error"]["type"] == "invalid_request_error"
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_config_repeat_penalty_provider_default():
+    """Unset DEFAULT_REPEAT_PENALTY resolves per provider: 1.1 for the
+    in-tree engine and Ollama (the reference's engine-side default),
+    1.0 for vllm — strict OpenAI-compatible backends reject the
+    non-standard repetition_penalty param, so it must not be emitted
+    by default."""
+    from tests.test_serving import make_config
+
+    assert make_config(LLM_PROVIDER="fake").default_repeat_penalty == 1.1
+    assert make_config(LLM_PROVIDER="ollama").default_repeat_penalty == 1.1
+    assert make_config(LLM_PROVIDER="vllm").default_repeat_penalty == 1.0
+    assert make_config(LLM_PROVIDER="vllm",
+                       DEFAULT_REPEAT_PENALTY="1.2"
+                       ).default_repeat_penalty == 1.2
+
+
+def test_vllm_strict_backend_repetition_penalty_fallback():
+    """A strict OpenAI-compatible backend that 400s on the vLLM-only
+    repetition_penalty param: the engine drops the param (for its
+    lifetime) and retries, instead of failing every generation."""
+    import json as _json
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    from fasttalk_tpu.engine.remote import VLLMRemoteEngine
+
+    async def run():
+        saw_param = []
+
+        async def chat(request: web.Request) -> web.StreamResponse:
+            body = await request.json()
+            saw_param.append("repetition_penalty" in body)
+            if "repetition_penalty" in body:
+                return web.json_response(
+                    {"error": "unexpected keyword 'repetition_penalty'"},
+                    status=400)
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            chunk = {"choices": [{"delta": {"content": "ok"},
+                                  "finish_reason": "stop"}]}
+            await resp.write(
+                f"data: {_json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1")
+            eng.start()
+            msgs = [{"role": "user", "content": "x"}]
+            p = GenerationParams(repeat_penalty=1.1)
+            events = [ev async for ev in eng.generate("r1", "s1", msgs, p)]
+            assert events[-1]["type"] == "done"
+            # first attempt carried the param, the retry dropped it,
+            # and a second request never sends it again
+            events = [ev async for ev in eng.generate("r2", "s2", msgs, p)]
+            assert events[-1]["type"] == "done"
+            assert saw_param == [True, False, False]
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    asyncio.run(run())
+
+
+def test_ws_config_plumbs_penalties():
+    """WS start_session config carries the penalty knobs into
+    GenerationParams; absent, the serving default (1.1, matching the
+    Ollama engine-side default the reference relied on) applies."""
+    from fasttalk_tpu.engine.fake import FakeEngine
+    from fasttalk_tpu.serving.server import WebSocketLLMServer
+    from tests.test_serving import make_config, make_ws_client, recv_json
+
+    async def run():
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false")
+        engine = FakeEngine(delay_s=0.001)
+        engine.start()
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)  # session_started
+            await ws.send_json({"type": "start_session", "config": {
+                "repeat_penalty": 1.25, "presence_penalty": 0.5,
+                "frequency_penalty": 0.1}})
+            await recv_json(ws)  # session_configured
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            while (await recv_json(ws))["type"] != "response_complete":
+                pass
+            p = engine.requests_seen[0]["params"]
+            assert p.repeat_penalty == 1.25
+            assert p.presence_penalty == 0.5
+            assert p.frequency_penalty == 0.1
+            await ws.close()
+
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "start_session", "config": {}})
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            while (await recv_json(ws))["type"] != "response_complete":
+                pass
+            p = engine.requests_seen[1]["params"]
+            assert p.repeat_penalty == 1.1  # serving default
+            assert p.presence_penalty == 0.0
+            await ws.close()
+        finally:
+            await client.close()
+
+    asyncio.run(run())
