@@ -97,12 +97,18 @@ class GenerationParams:
     repeat_penalty: float = 1.0
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # vLLM-parity extension (SamplingParams.ignore_eos): decode to the
+    # token budget instead of stopping on EOS — fixed-length benching
+    # and forced continuation.
+    ignore_eos: bool = False
 
     def __post_init__(self) -> None:
         # Client-reachable values: apply_penalties DIVIDES by
         # repeat_penalty, so 0/negative/NaN would poison the whole
         # generation with inf logits rather than erroring. Raising here
-        # surfaces as a 400 on /v1 and an error frame on the WS.
+        # surfaces as a 400 on /v1 and an invalid_config error frame on
+        # the WS (caught before the circuit breaker — a client-shape
+        # error must not open the shared breaker, serving/server.py).
         import math
 
         if not (math.isfinite(self.repeat_penalty)
@@ -214,6 +220,7 @@ class TPUEngine(EngineBase):
                  steps_per_call: int = 8, pipeline_depth: int = 2,
                  sampling_method: str = "fast",
                  spec_decode: str = "off", spec_draft_len: int = 7,
+                 spec_breakeven: float = 1.45,
                  shared_prefix: bool = True):
         self.cfg = model_cfg
         self.params = params
@@ -256,11 +263,32 @@ class TPUEngine(EngineBase):
         # the residual distribution on mismatch). Device-side drafting
         # keeps the call pipeline intact: the host is never in the
         # draft loop, so spec calls pipeline exactly like plain ones.
+        #
+        # Modes: "ngram" = every call speculative; "auto" = the engine
+        # decides per call from its own measured acceptance — spec when
+        # the EMA tokens-per-verify clears the measured break-even
+        # (docs/SPEC_DECODE.md: a verify block costs ~1.43 plain steps
+        # on v5e), plain otherwise, with a periodic probe call so a
+        # workload shift (e.g. templated text arriving) is noticed.
+        # Auto never loses more than the probe overhead (~1 call in
+        # 16) and wins whenever drafts are being accepted — VERDICT r4
+        # #3's no-knob-guessing mode.
+        # Requires the scatter-decode path, and is disabled under the
+        # Pallas attention kernel: the verify block runs the XLA
+        # scatter forward regardless, and plain calls in spec modes use
+        # the history-maintaining scatter variant — mixing kernels per
+        # call is an untested matrix, so the explicit pallas knob wins.
+        spec_ok = self._scatter_decode and not self.use_pallas_attention
+        self.spec_mode = (spec_decode
+                          if spec_ok
+                          and spec_decode in ("ngram", "auto") else "off")
         self.spec_draft = (max(1, spec_draft_len)
-                           if spec_decode == "ngram"
-                           and self._scatter_decode else 0)
-        # EMA of tokens emitted per verify block, used to right-size the
-        # dispatcher's token promises (see _dispatch_decode).
+                           if self.spec_mode != "off" else 0)
+        self.spec_breakeven = spec_breakeven
+        self._spec_probe_every = 16
+        self._spec_probe_countdown = 1  # probe on the first call
+        # EMA of tokens emitted per verify block: sizes the dispatcher's
+        # token promises and drives the auto-mode decision.
         self._spec_ema = 1.0
         # Cross-session shared-prefix KV: a fresh admission whose prompt
         # starts with rows already resident in ANOTHER slot (the
@@ -568,13 +596,26 @@ class TPUEngine(EngineBase):
         inactive = self._put(np.zeros((self.num_slots,), bool))
         for b in decode_buckets:
             for steps in sorted({self.steps_burst, self.steps_per_call}):
-                fn = self._get_decode_fn(b, steps)
-                self.cache, self._counts_dev, toks, _, _, _ = fn(
-                    self.params, self.cache, self._counts_dev,
-                    self._cur_tokens, self._positions_dev, inactive,
-                    self._temps_dev, self._topks_dev, self._topps_dev,
-                    self._reps_dev, self._press_dev, self._freqs_dev,
-                    self._rng_dev)
+                if self.spec_draft:
+                    # Spec modes dispatch the history-maintaining plain
+                    # variant (the no-history one is never used).
+                    fn = self._get_decode_fn(b, steps, with_history=True)
+                    (self.cache, self._history_dev, self._counts_dev,
+                     toks, _, _, _) = fn(
+                        self.params, self.cache, self._history_dev,
+                        self._counts_dev, self._cur_tokens,
+                        self._positions_dev, inactive, self._temps_dev,
+                        self._topks_dev, self._topps_dev,
+                        self._reps_dev, self._press_dev,
+                        self._freqs_dev, self._rng_dev)
+                else:
+                    fn = self._get_decode_fn(b, steps)
+                    self.cache, self._counts_dev, toks, _, _, _ = fn(
+                        self.params, self.cache, self._counts_dev,
+                        self._cur_tokens, self._positions_dev, inactive,
+                        self._temps_dev, self._topks_dev,
+                        self._topps_dev, self._reps_dev,
+                        self._press_dev, self._freqs_dev, self._rng_dev)
                 jax.block_until_ready(toks)
                 if self.spec_draft:
                     # All-inactive spec warmup: every write masks out.
@@ -774,10 +815,23 @@ class TPUEngine(EngineBase):
         explicit replicated placement is required."""
         return arr if self.mesh is None else self._put(arr)
 
-    def _get_decode_fn(self, kv_len: int, steps: int | None = None):
+    def _replicate_sharding(self):
+        """Fully-replicated NamedSharding on the mesh (None when single
+        device): constrains host-fetched program outputs so every host
+        of a multi-process (DCN) mesh can read them."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _get_decode_fn(self, kv_len: int, steps: int | None = None,
+                       with_history: bool = False):
         """K decode steps in one jitted call (K = ``steps``, default
         steps_per_call; the dispatcher also compiles the short
         ``steps_burst`` variant for admission-latency-sensitive moments).
+        ``with_history`` (auto-spec mode) additionally maintains the
+        speculative history buffer so probe calls draft from fresh text.
 
         The whole per-slot decode state is threaded through the call so
         nothing round-trips to the host between steps: carry = (sliced
@@ -787,12 +841,53 @@ class TPUEngine(EngineBase):
         serialised device and host work).
         """
         steps = self.steps_per_call if steps is None else steps
-        fn = self._decode_fns.get((kv_len, steps))
+        fn = self._decode_fns.get((kv_len, steps, with_history))
         if fn is not None:
             return fn
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
         scatter = self._scatter_decode and not use_pallas
         rows = jnp.arange(self.num_slots)
+        max_len = self.max_len
+        replicate = self._replicate_sharding()
+
+        if with_history:
+            # Auto-spec plain call: identical decode, plus maintaining
+            # the spec history invariant (history[s, pos] = fed token)
+            # so a later probe/spec call drafts from fresh text.
+            assert scatter
+
+            @partial(jax.jit, donate_argnums=(1, 2, 3))
+            def decode_call_hist(params, cache: KVCache, history, counts,
+                                 cur_tokens, positions, active, temps,
+                                 topks, topps, reps, press, freqs, rng):
+                def step(carry, _):
+                    ck, cv, hist, cnt, cur, pos, key = carry
+                    key, sub = jax.random.split(key)
+                    act = jnp.logical_and(active, pos < kv_len)
+                    wp = jnp.where(act, pos, max_len)
+                    hist = hist.at[rows, wp].set(cur, mode="drop",
+                                                 unique_indices=True)
+                    cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                                unique_indices=True)
+                    logits, newc = forward_decode(
+                        params, self.cfg, cur, pos, KVCache(ck, cv), act,
+                        attn_len=kv_len,
+                        pallas_int8=self.use_pallas_int8)
+                    lg = apply_penalties(logits[:, :self.sample_vocab],
+                                         cnt, reps, press, freqs)
+                    nxt = sample_tokens(lg, sub, temps, topks, topps,
+                                        method=self.sampling_method)
+                    pos = pos + act.astype(pos.dtype)
+                    return (newc.k, newc.v, hist, cnt, nxt, pos, key), nxt
+
+                (ck, cv, hist, cnt, cur, pos, rng), toks = jax.lax.scan(
+                    step, (cache.k, cache.v, history, counts, cur_tokens,
+                           positions, rng), None, length=steps)
+                return KVCache(ck, cv), hist, cnt, toks, cur, pos, rng
+
+            self._decode_fns[(kv_len, steps, with_history)] = \
+                decode_call_hist
+            return decode_call_hist
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_call(params, cache: KVCache, counts, cur_tokens,
@@ -856,9 +951,15 @@ class TPUEngine(EngineBase):
                 cache.k, ck, 0, axis=2)
             new_v = jax.lax.dynamic_update_slice_in_dim(
                 cache.v, cv, 0, axis=2)
+            # Sampled tokens leave the program fully replicated: on a
+            # multi-host (DCN) mesh a host can only fetch an array whose
+            # addressable shards cover it — and [K, S] ints are nothing
+            # next to the batch all-reduces GSPMD already inserted.
+            if replicate is not None:
+                toks = jax.lax.with_sharding_constraint(toks, replicate)
             return KVCache(new_k, new_v), cnt, toks, cur, pos, rng
 
-        self._decode_fns[(kv_len, steps)] = decode_call
+        self._decode_fns[(kv_len, steps, with_history)] = decode_call
         return decode_call
 
     def _get_spec_decode_fn(self, kv_len: int, steps: int):
@@ -1082,6 +1183,70 @@ class TPUEngine(EngineBase):
         self._prefill_fns[chunk] = prefill_step
         return prefill_step
 
+    def _ring_prefill_eligible(self, start: int, n_tokens: int) -> int:
+        """If this fresh prompt should prefill through ring attention,
+        return its (power-of-two) bucket; else 0.
+
+        Eligible when the engine runs on a mesh with sp > 1, the prompt
+        starts a fresh slot (ring attention is pure self-attention —
+        a non-zero start would need cache rows the ring never visits),
+        and it is long enough that one chip's attention working set is
+        the thing to avoid (>= max_len/sp — the per-chip KV shard; the
+        module's O(T/sp) memory promise, parallel/ring_attention.py).
+        """
+        if self.mesh is None or start != 0:
+            return 0
+        sp = self.mesh.shape.get("sp", 1)
+        if sp <= 1 or n_tokens < max(256, self.max_len // sp):
+            return 0
+        bucket = 1 << (n_tokens - 1).bit_length()  # next power of two
+        bucket = max(bucket, 2 * sp)
+        if bucket > self.max_len or bucket % sp:
+            return 0
+        return bucket
+
+    def _get_ring_prefill_fn(self, bucket: int):
+        """Whole-prompt prefill for ONE slot with attention routed
+        through parallel.ring_attention (VERDICT r4 #4): Q/K/V stay
+        sequence-sharded over "sp" and K/V blocks rotate the ICI ring,
+        so per-chip attention memory is O(T/sp) — where the default
+        GSPMD lowering all-gathers K/V per chip. K/V are also written
+        into the slot's (sp-sharded) cache rows, so decode attends the
+        exact rows the ring produced. Single call for the full
+        (bucketed) prompt — chunked prefill cannot ride the ring, since
+        a later chunk attends cache rows the rotation never visits."""
+        key = ("ring", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        from fasttalk_tpu.parallel.train import ring_override
+
+        ring = ring_override(self.mesh)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def ring_prefill(params, cache: KVCache, tokens, slot,
+                         last_index):
+            slot_shape = (self.cfg.num_layers, 1, self.max_len,
+                          self.cfg.num_kv_heads, self.cfg.head_dim)
+            lk = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0),
+                                       slot_shape)
+            lv = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
+                                       slot_shape)
+            positions = jnp.arange(bucket)[None, :]
+            logits, updated = forward(
+                params, self.cfg, tokens[None, :], positions,
+                KVCache(lk, lv), jnp.zeros((1,), jnp.int32),
+                attn_override=ring, override_write=True,
+                logits_indices=last_index[None])
+            new_k = jax.lax.dynamic_update_slice(
+                cache.k, updated.k, (0, slot, 0, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(
+                cache.v, updated.v, (0, slot, 0, 0, 0))
+            return KVCache(new_k, new_v), logits[0, 0]
+
+        self._prefill_fns[key] = ring_prefill
+        return ring_prefill
+
     def _get_batched_prefill_fn(self, chunk: int, group: int, ctx: int):
         """One prompt chunk for ``group`` slots at once.
 
@@ -1105,6 +1270,7 @@ class TPUEngine(EngineBase):
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
+        replicate = self._replicate_sharding()
 
         @partial(jax.jit, donate_argnums=(1,))
         def batched_prefill(params, cache: KVCache, tokens, rowcfg,
@@ -1135,6 +1301,9 @@ class TPUEngine(EngineBase):
                                    temps, topks, topps,
                                    method=self.sampling_method)
             new_cur = cur.at[slot_idx].set(firsts, mode="drop")
+            if replicate is not None:  # host-fetched on every DCN host
+                firsts = jax.lax.with_sharding_constraint(firsts,
+                                                          replicate)
             return KVCache(new_k, new_v), firsts, new_cur, rng
 
         self._prefill_fns[key] = batched_prefill
@@ -1177,6 +1346,8 @@ class TPUEngine(EngineBase):
         scatter it into the current-token vector — one program, no
         eager ops."""
         if self._sample_place_fn is None:
+            replicate = self._replicate_sharding()
+
             @jax.jit
             def sample_place(last_logits, cur, rng, cfg_row):
                 slot = cfg_row[0].astype(jnp.int32)
@@ -1186,6 +1357,9 @@ class TPUEngine(EngineBase):
                     cfg_row[1][None],
                     cfg_row[2].astype(jnp.int32)[None], cfg_row[3][None],
                     method=self.sampling_method)
+                if replicate is not None:
+                    first = jax.lax.with_sharding_constraint(first,
+                                                             replicate)
                 return first, cur.at[slot].set(first[0], mode="drop"), rng
 
             self._sample_place_fn = sample_place
@@ -1395,9 +1569,14 @@ class TPUEngine(EngineBase):
             bucket = next((b for b in _PREFILL_BUCKETS if b >= len(todo)),
                           None)
             if bucket is not None and len(todo) <= allowed \
-                    and reused + bucket <= self.max_len:
+                    and reused + bucket <= self.max_len \
+                    and not self._ring_prefill_eligible(reused,
+                                                        len(todo)):
                 batch.append((req, slot, reused, todo))
             else:
+                # Long prompts — and, on an sp>1 mesh, fresh prompts
+                # past one chip's KV shard (ring-eligible) — go through
+                # _advance_prefill.
                 self._prefilling.append(
                     _PrefillState(req=req, slot=slot, start=reused,
                                   todo=todo))
@@ -1426,33 +1605,52 @@ class TPUEngine(EngineBase):
         st = self._prefilling[0]
         req, slot = st.req, st.slot
         try:
-            take = min(len(st.todo), self.prefill_chunk)
-            bucket = next(b for b in _PREFILL_BUCKETS if b >= take)
-            # A padded bucket must not extend past the cache end —
-            # dynamic_update_slice would clamp the start and corrupt
-            # earlier rows. Shrink the chunk until its bucket fits.
-            while st.start + bucket > self.max_len and take > 1:
-                bucket //= 2
-                take = min(take, bucket)
-            if st.start + bucket > self.max_len:
-                self._prefilling.pop(0)
-                self._finish(req, "error",
-                             error="KV cache exhausted during prefill")
-                return
-            chunk = st.todo[:take]
-            padded = np.zeros((bucket,), np.int32)
-            padded[:take] = chunk
-            fn = self._get_prefill_fn(bucket)
-            # numpy scalars, not jnp ones: each eager jnp scalar is its
-            # own device round trip on relayed backends.
-            self.cache, st.last_logits = fn(
-                self.params, self.cache, self._arg(padded),
-                np.int32(st.start), np.int32(slot.index),
-                np.int32(take - 1))
-            slot.tokens.extend(chunk)
-            st.start += take
-            slot.kv_written = st.start
-            st.todo = st.todo[take:]
+            ring_bucket = self._ring_prefill_eligible(st.start,
+                                                      len(st.todo))
+            if ring_bucket:
+                # Whole prompt in ONE ring-attention call: per-chip
+                # attention memory O(T/sp) instead of the all-gather
+                # form (see _get_ring_prefill_fn).
+                n = len(st.todo)
+                padded = np.zeros((ring_bucket,), np.int32)
+                padded[:n] = st.todo
+                fn = self._get_ring_prefill_fn(ring_bucket)
+                self.cache, st.last_logits = fn(
+                    self.params, self.cache, self._arg(padded),
+                    np.int32(slot.index), np.int32(n - 1))
+                slot.tokens.extend(st.todo)
+                st.start = n
+                slot.kv_written = n
+                st.todo = []
+            else:
+                take = min(len(st.todo), self.prefill_chunk)
+                bucket = next(b for b in _PREFILL_BUCKETS if b >= take)
+                # A padded bucket must not extend past the cache end —
+                # dynamic_update_slice would clamp the start and corrupt
+                # earlier rows. Shrink the chunk until its bucket fits.
+                while st.start + bucket > self.max_len and take > 1:
+                    bucket //= 2
+                    take = min(take, bucket)
+                if st.start + bucket > self.max_len:
+                    self._prefilling.pop(0)
+                    self._finish(req, "error",
+                                 error="KV cache exhausted during "
+                                       "prefill")
+                    return
+                chunk = st.todo[:take]
+                padded = np.zeros((bucket,), np.int32)
+                padded[:take] = chunk
+                fn = self._get_prefill_fn(bucket)
+                # numpy scalars, not jnp ones: each eager jnp scalar is
+                # its own device round trip on relayed backends.
+                self.cache, st.last_logits = fn(
+                    self.params, self.cache, self._arg(padded),
+                    np.int32(st.start), np.int32(slot.index),
+                    np.int32(take - 1))
+                slot.tokens.extend(chunk)
+                st.start += take
+                slot.kv_written = st.start
+                st.todo = st.todo[take:]
             if st.todo:
                 return  # next chunk on a later iteration
             self._prefilling.pop(0)
@@ -1753,6 +1951,24 @@ class TPUEngine(EngineBase):
                 self._topps_dev, self._reps_dev, self._press_dev,
                 self._freqs_dev)
 
+    def _spec_call_wanted(self) -> bool:
+        """Per-call speculative/plain decision. "ngram": always spec.
+        "auto": spec while the measured EMA tokens-per-verify clears
+        the break-even (a verify block costs ~spec_breakeven plain
+        steps); below it, plain calls with a periodic probe so the EMA
+        tracks workload shifts — acceptance recovers (templated or
+        repetitive text arrives) and auto re-engages within one probe
+        period."""
+        if self.spec_mode == "ngram":
+            return True
+        if self._spec_ema >= self.spec_breakeven:
+            return True
+        self._spec_probe_countdown -= 1
+        if self._spec_probe_countdown <= 0:
+            self._spec_probe_countdown = self._spec_probe_every
+            return True
+        return False
+
     def _dispatch_decode(self) -> None:
         """Launch one K-step decode call; does not wait for results."""
         self._patch_slot_state()
@@ -1772,7 +1988,7 @@ class TPUEngine(EngineBase):
         base = int(self._positions[active].max()) \
             + sum(adv for _, _, adv, _ in self._inflight)
         T = self.spec_draft + 1
-        if self.spec_draft:
+        if self.spec_draft and self._spec_call_wanted():
             # Size the KV bucket by the EMA-EXPECTED advance (+1 block
             # of headroom), not the K*T worst case: worst-case sizing
             # jumped to the next bucket immediately — a mid-stream
@@ -1819,6 +2035,22 @@ class TPUEngine(EngineBase):
         max_pos = base + steps
         kv_len = next((b for b in _KV_BUCKETS
                        if b >= max_pos and b <= self.max_len), self.max_len)
+        if self.spec_draft:
+            # Auto mode chose plain for this call (or the spec bucket
+            # check fell through): keep the draft history fresh so the
+            # next probe drafts from current text, not stale history.
+            fn = self._get_decode_fn(kv_len, steps, with_history=True)
+            (self.cache, self._history_dev, self._counts_dev, toks,
+             self._cur_tokens, self._positions_dev, self._rng_dev) = fn(
+                self.params, self.cache, self._history_dev,
+                self._counts_dev, self._cur_tokens, self._positions_dev,
+                self._active_dev, self._temps_dev, self._topks_dev,
+                self._topps_dev, self._reps_dev, self._press_dev,
+                self._freqs_dev, self._rng_dev)
+            self._inflight.append(
+                (self._fetch_pool.submit(np.asarray, toks), steps, steps,
+                 snapshot))
+            return
         fn = self._get_decode_fn(kv_len, steps)
         (self.cache, self._counts_dev, toks, self._cur_tokens,
          self._positions_dev, self._rng_dev) = fn(
@@ -1893,7 +2125,8 @@ class TPUEngine(EngineBase):
         if req.cancelled:
             self._finish(req, "cancelled")
             return
-        if token_id in self.tokenizer.eos_ids:
+        if token_id in self.tokenizer.eos_ids \
+                and not req.params.ignore_eos:
             self._finish(req, "stop")
             return
         slot = req.slot

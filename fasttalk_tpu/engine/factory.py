@@ -245,5 +245,6 @@ def build_engine(cfg: Config) -> EngineBase:
         sampling_method=cfg.sampling,
         spec_decode=cfg.spec_decode,
         spec_draft_len=cfg.spec_draft_len,
+        spec_breakeven=cfg.spec_breakeven,
         shared_prefix=cfg.shared_prefix)
     return engine

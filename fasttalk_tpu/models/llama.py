@@ -127,6 +127,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             pallas_int8: bool = False,
             logits_indices: jnp.ndarray | None = None,
             attn_override: Any = None,
+            override_write: bool = False,
             ) -> tuple[jnp.ndarray, KVCache]:
     """Run the transformer over ``tokens`` [B, T], updating the cache.
 
@@ -147,11 +148,14 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     ``attn_override`` (optional): ``fn(q, k, v, positions) -> o`` over
     the freshly computed q/k/v of the whole block, replacing the
-    cache-read attention — the full-self-attention training regime
-    (T == the whole sequence, cache unused). This is how
-    parallel/ring_attention.py plugs in: K/V rotate over the "sp" ICI
-    ring instead of being all-gathered, so per-chip sequence memory is
-    O(T/sp). Cache writes are skipped (the override owns the K/V).
+    cache-read attention — the full-self-attention regime (T == the
+    whole sequence). This is how parallel/ring_attention.py plugs in:
+    K/V rotate over the "sp" ICI ring instead of being all-gathered,
+    so per-chip sequence memory is O(T/sp). Cache writes are skipped
+    by default (training passes a dummy cache); ``override_write=True``
+    additionally writes the fresh K/V into the cache — the serving
+    ring-prefill regime, where decode must later read what the ring
+    attended over.
 
     Returns (logits [B, T, vocab], updated cache). (The decode hot path
     is ``forward_decode`` below — scatter cache writes + bounded
@@ -182,6 +186,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         if attn_override is not None:
+            if override_write:
+                ck = _write_kv(ck, k, write_start, write_mask)
+                cv = _write_kv(cv, v, write_start, write_mask)
             o = attn_override(q, k, v, positions)
         else:
             ck = _write_kv(ck, k, write_start, write_mask)

@@ -57,6 +57,13 @@ def cache_meta(cfg: ModelConfig, dtype, quantize: bool, mesh,
         "dtype": jnp.dtype(dtype).name,
         "quantize": "int8" if quantize else "none",
         "mesh": dict(mesh.shape) if mesh is not None else None,
+        # Device topology: Orbax sharding metadata references concrete
+        # device names, and restoring under a different topology (e.g.
+        # a store written on 1 CPU device read under a forced 8-device
+        # CPU mesh) spews ERROR-level device-not-found records from
+        # orbax internals even when the fallback succeeds. A topology
+        # mismatch skips the cache and re-transforms instead.
+        "devices": [jax.devices()[0].platform, jax.device_count()],
         "source": checkpoint_fingerprint(ckpt_dir),
     }
 

@@ -227,6 +227,7 @@ def register_openai_routes(app: web.Application,
                 else body["repetition_penalty"]
                 if "repetition_penalty" in body
                 else defaults.get("repeat_penalty", 1.0)),
+            ignore_eos=bool(body.get("ignore_eos", False)),
         )
 
     def _breaker_503() -> web.Response | None:

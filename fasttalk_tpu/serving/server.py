@@ -379,10 +379,22 @@ class WebSocketLLMServer:
         tts = bool(state.gen_config.get("tts_chunking")) if state else False
         tts_buffer = ""
         try:
+            # Params validation BEFORE touching the breaker: a client
+            # that stored an invalid generation config (e.g.
+            # repeat_penalty 0) is a client-shape error — it must not
+            # count as a backend failure, or one misconfigured client
+            # would open the shared breaker for every session (the /v1
+            # route draws the same line with _BadRequest → 400).
+            try:
+                params = self._gen_params(session_id)
+            except (TypeError, ValueError) as e:
+                self.connection_manager.record_error(session_id)
+                await self._send_error(session_id, ws, "invalid_config",
+                                       str(e))
+                return
             self.breaker.check()
             messages = self.conversation_manager.get_messages_for_generation(
                 session_id)
-            params = self._gen_params(session_id)
             if self.agent is not None:
                 stream = self.agent.generate(request_id, session_id,
                                              messages, params)

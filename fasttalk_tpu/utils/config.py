@@ -232,19 +232,29 @@ class Config:
     # prefill load at high concurrency (single-device path).
     shared_prefix: bool = field(
         default_factory=lambda: _env_bool("TPU_SHARED_PREFIX", True))
-    # Speculative decoding: "off" | "ngram" (self-drafting prompt-lookup
-    # — draft from the slot's own token history on-device, verify
-    # draft+1 positions in one scatter-decode block, accept the longest
-    # sampled-equal prefix; exactly distribution-preserving, see
-    # engine/engine.py _get_spec_decode_fn). Worthwhile on repetitive /
-    # structured generations (code, extraction, long-form with entity
-    # reuse); neutral-to-slightly-negative on incompressible text, so
-    # opt-in. Single-device scatter path only.
+    # Speculative decoding: "off" | "ngram" | "auto". "ngram" is the
+    # always-on self-drafting prompt-lookup (draft from the slot's own
+    # token history on-device, verify draft+1 positions in one
+    # scatter-decode block, accept the longest sampled-equal prefix;
+    # exactly distribution-preserving, see engine/engine.py
+    # _get_spec_decode_fn) — worthwhile on repetitive/structured text,
+    # a measured ~25% regression on incompressible sampled text
+    # (docs/SPEC_DECODE.md). "auto" (default) makes that call per
+    # decode call from the engine's own measured acceptance EMA vs the
+    # break-even (TPU_SPEC_BREAKEVEN, default 1.45 plain-step
+    # equivalents per verify block), probing periodically — no knob
+    # guessing, bounded downside (~1 probe call in 16). Single-device
+    # scatter path only; the mesh path always decodes plain.
     spec_decode: str = field(
-        default_factory=lambda: _env_str("TPU_SPEC_DECODE", "off"))
+        default_factory=lambda: _env_str("TPU_SPEC_DECODE", "auto"))
     # Draft tokens proposed per verify block (block = draft + 1).
     spec_draft_len: int = field(
         default_factory=lambda: _env_int("TPU_SPEC_DRAFT", 7))
+    # Auto-mode enable threshold: EMA tokens-per-verify-block above
+    # which speculative calls win (a verify block costs ~1.43 plain
+    # steps on v5e — docs/SPEC_DECODE.md).
+    spec_breakeven: float = field(
+        default_factory=lambda: _env_float("TPU_SPEC_BREAKEVEN", 1.45))
     # Token sampling candidate preselection: "fast" (block-max, the
     # approx_max_k algorithm — greedy rows stay exact, measured 2.4x
     # cheaper than the full-vocab sort which was ~54% of a decode step)
@@ -312,11 +322,14 @@ class Config:
             errs.append("tp_size and dp_size must be >= 1")
         if self.decode_steps_per_call <= 0:
             errs.append("decode_steps_per_call must be >= 1")
-        if self.spec_decode not in ("off", "ngram"):
+        if self.spec_decode not in ("off", "ngram", "auto"):
             errs.append(
-                f"spec_decode must be off|ngram, got {self.spec_decode!r}")
+                f"spec_decode must be off|ngram|auto, "
+                f"got {self.spec_decode!r}")
         if self.spec_decode != "off" and not 1 <= self.spec_draft_len <= 31:
             errs.append("spec_draft_len must be in 1..31")
+        if self.spec_breakeven <= 0:
+            errs.append("spec_breakeven must be > 0")
         if self.pipeline_depth <= 0:
             errs.append("pipeline_depth must be >= 1")
         if self.sampling not in ("fast", "exact"):

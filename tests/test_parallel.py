@@ -244,6 +244,52 @@ def test_tp_engine_end_to_end_matches_single_device():
     assert single and single == sharded
 
 
+def test_ring_prefill_serving_long_prompt_matches_single_device():
+    """VERDICT r4 #4: on an sp>1 mesh, a fresh prompt LONGER than one
+    chip's KV shard (max_len/sp) prefills through ring attention —
+    parallel.ring_attention rotating K/V over the ring, O(T/sp)
+    per-chip attention memory — writes the slot's (sp-sharded) KV, and
+    the whole generation stays greedy-identical to the single-device
+    engine. Also asserts the ring path actually engaged (the compiled
+    ring executable exists), so a silently-degraded fallback cannot
+    fake parity."""
+    import asyncio
+
+    from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+    from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+
+    cfg = get_model_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    # ~350 byte-tokens: longer than the sp=2 engine's 256-row KV shard.
+    long_text = " ".join(f"w{i}" for i in range(110))
+    msgs = [{"role": "user", "content": long_text}]
+    gen = GenerationParams(temperature=0.0, top_k=0, top_p=1.0,
+                           max_tokens=8)
+
+    def run_engine(mesh):
+        eng = TPUEngine(cfg, params, ByteTokenizer(), num_slots=2,
+                        max_len=512, prefill_chunk=64, dtype=jnp.float32,
+                        mesh=mesh)
+        eng.start()
+
+        async def collect():
+            text = []
+            async for ev in eng.generate("r", "s", msgs, gen):
+                text.append(ev.get("text", ""))
+            return "".join(text)
+
+        try:
+            return asyncio.run(collect()), eng
+        finally:
+            eng.shutdown()
+
+    single, _ = run_engine(None)
+    sharded, eng = run_engine(make_mesh(sp=2, tp=2))
+    assert single and single == sharded
+    assert any(isinstance(k, tuple) and k and k[0] == "ring"
+               for k in eng._prefill_fns), "ring prefill never engaged"
+
+
 def test_validate_mesh_named_errors():
     from fasttalk_tpu.parallel.sharding import validate_mesh
 

@@ -332,6 +332,49 @@ def test_vllm_strict_backend_repetition_penalty_fallback():
     asyncio.run(run())
 
 
+def test_ws_invalid_penalty_is_client_error_not_breaker_failure():
+    """A stored invalid generation config (repeat_penalty 0) errors as
+    invalid_config on every user_message WITHOUT counting against the
+    shared circuit breaker — one misconfigured client must not open the
+    breaker for all sessions."""
+    from fasttalk_tpu.engine.fake import FakeEngine
+    from fasttalk_tpu.serving.server import WebSocketLLMServer
+    from tests.test_serving import make_config, make_ws_client, recv_json
+
+    async def run():
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false")
+        engine = FakeEngine(delay_s=0.001)
+        engine.start()
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "start_session", "config": {
+                "repeat_penalty": 0}})
+            await recv_json(ws)  # session_configured (stored as-is)
+            for _ in range(8):  # past the breaker failure threshold
+                await ws.send_json({"type": "user_message", "text": "x"})
+                err = await recv_json(ws)
+                assert err["type"] == "error", err
+                assert err["error"]["code"] == "invalid_config", err
+            assert server.breaker.to_dict()["state"] == "closed", \
+                server.breaker.to_dict()
+            # a well-configured request on the same server still serves
+            await ws.send_json({"type": "update_config", "config": {
+                "repeat_penalty": 1.1}})
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "ok"})
+            while (await recv_json(ws))["type"] != "response_complete":
+                pass
+            await ws.close()
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
 def test_ws_config_plumbs_penalties():
     """WS start_session config carries the penalty knobs into
     GenerationParams; absent, the serving default (1.1, matching the
