@@ -28,7 +28,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PORT = int(os.environ.get("BENCH_PORT", "18651"))
-TURNS = [
+TURNS = json.loads(os.environ.get("DEMO_TURNS", "null")) or [
     "Hi! In one sentence, what does a systolic array do?",
     "And why does that favour large batched matmuls?",
 ]
