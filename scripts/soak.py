@@ -9,13 +9,20 @@ end: zero client-observed errors, zero ERROR/CRITICAL log records,
 /health healthy, engine queues drained (with a settle window for
 in-flight cleanup), and a clean request still serves end to end.
 
-Assumes device-class generation speed (the client mix is sized for a
-real chip): on the ~0.5 tok/s virtual CPU mesh the offered load
-saturates every slot, the circuit breaker opens — correctly — and the
-no-backoff clients tally its rejections as errors. Use
-tests/test_parallel.py + the mesh concurrency checks for that path.
+Two profiles:
 
-Usage: python scripts/soak.py [seconds] (default 120)
+- ``device`` (default): the client mix is sized for a real chip. On
+  the slow CPU backend this offered load saturates every slot, the
+  circuit breaker opens — correctly — and the no-backoff clients tally
+  its rejections as errors, so it cannot run in CI.
+- ``ci`` (VERDICT r4 #7): slots-and-rate-scaled for the CPU backend —
+  fewer clients, tiny budgets, the committed tinychat checkpoint
+  (fast on CPU), short prompts. The same churn behaviors (cancel,
+  TCP abort, config updates, clean ends) and the same zero-error
+  invariants, runnable every round via tests/test_soak_ci.py instead
+  of once per hardware session.
+
+Usage: python scripts/soak.py [seconds] [ci|device]
 """
 
 from __future__ import annotations
@@ -32,8 +39,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PORT = int(os.environ.get("BENCH_PORT", "18663"))
 DURATION = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
-CLIENTS = 12
-PERSONA = ("You are a terse ops assistant. Answer in one sentence. " * 30)
+PROFILE = (sys.argv[2] if len(sys.argv) > 2
+           else os.environ.get("SOAK_PROFILE", "device"))
+CI = PROFILE == "ci"
+CLIENTS = 4 if CI else 12
+MAX_TOKENS_CHOICES = [2, 4, 8] if CI else [4, 16, 48, 96]
+LONG_FACTORS = [1, 1, 1, 4] if CI else [1, 1, 1, 40]
+PERSONA = ("You are a terse ops assistant. Answer in one sentence. "
+           * (4 if CI else 30))
 
 STATS = {"completed": 0, "cancelled": 0, "aborted": 0, "closed": 0,
          "errors": 0, "config_updates": 0, "tokens": 0}
@@ -45,7 +58,15 @@ class _ErrorCounter(logging.Handler):
         self.records: list[str] = []
 
     def emit(self, record):
-        self.records.append(record.getMessage())
+        msg = record.getMessage()
+        if "was not found in jax.local_devices" in msg:
+            # Known jax/orbax-internal noise, not a framework failure:
+            # a persistent-cache entry written under a different device
+            # topology logs this ERROR and falls back to a fresh
+            # compile/load. Matched on the message (not the logger) so
+            # every OTHER jax-side ERROR still fails the soak.
+            return
+        self.records.append(msg)
 
 
 def _abort_transport(ws) -> None:
@@ -68,7 +89,7 @@ async def client_loop(http, cid: int, deadline: float) -> None:
                     heartbeat=30) as ws:
                 msg = json.loads((await ws.receive()).data)
                 assert msg["type"] == "session_started", msg
-                cfg = {"max_tokens": rng.choice([4, 16, 48, 96]),
+                cfg = {"max_tokens": rng.choice(MAX_TOKENS_CHOICES),
                        "temperature": rng.choice([0.0, 0.7, 1.2])}
                 if rng.random() < 0.5:
                     cfg["system_prompt"] = PERSONA
@@ -79,7 +100,7 @@ async def client_loop(http, cid: int, deadline: float) -> None:
                     if time.monotonic() >= deadline:
                         break
                     text = ("tell me everything about everything " *
-                            rng.choice([1, 1, 1, 40]))
+                            rng.choice(LONG_FACTORS))
                     await ws.send_json({"type": "user_message",
                                         "text": f"[{cid}] {text}"})
                     fate = rng.random()
@@ -140,15 +161,25 @@ async def main() -> None:
     errors = _ErrorCounter()
     logging.getLogger().addHandler(errors)
 
-    cfg = Config(llm_provider="tpu",
-                 model_name=os.environ.get("LLM_MODEL", "llama3.2:1b"),
-                 decode_slots=16, max_model_len=2048,
-                 default_context_window=2048, port=PORT,
-                 monitoring_port=PORT + 1,
-                 quantize=os.environ.get("TPU_QUANTIZE", "int8"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if CI:
+        cfg = Config(llm_provider="tpu", model_name="tinychat",
+                     model_path=os.path.join(repo, "fasttalk_tpu",
+                                             "assets"),
+                     decode_slots=8, max_model_len=1024,
+                     default_context_window=1024, port=PORT,
+                     monitoring_port=PORT + 1, quantize="none")
+    else:
+        cfg = Config(llm_provider="tpu",
+                     model_name=os.environ.get("LLM_MODEL",
+                                               "llama3.2:1b"),
+                     decode_slots=16, max_model_len=2048,
+                     default_context_window=2048, port=PORT,
+                     monitoring_port=PORT + 1,
+                     quantize=os.environ.get("TPU_QUANTIZE", "int8"))
     engine, runner = await start_local_server(cfg, warmup="fast")
-    print(f"soaking {DURATION:.0f}s with {CLIENTS} churning clients...",
-          file=sys.stderr)
+    print(f"soaking {DURATION:.0f}s ({PROFILE} profile) with "
+          f"{CLIENTS} churning clients...", file=sys.stderr)
     deadline = time.monotonic() + DURATION
     try:
         async with aiohttp.ClientSession() as http:
@@ -179,8 +210,11 @@ async def main() -> None:
                 await ws.send_json({"type": "start_session",
                                     "config": {"max_tokens": 8}})
                 await ws.receive()
+                # "hello" is in-distribution for the ci profile's
+                # trained tinychat (an OOD prompt can legally answer
+                # with an immediate EOS and zero text tokens).
                 await ws.send_json({"type": "user_message",
-                                    "text": "final sanity"})
+                                    "text": "hello"})
                 got_tokens = 0
                 while True:
                     m = json.loads((await asyncio.wait_for(

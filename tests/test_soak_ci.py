@@ -1,0 +1,26 @@
+"""CI churn soak (VERDICT r4 #7): the scripts/soak.py adversarial
+session mix — cancels, mid-stream TCP aborts, config updates, clean
+ends — scaled to the CPU backend (``ci`` profile: fewer clients, tiny
+budgets, the committed tinychat checkpoint) so churn regressions are
+caught every round, not once per hardware session. Same invariants as
+the device soak: zero client-observed errors, zero ERROR-level log
+records, queues drained, a clean request still serves afterwards.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ci_soak_profile_runs_clean():
+    env = dict(os.environ, BENCH_PORT="18781")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "soak.py"),
+         "15", "ci"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    assert "SOAK OK" in proc.stdout, proc.stdout[-2000:]
