@@ -374,7 +374,7 @@ class TPUEngine(EngineBase):
         self._prefill_fns: dict[int, Any] = {}
         self._spec_fns: dict[tuple, Any] = {}
         self._patch_fn: Any = None
-        self._hist_patch_fn: Any = None
+        self._hist_patch_fns: dict[int, Any] = {}
         self._sample_place_fn: Any = None
 
         m = get_metrics()
@@ -636,11 +636,14 @@ class TPUEngine(EngineBase):
                     jax.block_until_ready(toks)
         if self.spec_draft:
             # The admission-path history upload (slot indices out of
-            # range: every row drops).
-            self._history_dev = self._get_hist_patch_fn()(
+            # range: every row drops). 256 is the common chat-prompt
+            # row bucket; longer prompts compile their bucket on first
+            # use (a tiny pad+scatter program).
+            self._history_dev = self._get_hist_patch_fn(
+                min(256, self.max_len))(
                 self._history_dev,
-                self._arg(np.zeros((self.num_slots, self.max_len),
-                                   np.int32)),
+                self._arg(np.zeros((self.num_slots,
+                                    min(256, self.max_len)), np.int32)),
                 self._arg(np.full((self.num_slots,), self.num_slots,
                                   np.int32)))
             jax.block_until_ready(self._history_dev)
@@ -1897,18 +1900,31 @@ class TPUEngine(EngineBase):
                 self._consume_token(req, int(arr[j]))
                 self._flush_emit(req)
 
-    def _get_hist_patch_fn(self):
+    def _get_hist_patch_fn(self, row_len: int | None = None):
         """Jitted history-row upload for speculative decoding: rows of
         freshly admitted slots replace their history rows wholesale
-        (out-of-range slot indices in the padded batch drop)."""
-        if self._hist_patch_fn is None:
+        (out-of-range slot indices in the padded batch drop).
+
+        ``row_len`` buckets the HOST-SIDE upload: shipping full
+        [S, max_len] rows cost 512 KB through the relay per admission
+        wave (measured as most of auto-spec's bench overhead once it
+        became the default) when the prompts being uploaded are ~100
+        tokens. The program pads to max_len on device — HBM-local and
+        free next to the link transfer it replaces."""
+        row_len = self.max_len if row_len is None else row_len
+        fn = self._hist_patch_fns.get(row_len)
+        if fn is None:
             @partial(jax.jit, donate_argnums=(0,))
             def apply_hist(hist, rows, slots):
-                return hist.at[slots].set(rows, mode="drop",
+                full = jnp.zeros((rows.shape[0], self.max_len),
+                                 rows.dtype)
+                full = jax.lax.dynamic_update_slice(full, rows, (0, 0))
+                return hist.at[slots].set(full, mode="drop",
                                           unique_indices=True)
 
-            self._hist_patch_fn = apply_hist
-        return self._hist_patch_fn
+            self._hist_patch_fns[row_len] = apply_hist
+            fn = apply_hist
+        return fn
 
     def _patch_slot_state(self) -> None:
         """Apply dirty host mirrors onto the chained device arrays via
@@ -1924,15 +1940,20 @@ class TPUEngine(EngineBase):
         calls."""
         if self.spec_draft and self._dirty_history:
             # Prompt tokens of freshly admitted slots -> device history
-            # (one padded [S, max_len] upload + one program; the
-            # sampled tokens appended later are maintained in-program).
-            rows = np.zeros((self.num_slots, self.max_len), np.int32)
+            # (one bucketed upload + one program that pads to max_len
+            # on device; the sampled tokens appended later are
+            # maintained in-program).
+            longest = max((len(t) for t in
+                           self._dirty_history.values()), default=1)
+            rb = min(self.max_len,
+                     max(256, 1 << (longest - 1).bit_length()))
+            rows = np.zeros((self.num_slots, rb), np.int32)
             slots = np.full((self.num_slots,), self.num_slots, np.int32)
             for i, (s, tokens) in enumerate(self._dirty_history.items()):
-                rows[i, :len(tokens)] = tokens[:self.max_len]
+                rows[i, :min(len(tokens), rb)] = tokens[:rb]
                 slots[i] = s
             self._dirty_history.clear()
-            self._history_dev = self._get_hist_patch_fn()(
+            self._history_dev = self._get_hist_patch_fn(rb)(
                 self._history_dev, self._arg(rows), self._arg(slots))
         if not self._dirty_slots:
             return
