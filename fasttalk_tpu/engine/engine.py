@@ -844,6 +844,14 @@ class TPUEngine(EngineBase):
         serialised device and host work).
         """
         steps = self.steps_per_call if steps is None else steps
+        sp = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
+        if sp > 1:
+            # The sp path attends the FULL sp-sharded cache through
+            # decode_attention_sharded (per-chip O(S/sp) folds + a
+            # statistics psum — masking bounds the horizon, so KV-
+            # bucket specialisation buys nothing); one executable per
+            # step count.
+            kv_len = self.max_len
         fn = self._decode_fns.get((kv_len, steps, with_history))
         if fn is not None:
             return fn
@@ -852,6 +860,16 @@ class TPUEngine(EngineBase):
         rows = jnp.arange(self.num_slots)
         max_len = self.max_len
         replicate = self._replicate_sharding()
+        cache_override = None
+        if sp > 1:
+            from fasttalk_tpu.parallel.ring_attention import \
+                decode_attention_sharded
+
+            mesh = self.mesh
+
+            def cache_override(q, ck, cv, positions):  # noqa: F811
+                return decode_attention_sharded(q, ck, cv, positions,
+                                                mesh)
 
         if with_history:
             # Auto-spec plain call: identical decode, plus maintaining
@@ -939,7 +957,8 @@ class TPUEngine(EngineBase):
                     params, self.cfg, cur[:, None], pos[:, None],
                     KVCache(sk, sv), pos, write_mask=act,
                     pallas_decode=use_pallas,
-                    pallas_int8=self.use_pallas_int8)
+                    pallas_int8=self.use_pallas_int8,
+                    cache_attn_override=cache_override)
                 lg = apply_penalties(logits[:, -1, :self.sample_vocab],
                                      cnt, reps, press, freqs)
                 nxt = sample_tokens(lg, sub, temps, topks, topps,

@@ -143,17 +143,19 @@ def build_engine(cfg: Config) -> EngineBase:
     model_cfg = get_model_config(cfg.model_name, cfg.model_path)
     dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
     acct = check_hbm_budget(model_cfg, cfg, dtype,
-                            n_devices=max(1, cfg.tp_size * cfg.dp_size))
+                            n_devices=max(1, cfg.tp_size * cfg.dp_size
+                                          * cfg.sp_size))
     log.info("HBM budget check passed",
              weight_gib=round(acct["weight_bytes_per_device"] / 2**30, 2),
              kv_gib=round(acct["kv_cache_bytes_per_device"] / 2**30, 2),
              limit_gib=round((acct["hbm_limit_bytes"] or 0) / 2**30, 2))
     mesh = put = raw_put = None
-    if cfg.tp_size > 1 or cfg.dp_size > 1:
+    if cfg.tp_size > 1 or cfg.dp_size > 1 or cfg.sp_size > 1:
         from fasttalk_tpu.parallel.mesh import make_mesh
         from fasttalk_tpu.parallel.sharding import param_put
 
-        mesh = make_mesh(dp=cfg.dp_size, tp=cfg.tp_size)
+        mesh = make_mesh(dp=cfg.dp_size, sp=cfg.sp_size,
+                         tp=cfg.tp_size)
         # Weights go straight into their TP shards as they stream off
         # disk — a 70B checkpoint must never materialise on one chip.
         put = param_put(mesh, dtype)

@@ -128,6 +128,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             logits_indices: jnp.ndarray | None = None,
             attn_override: Any = None,
             override_write: bool = False,
+            cache_attn_override: Any = None,
             ) -> tuple[jnp.ndarray, KVCache]:
     """Run the transformer over ``tokens`` [B, T], updating the cache.
 
@@ -156,6 +157,13 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     additionally writes the fresh K/V into the cache — the serving
     ring-prefill regime, where decode must later read what the ring
     attended over.
+
+    ``cache_attn_override`` (optional): ``fn(q, ck, cv, positions) ->
+    o`` replacing the CACHE-READ attention (writes still happen) —
+    how parallel.ring_attention.decode_attention_sharded plugs in for
+    sp-sharded serving decode: per-chip folds over the local KV shard
+    plus a statistics psum, instead of GSPMD's per-step K/V
+    all-gather.
 
     Returns (logits [B, T, vocab], updated cache). (The decode hot path
     is ``forward_decode`` below — scatter cache writes + bounded
@@ -193,7 +201,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         else:
             ck = _write_kv(ck, k, write_start, write_mask)
             cv = _write_kv(cv, v, write_start, write_mask)
-            if pallas_decode and t == 1:
+            if cache_attn_override is not None:
+                o = cache_attn_override(q, ck, cv, positions)
+            elif pallas_decode and t == 1:
                 from fasttalk_tpu.ops.pallas_attention import decode_attend
 
                 o = decode_attend(q[:, 0], ck, cv,

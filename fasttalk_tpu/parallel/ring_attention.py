@@ -85,3 +85,59 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         in_specs=(seq, seq, seq, P(None, axis_name)),
         out_specs=seq,
     )(q, k, v, positions)
+
+
+def _decode_attend_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         q_positions: jnp.ndarray,
+                         axis_name: str) -> jnp.ndarray:
+    """Per-device body for ``decode_attention_sharded``: fold the LOCAL
+    K/V shard with the flash recurrence, then combine the per-(query,
+    head) softmax statistics across the axis with pmax/psum — the
+    cross-chip flash-decoding combine. A shard whose keys are all
+    masked contributes exp(-inf)·0 = 0."""
+    b, t, nq, d = q.shape
+    nkv = k.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, t, nkv, g, d).astype(jnp.float32)
+    local_s = k.shape[1]
+    key_pos = jax.lax.axis_index(axis_name) * local_s \
+        + jnp.arange(local_s)
+    init = jax.tree.map(
+        lambda x: jax.lax.pcast(x, (axis_name,), to="varying"),
+        fold_init(b, t, nkv, g, d))
+    m, l, acc = online_softmax_fold(qg, k, v, q_positions, key_pos, init)
+    m_g = jax.lax.pmax(m, axis_name)
+    corr = jnp.exp(m - m_g)
+    l_g = jax.lax.psum(l * corr, axis_name)
+    acc_g = jax.lax.psum(acc * corr[..., None], axis_name)
+    out = acc_g / jnp.maximum(l_g, 1e-30)[..., None]
+    return out.reshape(b, t, nq, d).astype(q.dtype)
+
+
+def decode_attention_sharded(q: jnp.ndarray, k: jnp.ndarray,
+                             v: jnp.ndarray, q_positions: jnp.ndarray,
+                             mesh: Mesh, axis_name: str = "sp",
+                             ) -> jnp.ndarray:
+    """Cache-read GQA attention with the KV cache sequence-sharded over
+    ``axis_name`` — the decode-side complement of the ring prefill.
+
+    GSPMD's default lowering of ``ops.attention.attend`` over an
+    sp-sharded cache ALL-GATHERS K/V onto every chip each step — a
+    transient O(S) per-chip working set and O(S) ICI bytes that defeat
+    the sp axis's purpose at decode time. Here each chip folds only
+    its local O(S/sp) shard and the chips exchange just the softmax
+    statistics ([B, T, heads] scalars plus one [B, T, heads, D]
+    accumulator psum): per-chip memory stays O(S/sp) and ICI traffic
+    per step is independent of the sequence length.
+
+    q [B, T, Nq, D] and q_positions [B, T] replicated over the axis;
+    k/v [B, S, Nkv, D] sharded on S. "dp"/"tp" sharding stays with
+    GSPMD (manual axes: only ``axis_name``).
+    """
+    body = partial(_decode_attend_local, axis_name=axis_name)
+    return jax.shard_map(
+        body, mesh=mesh, axis_names=frozenset({axis_name}),
+        in_specs=(P(), P(None, axis_name, None, None),
+                  P(None, axis_name, None, None), P()),
+        out_specs=P(),
+    )(q, k, v, q_positions)

@@ -195,6 +195,11 @@ class Config:
     dtype: str = field(default_factory=lambda: _env_str("TPU_DTYPE", "bfloat16"))
     tp_size: int = field(default_factory=lambda: _env_int("TPU_TP_SIZE", 1))
     dp_size: int = field(default_factory=lambda: _env_int("TPU_DP_SIZE", 1))
+    # Sequence-parallel axis: shards each slot's KV over sp chips.
+    # Long fresh prompts prefill through ring attention and decode
+    # attends via the sharded flash-decoding combine — per-chip serving
+    # memory O(T/sp) (parallel/ring_attention.py).
+    sp_size: int = field(default_factory=lambda: _env_int("TPU_SP_SIZE", 1))
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
     # The length-pruning Pallas decode-attention kernel. Off by default:
     # profiled on v5e-1 its per-grid-cell cost (8 statically unrolled
@@ -318,8 +323,8 @@ class Config:
             errs.append("max_model_len must be > 0")
         if self.prefill_chunk <= 0 or self.prefill_chunk & (self.prefill_chunk - 1):
             errs.append("prefill_chunk must be a positive power of two")
-        if self.tp_size <= 0 or self.dp_size <= 0:
-            errs.append("tp_size and dp_size must be >= 1")
+        if self.tp_size <= 0 or self.dp_size <= 0 or self.sp_size <= 0:
+            errs.append("tp_size, dp_size and sp_size must be >= 1")
         if self.decode_steps_per_call <= 0:
             errs.append("decode_steps_per_call must be >= 1")
         if self.spec_decode not in ("off", "ngram", "auto"):

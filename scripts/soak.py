@@ -207,8 +207,15 @@ async def main() -> None:
             async with http.ws_connect(
                     f"ws://127.0.0.1:{PORT}/ws/llm") as ws:
                 await ws.receive()
+                # Greedy: at temperature the ci profile's trained
+                # model can legally sample EOS first (zero text
+                # tokens); greedy "hello" deterministically answers —
+                # and still fails loudly on real post-churn corruption.
                 await ws.send_json({"type": "start_session",
-                                    "config": {"max_tokens": 8}})
+                                    "config": {"max_tokens": 8,
+                                               "temperature": 0.0,
+                                               "top_k": 0,
+                                               "top_p": 1.0}})
                 await ws.receive()
                 # "hello" is in-distribution for the ci profile's
                 # trained tinychat (an OOD prompt can legally answer
@@ -235,3 +242,10 @@ async def main() -> None:
 
 if __name__ == "__main__":
     asyncio.run(main())
+    # Every invariant has passed and the verdict is printed. Exit hard:
+    # library atexit hooks (orbax async writer, tensorstore) have been
+    # observed turning an already-passed soak into a flaky nonzero exit
+    # during interpreter teardown.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)

@@ -244,6 +244,32 @@ def test_tp_engine_end_to_end_matches_single_device():
     assert single and single == sharded
 
 
+def test_decode_attention_sharded_matches_attend():
+    """The sp-sharded cache-read decode attention (per-chip flash folds
+    + statistics psum) is numerically the full-softmax ``attend`` —
+    including rows whose horizon leaves whole shards fully masked."""
+    import numpy as np
+
+    from fasttalk_tpu.ops.attention import attend
+    from fasttalk_tpu.parallel.ring_attention import \
+        decode_attention_sharded
+
+    mesh = make_mesh(sp=4)
+    rng = np.random.default_rng(0)
+    B, S, NQ, NKV, D = 3, 64, 8, 4, 16
+    q = jnp.asarray(rng.standard_normal((B, 1, NQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, NKV, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, NKV, D)), jnp.float32)
+    # horizons: mid-shard, first-shard-only (3 shards fully masked),
+    # and full
+    pos = jnp.asarray([[37], [5], [63]], jnp.int32)
+    ref = attend(q, k, v, pos)
+    got = jax.jit(lambda *a: decode_attention_sharded(*a, mesh=mesh))(
+        q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_prefill_serving_long_prompt_matches_single_device():
     """VERDICT r4 #4: on an sp>1 mesh, a fresh prompt LONGER than one
     chip's KV shard (max_len/sp) prefills through ring attention —
@@ -288,6 +314,25 @@ def test_ring_prefill_serving_long_prompt_matches_single_device():
     assert single and single == sharded
     assert any(isinstance(k, tuple) and k and k[0] == "ring"
                for k in eng._prefill_fns), "ring prefill never engaged"
+
+
+def test_sp_size_reaches_serving_mesh_from_config():
+    """TPU_SP_SIZE is a product-surface knob: the factory builds the
+    serving mesh with the sp axis (ring prefill + sharded flash
+    decoding reachable from `main.py websocket`, not just tests)."""
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.utils.config import Config
+
+    cfg = Config(llm_provider="tpu", model_name="test-tiny",
+                 sp_size=2, tp_size=2, decode_slots=2, max_model_len=512,
+                 default_context_window=512, enable_agent=False,
+                 port=18815, monitoring_port=18816, warmup="off")
+    eng = build_engine(cfg)
+    assert dict(eng.mesh.shape) == {"dp": 1, "sp": 2, "tp": 2}
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="sp_size"):
+        Config(llm_provider="tpu", model_name="test-tiny", sp_size=0,
+               port=18817, monitoring_port=18818)
 
 
 def test_validate_mesh_named_errors():

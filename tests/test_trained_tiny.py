@@ -282,6 +282,36 @@ def test_trained_model_over_websocket_protocol():
     assert "Grace" in replies[1]  # context recall over the WS protocol
 
 
+def test_int8_quantized_trained_model_stays_correct():
+    """TPU_QUANTIZE=int8 on REAL trained weights (every prior int8
+    test ran random init): the per-channel weight quantization must
+    preserve answer content and natural EOS stops, not just run."""
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.utils.config import Config
+
+    cfg = Config(llm_provider="tpu", model_name="tinychat",
+                 model_path=os.path.dirname(CKPT), port=18767,
+                 monitoring_port=18768, enable_agent=False,
+                 max_model_len=1024, default_context_window=1024,
+                 quantize="int8")
+    eng = build_engine(cfg)
+    eng.start()
+    try:
+        text, final = _chat(eng, [
+            {"role": "user", "content": "what color is the sky?"}],
+            request_id="q8", max_tokens=32)
+        assert final["finish_reason"] == "stop", (text, final)
+        assert "blue" in text.lower(), text
+        text, final = _chat(eng, [
+            {"role": "user", "content": "my name is Opal."},
+            {"role": "assistant", "content": "Nice to meet you, Opal!"},
+            {"role": "user", "content": "what is my name?"}],
+            request_id="q8b", max_tokens=24)
+        assert "Opal" in text, text
+    finally:
+        eng.shutdown()
+
+
 def test_spec_decode_acceptance_on_trained_templated_text():
     """With trained weights on templated text, prompt-lookup drafts are
     frequently right — acceptance must clear the plain-decode
