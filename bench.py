@@ -41,6 +41,12 @@ NUM_SESSIONS = int(os.environ.get("BENCH_SESSIONS", "16"))
 MAX_TOKENS = int(os.environ.get("BENCH_MAX_TOKENS", "128"))
 MODE = os.environ.get("BENCH_MODE", "ws")
 PORT = int(os.environ.get("BENCH_PORT", "18613"))  # relay squats 81xx
+# Fixed-length generations for TRAINED checkpoints (e.g.
+# BENCH_MODEL=tinychat MODEL_PATH=fasttalk_tpu/assets
+# BENCH_IGNORE_EOS=1): a trained model answers the bench prompt with a
+# short reply + EOS, which measures nothing; ignore_eos decodes the
+# full budget. Irrelevant for random-init weights (EOS ~never sampled).
+IGNORE_EOS = os.environ.get("BENCH_IGNORE_EOS", "") == "1"
 PROMPT = ("You are a concise assistant for a realtime voice app. "
           "Explain, in plain language, how a systolic array multiplies "
           "matrices and why that favours large batched matmuls.")
@@ -84,7 +90,8 @@ async def ws_session(http, i: int, max_tokens: int) -> dict:
         await ws.send_json({"type": "start_session",
                             "config": {"temperature": 0.7, "top_k": 40,
                                        "top_p": 0.9,
-                                       "max_tokens": max_tokens}})
+                                       "max_tokens": max_tokens,
+                                       "ignore_eos": IGNORE_EOS}})
         msg = json.loads((await ws.receive()).data)
         assert msg["type"] == "session_configured", msg
         t0 = time.monotonic()

@@ -110,8 +110,7 @@ def _decode_attend_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     corr = jnp.exp(m - m_g)
     l_g = jax.lax.psum(l * corr, axis_name)
     acc_g = jax.lax.psum(acc * corr[..., None], axis_name)
-    out = acc_g / jnp.maximum(l_g, 1e-30)[..., None]
-    return out.reshape(b, t, nq, d).astype(q.dtype)
+    return fold_finish((m_g, l_g, acc_g), q.dtype)
 
 
 def decode_attention_sharded(q: jnp.ndarray, k: jnp.ndarray,

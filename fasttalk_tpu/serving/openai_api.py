@@ -201,6 +201,10 @@ def register_openai_routes(app: web.Application,
         stop = body.get("stop") or []
         if isinstance(stop, str):
             stop = [stop]
+        ignore_eos = body.get("ignore_eos", False)
+        if not isinstance(ignore_eos, bool):
+            raise _BadRequest(
+                f"ignore_eos must be a boolean, got {ignore_eos!r}")
         return GenerationParams(
             temperature=float(body.get(
                 "temperature", defaults.get("temperature", 0.7))),
@@ -227,7 +231,7 @@ def register_openai_routes(app: web.Application,
                 else body["repetition_penalty"]
                 if "repetition_penalty" in body
                 else defaults.get("repeat_penalty", 1.0)),
-            ignore_eos=bool(body.get("ignore_eos", False)),
+            ignore_eos=ignore_eos,
         )
 
     def _breaker_503() -> web.Response | None:

@@ -299,7 +299,7 @@ class WebSocketLLMServer:
     # in the config blob is stored for echo but never splatted inward.
     _GEN_KEYS = ("temperature", "top_p", "top_k", "max_tokens", "stop",
                  "tts_chunking", "repeat_penalty", "presence_penalty",
-                 "frequency_penalty")
+                 "frequency_penalty", "ignore_eos")
 
     @classmethod
     def _gen_overrides(cls, cfg: dict) -> dict:
@@ -351,6 +351,13 @@ class WebSocketLLMServer:
         stop = over.get("stop", [])
         if isinstance(stop, str):
             stop = [stop]
+        ignore_eos = over.get("ignore_eos", False)
+        if not isinstance(ignore_eos, bool):
+            # Strict: bool("false") is True — a stringly-typed client
+            # value must 400/invalid_config like every other bad knob,
+            # not silently decode every reply to the full budget.
+            raise ValueError(
+                f"ignore_eos must be a boolean, got {ignore_eos!r}")
         return GenerationParams(
             temperature=float(over.get("temperature",
                                        self.config.default_temperature)),
@@ -366,6 +373,7 @@ class WebSocketLLMServer:
             frequency_penalty=float(over.get(
                 "frequency_penalty",
                 self.config.default_frequency_penalty)),
+            ignore_eos=ignore_eos,
         )
 
     async def _generate(self, session_id: str, user_text: str,

@@ -404,6 +404,20 @@ def test_ws_config_plumbs_penalties():
             assert p.repeat_penalty == 1.25
             assert p.presence_penalty == 0.5
             assert p.frequency_penalty == 0.1
+            assert p.ignore_eos is False  # default
+            await ws.close()
+
+            # ignore_eos is a WS config knob too (vLLM-parity
+            # extension; the trained-model bench needs it).
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)
+            await ws.send_json({"type": "start_session", "config": {
+                "ignore_eos": True}})
+            await recv_json(ws)
+            await ws.send_json({"type": "user_message", "text": "go"})
+            while (await recv_json(ws))["type"] != "response_complete":
+                pass
+            assert engine.requests_seen[-1]["params"].ignore_eos is True
             await ws.close()
 
             ws = await client.ws_connect("/ws/llm")
@@ -413,7 +427,7 @@ def test_ws_config_plumbs_penalties():
             await ws.send_json({"type": "user_message", "text": "hi"})
             while (await recv_json(ws))["type"] != "response_complete":
                 pass
-            p = engine.requests_seen[1]["params"]
+            p = engine.requests_seen[-1]["params"]
             assert p.repeat_penalty == 1.1  # serving default
             assert p.presence_penalty == 0.0
             await ws.close()
