@@ -355,6 +355,13 @@ class TPUEngine(EngineBase):
             thread_name_prefix="tpu-fetch")
         self._reset_decode_state()
 
+        # Multi-host SPMD serving (parallel/spmd_serving.py): when set,
+        # every serving-time device call publishes a replay descriptor
+        # BEFORE dispatching, so follower processes execute the same
+        # program sequence against their shards. Leader-only decision
+        # making; followers never start() an engine thread.
+        self.call_sink: Any = None
+
         self._commands: queue.Queue = queue.Queue()
         self._waiting: list[_Request] = []
         self._prefilling: list[_PrefillState] = []  # long prompts, FIFO
@@ -518,6 +525,13 @@ class TPUEngine(EngineBase):
         with self._lifecycle_lock:
             if self._closed:
                 return False  # shutdown won; never resurrect past it
+            if self.call_sink is not None:
+                # Restart is leader-local device-state surgery and is
+                # not replicated to followers; multi-host recovery is a
+                # cluster restart (parallel/spmd_serving.py scope note).
+                log.error("engine restart unsupported in multi-host "
+                          "SPMD serving mode")
+                return False
             if self.check_connection():
                 return True
             if self._thread is not None and self._thread.is_alive():
@@ -576,6 +590,12 @@ class TPUEngine(EngineBase):
             return
         if self._started:
             raise RuntimeError("warmup() must be called before start()")
+        if self.call_sink is not None:
+            # Warmup calls are not published to followers; multi-host
+            # serving compiles lazily on both sides instead.
+            raise RuntimeError(
+                "warmup is unsupported with a multi-host call sink "
+                "attached (set TPU_WARMUP=off)")
         t0 = time.monotonic()
         kv_buckets = [b for b in _KV_BUCKETS if b <= self.max_len] \
             or [self.max_len]
@@ -800,6 +820,12 @@ class TPUEngine(EngineBase):
         }
 
     # ---------------- jitted steps ----------------
+
+    def _sink(self, kind: str, **payload) -> None:
+        """Publish a device-call replay descriptor to the attached
+        multi-host call sink (no-op single-host)."""
+        if self.call_sink is not None:
+            self.call_sink(kind, payload)
 
     def _put(self, arr):
         """Host array (or PRNG key) → device, replicated over the mesh
@@ -1456,6 +1482,16 @@ class TPUEngine(EngineBase):
                                   + len(self._prefilling))
         except Exception as e:  # engine thread must not die silently
             log.critical(f"engine thread crashed: {e}", exc_info=True)
+            if self.call_sink is not None:
+                # A published descriptor may precede the crash: tell
+                # followers the cluster is dead rather than leaving
+                # them blocked in their recv loop (the prefill paths
+                # publish their own aborts; this covers the
+                # decode/spec/patch family and anything unforeseen).
+                try:
+                    self._sink("abort", reason=f"engine crashed: {e}")
+                except Exception:
+                    pass
             self._abort_all(f"engine crashed: {e}")
         else:
             self._abort_all("engine shut down")
@@ -1575,6 +1611,8 @@ class TPUEngine(EngineBase):
                 src, share = self.slots.best_shared_prefix(slot, prompt)
                 share = self._share_granule(share)
                 if src is not None and share >= 16:
+                    self._sink("prefix_copy", share=share,
+                               src=src.index, dst=slot.index)
                     self.cache = self._get_prefix_copy_fn(share)(
                         self.cache, np.int32(src.index),
                         np.int32(slot.index))
@@ -1637,6 +1675,8 @@ class TPUEngine(EngineBase):
                 padded = np.zeros((ring_bucket,), np.int32)
                 padded[:n] = st.todo
                 fn = self._get_ring_prefill_fn(ring_bucket)
+                self._sink("ring_prefill", bucket=ring_bucket,
+                           tokens=padded, slot=slot.index, last=n - 1)
                 self.cache, st.last_logits = fn(
                     self.params, self.cache, self._arg(padded),
                     np.int32(slot.index), np.int32(n - 1))
@@ -1663,6 +1703,9 @@ class TPUEngine(EngineBase):
                 padded = np.zeros((bucket,), np.int32)
                 padded[:take] = chunk
                 fn = self._get_prefill_fn(bucket)
+                self._sink("prefill", bucket=bucket, tokens=padded,
+                           start=st.start, slot=slot.index,
+                           last=take - 1)
                 # numpy scalars, not jnp ones: each eager jnp scalar is
                 # its own device round trip on relayed backends.
                 self.cache, st.last_logits = fn(
@@ -1680,6 +1723,7 @@ class TPUEngine(EngineBase):
             cfg_row = np.array([slot.index, req.params.temperature,
                                 req.params.top_k, req.params.top_p],
                                np.float32)
+            self._sink("sample_place", cfg_row=cfg_row)
             first, self._cur_tokens, self._rng_dev = \
                 self._get_sample_place_fn()(
                     st.last_logits, self._cur_tokens, self._rng_dev,
@@ -1689,6 +1733,14 @@ class TPUEngine(EngineBase):
         except Exception as e:
             log.error(f"prefill failed for {req.request_id}: {e}",
                       exc_info=True)
+            if self.call_sink is not None:
+                # A dispatch error AFTER a published descriptor means
+                # per-host device state may have diverged: scoping the
+                # error to one request would serve a corrupted cluster.
+                # Abort followers and escalate (engine thread →
+                # _abort_all; multi-host recovery = cluster restart).
+                self._sink("abort", reason=str(e))
+                raise
             if self._prefilling and self._prefilling[0] is st:
                 self._prefilling.pop(0)
             self._finish(req, "error", error=str(e))
@@ -1760,6 +1812,8 @@ class TPUEngine(EngineBase):
                     or share + delta_b > self.max_len:
                 second.append((req, slot, 0, req.prompt_tokens))
                 continue
+            self._sink("prefix_copy", share=share, src=lslot.index,
+                       dst=slot.index)
             self.cache = self._get_prefix_copy_fn(share)(
                 self.cache, np.int32(lslot.index), np.int32(slot.index))
             slot.tokens = list(req.prompt_tokens[:share])
@@ -1787,10 +1841,15 @@ class TPUEngine(EngineBase):
                 try:
                     self._prefill_group(bucket, sub)
                 except Exception as e:
+                    log.error(f"batched prefill failed: {e}", exc_info=True)
+                    if self.call_sink is not None:
+                        # See _advance_prefill: a post-publish dispatch
+                        # error must abort the cluster, not be scoped.
+                        self._sink("abort", reason=str(e))
+                        raise
                     # Scoped to this device call: requests in other
                     # groups (possibly already activated and streaming)
                     # are untouched.
-                    log.error(f"batched prefill failed: {e}", exc_info=True)
                     for req, _, _, _ in sub:
                         self._finish(req, "error", error=str(e))
         self._m_prefill.observe((time.monotonic() - t0) * 1000)
@@ -1823,6 +1882,8 @@ class TPUEngine(EngineBase):
         ctx = next((b for b in _KV_BUCKETS
                     if b >= need and b <= self.max_len), self.max_len)
         fn = self._get_batched_prefill_fn(bucket, gp, ctx)
+        self._sink("batched_prefill", bucket=bucket, gp=gp, ctx=ctx,
+                   tokens=tokens, rowcfg=rowcfg)
         # First tokens stay on device: the program scatters them into
         # the decode chain's current-token vector, and the host copy is
         # async — the engine thread dispatches the first decode call
@@ -1972,6 +2033,7 @@ class TPUEngine(EngineBase):
                 rows[i, :min(len(tokens), rb)] = tokens[:rb]
                 slots[i] = s
             self._dirty_history.clear()
+            self._sink("hist_patch", rb=rb, rows=rows, slots=slots)
             self._history_dev = self._get_hist_patch_fn(rb)(
                 self._history_dev, self._arg(rows), self._arg(slots))
         if not self._dirty_slots:
@@ -1982,6 +2044,7 @@ class TPUEngine(EngineBase):
                          self._temps[s], self._topks[s], self._topps[s],
                          self._reps[s], self._press[s], self._freqs[s])
         self._dirty_slots.clear()
+        self._sink("patch", packed=packed)
         (self._counts_dev, self._positions_dev, self._active_dev,
          self._temps_dev, self._topks_dev, self._topps_dev,
          self._reps_dev, self._press_dev, self._freqs_dev) = \
@@ -2052,6 +2115,7 @@ class TPUEngine(EngineBase):
                                if b >= need and b <= self.max_len),
                               self.max_len)
                 fn = self._get_spec_decode_fn(kv_len, steps)
+                self._sink("spec", kv_len=kv_len, steps=steps)
                 (self.cache, self._history_dev, self._counts_dev, toks,
                  self._cur_tokens, self._positions_dev,
                  self._rng_dev) = fn(
@@ -2080,6 +2144,8 @@ class TPUEngine(EngineBase):
             # check fell through): keep the draft history fresh so the
             # next probe drafts from current text, not stale history.
             fn = self._get_decode_fn(kv_len, steps, with_history=True)
+            self._sink("decode", kv_len=kv_len, steps=steps,
+                       with_history=True)
             (self.cache, self._history_dev, self._counts_dev, toks,
              self._cur_tokens, self._positions_dev, self._rng_dev) = fn(
                 self.params, self.cache, self._history_dev,
@@ -2092,6 +2158,8 @@ class TPUEngine(EngineBase):
                  snapshot))
             return
         fn = self._get_decode_fn(kv_len, steps)
+        self._sink("decode", kv_len=kv_len, steps=steps,
+                   with_history=False)
         (self.cache, self._counts_dev, toks, self._cur_tokens,
          self._positions_dev, self._rng_dev) = fn(
             self.params, self.cache, self._counts_dev, self._cur_tokens,

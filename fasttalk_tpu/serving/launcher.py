@@ -37,9 +37,41 @@ def build_agent(config: Config, engine: EngineBase):
         return None
 
 
+def run_spmd_follower(config: Config) -> int:
+    """Multi-host SPMD serving, follower role (TPU_SPMD_ROLE=follower):
+    build the identical engine over the global mesh and replay the
+    leader's device-call stream against this host's shards. No gateway,
+    no engine thread — the leader is the cluster's only decision-maker
+    (parallel/spmd_serving.py)."""
+    from fasttalk_tpu.parallel.spmd_serving import follower_loop
+
+    engine = build_engine(config)
+    host, port = config.spmd_addr.rsplit(":", 1)
+    log.info(f"SPMD follower: replaying leader calls from "
+             f"{host}:{port}")
+    follower_loop(engine, host, int(port))
+    return 0
+
+
 class ServerLauncher:
     def __init__(self, config: Config, engine: EngineBase | None = None):
         self.config = config
+        self._spmd_sink = None
+        if config.spmd_role == "leader" and engine is None:
+            # Followers must replay every serving-time device call, so
+            # the sink attaches before any traffic — and warmup (which
+            # is not published) is forced off for the whole cluster.
+            from fasttalk_tpu.parallel.spmd_serving import CallBroadcaster
+
+            if config.warmup not in ("off", "", "none"):
+                log.info("SPMD leader: forcing TPU_WARMUP=off "
+                         "(warmup calls are not replicated)")
+                config.warmup = "off"
+            engine = build_engine(config)
+            host, port = config.spmd_addr.rsplit(":", 1)
+            self._spmd_sink = CallBroadcaster(
+                host, int(port), config.spmd_followers)
+            engine.call_sink = self._spmd_sink
         self.engine = engine if engine is not None else build_engine(config)
         self.agent = build_agent(config, self.engine)
         self.server = WebSocketLLMServer(config, self.engine, self.agent)
@@ -60,6 +92,17 @@ class ServerLauncher:
             await asyncio.sleep(interval)
             if self._stop.is_set() or self.engine.check_connection():
                 continue
+            if self._spmd_sink is not None:
+                # In-place restart is leader-local state surgery and is
+                # not replicated to followers (engine.restart refuses):
+                # an SPMD engine death is fatal to this process so the
+                # orchestrator can restart the CLUSTER, instead of the
+                # gateway serving errors behind a 5s restart-fail loop.
+                log.critical("engine thread died in multi-host SPMD "
+                             "mode; shutting the gateway down for a "
+                             "cluster restart")
+                self._stop.set()
+                return
             restart = getattr(self.engine, "restart", None)
             if restart is None or not self.config.engine_auto_restart:
                 continue
@@ -124,6 +167,11 @@ class ServerLauncher:
                 # otherwise every shutdown leaks its FDs (ADVICE r2).
                 await self.agent.aclose()
             self.engine.shutdown()
+            if self._spmd_sink is not None:
+                # After engine.shutdown(): the engine thread has
+                # stopped publishing, so the stop frame is the stream's
+                # clean tail.
+                self._spmd_sink.close()
 
     def start(self) -> None:
         """Blocking entry point (signal-driven shutdown)."""

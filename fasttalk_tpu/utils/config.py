@@ -200,6 +200,19 @@ class Config:
     # attends via the sharded flash-decoding combine — per-chip serving
     # memory O(T/sp) (parallel/ring_attention.py).
     sp_size: int = field(default_factory=lambda: _env_int("TPU_SP_SIZE", 1))
+    # Multi-host SPMD serving role (parallel/spmd_serving.py):
+    # "off" | "leader" (serves the gateway; publishes every device call
+    # to followers over TPU_SPMD_ADDR) | "follower" (replays the
+    # leader's calls against this host's shards; no gateway). Requires
+    # the usual jax.distributed env (TPU_COORDINATOR_ADDR,
+    # TPU_NUM_PROCESSES, TPU_PROCESS_ID) for the device cluster itself.
+    spmd_role: str = field(
+        default_factory=lambda: _env_str("TPU_SPMD_ROLE", "off"))
+    spmd_addr: str = field(
+        default_factory=lambda: _env_str("TPU_SPMD_ADDR",
+                                         "127.0.0.1:8890"))
+    spmd_followers: int = field(
+        default_factory=lambda: _env_int("TPU_SPMD_FOLLOWERS", 1))
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
     # The length-pruning Pallas decode-attention kernel. Off by default:
     # profiled on v5e-1 its per-grid-cell cost (8 statically unrolled
@@ -325,6 +338,13 @@ class Config:
             errs.append("prefill_chunk must be a positive power of two")
         if self.tp_size <= 0 or self.dp_size <= 0 or self.sp_size <= 0:
             errs.append("tp_size, dp_size and sp_size must be >= 1")
+        if self.spmd_role not in ("off", "leader", "follower"):
+            errs.append("spmd_role must be off|leader|follower")
+        if self.spmd_role != "off":
+            if ":" not in self.spmd_addr:
+                errs.append("spmd_addr must be host:port")
+            if self.spmd_followers <= 0:
+                errs.append("spmd_followers must be >= 1")
         if self.decode_steps_per_call <= 0:
             errs.append("decode_steps_per_call must be >= 1")
         if self.spec_decode not in ("off", "ngram", "auto"):

@@ -109,6 +109,13 @@ def run_test(args: argparse.Namespace) -> int:
 
 
 def run_websocket(args: argparse.Namespace) -> int:
+    # Multi-host first: jax.distributed must initialise before ANY jax
+    # call (Config's device detection touches the backend). No-op
+    # without cluster env.
+    from fasttalk_tpu.parallel.distributed import maybe_initialize
+
+    maybe_initialize()
+
     from fasttalk_tpu.serving.launcher import ServerLauncher
     from fasttalk_tpu.utils.config import Config
     from fasttalk_tpu.utils.logger import configure_logging, get_logger
@@ -121,6 +128,10 @@ def run_websocket(args: argparse.Namespace) -> int:
     log.info(f"Starting FastTalk-TPU: provider={cfg.llm_provider} "
              f"model={cfg.model_name} device={cfg.compute_device} "
              f"port={cfg.port} monitoring={cfg.monitoring_port}")
+    if cfg.spmd_role == "follower":
+        from fasttalk_tpu.serving.launcher import run_spmd_follower
+
+        return run_spmd_follower(cfg)
     ServerLauncher(cfg).start()
     return 0
 

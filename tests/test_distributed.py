@@ -73,21 +73,48 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _free_ports(n: int) -> list[int]:
+    """n DISTINCT free ports: all sockets held open while allocating
+    (sequential _free_port() calls can hand back the same port), and
+    ephemeral so consecutive test runs don't collide on a fixed port
+    still in TIME_WAIT (observed wedging the jax coordinator)."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def dcn_worker_env(pid: int | None, n_procs: int, dcn_port: int,
+                   local_devices: int, **extra: str) -> dict:
+    """Env for a (possibly clustered) CPU-mesh worker subprocess: scrub
+    the host's jax/cluster vars, set the forced device count, and (when
+    ``pid`` is given) the jax.distributed coordination trio. Shared
+    with tests/test_spmd_serving.py so the cluster bootstrap contract
+    lives in one place."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
+                        "TPU_COORDINATOR_ADDR", "TPU_NUM_PROCESSES",
+                        "TPU_PROCESS_ID")}
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count="
+                         f"{local_devices}",
+               FASTTALK_REPO=REPO, **extra)
+    if pid is not None:
+        env.update(TPU_COORDINATOR_ADDR=f"127.0.0.1:{dcn_port}",
+                   TPU_NUM_PROCESSES=str(n_procs),
+                   TPU_PROCESS_ID=str(pid))
+    return env
+
+
 def test_two_process_dcn_cluster(tmp_path):
     port = _free_port()
-    env_base = {k: v for k, v in os.environ.items()
-                if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
-                             "TPU_COORDINATOR_ADDR", "TPU_NUM_PROCESSES",
-                             "TPU_PROCESS_ID")}
     procs = []
     for pid in range(2):
-        env = dict(env_base,
-                   JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                   TPU_COORDINATOR_ADDR=f"127.0.0.1:{port}",
-                   TPU_NUM_PROCESSES="2",
-                   TPU_PROCESS_ID=str(pid),
-                   FASTTALK_REPO=REPO)
+        env = dcn_worker_env(pid, 2, port, local_devices=4)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", WORKER], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -186,22 +213,11 @@ DECODE_WORKER = textwrap.dedent("""
 
 
 def _run_decode_workers(n_procs: int, port: int) -> list[str]:
-    env_base = {k: v for k, v in os.environ.items()
-                if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
-                             "TPU_COORDINATOR_ADDR", "TPU_NUM_PROCESSES",
-                             "TPU_PROCESS_ID")}
     local_devices = 4 // n_procs
     procs = []
     for pid in range(n_procs):
-        env = dict(env_base,
-                   JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count="
-                             f"{local_devices}",
-                   FASTTALK_REPO=REPO)
-        if n_procs > 1:
-            env.update(TPU_COORDINATOR_ADDR=f"127.0.0.1:{port}",
-                       TPU_NUM_PROCESSES=str(n_procs),
-                       TPU_PROCESS_ID=str(pid))
+        env = dcn_worker_env(pid if n_procs > 1 else None, n_procs,
+                             port, local_devices)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", DECODE_WORKER], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))

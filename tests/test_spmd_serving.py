@@ -1,0 +1,281 @@
+"""Multi-host SPMD serving: the FULL engine serving loop across two
+real OS processes (parallel/spmd_serving.py).
+
+The earlier DCN test (test_distributed.py) proved the engine's compiled
+decode programs cross a process boundary in a scripted lockstep drive.
+This one proves the PRODUCT loop does: the leader process runs a real
+TPUEngine — engine thread, admission, batched prefill, continuous-
+batching decode, EOS retirement, KV-resident second turn — over a
+global dp×tp mesh spanning both processes, publishing each device call
+it decides to make; the follower replays them against its shards. The
+leader's streamed text must equal a single-process run of the same
+mesh shape.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tests.test_distributed import _free_ports, dcn_worker_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["FASTTALK_REPO"])
+
+    from fasttalk_tpu.parallel.distributed import maybe_initialize
+    maybe_initialize()
+
+    import asyncio
+    import jax
+
+    from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+    from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+    from fasttalk_tpu.models.configs import get_model_config
+    from fasttalk_tpu.models.llama import init_params
+    from fasttalk_tpu.parallel.mesh import MeshSpec, make_mesh
+    from fasttalk_tpu.parallel.spmd_serving import (CallBroadcaster,
+                                                    follower_loop)
+
+    TINY = get_model_config("test-tiny")
+    mesh = make_mesh(MeshSpec(dp=2, sp=1, tp=2))
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=4,
+                    max_len=256, prefill_chunk=64, seed=0, mesh=mesh)
+
+    role = os.environ["SPMD_ROLE"]
+    port = int(os.environ["SPMD_PORT"])
+    if role == "follower":
+        n = follower_loop(eng, "127.0.0.1", port)
+        print(f"FOLLOWER_OK calls={n}", flush=True)
+        sys.exit(0)
+
+    sink = None
+    if role == "leader":
+        sink = CallBroadcaster("127.0.0.1", port, n_followers=1)
+        eng.call_sink = sink
+    # role == "single": same code path, no cluster, no sink.
+
+    async def chat(rid, sid, messages, max_tokens=12):
+        text = ""
+        async for ev in eng.generate(rid, sid, messages,
+                                     GenerationParams(
+                                         temperature=0.0, top_k=0,
+                                         top_p=1.0,
+                                         max_tokens=max_tokens)):
+            if ev["type"] == "token":
+                text += ev["text"]
+            elif ev["type"] == "error":
+                raise RuntimeError(ev)
+        return text
+
+    async def main():
+        out = []
+        # concurrent admission burst -> batched prefill + batched decode
+        r = await asyncio.gather(
+            chat("a", "sa", [{"role": "user", "content": "first"}]),
+            chat("b", "sb", [{"role": "user", "content": "second"}]))
+        out.extend(r)
+        # KV-resident multi-turn on session a (prefix reuse path)
+        out.append(await chat(
+            "a2", "sa",
+            [{"role": "user", "content": "first"},
+             {"role": "assistant", "content": r[0]},
+             {"role": "user", "content": "again"}]))
+        return out
+
+    eng.start()
+    try:
+        streams = asyncio.run(main())
+    finally:
+        eng.shutdown()
+        if sink is not None:
+            sink.close()
+    print("STREAMS=" + repr(streams), flush=True)
+""")
+
+
+def _env(pid: int | None, n_procs: int, dcn_port: int, spmd_port: int,
+         role: str, local_devices: int) -> dict:
+    return dcn_worker_env(pid, n_procs, dcn_port, local_devices,
+                          SPMD_ROLE=role, SPMD_PORT=str(spmd_port))
+
+
+def _run_to_file(args, env, path):
+    """Spawn with output to a FILE, not a pipe: an unread 64 KB pipe
+    buffer blocks the child's writes mid-boot (XLA's AOT warnings
+    alone overflow it) — a silent wedge."""
+    fh = open(path, "w+")
+    return subprocess.Popen(args, env=env, stdout=fh,
+                            stderr=subprocess.STDOUT, text=True), fh
+
+
+def _wait_read(proc, fh, timeout):
+    try:
+        proc.wait(timeout=timeout)
+    finally:
+        fh.flush()
+        fh.seek(0)
+        out = fh.read()
+        fh.close()
+    return out
+
+
+def test_full_serving_loop_spans_processes(tmp_path):
+    dcn_port, spmd_port = _free_ports(2)
+    leader, lf = _run_to_file(
+        [sys.executable, "-c", WORKER],
+        _env(0, 2, dcn_port, spmd_port, "leader", 2),
+        tmp_path / "leader.log")
+    follower, ff = _run_to_file(
+        [sys.executable, "-c", WORKER],
+        _env(1, 2, dcn_port, spmd_port, "follower", 2),
+        tmp_path / "follower.log")
+    try:
+        outs = [_wait_read(leader, lf, 300),
+                _wait_read(follower, ff, 300)]
+    except subprocess.TimeoutExpired:
+        leader.kill()
+        follower.kill()
+        tails = []
+        for name in ("leader", "follower"):
+            try:
+                tails.append(f"--- {name} ---\n" + (
+                    tmp_path / f"{name}.log").read_text()[-3000:])
+            except OSError:
+                pass
+        pytest.fail("spmd serving worker timed out\n"
+                    + "\n".join(tails))
+    assert leader.returncode == 0, f"leader failed:\n{outs[0]}"
+    assert follower.returncode == 0, f"follower failed:\n{outs[1]}"
+    assert "FOLLOWER_OK" in outs[1], outs[1]
+    replayed = int(outs[1].split("FOLLOWER_OK calls=")[1].split()[0])
+    # prefills + patches + decode calls for three generations
+    assert replayed >= 6, outs[1]
+
+    single, sf = _run_to_file(
+        [sys.executable, "-c", WORKER],
+        _env(None, 1, 0, 0, "single", 4), tmp_path / "single.log")
+    out_single = _wait_read(single, sf, 300)
+    assert single.returncode == 0, f"single failed:\n{out_single}"
+
+    def streams(out: str) -> str:
+        return out.split("STREAMS=")[1].splitlines()[0]
+
+    # The leader's full-serving-loop output across two processes is
+    # identical to the single-process run of the same mesh shape.
+    assert streams(outs[0]) == streams(out_single), (
+        streams(outs[0]), streams(out_single))
+
+
+def test_product_gateway_launches_multi_host(tmp_path):
+    """The PRODUCT surface, not the engine API: `main.py websocket`
+    with TPU_SPMD_ROLE=leader serves the WS gateway over a 2-process
+    mesh while a second `main.py websocket` with role=follower replays
+    its calls — a real client streams tokens from the leader.
+
+    Subprocess output goes to FILES, not pipes: the XLA AOT-loader
+    warnings alone overflow a 64 KB pipe buffer mid-boot, and an
+    unread pipe blocks the child's write() — a silent boot wedge."""
+    import asyncio
+    import json
+
+    # Distinct ephemeral ports in one allocation: sequential
+    # _free_port() calls can hand back duplicates (e.g. ws_port ==
+    # dcn coordinator port wedges the boot), and fixed ports collide
+    # across consecutive runs via TIME_WAIT.
+    (dcn_port, spmd_port, ws_port, mon_l, ws_f, mon_f) = _free_ports(6)
+    common = dict(LLM_PROVIDER="tpu", LLM_MODEL="test-tiny",
+                  TPU_TP_SIZE="2", TPU_DP_SIZE="2",
+                  TPU_DECODE_SLOTS="4", TPU_MAX_MODEL_LEN="256",
+                  DEFAULT_CONTEXT_WINDOW="256", TPU_WARMUP="off",
+                  ENABLE_PYDANTIC_AI="false",
+                  TPU_SPMD_ADDR=f"127.0.0.1:{spmd_port}",
+                  LLM_PORT=str(ws_port),
+                  LLM_MONITORING_PORT=str(mon_l))
+    logs = {}
+    procs = {}
+    for role, env in (
+            ("leader", {**dcn_worker_env(0, 2, dcn_port, 2), **common,
+                        "TPU_SPMD_ROLE": "leader",
+                        "TPU_SPMD_FOLLOWERS": "1"}),
+            ("follower", {**dcn_worker_env(1, 2, dcn_port, 2), **common,
+                          "TPU_SPMD_ROLE": "follower",
+                          "LLM_PORT": str(ws_f),
+                          "LLM_MONITORING_PORT": str(mon_f)})):
+        logs[role] = open(tmp_path / f"{role}.log", "w+")
+        procs[role] = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "main.py"),
+             "websocket"], env=env, cwd=REPO, stdout=logs[role],
+            stderr=subprocess.STDOUT, text=True)
+    leader, follower = procs["leader"], procs["follower"]
+
+    async def chat() -> tuple[str, dict]:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            deadline = asyncio.get_event_loop().time() + 180
+            while True:
+                try:
+                    async with http.get(
+                            f"http://127.0.0.1:{ws_port}/health") as r:
+                        if r.status in (200, 503):
+                            break
+                except aiohttp.ClientError:
+                    pass
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError("leader gateway never came up")
+                await asyncio.sleep(2)
+            async with http.ws_connect(
+                    f"ws://127.0.0.1:{ws_port}/ws/llm") as ws:
+                json.loads((await ws.receive()).data)
+                await ws.send_json({"type": "start_session",
+                                    "config": {"max_tokens": 8,
+                                               "temperature": 0.0,
+                                               "top_k": 0,
+                                               "top_p": 1.0}})
+                json.loads((await ws.receive()).data)
+                await ws.send_json({"type": "user_message",
+                                    "text": "multi host"})
+                text = ""
+                while True:
+                    m = json.loads((await ws.receive()).data)
+                    if m["type"] == "token":
+                        text += m["data"]
+                    elif m["type"] == "response_complete":
+                        return text, m["stats"]
+                    else:
+                        raise AssertionError(m)
+
+    failure = None
+    try:
+        text, stats = asyncio.run(asyncio.wait_for(chat(), timeout=240))
+        assert stats["tokens_generated"] > 0, stats
+        assert text
+    except (TimeoutError, AssertionError) as e:
+        failure = e
+    finally:
+        leader.terminate()
+        follower.terminate()
+        try:
+            leader.wait(timeout=60)
+            follower.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            leader.kill()
+            follower.kill()
+        outs = {}
+        for role, fh in logs.items():
+            fh.flush()
+            fh.seek(0)
+            outs[role] = fh.read()
+            fh.close()
+        out_l, out_f = outs["leader"], outs["follower"]
+    if failure is not None:
+        pytest.fail(f"{failure}\n--- leader tail ---\n{out_l[-3000:]}"
+                    f"\n--- follower tail ---\n{out_f[-3000:]}")
+    assert "spmd follower connected" in out_l, out_l[-2000:]
+    assert "replaying leader calls" in out_f, out_f[-2000:]
