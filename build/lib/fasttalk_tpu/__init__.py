@@ -1,0 +1,21 @@
+"""FastTalk-TPU: a TPU-native LLM serving framework.
+
+A from-scratch rebuild of the capabilities of the FastTalk LLM microservice
+(reference: Berkay2002/fasttalk-llm-microservice) with the inference engine
+in-tree on JAX/XLA instead of delegated to external vLLM/Ollama containers.
+
+Layering (mirrors reference SURVEY.md §1, engine collapsed in-process):
+
+- ``fasttalk_tpu.utils``      — config, logging, errors, metrics (ref L0)
+- ``fasttalk_tpu.models``     — functional Llama forward + weight loading
+- ``fasttalk_tpu.ops``        — attention, RoPE, sampling kernels
+- ``fasttalk_tpu.parallel``   — mesh construction + TP/DP shardings
+- ``fasttalk_tpu.engine``     — KV cache, continuous-batching scheduler,
+                                 async streaming engine (replaces the external
+                                 vLLM/Ollama containers of the reference)
+- ``fasttalk_tpu.serving``    — WebSocket/HTTP server, sessions (ref L2/L3)
+- ``fasttalk_tpu.agents``     — native tool-calling agent (ref voice_agent)
+- ``fasttalk_tpu.monitoring`` — health/metrics sidecar (ref service_monitor)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,22 @@
+from fasttalk_tpu.agents.hermes import (
+    HermesStreamParser,
+    ToolCall,
+    format_tool_result,
+    tools_system_prompt,
+)
+from fasttalk_tpu.agents.tools import (
+    OfflineSearchBackend,
+    RateLimiter,
+    Tool,
+    ToolRegistry,
+    WebSearchBackend,
+    build_default_registry,
+)
+from fasttalk_tpu.agents.voice_agent import VoiceAgent
+
+__all__ = [
+    "HermesStreamParser", "ToolCall", "format_tool_result",
+    "tools_system_prompt",
+    "OfflineSearchBackend", "RateLimiter", "Tool", "ToolRegistry",
+    "WebSearchBackend", "build_default_registry", "VoiceAgent",
+]

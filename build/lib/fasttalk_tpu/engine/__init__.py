@@ -1,0 +1,18 @@
+from fasttalk_tpu.engine.engine import EngineBase, GenerationParams, TPUEngine
+from fasttalk_tpu.engine.factory import build_engine
+from fasttalk_tpu.engine.fake import FakeEngine
+from fasttalk_tpu.engine.slots import Slot, SlotManager
+from fasttalk_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    StreamDetokenizer,
+    Tokenizer,
+    load_tokenizer,
+)
+
+__all__ = [
+    "EngineBase", "GenerationParams", "TPUEngine", "build_engine",
+    "FakeEngine", "Slot", "SlotManager",
+    "ByteTokenizer", "HFTokenizer", "StreamDetokenizer", "Tokenizer",
+    "load_tokenizer",
+]

@@ -1,0 +1,2370 @@
+"""The TPU inference engine: continuous batching over fixed decode slots.
+
+This replaces the external vLLM/Ollama containers of the reference with an
+in-process JAX engine (SURVEY.md §7 design stance: the engine is an
+in-process library behind the same async-generator seam the reference
+handlers exposed, vllm_handler.py:216-225).
+
+Architecture (JetStream-style, XLA-first):
+
+- **Fixed shapes.** S decode slots; one jitted decode step advances all
+  slots at once. Prefill is chunked into power-of-two buckets; each bucket
+  compiles once. KV-length buckets bound attention cost: the decode step
+  is compiled per cache-prefix length in {512, 1024, ...} and the engine
+  picks the smallest bucket covering the longest active sequence.
+- **Donated KV cache.** The cache pytree is donated through every jitted
+  call, so K/V updates happen in place in HBM. Idle slots are excluded
+  from cache writes by a per-slot write mask, so a parked session's
+  resident KV can never be clobbered by the batched step.
+- **Single engine thread** owns every device interaction; asyncio callers
+  talk to it through a command queue, and token deltas travel back via
+  ``loop.call_soon_threadsafe`` onto per-request ``asyncio.Queue``s. A
+  generation is therefore fully async on the serving side — the
+  event-loop-stalling sync-generator bug of the reference
+  (websocket_server_vllm.py:578, SURVEY.md §3.3 warning) cannot occur.
+- **Device-resident decode state, multi-token calls, pipelined dispatch.**
+  Positions, active mask, per-slot sampling params, the current token and
+  the PRNG key all live on the device and are chained call-to-call; one
+  jitted call runs ``steps_per_call`` decode steps under ``lax.scan`` and
+  returns all sampled tokens, and up to ``pipeline_depth`` calls stay in
+  flight so the host-side fetch/detokenise of call N overlaps the device
+  compute of call N+1. Host mirrors are reconciled (and re-uploaded) only
+  when the slot set changes — request admission, completion, cancel. A
+  slot that finishes mid-call keeps decoding garbage until the pipeline
+  drains; those tokens are dropped on the host and their (masked or
+  past-the-kept-length) KV writes are never attended to.
+- **Mid-decode cancellation.** Cancel is a command; the engine deactivates
+  the slot at the next step boundary, freeing capacity immediately
+  (reference flaw: cancel could not even be received until generation
+  completed, SURVEY.md §3.6).
+- **KV residency across turns.** Sessions pin slots (engine/slots.py);
+  a follow-up turn prefills only the token delta after prefix matching.
+- **Shared-prefix KV.** A fresh session whose prompt starts with rows
+  resident in ANOTHER slot (common system prompt) gets them by device
+  copy — cross-session at admission, and intra-batch for cold bursts
+  (leader prefills, members stamp; see _prefill_batched_shared).
+- **Speculative decoding** (opt-in): on-device prompt-lookup drafts
+  verified as multi-token scatter-decode blocks, exactly
+  distribution-preserving (see _get_spec_decode_fn and
+  docs/SPEC_DECODE.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncGenerator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fasttalk_tpu.engine.slots import Slot, SlotManager
+from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
+                                       init_cache)
+from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
+                                       sample_tokens)
+from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from fasttalk_tpu.utils.logger import get_logger
+from fasttalk_tpu.utils.metrics import get_metrics
+
+log = get_logger("engine")
+
+_KV_BUCKETS = (512, 1024, 2048, 4096, 8192, 16384, 32768)
+_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+
+
+@dataclass
+class GenerationParams:
+    temperature: float = 0.7
+    top_k: int = 40
+    top_p: float = 0.9
+    max_tokens: int = 2048
+    stop: list[str] = field(default_factory=list)
+    # Penalties against the current generation's emitted tokens, applied
+    # on device by ops/sampling.apply_penalties. Neutral at the engine
+    # seam (1.0 / 0.0 / 0.0); the serving layer defaults repeat_penalty
+    # to 1.1 (Config), matching the Ollama engine-side default the
+    # reference silently relied on.
+    repeat_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # vLLM-parity extension (SamplingParams.ignore_eos): decode to the
+    # token budget instead of stopping on EOS — fixed-length benching
+    # and forced continuation.
+    ignore_eos: bool = False
+
+    def __post_init__(self) -> None:
+        # Client-reachable values: apply_penalties DIVIDES by
+        # repeat_penalty, so 0/negative/NaN would poison the whole
+        # generation with inf logits rather than erroring. Raising here
+        # surfaces as a 400 on /v1 and an invalid_config error frame on
+        # the WS (caught before the circuit breaker — a client-shape
+        # error must not open the shared breaker, serving/server.py).
+        import math
+
+        if not (math.isfinite(self.repeat_penalty)
+                and 0.0 < self.repeat_penalty <= 2.0):
+            raise ValueError(
+                f"repeat_penalty must be in (0, 2], got "
+                f"{self.repeat_penalty}")
+        if not math.isfinite(self.presence_penalty):
+            raise ValueError("presence_penalty must be finite")
+        if not math.isfinite(self.frequency_penalty):
+            raise ValueError("frequency_penalty must be finite")
+    # Text-completion mode (/v1/completions): the prompt is the joined
+    # message content, tokenized verbatim (BOS + bytes, no chat
+    # template). Out of band on purpose — an in-band role sentinel
+    # would let chat clients bypass the template.
+    raw_prompt: bool = False
+
+
+def raw_prompt_text(messages: list[dict]) -> str:
+    """The raw completion prompt for ``raw_prompt=True``: joined message
+    content. One definition for every backend (tpu/vllm/ollama must
+    produce the same prompt for the same request)."""
+    return "".join(str(m.get("content") or "") for m in messages)
+
+
+@dataclass
+class _PrefillState:
+    """A long prompt being prefilled chunk-by-chunk, interleaved with
+    decode calls so running sessions keep streaming (one chunk per engine
+    loop iteration; the reference's analogue was head-of-line blocking
+    the whole gateway on a single HTTP request)."""
+
+    req: "_Request"
+    slot: Slot
+    start: int
+    todo: list[int]
+    t0: float = field(default_factory=time.monotonic)
+    last_logits: Any = None
+
+
+@dataclass
+class _Request:
+    request_id: str
+    session_id: str
+    prompt_tokens: list[int]
+    params: GenerationParams
+    out_queue: asyncio.Queue
+    loop: asyncio.AbstractEventLoop
+    submitted_at: float = field(default_factory=time.monotonic)
+    detok: StreamDetokenizer | None = None
+    slot: Slot | None = None
+    generated: int = 0
+    pending_text: str = ""     # held back for stop-string matching
+    emit_buf: str = ""         # text batched within one retirement
+    first_token_at: float | None = None
+    first_pending: bool = False  # first sampled token not yet fetched
+    cancelled: bool = False
+    finished: bool = False
+
+
+class EngineBase:
+    """The engine seam the serving layer depends on. Mirrors the surface
+    of the reference's backend handlers (generate stream + connection
+    check + model info + cancel, vllm_handler.py:117-326) as one async
+    interface; tests substitute a FakeEngine."""
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    def cancel(self, request_id: str) -> bool:
+        raise NotImplementedError
+
+    def release_session(self, session_id: str) -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        raise NotImplementedError
+
+    def get_model_info(self) -> dict:
+        raise NotImplementedError
+
+    def get_stats(self) -> dict:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+    def warmup(self, level: str = "off") -> None:
+        """Pre-compile hot shapes before serving traffic (no-op by
+        default; the TPU engine overrides)."""
+
+
+class TPUEngine(EngineBase):
+    """The real engine. Owns params, KV cache, tokenizer, decode loop."""
+
+    def __init__(self, model_cfg: ModelConfig, params: Any,
+                 tokenizer: Tokenizer, *, num_slots: int = 16,
+                 max_len: int = 8192, prefill_chunk: int = 512,
+                 dtype: Any = jnp.bfloat16, seed: int = 0,
+                 context_window: int | None = None, mesh: Any = None,
+                 use_pallas_attention: bool = False,
+                 use_pallas_int8: bool = True,
+                 steps_per_call: int = 8, pipeline_depth: int = 2,
+                 sampling_method: str = "fast",
+                 spec_decode: str = "off", spec_draft_len: int = 7,
+                 spec_breakeven: float = 1.45,
+                 shared_prefix: bool = True):
+        self.cfg = model_cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.num_slots = num_slots
+        # Cache length rounds up to the bucket granule: the flash prefill
+        # (block 512) and the Pallas decode kernel (block 128) both need
+        # a divisible key axis, and an off-granule TPU_MAX_MODEL_LEN like
+        # 1000 is a legal config. The request-visible limit stays at the
+        # configured length via usable_len.
+        self.max_len = -(-max_len // _KV_BUCKETS[0]) * _KV_BUCKETS[0]
+        self.usable_len = min(max_len, context_window or max_len)
+        self.prefill_chunk = min(prefill_chunk, max(_PREFILL_BUCKETS))
+        self.dtype = dtype
+        self.mesh = mesh
+        # GSPMD cannot partition a custom kernel over a mesh; the Pallas
+        # paths are single-device optimisations only. The attention and
+        # int8-matmul kernels gate independently.
+        self.use_pallas_attention = use_pallas_attention and mesh is None
+        self.use_pallas_int8 = use_pallas_int8 and mesh is None
+        # Single-device decode uses models.llama.forward_decode: the
+        # whole cache rides the step scan's CARRY (carries alias inside
+        # a program), each step scatter-writes only the new K/V column,
+        # and attention reads a slice bounded by the KV bucket. The r2
+        # design sliced the bucket out of the cache and scattered it
+        # back around every K-step call; together with the scan-ys
+        # recycling inside forward() those copies traced at ~40% of
+        # decode wall time on a v5e-1 (measured best structure of five:
+        # 3.96 ms/step vs 4.99 classic, llama.py forward_decode note).
+        # The mesh path keeps forward(): its cache is "sp"-sharded and
+        # per-layer dynamic slices would break GSPMD's even sharding.
+        self._scatter_decode = mesh is None
+        # Self-drafting speculative decoding (engine-owned, no second
+        # model): drafts come from the slot's own token history via
+        # on-device prompt-lookup, a verify block of draft+1 positions
+        # runs through forward_decode_multi, and the longest
+        # sampled-equal prefix is accepted — exactly
+        # distribution-preserving for deterministic drafts (sampling
+        # t~p and accepting while t == draft emits accept-prob p(d) and
+        # the residual distribution on mismatch). Device-side drafting
+        # keeps the call pipeline intact: the host is never in the
+        # draft loop, so spec calls pipeline exactly like plain ones.
+        #
+        # Modes: "ngram" = every call speculative; "auto" = the engine
+        # decides per call from its own measured acceptance — spec when
+        # the EMA tokens-per-verify clears the measured break-even
+        # (docs/SPEC_DECODE.md: a verify block costs ~1.43 plain steps
+        # on v5e), plain otherwise, with a periodic probe call so a
+        # workload shift (e.g. templated text arriving) is noticed.
+        # Auto never loses more than the probe overhead (~1 call in
+        # 16) and wins whenever drafts are being accepted — VERDICT r4
+        # #3's no-knob-guessing mode.
+        # Requires the scatter-decode path, and is disabled under the
+        # Pallas attention kernel: the verify block runs the XLA
+        # scatter forward regardless, and plain calls in spec modes use
+        # the history-maintaining scatter variant — mixing kernels per
+        # call is an untested matrix, so the explicit pallas knob wins.
+        spec_ok = self._scatter_decode and not self.use_pallas_attention
+        self.spec_mode = (spec_decode
+                          if spec_ok
+                          and spec_decode in ("ngram", "auto") else "off")
+        self.spec_draft = (max(1, spec_draft_len)
+                           if self.spec_mode != "off" else 0)
+        self.spec_breakeven = spec_breakeven
+        self._spec_probe_every = 16
+        self._spec_probe_countdown = 1  # probe on the first call
+        # EMA of tokens emitted per verify block: sizes the dispatcher's
+        # token promises and drives the auto-mode decision.
+        self._spec_ema = 1.0
+        # Cross-session shared-prefix KV: a fresh admission whose prompt
+        # starts with rows already resident in ANOTHER slot (the
+        # common-system-prompt fleet case) copies those rows in HBM
+        # instead of re-prefilling them — a [L, plen, Kv, H] device
+        # copy is ~free next to recomputing the prefix through the
+        # model. Single-device only: on a mesh the slot axis is
+        # "dp"-sharded and a cross-slot dynamic slice would bounce
+        # through collectives.
+        self.shared_prefix = shared_prefix and mesh is None
+
+        if mesh is not None:
+            # Tensor-parallel serving: weights and KV sharded over ICI;
+            # GSPMD turns the row-parallel matmuls into all-reduces.
+            # (The reference's only TP story was forwarding
+            # --tensor-parallel-size to an external container,
+            # docker-compose.vllm.yml:42.) The cache is created directly
+            # in its shards; params are re-placed (a no-op when the
+            # loader already put them with parallel.sharding.param_put).
+            from fasttalk_tpu.parallel.sharding import (shard_params,
+                                                        validate_mesh)
+            validate_mesh(mesh, num_kv_heads=model_cfg.num_kv_heads,
+                          num_heads=model_cfg.num_heads,
+                          hidden=model_cfg.hidden_size,
+                          intermediate=model_cfg.intermediate_size,
+                          vocab=model_cfg.vocab_size,
+                          num_slots=num_slots, max_len=self.max_len)
+            self.params = shard_params(params, mesh)
+        self.cache = self._make_cache()
+        self.seed = seed
+        # Sampling is restricted to ids the tokenizer can decode: with a
+        # real checkpoint the two vocabs match and this is a no-op, but
+        # weight-free serving pairs random-init weights (model vocab,
+        # e.g. 128256) with the bundled 32k tokenizer — unclamped
+        # sampling then emits ~75% undecodable ids, whose empty text
+        # deltas hold first-token frames back a whole decode call.
+        self.sample_vocab = min(model_cfg.vocab_size,
+                                getattr(tokenizer, "vocab_size",
+                                        model_cfg.vocab_size))
+        self.slots = SlotManager(num_slots, self.max_len)
+        self.steps_per_call = max(1, steps_per_call)
+        # Burst-mode call length: while admissions or prefills are
+        # pending, dispatch SHORT calls so a new arrival's prefill waits
+        # behind ~30 ms of in-order device queue instead of
+        # pipeline_depth x ~100 ms (long calls amortise the per-call
+        # cache boundary copy, which is what steady-state wants; TTFT
+        # under concurrent load wants the opposite).
+        self.steps_burst = min(8, self.steps_per_call)
+        self.pipeline_depth = max(1, pipeline_depth)
+        self.sampling_method = sampling_method
+        # Device→host copies run on a small worker pool, submitted at
+        # dispatch time, so fetches overlap both each other and later
+        # calls' compute. On relayed devices every fetch REQUEST costs a
+        # full link round trip when it is issued (measured ~105 ms RTT
+        # with copy_to_host_async a no-op — serial retirement capped the
+        # whole engine at one K-step call per RTT), but concurrent
+        # fetches share the trip (8 parallel fetches ≈ 1 RTT,
+        # scripts/profile_prefill.py), so retirement only ever waits on
+        # the oldest outstanding copy. Workers only read result arrays
+        # the engine never mutates; all dispatch stays on the engine
+        # thread.
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=max(4, self.pipeline_depth + 2),
+            thread_name_prefix="tpu-fetch")
+        self._reset_decode_state()
+
+        # Multi-host SPMD serving (parallel/spmd_serving.py): when set,
+        # every serving-time device call publishes a replay descriptor
+        # BEFORE dispatching, so follower processes execute the same
+        # program sequence against their shards. Leader-only decision
+        # making; followers never start() an engine thread.
+        self.call_sink: Any = None
+
+        self._commands: queue.Queue = queue.Queue()
+        self._waiting: list[_Request] = []
+        self._prefilling: list[_PrefillState] = []  # long prompts, FIFO
+        self._running: dict[int, _Request] = {}  # slot index -> request
+        self._by_id: dict[str, _Request] = {}
+        self._release_after: set[str] = set()  # sessions to unpin on finish
+        self._thread: threading.Thread | None = None
+        self._stopped = threading.Event()
+        self._started = False
+        # Serializes shutdown vs. supervised restart: without it a
+        # restart running on an executor thread could observe
+        # _started=False mid-shutdown and spawn a fresh engine thread
+        # after the process believes the engine is down.
+        self._lifecycle_lock = threading.Lock()
+        self._closed = False
+        self._decode_fns: dict[int, Any] = {}
+        self._prefill_fns: dict[int, Any] = {}
+        self._spec_fns: dict[tuple, Any] = {}
+        self._patch_fn: Any = None
+        self._hist_patch_fns: dict[int, Any] = {}
+        self._sample_place_fn: Any = None
+
+        m = get_metrics()
+        self._m_tokens = m.counter("engine_tokens_generated_total",
+                                   "tokens generated by the engine")
+        self._m_requests = m.counter("engine_requests_total",
+                                     "generation requests accepted")
+        self._m_ttft = m.histogram("engine_ttft_ms", "time to first token")
+        self._m_step = m.histogram(
+            "engine_decode_wait_ms",
+            "host blocking wait per retired K-step decode call "
+            "(near zero when retirement overlaps the next call)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000, 4000))
+        self._m_prefill = m.histogram(
+            "engine_prefill_ms", "prefill wall time per request",
+            buckets=(4, 16, 64, 256, 1000, 4000, 16000, 60000))
+        self._m_active = m.gauge("engine_active_slots", "slots decoding")
+        self._m_queue = m.gauge("engine_queue_depth", "requests waiting")
+        self._m_prefix = m.counter("engine_prefix_tokens_reused_total",
+                                   "prompt tokens served from resident KV")
+        self._m_shared = m.counter(
+            "engine_shared_prefix_tokens_total",
+            "prompt tokens served by cross-slot KV copy instead of "
+            "prefill")
+        self._m_spec = m.histogram(
+            "engine_spec_tokens_per_verify",
+            "tokens emitted per speculative verify block (accepted "
+            "drafts + 1); 1 means no draft accepted",
+            buckets=tuple(range(1, max(2, self.spec_draft + 2))))
+
+    def _make_cache(self) -> KVCache:
+        if self.mesh is None:
+            return init_cache(self.cfg, self.num_slots, self.max_len,
+                              self.dtype)
+        from jax.sharding import NamedSharding
+
+        from fasttalk_tpu.parallel.sharding import cache_pspecs
+
+        return init_cache(self.cfg, self.num_slots, self.max_len, self.dtype,
+                          device=NamedSharding(self.mesh, cache_pspecs().k))
+
+    def _reset_decode_state(self) -> None:
+        """(Re)build the host mirrors and device-resident decode state."""
+        num_slots = self.num_slots
+        # Host mirrors of the per-slot decode state. The authoritative
+        # copies live on the device and chain through decode calls; slot
+        # changes are scattered onto them with _patch_slot_state.
+        self._positions = np.zeros((num_slots,), np.int32)
+        self._active_mask = np.zeros((num_slots,), bool)
+        self._temps = np.zeros((num_slots,), np.float32)
+        self._topks = np.zeros((num_slots,), np.int32)
+        self._topps = np.ones((num_slots,), np.float32)
+        self._reps = np.ones((num_slots,), np.float32)
+        self._press = np.zeros((num_slots,), np.float32)
+        self._freqs = np.zeros((num_slots,), np.float32)
+        self._cur_tokens = self._put(np.zeros((num_slots,), np.int32))
+        self._positions_dev = self._put(self._positions)
+        self._active_dev = self._put(self._active_mask)
+        self._temps_dev = self._put(self._temps)
+        self._topks_dev = self._put(self._topks)
+        self._topps_dev = self._put(self._topps)
+        self._reps_dev = self._put(self._reps)
+        self._press_dev = self._put(self._press)
+        self._freqs_dev = self._put(self._freqs)
+        # Per-slot emitted-token counts [S, sample_vocab] — the penalty
+        # state (ops/sampling.apply_penalties). Maintained in-program by
+        # the decode steps (each step counts the token it FEEDS, so every
+        # emitted token — including the prefill-sampled first — is
+        # counted exactly once); zeroed by the patch program when a slot
+        # is (re)admitted or finishes. At [16, 128k] int32 this is ~8 MB.
+        self._counts_dev = self._put(
+            np.zeros((num_slots, self.sample_vocab), np.int32))
+        self._rng_dev = self._put(jax.random.PRNGKey(self.seed))
+        # Speculative decoding's device-resident token history
+        # [S, max_len]: the draft source. Chained through spec calls
+        # (accepted tokens appended in-program); prompt tokens are
+        # uploaded at admission via _patch_slot_state. int32, ~KBs.
+        self._history_dev = (self._put(
+            np.zeros((num_slots, self.max_len), np.int32))
+            if self.spec_draft else None)
+        # slot index -> prompt token list awaiting history upload.
+        self._dirty_history: dict[int, list[int]] = {}
+        # Slots whose host mirrors changed since the last device patch.
+        # Changes are SCATTERED onto the chained device arrays instead of
+        # draining the pipeline and re-uploading everything — admission
+        # and completion never stall in-flight decode calls.
+        self._dirty_slots: set[int] = set()
+        # In-flight decode calls: (host-copy Future, EXPECTED tokens the
+        # call will emit per request, EXPECTED positions it advances,
+        # the (slot index, request) pairs running at dispatch time).
+        # Plain calls emit exactly K tokens (both fields == K);
+        # speculative calls emit K..K*(G+1) and both fields are
+        # EMA-based estimates — the dispatcher's base/bucket math may
+        # therefore transiently under- or over-estimate device
+        # positions, which is safe: the in-call act gate masks steps
+        # that would overflow the chosen bucket, and retirement re-syncs
+        # the host mirrors (one under-productive call worst case; never
+        # a correctness issue). Tokens are attributed to the
+        # dispatch-time request, never to whoever occupies the slot at
+        # retirement — a slot can be re-admitted to a new request while
+        # an older call is still in flight.
+        self._inflight: deque[
+            tuple[Future, float, int, list[tuple[int, _Request]]]] = deque()
+        # First sampled tokens whose device→host copy is still in
+        # flight: (host-copy Future, [(row, slot_index, request), ...]).
+        # Admission emits the first token only when the fetch lands, so
+        # prefill never blocks the engine thread on a device round trip.
+        self._pending_firsts: deque[tuple[Future, list]] = deque()
+
+    # ---------------- public (asyncio side) ----------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._stopped.clear()
+        self._thread = threading.Thread(target=self._run, name="tpu-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        with self._lifecycle_lock:
+            self._closed = True
+            if self._started:
+                self._commands.put(("stop", None))
+                self._stopped.wait(timeout=30)
+                self._started = False
+            self._fetch_pool.shutdown(wait=False, cancel_futures=True)
+
+    def restart(self) -> bool:
+        """Recover from an engine-thread crash: rebuild the device-side
+        decode state (the crash may have struck mid-call, leaving the
+        donated cache buffer consumed or poisoned) and start a fresh
+        thread on the SAME command queue, so requests submitted during
+        the outage are served rather than lost. Session KV residency is
+        dropped — a session's next turn re-prefills — but the process
+        keeps serving, where the reference's only recovery was a
+        container restart (docker restart: unless-stopped,
+        docker-compose.vllm.yml:14). Compiled executables are kept:
+        weights are intact, so nothing needs recompiling."""
+        with self._lifecycle_lock:
+            if self._closed:
+                return False  # shutdown won; never resurrect past it
+            if self.call_sink is not None:
+                # Restart is leader-local device-state surgery and is
+                # not replicated to followers; multi-host recovery is a
+                # cluster restart (parallel/spmd_serving.py scope note).
+                log.error("engine restart unsupported in multi-host "
+                          "SPMD serving mode")
+                return False
+            if self.check_connection():
+                return True
+            if self._thread is not None and self._thread.is_alive():
+                return False  # still tearing down; try again later
+            log.warning("engine restart: rebuilding device decode state")
+            self._waiting.clear()
+            self._prefilling.clear()
+            self._running.clear()
+            self._release_after.clear()
+            # Keep registrations of requests submitted in the crash race
+            # window (registered after _abort_all's sweep): their queued
+            # submit commands survive on the shared command queue and the
+            # new thread will admit them — dropping the registration
+            # would strand cancel() for those ids. Prune IN PLACE (not a
+            # dict rebuild): generate() on the event loop can insert a
+            # registration concurrently, and a rebuild would silently
+            # drop it (ADVICE r2) — per-key pops never lose an insert.
+            for rid in [rid for rid, r in self._by_id.items()
+                        if r.finished]:
+                self._by_id.pop(rid, None)
+            self.slots = SlotManager(self.num_slots, self.max_len)
+            # Release the old KV cache (and the in-flight refs pinning
+            # decode-state arrays) BEFORE allocating the fresh one: on
+            # host-side crashes the donated buffer was never consumed,
+            # and holding both copies transiently doubles KV HBM — on
+            # memory-tight configs the recovery path itself would OOM
+            # and the watchdog would re-OOM every probe (ADVICE r2).
+            self.cache = None
+            self._inflight.clear()
+            self._pending_firsts.clear()
+            self.cache = self._make_cache()
+            self._reset_decode_state()
+            self._started = False
+            self.start()
+            return self.check_connection()
+
+    def warmup(self, level: str = "fast") -> None:
+        """Compile hot shapes before serving traffic, so the first users
+        never pay the 20-40s XLA compile (the reference's analogue was
+        the engine container's multi-minute cold start behind a 300s
+        health start_period, docker-compose.vllm.yml:62-67).
+
+        Must run before ``start()`` (single-threaded device access).
+        ``fast`` compiles the common chat shapes (~6 executables): the
+        first decode KV bucket, batched prefill at the typical prompt
+        bucket and the configured chunk for group sizes {1, num_slots},
+        plus the single-slot long-prompt path at the full chunk size
+        (one long system prompt is common in voice deployments).
+        ``full`` adds every decode KV bucket up to max_len and every
+        prefill bucket. Warmup
+        calls mask their writes (or, for the single-slot path, write
+        into a slot region no session has claimed yet), so no later
+        request can observe warmup garbage.
+        """
+        if level in ("off", "", "none"):
+            return
+        if self._started:
+            raise RuntimeError("warmup() must be called before start()")
+        if self.call_sink is not None:
+            # Warmup calls are not published to followers; multi-host
+            # serving compiles lazily on both sides instead.
+            raise RuntimeError(
+                "warmup is unsupported with a multi-host call sink "
+                "attached (set TPU_WARMUP=off)")
+        t0 = time.monotonic()
+        kv_buckets = [b for b in _KV_BUCKETS if b <= self.max_len] \
+            or [self.max_len]
+        # Serving picks buckets from _PREFILL_BUCKETS with b >= chunk, so
+        # a sub-16 prefill_chunk still lands on the smallest bucket.
+        pbuckets = [b for b in _PREFILL_BUCKETS
+                    if b <= self.prefill_chunk] or [_PREFILL_BUCKETS[0]]
+        if level != "full":
+            common = 64 if 64 in pbuckets else pbuckets[0]
+            # Include the long-prompt chunk bucket so the fast warmup's
+            # single-slot compile below actually triggers.
+            chunk_bucket = next((x for x in _PREFILL_BUCKETS
+                                 if x >= self.prefill_chunk),
+                                _PREFILL_BUCKETS[-1])
+            pbuckets = sorted({common, pbuckets[-1], chunk_bucket})
+        decode_buckets = kv_buckets if level == "full" else kv_buckets[:1]
+
+        inactive = self._put(np.zeros((self.num_slots,), bool))
+        for b in decode_buckets:
+            for steps in sorted({self.steps_burst, self.steps_per_call}):
+                if self.spec_draft:
+                    # Spec modes dispatch the history-maintaining plain
+                    # variant (the no-history one is never used).
+                    fn = self._get_decode_fn(b, steps, with_history=True)
+                    (self.cache, self._history_dev, self._counts_dev,
+                     toks, _, _, _) = fn(
+                        self.params, self.cache, self._history_dev,
+                        self._counts_dev, self._cur_tokens,
+                        self._positions_dev, inactive, self._temps_dev,
+                        self._topks_dev, self._topps_dev,
+                        self._reps_dev, self._press_dev,
+                        self._freqs_dev, self._rng_dev)
+                else:
+                    fn = self._get_decode_fn(b, steps)
+                    self.cache, self._counts_dev, toks, _, _, _ = fn(
+                        self.params, self.cache, self._counts_dev,
+                        self._cur_tokens, self._positions_dev, inactive,
+                        self._temps_dev, self._topks_dev,
+                        self._topps_dev, self._reps_dev,
+                        self._press_dev, self._freqs_dev, self._rng_dev)
+                jax.block_until_ready(toks)
+                if self.spec_draft:
+                    # All-inactive spec warmup: every write masks out.
+                    # No eligibility gate here — dispatch eligibility
+                    # depends on runtime positions (EMA-sized need),
+                    # so any gate that skips a (bucket, steps) pair
+                    # warmup-time can still see it requested mid-stream
+                    # and pay the compile under traffic.
+                    sfn = self._get_spec_decode_fn(b, steps)
+                    (self.cache, self._history_dev, self._counts_dev,
+                     toks, _, _, _) = sfn(
+                        self.params, self.cache, self._history_dev,
+                        self._counts_dev, self._cur_tokens,
+                        self._positions_dev, inactive,
+                        self._temps_dev, self._topks_dev,
+                        self._topps_dev, self._reps_dev, self._press_dev,
+                        self._freqs_dev, self._rng_dev)
+                    jax.block_until_ready(toks)
+        if self.spec_draft:
+            # The admission-path history upload (slot indices out of
+            # range: every row drops). 256 is the common chat-prompt
+            # row bucket; longer prompts compile their bucket on first
+            # use (a tiny pad+scatter program).
+            self._history_dev = self._get_hist_patch_fn(
+                min(256, self.max_len))(
+                self._history_dev,
+                self._arg(np.zeros((self.num_slots,
+                                    min(256, self.max_len)), np.int32)),
+                self._arg(np.full((self.num_slots,), self.num_slots,
+                                  np.int32)))
+            jax.block_until_ready(self._history_dev)
+        # The admission-path helper programs (slot-state patch; they are
+        # tiny but a first-request compile is still seconds).
+        nopatch = np.zeros((self.num_slots, 9), np.float32)
+        (self._counts_dev, self._positions_dev, self._active_dev,
+         self._temps_dev, self._topks_dev, self._topps_dev,
+         self._reps_dev, self._press_dev, self._freqs_dev) = \
+            self._get_patch_fn()(
+                self._arg(nopatch), self._counts_dev, self._positions_dev,
+                self._active_dev, self._temps_dev, self._topks_dev,
+                self._topps_dev, self._reps_dev, self._press_dev,
+                self._freqs_dev)
+
+        # The single-slot long-prompt path buckets by the smallest
+        # _PREFILL_BUCKETS entry covering a full chunk — warm exactly
+        # that shape (pbuckets[-1] only equals it when prefill_chunk is
+        # itself a bucket value).
+        long_bucket = next((x for x in _PREFILL_BUCKETS
+                            if x >= self.prefill_chunk), _PREFILL_BUCKETS[-1])
+        for b in pbuckets:
+            # Must match the ctx _prefill_group derives for a fresh
+            # session (starts=0): the smallest KV bucket covering b.
+            ctx = next((k for k in kv_buckets if k >= b), self.max_len)
+            for gp in sorted({1, self.num_slots}):
+                fn = self._get_batched_prefill_fn(b, gp, ctx)
+                # All rows masked + out-of-range scatter: no cache (or
+                # cur-token) writes. Args are built exactly as the
+                # serving path builds them (numpy via _arg) so the
+                # compiled executable keys on the same avals.
+                rowcfg = np.zeros((gp, 7), np.float32)
+                rowcfg[:, 0] = np.arange(self.num_slots,
+                                         self.num_slots + gp)
+                rowcfg[:, 4:] = (1.0, 40, 0.9)
+                (self.cache, firsts, self._cur_tokens,
+                 self._rng_dev) = fn(
+                    self.params, self.cache,
+                    self._arg(np.zeros((gp, b), np.int32)),
+                    self._arg(rowcfg), self._cur_tokens, self._rng_dev)
+                jax.block_until_ready(firsts)
+            if level == "full" or b == long_bucket:
+                # Single-slot long-prompt path: writes land in slot 0's
+                # region, unclaimed at warmup time (kv_written stays 0,
+                # so nothing ever trusts them). Its first-token sample
+                # runs the same jitted sample-and-place program the
+                # serving path uses (slot index out of range: the
+                # current-token scatter drops).
+                fn = self._get_prefill_fn(b)
+                self.cache, last = fn(self.params, self.cache,
+                                      self._arg(np.zeros((b,), np.int32)),
+                                      np.int32(0), np.int32(0),
+                                      np.int32(b - 1))
+                cfg_row = np.array([self.num_slots, 1.0, 40, 0.9],
+                                   np.float32)
+                first, self._cur_tokens, self._rng_dev = \
+                    self._get_sample_place_fn()(
+                        last, self._cur_tokens, self._rng_dev,
+                        self._arg(cfg_row))
+                jax.block_until_ready(first)
+        jax.block_until_ready(self.cache.k)
+        # Warm every fetch worker's first device→host copy: on relayed
+        # attach paths a thread's FIRST fetch pays one-time client
+        # setup well beyond the steady RTT, and without this the first
+        # real generation absorbed it as multi-second TTFT.
+        futs = [self._fetch_pool.submit(np.asarray, self._cur_tokens)
+                for _ in range(self._fetch_pool._max_workers)]
+        for f in futs:
+            f.result()
+        log.info(f"warmup({level}) compiled "
+                 f"{len(self._decode_fns) + len(self._prefill_fns)} "
+                 f"executables in {time.monotonic() - t0:.1f}s")
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        """Stream events: {"type": "token", "text": ...} per delta, then a
+        terminal {"type": "done"|"error"|"cancelled", ...}."""
+        if not self.check_connection():
+            raise LLMServiceError("Engine is not running (call start())",
+                                  category=ErrorCategory.CONNECTION,
+                                  recoverable=True)
+        if params.raw_prompt:
+            # Raw text-completion path (/v1/completions): BOS + verbatim
+            # tokens, no chat template (matching vLLM's completions
+            # endpoint, which prepends BOS by default).
+            prompt = self.tokenizer.encode_prompt(raw_prompt_text(messages))
+        else:
+            prompt = self.tokenizer.apply_chat_template(messages)
+        if len(prompt) >= self.usable_len:
+            raise LLMServiceError(
+                f"Prompt of {len(prompt)} tokens exceeds context window "
+                f"{self.usable_len}", category=ErrorCategory.VALIDATION,
+                recoverable=False)
+        req = _Request(
+            request_id=request_id, session_id=session_id,
+            prompt_tokens=prompt, params=params,
+            out_queue=asyncio.Queue(), loop=asyncio.get_running_loop(),
+            detok=StreamDetokenizer(self.tokenizer))
+        self._m_requests.inc()
+        # Register before enqueueing so an immediate cancel() can't race
+        # the engine thread's command drain.
+        self._by_id[request_id] = req
+        self._commands.put(("submit", req))
+        terminal = False
+        try:
+            while True:
+                event = await req.out_queue.get()
+                if event["type"] in ("done", "error", "cancelled"):
+                    terminal = True
+                yield event
+                if terminal:
+                    return
+        finally:
+            if not terminal:
+                # Caller abandoned the stream (e.g. WebSocket dropped):
+                # free the slot instead of decoding to max_tokens.
+                self.cancel(request_id)
+
+    def cancel(self, request_id: str) -> bool:
+        req = self._by_id.get(request_id)
+        if req is None:
+            return False
+        req.cancelled = True  # visible to the engine thread immediately
+        self._commands.put(("cancel", request_id))
+        return True
+
+    def release_session(self, session_id: str) -> None:
+        self._commands.put(("release", session_id))
+
+    def check_connection(self) -> bool:
+        return self._started and self._thread is not None \
+            and self._thread.is_alive()
+
+    def get_model_info(self) -> dict:
+        return {
+            "model": self.cfg.name,
+            "vocab_size": self.cfg.vocab_size,
+            "num_layers": self.cfg.num_layers,
+            "hidden_size": self.cfg.hidden_size,
+            "parameters": self.cfg.param_count(),
+            "context_window": self.usable_len,
+            "decode_slots": self.num_slots,
+            "dtype": jnp.dtype(self.dtype).name,
+            "devices": [str(d) for d in jax.devices()],
+            "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
+        }
+
+    def get_stats(self) -> dict:
+        return {
+            "slots": self.slots.stats(),
+            "waiting": len(self._waiting),
+            "running": len(self._running),
+        }
+
+    # ---------------- jitted steps ----------------
+
+    def _sink(self, kind: str, **payload) -> None:
+        """Publish a device-call replay descriptor to the attached
+        multi-host call sink (no-op single-host)."""
+        if self.call_sink is not None:
+            self.call_sink(kind, payload)
+
+    def _put(self, arr):
+        """Host array (or PRNG key) → device, replicated over the mesh
+        when present."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec()))
+
+    def _arg(self, arr):
+        """Host array destined to be a jitted-call argument. Without a
+        mesh the numpy array is passed as-is — the call's own transfer
+        is one dispatch, where an explicit device_put costs a separate
+        ~ms-scale round trip per array on relayed devices. With a mesh,
+        explicit replicated placement is required."""
+        return arr if self.mesh is None else self._put(arr)
+
+    def _replicate_sharding(self):
+        """Fully-replicated NamedSharding on the mesh (None when single
+        device): constrains host-fetched program outputs so every host
+        of a multi-process (DCN) mesh can read them."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _get_decode_fn(self, kv_len: int, steps: int | None = None,
+                       with_history: bool = False):
+        """K decode steps in one jitted call (K = ``steps``, default
+        steps_per_call; the dispatcher also compiles the short
+        ``steps_burst`` variant for admission-latency-sensitive moments).
+        ``with_history`` (auto-spec mode) additionally maintains the
+        speculative history buffer so probe calls draft from fresh text.
+
+        The whole per-slot decode state is threaded through the call so
+        nothing round-trips to the host between steps: carry = (sliced
+        K/V, current token, positions, rng). Returns all K sampled
+        tokens; the host consumes them at retirement (SURVEY.md §7 hard
+        part #3 — the naive per-step blocking get this replaces
+        serialised device and host work).
+        """
+        steps = self.steps_per_call if steps is None else steps
+        sp = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
+        if sp > 1:
+            # The sp path attends the FULL sp-sharded cache through
+            # decode_attention_sharded (per-chip O(S/sp) folds + a
+            # statistics psum — masking bounds the horizon, so KV-
+            # bucket specialisation buys nothing); one executable per
+            # step count.
+            kv_len = self.max_len
+        fn = self._decode_fns.get((kv_len, steps, with_history))
+        if fn is not None:
+            return fn
+        use_pallas = self.use_pallas_attention and kv_len % 128 == 0
+        scatter = self._scatter_decode and not use_pallas
+        rows = jnp.arange(self.num_slots)
+        max_len = self.max_len
+        replicate = self._replicate_sharding()
+        cache_override = None
+        if sp > 1:
+            from fasttalk_tpu.parallel.ring_attention import \
+                decode_attention_sharded
+
+            mesh = self.mesh
+
+            def cache_override(q, ck, cv, positions):  # noqa: F811
+                return decode_attention_sharded(q, ck, cv, positions,
+                                                mesh)
+
+        if with_history:
+            # Auto-spec plain call: identical decode, plus maintaining
+            # the spec history invariant (history[s, pos] = fed token)
+            # so a later probe/spec call drafts from fresh text.
+            assert scatter
+
+            @partial(jax.jit, donate_argnums=(1, 2, 3))
+            def decode_call_hist(params, cache: KVCache, history, counts,
+                                 cur_tokens, positions, active, temps,
+                                 topks, topps, reps, press, freqs, rng):
+                def step(carry, _):
+                    ck, cv, hist, cnt, cur, pos, key = carry
+                    key, sub = jax.random.split(key)
+                    act = jnp.logical_and(active, pos < kv_len)
+                    wp = jnp.where(act, pos, max_len)
+                    hist = hist.at[rows, wp].set(cur, mode="drop",
+                                                 unique_indices=True)
+                    cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                                unique_indices=True)
+                    logits, newc = forward_decode(
+                        params, self.cfg, cur, pos, KVCache(ck, cv), act,
+                        attn_len=kv_len,
+                        pallas_int8=self.use_pallas_int8)
+                    lg = apply_penalties(logits[:, :self.sample_vocab],
+                                         cnt, reps, press, freqs)
+                    nxt = sample_tokens(lg, sub, temps, topks, topps,
+                                        method=self.sampling_method)
+                    pos = pos + act.astype(pos.dtype)
+                    return (newc.k, newc.v, hist, cnt, nxt, pos, key), nxt
+
+                (ck, cv, hist, cnt, cur, pos, rng), toks = jax.lax.scan(
+                    step, (cache.k, cache.v, history, counts, cur_tokens,
+                           positions, rng), None, length=steps)
+                return KVCache(ck, cv), hist, cnt, toks, cur, pos, rng
+
+            self._decode_fns[(kv_len, steps, with_history)] = \
+                decode_call_hist
+            return decode_call_hist
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_call(params, cache: KVCache, counts, cur_tokens,
+                        positions, active, temps, topks, topps,
+                        reps, press, freqs, rng):
+            if scatter:
+                def step(carry, _):
+                    ck, cv, cnt, cur, pos, key = carry
+                    key, sub = jax.random.split(key)
+                    # A slot that finished mid-pipeline keeps "decoding"
+                    # until the host reconciles; clamp it off the
+                    # attention horizon so its garbage writes can never
+                    # clobber live rows.
+                    act = jnp.logical_and(active, pos < kv_len)
+                    # Count the token being FED (it was emitted last
+                    # step or by prefill), so the penalty at sampling
+                    # time covers every emitted token exactly once.
+                    cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                                unique_indices=True)
+                    logits, newc = forward_decode(
+                        params, self.cfg, cur, pos, KVCache(ck, cv), act,
+                        attn_len=kv_len,
+                        pallas_int8=self.use_pallas_int8)
+                    lg = apply_penalties(logits[:, :self.sample_vocab],
+                                         cnt, reps, press, freqs)
+                    nxt = sample_tokens(lg, sub, temps, topks, topps,
+                                        method=self.sampling_method)
+                    pos = pos + act.astype(pos.dtype)
+                    return (newc.k, newc.v, cnt, nxt, pos, key), nxt
+
+                (ck, cv, cnt, cur, pos, rng), toks = jax.lax.scan(
+                    step, (cache.k, cache.v, counts, cur_tokens,
+                           positions, rng), None, length=steps)
+                return KVCache(ck, cv), cnt, toks, cur, pos, rng
+
+            ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
+            cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
+
+            def step(carry, _):
+                sk, sv, cnt, cur, pos, key = carry
+                key, sub = jax.random.split(key)
+                act = jnp.logical_and(active, pos < kv_len)
+                cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                            unique_indices=True)
+                logits, small = forward(
+                    params, self.cfg, cur[:, None], pos[:, None],
+                    KVCache(sk, sv), pos, write_mask=act,
+                    pallas_decode=use_pallas,
+                    pallas_int8=self.use_pallas_int8,
+                    cache_attn_override=cache_override)
+                lg = apply_penalties(logits[:, -1, :self.sample_vocab],
+                                     cnt, reps, press, freqs)
+                nxt = sample_tokens(lg, sub, temps, topks, topps,
+                                    method=self.sampling_method)
+                pos = pos + act.astype(pos.dtype)
+                return (small.k, small.v, cnt, nxt, pos, key), nxt
+
+            (ck, cv, cnt, cur, pos, rng), toks = jax.lax.scan(
+                step, (ck, cv, counts, cur_tokens, positions, rng), None,
+                length=steps)
+            new_k = jax.lax.dynamic_update_slice_in_dim(
+                cache.k, ck, 0, axis=2)
+            new_v = jax.lax.dynamic_update_slice_in_dim(
+                cache.v, cv, 0, axis=2)
+            # Sampled tokens leave the program fully replicated: on a
+            # multi-host (DCN) mesh a host can only fetch an array whose
+            # addressable shards cover it — and [K, S] ints are nothing
+            # next to the batch all-reduces GSPMD already inserted.
+            if replicate is not None:
+                toks = jax.lax.with_sharding_constraint(toks, replicate)
+            return KVCache(new_k, new_v), cnt, toks, cur, pos, rng
+
+        self._decode_fns[(kv_len, steps, with_history)] = decode_call
+        return decode_call
+
+    def _get_spec_decode_fn(self, kv_len: int, steps: int):
+        """K speculative steps in one jitted call (single-device scatter
+        path). Each step, entirely on device:
+
+        1. maintain the history invariant ``history[s, pos] = cur``;
+        2. DRAFT via prompt-lookup: find the most recent prior
+           occurrence of the current token in the slot's history and
+           propose the G tokens that followed it;
+        3. VERIFY current + draft (T = G+1 positions) in one
+           ``forward_decode_multi`` block — same weight-streaming cost
+           as ~one plain step at small batch, since decode is
+           weight-bound;
+        4. ACCEPT: sample every position; keep the longest prefix where
+           the sample equals the draft; emit accepted+1 tokens (the
+           first mismatch IS the residual-distribution sample, so the
+           output distribution is exactly the plain-decode one);
+        5. append the emitted tokens to the history, advance positions
+           by n_out.
+
+        Rejected positions' KV is garbage but unreachable: attention
+        masks to each query's absolute position, and the next block's
+        writes start at the accepted length, overwriting it first.
+        Returns per-step (tokens [K, S, T], n_out [K, S]); the host
+        consumes the first n_out tokens per row.
+        """
+        key = (kv_len, steps)
+        fn = self._spec_fns.get(key)
+        if fn is not None:
+            return fn
+        from fasttalk_tpu.models.llama import forward_decode_multi
+
+        G = self.spec_draft
+        T = G + 1
+        S = self.num_slots
+        max_len = self.max_len
+        sv = self.sample_vocab
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def spec_call(params, cache: KVCache, history, counts, cur_tokens,
+                      positions, active, temps, topks, topps,
+                      reps, press, freqs, rng):
+            rows = jnp.arange(S)
+
+            def step(carry, _):
+                ck, cv, hist, cnt, cur, pos, key = carry
+                # Need T columns of cache headroom inside this bucket;
+                # slots without it sit the step out (the dispatcher
+                # falls back to plain decode before this can starve a
+                # request — see _dispatch_decode).
+                act = jnp.logical_and(active, pos + T <= kv_len)
+                wp = jnp.where(act, pos, max_len)
+                hist = hist.at[rows, wp].set(cur, mode="drop",
+                                             unique_indices=True)
+                # Penalty base counts: the fed token (emitted last
+                # block) counts now, same as the plain decode step.
+                cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                            unique_indices=True)
+                idx = jnp.arange(max_len)
+                m = jnp.logical_and(hist == cur[:, None],
+                                    idx[None, :] < pos[:, None])
+                j = jnp.max(jnp.where(m, idx[None, :], -1), axis=1)
+                start = jnp.clip(j + 1, 0, max_len - 1)
+                didx = jnp.clip(start[:, None] + jnp.arange(G)[None, :],
+                                0, max_len - 1)
+                drafts = jnp.take_along_axis(hist, didx, axis=1)  # [S, G]
+                tokens_in = jnp.concatenate([cur[:, None], drafts], 1)
+                logits, newc = forward_decode_multi(
+                    params, self.cfg, tokens_in, pos, KVCache(ck, cv),
+                    act, attn_len=kv_len,
+                    pallas_int8=self.use_pallas_int8)
+                key, sub = jax.random.split(key)
+                # EXACT per-position penalty counts, without vocab-wide
+                # per-position intermediates: block position j is
+                # conditioned on fed tokens cur, d_1..d_j — if position
+                # j's sample is ever emitted, those drafts were accepted
+                # (= emitted), so plain decode would have counted them.
+                # Only the <= G draft-token columns can differ from the
+                # base counts, so penalise everything against the base
+                # [S, 1, V] (broadcast, fused by XLA), then re-penalise
+                # just those entries with their within-block counts and
+                # scatter them in. Keeps speculative decoding exactly
+                # distribution-preserving under penalties.
+                lgf = logits[..., :sv].astype(jnp.float32)  # [S, T, sv]
+                r3 = reps[:, None, None]
+                p3 = press[:, None, None]
+                f3 = freqs[:, None, None]
+                lg = penalize_values(
+                    lgf, cnt[:, None, :].astype(jnp.float32), r3, p3, f3)
+                # occ[s, i, k]: occurrences of d_i among d_1..d_{k+1};
+                # extra count of token d_i at block position j is its
+                # occurrence count among the fed d_1..d_j.
+                eq = (drafts[:, :, None] == drafts[:, None, :]) \
+                    .astype(jnp.float32)                      # [S, G, G]
+                extra = jnp.concatenate(
+                    [jnp.zeros((S, G, 1), jnp.float32),
+                     jnp.cumsum(eq, axis=2)], axis=2)         # [S, G, T]
+                dcl = jnp.minimum(drafts, sv - 1)
+                dcol = jnp.broadcast_to(dcl[:, None, :], (S, T, G))
+                raw = jnp.take_along_axis(lgf, dcol, axis=2)  # [S, T, G]
+                base_c = jnp.take_along_axis(cnt, dcl, axis=1) \
+                    .astype(jnp.float32)                      # [S, G]
+                c_true = base_c[:, None, :] \
+                    + jnp.swapaxes(extra, 1, 2)               # [S, T, G]
+                corr = penalize_values(raw, c_true, r3, p3, f3)
+                # Equal drafts get equal corrected values, so the
+                # duplicate-index scatter is value-consistent;
+                # out-of-vocab draft ids (prompt tokens beyond the
+                # tokenizer vocab) drop — they can never be sampled.
+                scat = jnp.where(
+                    jnp.broadcast_to((drafts < sv)[:, None, :],
+                                     (S, T, G)), dcol, sv)
+                lg = lg.at[jnp.arange(S)[:, None, None],
+                           jnp.arange(T)[None, :, None],
+                           scat].set(corr, mode="drop")
+                t_samp = sample_tokens(
+                    lg.reshape(S * T, sv), sub, jnp.repeat(temps, T),
+                    jnp.repeat(topks, T), jnp.repeat(topps, T),
+                    method=self.sampling_method).reshape(S, T)
+                match = (t_samp[:, :-1] == drafts).astype(jnp.int32)
+                a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # 0..G
+                n_out = jnp.where(act, a + 1, 0)
+                new_cur = jnp.where(
+                    act, jnp.take_along_axis(t_samp, a[:, None], 1)[:, 0],
+                    cur)
+                out_idx = pos[:, None] + 1 + jnp.arange(T)[None, :]
+                keep = jnp.arange(T)[None, :] < n_out[:, None]
+                hist = hist.at[
+                    rows[:, None], jnp.where(keep, out_idx, max_len)].set(
+                    t_samp, mode="drop")
+                # Commit accepted drafts to the counts (they were fed
+                # AND emitted). The residual sample t_samp[:, a] is
+                # new_cur — counted when fed next block, like plain
+                # decode's sampled token.
+                add = jnp.arange(T)[None, :] < (n_out - 1)[:, None]
+                cnt = cnt.at[rows[:, None],
+                             jnp.where(add, t_samp, sv)].add(
+                    jnp.int32(1), mode="drop")
+                pos = pos + n_out
+                # n_out packed as a trailing column: ONE host fetch per
+                # call (a tuple fetch costs two serial link round trips
+                # on relayed attach paths).
+                packed = jnp.concatenate([t_samp, n_out[:, None]], axis=1)
+                return (newc.k, newc.v, hist, cnt, new_cur, pos, key), \
+                    packed
+
+            (ck, cv, hist, cnt, cur, pos, rng), toks = jax.lax.scan(
+                step, (cache.k, cache.v, history, counts, cur_tokens,
+                       positions, rng), None, length=steps)
+            return (KVCache(ck, cv), hist, cnt, toks, cur, pos, rng)
+
+        self._spec_fns[key] = spec_call
+        return spec_call
+
+    @staticmethod
+    def _share_granule(share: int) -> int:
+        """Round a shared-prefix length down to a power of two (min 16).
+
+        The copy executable set is keyed on length; a 16-token granule
+        compiled one executable per distinct share length — an
+        unpredictable synchronous compile stall on the TTFT-critical
+        admission path for heterogeneous system prompts, and up to
+        max_len/16 executables (ADVICE r4). Powers of two bound the set
+        at log2(max_len) ≈ 11 while keeping at least half of any share.
+        """
+        if share < 16:
+            return 0
+        return 1 << (share.bit_length() - 1)
+
+    def _get_prefix_copy_fn(self, plen: int):
+        """Copy one slot's leading ``plen`` KV rows onto another slot —
+        the shared-prefix stamp. Pure HBM traffic (2·L·plen·Kv·H
+        elements), ordered against prefills and decode calls by the
+        donated-cache chain like every other cache op."""
+        key = ("pcopy", plen)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        shape = (self.cfg.num_layers, 1, plen, self.cfg.num_kv_heads,
+                 self.cfg.head_dim)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def prefix_copy(cache: KVCache, src, dst):
+            rk = jax.lax.dynamic_slice(cache.k, (0, src, 0, 0, 0), shape)
+            rv = jax.lax.dynamic_slice(cache.v, (0, src, 0, 0, 0), shape)
+            return KVCache(
+                jax.lax.dynamic_update_slice(cache.k, rk,
+                                             (0, dst, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(cache.v, rv,
+                                             (0, dst, 0, 0, 0)))
+
+        self._prefill_fns[key] = prefix_copy
+        return prefix_copy
+
+    def _get_prefill_fn(self, chunk: int):
+        fn = self._prefill_fns.get(chunk)
+        if fn is not None:
+            return fn
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_step(params, cache: KVCache, tokens, start, slot,
+                         last_index):
+            """Run one prompt chunk for one slot; returns last-token logits."""
+            slot_shape = (self.cfg.num_layers, 1, self.max_len,
+                          self.cfg.num_kv_heads, self.cfg.head_dim)
+            lk = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0), slot_shape)
+            lv = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0), slot_shape)
+            positions = start + jnp.arange(chunk)[None, :]
+            logits, updated = forward(
+                params, self.cfg, tokens[None, :], positions,
+                KVCache(lk, lv), start[None], blockwise=True,
+                pallas_int8=self.use_pallas_int8,
+                logits_indices=last_index[None])
+            new_k = jax.lax.dynamic_update_slice(
+                cache.k, updated.k, (0, slot, 0, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(
+                cache.v, updated.v, (0, slot, 0, 0, 0))
+            return KVCache(new_k, new_v), logits[0, 0]
+
+        self._prefill_fns[chunk] = prefill_step
+        return prefill_step
+
+    def _ring_prefill_eligible(self, start: int, n_tokens: int) -> int:
+        """If this fresh prompt should prefill through ring attention,
+        return its (power-of-two) bucket; else 0.
+
+        Eligible when the engine runs on a mesh with sp > 1, the prompt
+        starts a fresh slot (ring attention is pure self-attention —
+        a non-zero start would need cache rows the ring never visits),
+        and it is long enough that one chip's attention working set is
+        the thing to avoid (>= max_len/sp — the per-chip KV shard; the
+        module's O(T/sp) memory promise, parallel/ring_attention.py).
+        """
+        if self.mesh is None or start != 0:
+            return 0
+        sp = self.mesh.shape.get("sp", 1)
+        if sp <= 1 or n_tokens < max(256, self.max_len // sp):
+            return 0
+        bucket = 1 << (n_tokens - 1).bit_length()  # next power of two
+        bucket = max(bucket, 2 * sp)
+        if bucket > self.max_len or bucket % sp:
+            return 0
+        return bucket
+
+    def _get_ring_prefill_fn(self, bucket: int):
+        """Whole-prompt prefill for ONE slot with attention routed
+        through parallel.ring_attention (VERDICT r4 #4): Q/K/V stay
+        sequence-sharded over "sp" and K/V blocks rotate the ICI ring,
+        so per-chip attention memory is O(T/sp) — where the default
+        GSPMD lowering all-gathers K/V per chip. K/V are also written
+        into the slot's (sp-sharded) cache rows, so decode attends the
+        exact rows the ring produced. Single call for the full
+        (bucketed) prompt — chunked prefill cannot ride the ring, since
+        a later chunk attends cache rows the rotation never visits."""
+        key = ("ring", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        from fasttalk_tpu.parallel.train import ring_override
+
+        ring = ring_override(self.mesh)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def ring_prefill(params, cache: KVCache, tokens, slot,
+                         last_index):
+            slot_shape = (self.cfg.num_layers, 1, self.max_len,
+                          self.cfg.num_kv_heads, self.cfg.head_dim)
+            lk = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0),
+                                       slot_shape)
+            lv = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
+                                       slot_shape)
+            positions = jnp.arange(bucket)[None, :]
+            logits, updated = forward(
+                params, self.cfg, tokens[None, :], positions,
+                KVCache(lk, lv), jnp.zeros((1,), jnp.int32),
+                attn_override=ring, override_write=True,
+                logits_indices=last_index[None])
+            new_k = jax.lax.dynamic_update_slice(
+                cache.k, updated.k, (0, slot, 0, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(
+                cache.v, updated.v, (0, slot, 0, 0, 0))
+            return KVCache(new_k, new_v), logits[0, 0]
+
+        self._prefill_fns[key] = ring_prefill
+        return ring_prefill
+
+    def _get_batched_prefill_fn(self, chunk: int, group: int, ctx: int):
+        """One prompt chunk for ``group`` slots at once.
+
+        Gathers the first ``ctx`` KV positions of the target slots (the
+        forward never reads or writes past start+chunk <= ctx, and
+        gathering full max_len rows would transiently double the KV
+        cache's HBM), runs one [group, chunk] forward with per-row write
+        offsets, scatters the region back. Padding rows carry
+        write_mask=False and an out-of-range slot index, so their
+        scatter is dropped.
+
+        The per-row scalars travel in ONE packed f32 array (rowcfg
+        [group, 7]: slot, start, last_idx, mask, temp, top_k, top_p —
+        all exactly representable) and the sampled first tokens are
+        scattered into the decode chain's current-token vector inside
+        the same program: on relayed devices every extra transfer or
+        eager op costs a fixed multi-ms turnaround, so the whole burst
+        is one host→device call.
+        """
+        key = (chunk, group, ctx)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        replicate = self._replicate_sharding()
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def batched_prefill(params, cache: KVCache, tokens, rowcfg,
+                            cur, rng):
+            slot_idx = rowcfg[:, 0].astype(jnp.int32)
+            starts = rowcfg[:, 1].astype(jnp.int32)
+            last_idx = rowcfg[:, 2].astype(jnp.int32)
+            mask = rowcfg[:, 3] > 0.5
+            temps, topks, topps = (rowcfg[:, 4],
+                                   rowcfg[:, 5].astype(jnp.int32),
+                                   rowcfg[:, 6])
+            gk = cache.k[:, slot_idx, :ctx]  # [L, group, ctx, Kv, H]
+            gv = cache.v[:, slot_idx, :ctx]
+            positions = starts[:, None] + jnp.arange(chunk)[None, :]
+            logits, upd = forward(
+                params, self.cfg, tokens, positions, KVCache(gk, gv),
+                starts, blockwise=True, write_mask=mask,
+                pallas_int8=self.use_pallas_int8,
+                logits_indices=last_idx)
+            new_k = cache.k.at[:, slot_idx, :ctx].set(
+                upd.k, mode="drop", unique_indices=True)
+            new_v = cache.v.at[:, slot_idx, :ctx].set(
+                upd.v, mode="drop", unique_indices=True)
+            # First-token sampling fused into the same call: one device
+            # round-trip per burst instead of two (TTFT-critical).
+            rng, sub = jax.random.split(rng)
+            firsts = sample_tokens(logits[:, 0, :self.sample_vocab], sub,
+                                   temps, topks, topps,
+                                   method=self.sampling_method)
+            new_cur = cur.at[slot_idx].set(firsts, mode="drop")
+            if replicate is not None:  # host-fetched on every DCN host
+                firsts = jax.lax.with_sharding_constraint(firsts,
+                                                          replicate)
+            return KVCache(new_k, new_v), firsts, new_cur, rng
+
+        self._prefill_fns[key] = batched_prefill
+        return batched_prefill
+
+    def _get_patch_fn(self):
+        """One jitted program applying all dirty-slot mirror changes:
+        packed [S, 9] = (dirty, position, active, temp, top_k, top_p,
+        repeat_penalty, presence_penalty, frequency_penalty). Dirty
+        slots also get their penalty-count row zeroed (a slot goes dirty
+        exactly at (re)admission and completion — both are generation
+        boundaries, and penalties are per-generation). Composes with
+        in-flight calls (it consumes the latest chained arrays) without
+        draining the pipeline, and costs one transfer + one program
+        instead of per-field eager scatters."""
+        if self._patch_fn is None:
+            @partial(jax.jit, donate_argnums=(1,))
+            def apply_patch(packed, counts, pos, active, temps, topks,
+                            topps, reps, press, freqs):
+                dirty = packed[:, 0] > 0.5
+                pos = jnp.where(dirty, packed[:, 1].astype(pos.dtype), pos)
+                active = jnp.where(dirty, packed[:, 2] > 0.5, active)
+                temps = jnp.where(dirty, packed[:, 3], temps)
+                topks = jnp.where(dirty, packed[:, 4].astype(topks.dtype),
+                                  topks)
+                topps = jnp.where(dirty, packed[:, 5], topps)
+                reps = jnp.where(dirty, packed[:, 6], reps)
+                press = jnp.where(dirty, packed[:, 7], press)
+                freqs = jnp.where(dirty, packed[:, 8], freqs)
+                counts = jnp.where(dirty[:, None], 0, counts)
+                return counts, pos, active, temps, topks, topps, \
+                    reps, press, freqs
+
+            self._patch_fn = apply_patch
+        return self._patch_fn
+
+    def _get_sample_place_fn(self):
+        """Jitted completion of a single-slot long prefill: split the
+        rng, sample the first token from the chunk's last logits and
+        scatter it into the current-token vector — one program, no
+        eager ops."""
+        if self._sample_place_fn is None:
+            replicate = self._replicate_sharding()
+
+            @jax.jit
+            def sample_place(last_logits, cur, rng, cfg_row):
+                slot = cfg_row[0].astype(jnp.int32)
+                rng, sub = jax.random.split(rng)
+                first = sample_tokens(
+                    last_logits[None, :self.sample_vocab], sub,
+                    cfg_row[1][None],
+                    cfg_row[2].astype(jnp.int32)[None], cfg_row[3][None],
+                    method=self.sampling_method)
+                if replicate is not None:
+                    first = jax.lax.with_sharding_constraint(first,
+                                                             replicate)
+                return first, cur.at[slot].set(first[0], mode="drop"), rng
+
+            self._sample_place_fn = sample_place
+        return self._sample_place_fn
+
+    # ---------------- engine thread ----------------
+
+    def _run(self) -> None:
+        log.info("engine thread started",
+                 model=self.cfg.name, slots=self.num_slots,
+                 max_len=self.max_len)
+        try:
+            while True:
+                idle = not self._running and not self._inflight \
+                    and not self._prefilling and not self._pending_firsts
+                if not self._drain_commands(block=idle):
+                    break
+                if self._waiting:
+                    if not self._running and not self._inflight \
+                            and not self._prefilling:
+                        # Burst coalescing: from idle, the first request
+                        # of a concurrent burst arrives a few ms before
+                        # the rest, and admitting it alone would queue a
+                        # full decode call ahead of everyone else's
+                        # prefill (traced: +387 ms first-token for the
+                        # stragglers). A 3 ms grace drains the rest of
+                        # the burst into ONE admission group; a solo
+                        # request pays +3 ms TTFT.
+                        stop = False
+                        for _ in range(2):
+                            time.sleep(0.003)
+                            if not self._drain_commands(block=False):
+                                stop = True
+                                break
+                        if stop:
+                            break
+                    self._admit()
+                if self._prefilling:
+                    # One chunk per iteration: long prompts interleave
+                    # with decode calls instead of stalling every
+                    # running session for their whole prefill. Safe
+                    # without draining the pipeline: chunk writes target
+                    # reserved slots and are ordered behind in-flight
+                    # calls by the cache data dependency.
+                    self._advance_prefill()
+                if self._pending_firsts:
+                    # Emit any first tokens whose async fetch has landed;
+                    # block when nothing else would make progress — which
+                    # includes running requests whose whole remaining
+                    # budget IS the pending first token (max_tokens=1):
+                    # no decode call will ever be dispatched for those,
+                    # so a non-blocking poll here would spin forever.
+                    idle_wait = not self._inflight and not (
+                        self._running and self._should_dispatch())
+                    self._drain_firsts(block=idle_wait)
+                if self._running:
+                    if self._should_dispatch():
+                        self._dispatch_decode()
+                        if len(self._inflight) >= self.pipeline_depth:
+                            self._retire_oldest()
+                    elif self._inflight:
+                        self._retire_oldest()
+                elif self._inflight:
+                    # Retire ONE call per iteration, not the whole
+                    # pipeline: a new request arriving while the tail of
+                    # a finished generation drains would otherwise wait
+                    # pipeline_depth × call-time before admission (the
+                    # command queue is only read between iterations).
+                    self._retire_oldest()
+                self._m_active.set(len(self._running))
+                self._m_queue.set(len(self._waiting)
+                                  + len(self._prefilling))
+        except Exception as e:  # engine thread must not die silently
+            log.critical(f"engine thread crashed: {e}", exc_info=True)
+            if self.call_sink is not None:
+                # A published descriptor may precede the crash: tell
+                # followers the cluster is dead rather than leaving
+                # them blocked in their recv loop (the prefill paths
+                # publish their own aborts; this covers the
+                # decode/spec/patch family and anything unforeseen).
+                try:
+                    self._sink("abort", reason=f"engine crashed: {e}")
+                except Exception:
+                    pass
+            self._abort_all(f"engine crashed: {e}")
+        else:
+            self._abort_all("engine shut down")
+        finally:
+            self._stopped.set()
+            log.info("engine thread stopped")
+
+    def _abort_all(self, reason: str) -> None:
+        """Terminal-event every outstanding request so no caller awaits
+        forever after a stop or crash."""
+        for req in list(self._by_id.values()):
+            if not req.finished:
+                req.finished = True
+                self._emit(req, {"type": "error", "error": reason,
+                                 "code": "internal_error"})
+        self._by_id.clear()
+        self._waiting.clear()
+        self._prefilling.clear()
+        self._running.clear()
+        self._inflight.clear()
+        self._pending_firsts.clear()
+
+    def _drain_commands(self, block: bool) -> bool:
+        """Process queued commands. Returns False on stop."""
+        while True:
+            try:
+                cmd, arg = self._commands.get(timeout=0.05 if block else 0.0)
+            except queue.Empty:
+                return True
+            block = False
+            if cmd == "stop":
+                return False
+            if cmd == "submit":
+                if arg.finished:
+                    # Already terminal (errored by _abort_all during a
+                    # crash before this drain saw it): admitting it
+                    # would leak a slot on a request nobody consumes.
+                    pass
+                elif arg.cancelled:  # cancelled before the drain saw it
+                    self._finish(arg, "cancelled")
+                else:
+                    self._waiting.append(arg)
+            elif cmd == "cancel":
+                req = self._by_id.get(arg)
+                if req is not None:
+                    req.cancelled = True
+                    if req in self._waiting:
+                        self._waiting.remove(req)
+                        self._finish(req, "cancelled")
+            elif cmd == "release":
+                slot = self.slots.lookup(arg)
+                if slot is not None and slot.active:
+                    self._release_after.add(arg)
+                else:
+                    self.slots.release_session(arg)
+
+    def _admit(self) -> None:
+        """Move waiting requests into free slots.
+
+        Skips (rather than head-of-line blocks on) a request whose session
+        is still generating. Requests whose remaining prompt fits one
+        prefill bucket (the common chat-turn case) are prefetched together
+        in one batched device call — a burst of N arrivals costs one
+        prefill + one sample round-trip instead of 2N (the reference
+        serialised engine-side prefills the same way it serialised
+        everything: one HTTP request at a time).
+        """
+        # The batched path normally caps prompts at prefill_chunk so a
+        # long prefill cannot stall running sessions (chunked path
+        # interleaves instead). From IDLE there is nobody to stall, and
+        # the chunked path would serialize a cold burst of long prompts
+        # at one link round trip per chunk (measured: 16 × ~600-token
+        # personas took 5 s p50 TTFT through it) — so allow one batched
+        # call up to the 1024 bucket, which also lets intra-batch
+        # prefix sharing engage on exactly the long-persona bursts
+        # where it pays.
+        idle = not self._running and not self._inflight \
+            and not self._prefilling
+        allowed = max(self.prefill_chunk, 1024) if idle \
+            else self.prefill_chunk
+        batch: list[tuple[_Request, Slot, int, list[int]]] = []
+        i = 0
+        while i < len(self._waiting):
+            req = self._waiting[i]
+            slot = self.slots.lookup(req.session_id)
+            if slot is not None and slot.active:
+                i += 1  # session busy; try the next waiting request
+                continue
+            slot = self.slots.acquire(req.session_id)
+            if slot is None:
+                break  # all slots actively decoding
+            # Re-acquiring a slot still visible in an in-flight call is
+            # safe without draining: the donated cache chains every call,
+            # so the old call's garbage writes (all at positions >= the
+            # kept length > the reused prefix) execute strictly before
+            # this slot's fresh prefill, whose writes then win; the old
+            # call's tokens are dropped at retirement by the snapshot
+            # ownership check.
+            self._waiting.pop(i)
+            # Reserve immediately: activation is deferred to after the
+            # batched prefill, and an unreserved slot would be fair game
+            # for eviction by the next acquire in this same loop.
+            req.slot = slot
+            slot.active = True
+            prompt = req.prompt_tokens
+            reused = self.slots.reuse_prefix(slot, prompt)
+            if reused:
+                self._m_prefix.inc(reused)
+            elif self.shared_prefix:
+                # Fresh slot: stamp the longest prefix resident in any
+                # OTHER slot (common system prompt across sessions)
+                # instead of re-prefilling it. Rounded down to a
+                # power-of-two granule so the copy executable set stays
+                # bounded (_share_granule). The source's rows [0:share)
+                # are stable: its own writes only ever target positions
+                # >= its kept length.
+                src, share = self.slots.best_shared_prefix(slot, prompt)
+                share = self._share_granule(share)
+                if src is not None and share >= 16:
+                    self._sink("prefix_copy", share=share,
+                               src=src.index, dst=slot.index)
+                    self.cache = self._get_prefix_copy_fn(share)(
+                        self.cache, np.int32(src.index),
+                        np.int32(slot.index))
+                    slot.tokens = list(prompt[:share])
+                    slot.kv_written = share
+                    reused = share
+                    self._m_shared.inc(share)
+            todo = prompt[reused:]
+            if reused + len(todo) > self.usable_len:
+                self._finish(req, "error",
+                             error=f"prompt ({len(prompt)} tok) exceeds "
+                             "context")
+                continue
+            bucket = next((b for b in _PREFILL_BUCKETS if b >= len(todo)),
+                          None)
+            if bucket is not None and len(todo) <= allowed \
+                    and reused + bucket <= self.max_len \
+                    and not self._ring_prefill_eligible(reused,
+                                                        len(todo)):
+                batch.append((req, slot, reused, todo))
+            else:
+                # Long prompts — and, on an sp>1 mesh, fresh prompts
+                # past one chip's KV shard (ring-eligible) — go through
+                # _advance_prefill.
+                self._prefilling.append(
+                    _PrefillState(req=req, slot=slot, start=reused,
+                                  todo=todo))
+        if batch:
+            if self.shared_prefix and len(batch) >= 2:
+                self._prefill_batched_shared(batch)
+            else:
+                self._prefill_batched(batch)
+
+    def _advance_prefill(self) -> None:
+        """Run ONE chunk of the oldest in-progress long prefill."""
+        # Sweep the WHOLE queue for cancelled/finished entries — a
+        # cancel must free its reserved slot and emit its terminal event
+        # immediately, not after every earlier long prefill completes.
+        keep: list[_PrefillState] = []
+        for st in self._prefilling:
+            if st.req.finished:
+                continue
+            if st.req.cancelled:
+                self._finish(st.req, "cancelled")
+                continue
+            keep.append(st)
+        self._prefilling = keep
+        if not self._prefilling:
+            return
+        st = self._prefilling[0]
+        req, slot = st.req, st.slot
+        try:
+            ring_bucket = self._ring_prefill_eligible(st.start,
+                                                      len(st.todo))
+            if ring_bucket:
+                # Whole prompt in ONE ring-attention call: per-chip
+                # attention memory O(T/sp) instead of the all-gather
+                # form (see _get_ring_prefill_fn).
+                n = len(st.todo)
+                padded = np.zeros((ring_bucket,), np.int32)
+                padded[:n] = st.todo
+                fn = self._get_ring_prefill_fn(ring_bucket)
+                self._sink("ring_prefill", bucket=ring_bucket,
+                           tokens=padded, slot=slot.index, last=n - 1)
+                self.cache, st.last_logits = fn(
+                    self.params, self.cache, self._arg(padded),
+                    np.int32(slot.index), np.int32(n - 1))
+                slot.tokens.extend(st.todo)
+                st.start = n
+                slot.kv_written = n
+                st.todo = []
+            else:
+                take = min(len(st.todo), self.prefill_chunk)
+                bucket = next(b for b in _PREFILL_BUCKETS if b >= take)
+                # A padded bucket must not extend past the cache end —
+                # dynamic_update_slice would clamp the start and corrupt
+                # earlier rows. Shrink the chunk until its bucket fits.
+                while st.start + bucket > self.max_len and take > 1:
+                    bucket //= 2
+                    take = min(take, bucket)
+                if st.start + bucket > self.max_len:
+                    self._prefilling.pop(0)
+                    self._finish(req, "error",
+                                 error="KV cache exhausted during "
+                                       "prefill")
+                    return
+                chunk = st.todo[:take]
+                padded = np.zeros((bucket,), np.int32)
+                padded[:take] = chunk
+                fn = self._get_prefill_fn(bucket)
+                self._sink("prefill", bucket=bucket, tokens=padded,
+                           start=st.start, slot=slot.index,
+                           last=take - 1)
+                # numpy scalars, not jnp ones: each eager jnp scalar is
+                # its own device round trip on relayed backends.
+                self.cache, st.last_logits = fn(
+                    self.params, self.cache, self._arg(padded),
+                    np.int32(st.start), np.int32(slot.index),
+                    np.int32(take - 1))
+                slot.tokens.extend(chunk)
+                st.start += take
+                slot.kv_written = st.start
+                st.todo = st.todo[take:]
+            if st.todo:
+                return  # next chunk on a later iteration
+            self._prefilling.pop(0)
+            self._m_prefill.observe((time.monotonic() - st.t0) * 1000)
+            cfg_row = np.array([slot.index, req.params.temperature,
+                                req.params.top_k, req.params.top_p],
+                               np.float32)
+            self._sink("sample_place", cfg_row=cfg_row)
+            first, self._cur_tokens, self._rng_dev = \
+                self._get_sample_place_fn()(
+                    st.last_logits, self._cur_tokens, self._rng_dev,
+                    self._arg(cfg_row))
+            self._activate(req, slot)
+            self._defer_first(first, [(0, slot.index, req)])
+        except Exception as e:
+            log.error(f"prefill failed for {req.request_id}: {e}",
+                      exc_info=True)
+            if self.call_sink is not None:
+                # A dispatch error AFTER a published descriptor means
+                # per-host device state may have diverged: scoping the
+                # error to one request would serve a corrupted cluster.
+                # Abort followers and escalate (engine thread →
+                # _abort_all; multi-host recovery = cluster restart).
+                self._sink("abort", reason=str(e))
+                raise
+            if self._prefilling and self._prefilling[0] is st:
+                self._prefilling.pop(0)
+            self._finish(req, "error", error=str(e))
+
+    # Intra-batch sharing engages only when the common prefix is at
+    # least this long: below it, the extra prefill wave + copy
+    # dispatches cost more than the recompute they save (a share has to
+    # move the delta into a SMALLER prefill bucket to win).
+    _INTRA_SHARE_MIN = 64
+
+    def _prefill_batched_shared(
+            self, batch: list[tuple[_Request, Slot, int, list[int]]]) -> None:
+        """Intra-batch shared prefix: when several FRESH admissions of
+        one burst share a long leading prefix (a fleet of sessions with
+        one system prompt arriving together), prefill the longest-
+        prompt leader in a first wave, stamp the shared rows onto the
+        other slots by device copy, and batch-prefill only their
+        deltas — burst prefill compute drops from N×full toward
+        1×full + N×delta."""
+        from fasttalk_tpu.engine.slots import _lcp
+
+        fresh = [item for item in batch if item[2] == 0]
+        members: list[tuple[tuple, int]] = []
+        if len(fresh) >= 2:
+            leader = max(fresh, key=lambda it: len(it[0].prompt_tokens))
+            lp = leader[0].prompt_tokens
+            for item in fresh:
+                if item is leader:
+                    continue
+                pt = item[0].prompt_tokens
+                share = _lcp(lp, pt, min(len(lp), len(pt) - 1))
+                share = self._share_granule(share)
+                if share < self._INTRA_SHARE_MIN:
+                    continue
+                # Sharing must actually shrink the member's prefill
+                # bucket (else two serialized waves + copies are
+                # strictly slower than the one batched wave), and the
+                # delta bucket must still fit the cache at its new
+                # start (the admission guard checked start=0; a clamped
+                # out-of-range write start would silently corrupt KV).
+                full_b = next(b for b in _PREFILL_BUCKETS
+                              if b >= len(pt))
+                delta_b = next(b for b in _PREFILL_BUCKETS
+                               if b >= max(1, len(pt) - share))
+                if delta_b < full_b and share + delta_b <= self.max_len:
+                    members.append((item, share))
+        if not members:
+            self._prefill_batched(batch)
+            return
+        member_ids = {id(it) for it, _ in members}
+        self._prefill_batched([it for it in batch
+                               if id(it) not in member_ids])
+        lreq, lslot = leader[0], leader[1]
+        second: list[tuple[_Request, Slot, int, list[int]]] = []
+        for (req, slot, _reused, _todo), share in members:
+            if req.finished:
+                continue
+            # Re-clamp against what the leader actually wrote (its
+            # prefill may have errored and finished the request) — and
+            # re-check the delta-bucket fit, since a SMALLER share
+            # means a LARGER delta whose bucket may no longer fit at
+            # the new start.
+            share = self._share_granule(min(share, lslot.kv_written))
+            delta_b = next(
+                (b for b in _PREFILL_BUCKETS
+                 if b >= max(1, len(req.prompt_tokens) - share)), None)
+            if lreq.finished or share < self._INTRA_SHARE_MIN \
+                    or delta_b is None \
+                    or share + delta_b > self.max_len:
+                second.append((req, slot, 0, req.prompt_tokens))
+                continue
+            self._sink("prefix_copy", share=share, src=lslot.index,
+                       dst=slot.index)
+            self.cache = self._get_prefix_copy_fn(share)(
+                self.cache, np.int32(lslot.index), np.int32(slot.index))
+            slot.tokens = list(req.prompt_tokens[:share])
+            slot.kv_written = share
+            self._m_shared.inc(share)
+            second.append((req, slot, share, req.prompt_tokens[share:]))
+        if second:
+            self._prefill_batched(second)
+
+    def _prefill_batched(
+            self, batch: list[tuple[_Request, Slot, int, list[int]]]) -> None:
+        """Prefill several single-bucket prompts in one device call per
+        (bucket, group-size) shape: gather the target slots' KV rows,
+        run one batched forward, scatter the rows back, then sample every
+        first token in a single batched call."""
+        t0 = time.monotonic()
+        by_bucket: dict[int, list] = {}
+        for item in batch:
+            bucket = next(b for b in _PREFILL_BUCKETS
+                          if b >= max(1, len(item[3])))
+            by_bucket.setdefault(bucket, []).append(item)
+        for bucket, group in sorted(by_bucket.items()):
+            while group:
+                sub, group = group[:self.num_slots], group[self.num_slots:]
+                try:
+                    self._prefill_group(bucket, sub)
+                except Exception as e:
+                    log.error(f"batched prefill failed: {e}", exc_info=True)
+                    if self.call_sink is not None:
+                        # See _advance_prefill: a post-publish dispatch
+                        # error must abort the cluster, not be scoped.
+                        self._sink("abort", reason=str(e))
+                        raise
+                    # Scoped to this device call: requests in other
+                    # groups (possibly already activated and streaming)
+                    # are untouched.
+                    for req, _, _, _ in sub:
+                        self._finish(req, "error", error=str(e))
+        self._m_prefill.observe((time.monotonic() - t0) * 1000)
+
+    def _prefill_group(self, bucket: int,
+                       sub: list[tuple[_Request, Slot, int, list[int]]],
+                       ) -> None:
+        """One batched prefill device call + one batched first-token
+        sample for a same-bucket group of requests."""
+        g = len(sub)
+        # Only two group shapes ever compile per bucket: 1 and num_slots.
+        # A mid-size burst pads to the full batch (the padded rows are
+        # masked) — wasted FLOPs are bounded and tiny next to the cost of
+        # compiling per burst size.
+        gp = 1 if g == 1 else self.num_slots
+        tokens = np.zeros((gp, bucket), np.int32)
+        rowcfg = np.zeros((gp, 7), np.float32)
+        # Padding rows scatter out of range (mode="drop"); each gets a
+        # distinct index so unique_indices holds.
+        rowcfg[:, 0] = np.arange(self.num_slots,
+                                 self.num_slots + gp, dtype=np.float32)
+        for j, (req, slot, start, todo) in enumerate(sub):
+            tokens[j, :len(todo)] = todo
+            rowcfg[j] = (slot.index, start, len(todo) - 1, 1.0,
+                         req.params.temperature, req.params.top_k,
+                         req.params.top_p)
+        # Gather only as much of each slot row as this chunk can touch,
+        # rounded to a KV bucket so the shape set stays small.
+        need = int(rowcfg[:, 1].max()) + bucket
+        ctx = next((b for b in _KV_BUCKETS
+                    if b >= need and b <= self.max_len), self.max_len)
+        fn = self._get_batched_prefill_fn(bucket, gp, ctx)
+        self._sink("batched_prefill", bucket=bucket, gp=gp, ctx=ctx,
+                   tokens=tokens, rowcfg=rowcfg)
+        # First tokens stay on device: the program scatters them into
+        # the decode chain's current-token vector, and the host copy is
+        # async — the engine thread dispatches the first decode call
+        # without waiting for the round trip; text is emitted when the
+        # fetch lands.
+        self.cache, firsts_dev, self._cur_tokens, self._rng_dev = fn(
+            self.params, self.cache, self._arg(tokens), self._arg(rowcfg),
+            self._cur_tokens, self._rng_dev)
+        entries = []
+        for j, (req, slot, start, todo) in enumerate(sub):
+            slot.tokens.extend(todo)
+            slot.kv_written = start + len(todo)
+            self._activate(req, slot)
+            entries.append((j, slot.index, req))
+        self._defer_first(firsts_dev, entries)
+
+    def _should_dispatch(self) -> bool:
+        """Dispatch another K-step call only if some running request can
+        still use tokens beyond what in-flight calls already promise it.
+
+        Without this cap the dispatcher runs pipeline_depth calls past
+        every generation's end; those stale calls hold the (in-order)
+        device queue and the NEXT request's prefill — and therefore its
+        first token — waits behind all of them. A length-capped
+        generation now finishes with an empty pipeline."""
+        if self._pending_firsts and self._running and all(
+                req.first_pending for req in self._running.values()):
+            # Pure admission burst: EVERY running request is still
+            # waiting for its prefill-sampled first token. A decode
+            # dispatch now would enter the in-order device stream ahead
+            # of the firsts fetch and push first-token latency a whole
+            # call's compute later (traced: +150 ms at 32 steps on the
+            # relayed attach, scripts/profile_ttft.py). Hold off; the
+            # loop blocks on the fetch and decode follows one link
+            # round trip later. Steady state is untouched — any request
+            # past its first token makes this condition false.
+            return False
+        promised: dict[int, int] = {}
+        for _, min_toks, _, snap in self._inflight:
+            for _, req in snap:
+                promised[id(req)] = promised.get(id(req), 0) + min_toks
+        # A first token whose fetch hasn't landed is not yet counted in
+        # req.generated but will be — ignoring it over-dispatches one
+        # whole stale call at exact-budget boundaries.
+        return any(
+            req.params.max_tokens - req.generated
+            - (1 if req.first_pending else 0) > promised.get(id(req), 0)
+            for req in self._running.values())
+
+    def _activate(self, req: _Request, slot: Slot) -> None:
+        """Mark a freshly prefilled slot as decoding. The first sampled
+        token is already on the device (scattered into the decode
+        chain's current-token vector by the caller); its text is emitted
+        by _drain_firsts when the async fetch lands."""
+        s = slot.index
+        slot.active = True
+        req.slot = slot
+        self._running[s] = req
+        self._positions[s] = len(slot.tokens)
+        self._active_mask[s] = True
+        self._temps[s] = req.params.temperature
+        self._topks[s] = req.params.top_k
+        self._topps[s] = req.params.top_p
+        self._reps[s] = req.params.repeat_penalty
+        self._press[s] = req.params.presence_penalty
+        self._freqs[s] = req.params.frequency_penalty
+        self._dirty_slots.add(s)
+        if self.spec_draft:
+            self._dirty_history[s] = list(slot.tokens)
+
+    def _defer_first(self, firsts_dev: Any, entries: list) -> None:
+        """Queue first sampled tokens for emission once their
+        device→host copy (started here, on a worker) completes."""
+        for _, _, req in entries:
+            req.first_pending = True
+        self._pending_firsts.append(
+            (self._fetch_pool.submit(np.asarray, firsts_dev), entries))
+
+    def _drain_firsts(self, block: bool) -> None:
+        """Emit first tokens whose fetch has landed (all of them when
+        ``block``). Entry guards mirror _retire_oldest: a request that
+        finished (cancel, error) before its first token arrived drops
+        it."""
+        while self._pending_firsts:
+            fut, entries = self._pending_firsts[0]
+            if not block and not fut.done():
+                return
+            self._pending_firsts.popleft()
+            arr = fut.result()
+            for j, s, req in entries:
+                req.first_pending = False
+                if req.finished or self._running.get(s) is not req:
+                    continue
+                self._consume_token(req, int(arr[j]))
+                self._flush_emit(req)
+
+    def _get_hist_patch_fn(self, row_len: int | None = None):
+        """Jitted history-row upload for speculative decoding: rows of
+        freshly admitted slots replace their history rows wholesale
+        (out-of-range slot indices in the padded batch drop).
+
+        ``row_len`` buckets the HOST-SIDE upload: shipping full
+        [S, max_len] rows cost 512 KB through the relay per admission
+        wave (measured as most of auto-spec's bench overhead once it
+        became the default) when the prompts being uploaded are ~100
+        tokens. The program pads to max_len on device — HBM-local and
+        free next to the link transfer it replaces."""
+        row_len = self.max_len if row_len is None else row_len
+        fn = self._hist_patch_fns.get(row_len)
+        if fn is None:
+            @partial(jax.jit, donate_argnums=(0,))
+            def apply_hist(hist, rows, slots):
+                full = jnp.zeros((rows.shape[0], self.max_len),
+                                 rows.dtype)
+                full = jax.lax.dynamic_update_slice(full, rows, (0, 0))
+                return hist.at[slots].set(full, mode="drop",
+                                          unique_indices=True)
+
+            self._hist_patch_fns[row_len] = apply_hist
+            fn = apply_hist
+        return fn
+
+    def _patch_slot_state(self) -> None:
+        """Apply dirty host mirrors onto the chained device arrays via
+        one jitted program and one packed transfer.
+
+        In-flight calls are untouched — safe because their snapshots
+        drop tokens of finished requests at retirement, and a freed
+        slot's fresh prefill is ordered after any in-flight garbage
+        writes by the donated-cache data dependency (see _admit).
+        Every later dispatch sees the patched state. This replaces the
+        old flush-the-pipeline-and-reupload on every slot-set change,
+        which serialised admission behind up to pipeline_depth decode
+        calls."""
+        if self.spec_draft and self._dirty_history:
+            # Prompt tokens of freshly admitted slots -> device history
+            # (one bucketed upload + one program that pads to max_len
+            # on device; the sampled tokens appended later are
+            # maintained in-program).
+            longest = max((len(t) for t in
+                           self._dirty_history.values()), default=1)
+            rb = min(self.max_len,
+                     max(256, 1 << (longest - 1).bit_length()))
+            rows = np.zeros((self.num_slots, rb), np.int32)
+            slots = np.full((self.num_slots,), self.num_slots, np.int32)
+            for i, (s, tokens) in enumerate(self._dirty_history.items()):
+                rows[i, :min(len(tokens), rb)] = tokens[:rb]
+                slots[i] = s
+            self._dirty_history.clear()
+            self._sink("hist_patch", rb=rb, rows=rows, slots=slots)
+            self._history_dev = self._get_hist_patch_fn(rb)(
+                self._history_dev, self._arg(rows), self._arg(slots))
+        if not self._dirty_slots:
+            return
+        packed = np.zeros((self.num_slots, 9), np.float32)
+        for s in self._dirty_slots:
+            packed[s] = (1.0, self._positions[s], self._active_mask[s],
+                         self._temps[s], self._topks[s], self._topps[s],
+                         self._reps[s], self._press[s], self._freqs[s])
+        self._dirty_slots.clear()
+        self._sink("patch", packed=packed)
+        (self._counts_dev, self._positions_dev, self._active_dev,
+         self._temps_dev, self._topks_dev, self._topps_dev,
+         self._reps_dev, self._press_dev, self._freqs_dev) = \
+            self._get_patch_fn()(
+                self._arg(packed), self._counts_dev, self._positions_dev,
+                self._active_dev, self._temps_dev, self._topks_dev,
+                self._topps_dev, self._reps_dev, self._press_dev,
+                self._freqs_dev)
+
+    def _spec_call_wanted(self) -> bool:
+        """Per-call speculative/plain decision. "ngram": always spec.
+        "auto": spec while the measured EMA tokens-per-verify clears
+        the break-even (a verify block costs ~spec_breakeven plain
+        steps); below it, plain calls with a periodic probe so the EMA
+        tracks workload shifts — acceptance recovers (templated or
+        repetitive text arrives) and auto re-engages within one probe
+        period."""
+        if self.spec_mode == "ngram":
+            return True
+        if self._spec_ema >= self.spec_breakeven:
+            return True
+        self._spec_probe_countdown -= 1
+        if self._spec_probe_countdown <= 0:
+            self._spec_probe_countdown = self._spec_probe_every
+            return True
+        return False
+
+    def _dispatch_decode(self) -> None:
+        """Launch one K-step decode call; does not wait for results."""
+        self._patch_slot_state()
+        active = list(self._running)
+        snapshot = list(self._running.items())
+        # Short calls while admissions/prefills are pending or a first
+        # token's fetch is still in flight (anything TTFT-critical waits
+        # behind the in-order device queue); long calls in steady state
+        # (amortise the per-call cache boundary copy).
+        steps = (self.steps_burst if self._waiting or self._prefilling
+                 or any(req.first_pending
+                        for req in self._running.values())
+                 else self.steps_per_call)
+        # Device positions lead the host mirrors by the in-flight calls'
+        # maximum advances; size the KV bucket for where the device can
+        # be at the END of this call.
+        base = int(self._positions[active].max()) \
+            + sum(adv for _, _, adv, _ in self._inflight)
+        T = self.spec_draft + 1
+        if self.spec_draft and self._spec_call_wanted():
+            # Size the KV bucket by the EMA-EXPECTED advance (+1 block
+            # of headroom), not the K*T worst case: worst-case sizing
+            # jumped to the next bucket immediately — a mid-stream
+            # compile (~0.4 s traced) and doubled attention reads for
+            # advances that almost never happen. Underestimates are
+            # SAFE: the in-call act gate (pos + T <= kv_len) makes a
+            # slot sit out steps that would overflow the bucket, the
+            # under-delivery shows up in the retired n_out, and the
+            # host's position mirrors re-size the next call.
+            exp_adv = int(steps * min(float(T),
+                                      max(1.0, self._spec_ema) + 1.0))
+            # The bucket must leave at least one FULL verify block of
+            # headroom past every slot's worst-case position, or the
+            # in-call act gate masks every step and the call makes no
+            # progress — with mirrors never advancing, the identical
+            # no-op call would be re-dispatched forever (livelock;
+            # reachable when T > exp_adv near a bucket edge).
+            need = base + max(exp_adv, T)
+            if need <= self.max_len:
+                kv_len = next((b for b in _KV_BUCKETS
+                               if b >= need and b <= self.max_len),
+                              self.max_len)
+                fn = self._get_spec_decode_fn(kv_len, steps)
+                self._sink("spec", kv_len=kv_len, steps=steps)
+                (self.cache, self._history_dev, self._counts_dev, toks,
+                 self._cur_tokens, self._positions_dev,
+                 self._rng_dev) = fn(
+                    self.params, self.cache, self._history_dev,
+                    self._counts_dev, self._cur_tokens,
+                    self._positions_dev, self._active_dev,
+                    self._temps_dev, self._topks_dev, self._topps_dev,
+                    self._reps_dev, self._press_dev, self._freqs_dev,
+                    self._rng_dev)
+                # Promise the EMA-expected tokens, not the minimum:
+                # spec calls deliver K..K*T, and promising K made the
+                # dispatcher queue up to T× too many calls — a
+                # stale-call tail holding the in-order device queue for
+                # seconds (traced).
+                promise = steps * min(float(T),
+                                      max(1.0, self._spec_ema))
+                self._inflight.append(
+                    (self._fetch_pool.submit(np.asarray, toks), promise,
+                     exp_adv, snapshot))
+                return
+        max_pos = base + steps
+        kv_len = next((b for b in _KV_BUCKETS
+                       if b >= max_pos and b <= self.max_len), self.max_len)
+        if self.spec_draft:
+            # Auto mode chose plain for this call (or the spec bucket
+            # check fell through): keep the draft history fresh so the
+            # next probe drafts from current text, not stale history.
+            fn = self._get_decode_fn(kv_len, steps, with_history=True)
+            self._sink("decode", kv_len=kv_len, steps=steps,
+                       with_history=True)
+            (self.cache, self._history_dev, self._counts_dev, toks,
+             self._cur_tokens, self._positions_dev, self._rng_dev) = fn(
+                self.params, self.cache, self._history_dev,
+                self._counts_dev, self._cur_tokens, self._positions_dev,
+                self._active_dev, self._temps_dev, self._topks_dev,
+                self._topps_dev, self._reps_dev, self._press_dev,
+                self._freqs_dev, self._rng_dev)
+            self._inflight.append(
+                (self._fetch_pool.submit(np.asarray, toks), steps, steps,
+                 snapshot))
+            return
+        fn = self._get_decode_fn(kv_len, steps)
+        self._sink("decode", kv_len=kv_len, steps=steps,
+                   with_history=False)
+        (self.cache, self._counts_dev, toks, self._cur_tokens,
+         self._positions_dev, self._rng_dev) = fn(
+            self.params, self.cache, self._counts_dev, self._cur_tokens,
+            self._positions_dev, self._active_dev, self._temps_dev,
+            self._topks_dev, self._topps_dev, self._reps_dev,
+            self._press_dev, self._freqs_dev, self._rng_dev)
+        # Start the device→host copy NOW on a worker thread: by
+        # retirement time it has been in flight for a whole call's
+        # compute, and later calls' fetches overlap it (see the
+        # _fetch_pool note in __init__).
+        self._inflight.append(
+            (self._fetch_pool.submit(np.asarray, toks), steps, steps,
+             snapshot))
+
+    def _retire_oldest(self) -> None:
+        """Block on the oldest in-flight call and consume its tokens."""
+        fut, _, _, snapshot = self._inflight.popleft()
+        if any(req.first_pending for _, req in snapshot):
+            # A request in this call still awaits its first token:
+            # emit firsts before any of its decode tokens (the firsts
+            # copy was issued earlier and overlaps this call's fetch on
+            # the worker pool, so this wait is bounded).
+            self._drain_firsts(block=True)
+        t0 = time.monotonic()
+        res = fut.result()  # sync point
+        self._m_step.observe((time.monotonic() - t0) * 1000)
+        # The block above gave every pending firsts-copy >= one call's
+        # wall time to land: emit whatever arrived NOW. Without this, a
+        # request admitted after call N dispatched waits for call N+1's
+        # retirement (whose snapshot it is in) — burst admissions saw
+        # their first tokens staggered one ~140 ms retirement per
+        # admission group (measured: WS-burst p50 TTFT 412 ms engine-side
+        # vs 166 ms when all requests land in one group).
+        if self._pending_firsts:
+            self._drain_firsts(block=False)
+        if res.ndim == 3:
+            # Speculative call [K, S, T+1]: per row, columns :T are the
+            # sampled tokens and column T is n_out; the first n_out
+            # tokens are real (accepted drafts + the residual sample).
+            # Positions advance one per token, same as plain decode.
+            for k in range(res.shape[0]):
+                for s, req in snapshot:
+                    if req.finished or self._running.get(s) is not req:
+                        continue
+                    n = int(res[k, s, -1])
+                    if n:
+                        self._m_spec.observe(n)
+                        self._spec_ema = (0.9 * self._spec_ema
+                                          + 0.1 * n)
+                    for i in range(n):
+                        if req.finished \
+                                or self._running.get(s) is not req:
+                            break
+                        self._positions[s] += 1
+                        self._consume_token(req, int(res[k, s, i]))
+        else:
+            for k in range(res.shape[0]):
+                for s, req in snapshot:
+                    if req.finished or self._running.get(s) is not req:
+                        # Request ended earlier in this call, or the
+                        # slot was re-admitted to a newer request: drop
+                        # the token.
+                        continue
+                    self._positions[s] += 1
+                    self._consume_token(req, int(res[k, s]))
+        for _, req in snapshot:
+            self._flush_emit(req)
+
+    def _consume_token(self, req: _Request, token_id: int) -> None:
+        """Handle one newly sampled token for a request (host side)."""
+        if req.cancelled:
+            self._finish(req, "cancelled")
+            return
+        if token_id in self.tokenizer.eos_ids \
+                and not req.params.ignore_eos:
+            self._finish(req, "stop")
+            return
+        slot = req.slot
+        assert slot is not None and req.detok is not None
+        slot.tokens.append(token_id)
+        req.generated += 1
+        if req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+            self._m_ttft.observe(
+                (req.first_token_at - req.submitted_at) * 1000)
+        self._m_tokens.inc()
+        delta = req.detok.push(token_id)
+        if delta:
+            self._stream_text(req, delta)
+        if req.finished:
+            return  # stop string hit inside _stream_text
+        if req.generated >= req.params.max_tokens:
+            self._finish(req, "length")
+        elif len(slot.tokens) >= self.usable_len:
+            self._finish(req, "length")
+
+    def _stream_text(self, req: _Request, delta: str) -> None:
+        """Emit text, holding back any suffix that could start a stop seq."""
+        stops = req.params.stop
+        req.pending_text += delta
+        if not stops:
+            req.emit_buf += req.pending_text
+            req.pending_text = ""
+            return
+        for stop in stops:
+            idx = req.pending_text.find(stop)
+            if idx >= 0:
+                req.emit_buf += req.pending_text[:idx]
+                req.pending_text = ""
+                self._finish(req, "stop", suppress_flush=True)
+                return
+        hold = 0
+        for stop in stops:
+            for k in range(min(len(stop) - 1, len(req.pending_text)), 0, -1):
+                if req.pending_text.endswith(stop[:k]):
+                    hold = max(hold, k)
+                    break
+        cut = len(req.pending_text) - hold
+        emit_now, req.pending_text = req.pending_text[:cut], req.pending_text[cut:]
+        if emit_now:
+            req.emit_buf += emit_now
+
+    def _finish(self, req: _Request, reason: str, error: str | None = None,
+                suppress_flush: bool = False) -> None:
+        if req.finished:
+            return
+        req.finished = True
+        slot = req.slot
+        if slot is not None:
+            decoding = self._running.get(slot.index) is req
+            slot.active = False
+            slot.last_used = time.monotonic()
+            self._running.pop(slot.index, None)
+            self._active_mask[slot.index] = False
+            self._temps[slot.index] = 0.0
+            self._reps[slot.index] = 1.0
+            self._press[slot.index] = 0.0
+            self._freqs[slot.index] = 0.0
+            if decoding:
+                # KV rows are written only up to the position reached by
+                # *feeding* tokens; a final token kept on max_tokens/stop
+                # was sampled but never fed — not trusted for reuse.
+                # (If the request died before activation, the prefill
+                # paths maintained kv_written themselves and the
+                # positions mirror is stale — leave it alone.)
+                slot.kv_written = min(slot.length,
+                                      int(self._positions[slot.index]))
+            # Host positions mirror is authoritative again (the device
+            # copy may have speculatively advanced past the kept length).
+            self._positions[slot.index] = slot.length
+            self._dirty_slots.add(slot.index)
+            sid = slot.session_id
+            if sid is not None and sid in self._release_after:
+                self._release_after.discard(sid)
+                self.slots.release_session(sid)
+        self._by_id.pop(req.request_id, None)
+
+        if not suppress_flush and req.detok is not None \
+                and reason not in ("cancelled",):
+            req.pending_text += req.detok.flush()
+        if req.pending_text and reason != "cancelled":
+            # Final flush still honours stop strings (text that was held
+            # back may contain one).
+            text = req.pending_text
+            for stop in req.params.stop:
+                idx = text.find(stop)
+                if idx >= 0:
+                    text = text[:idx]
+                    reason = "stop"
+            req.emit_buf += text
+        req.pending_text = ""
+        self._flush_emit(req)
+
+        if error is not None:
+            self._emit(req, {"type": "error", "error": error,
+                             "code": "model_error"})
+            return
+        duration = time.monotonic() - req.submitted_at
+        ttft_ms = ((req.first_token_at or time.monotonic())
+                   - req.submitted_at) * 1000
+        self._emit(req, {
+            "type": "cancelled" if reason == "cancelled" else "done",
+            "finish_reason": reason,
+            "stats": {
+                "tokens_generated": req.generated,
+                "processing_time_ms": duration * 1000,
+                "tokens_per_second": req.generated / duration
+                if duration > 0 else 0.0,
+                "ttft_ms": ttft_ms,
+                "prompt_tokens": len(req.prompt_tokens),
+            },
+        })
+
+    def _flush_emit(self, req: _Request) -> None:
+        """Send the text batched during one retirement as a single token
+        event. At full batch this collapses steps_per_call × num_slots
+        queue crossings per call into one per request — the host-side
+        per-token cost (call_soon_threadsafe + event-loop wakeup) was a
+        measurable slice of aggregate throughput."""
+        if req.emit_buf:
+            text, req.emit_buf = req.emit_buf, ""
+            self._emit(req, {"type": "token", "text": text})
+
+    def _emit(self, req: _Request, event: dict) -> None:
+        try:
+            req.loop.call_soon_threadsafe(req.out_queue.put_nowait, event)
+        except RuntimeError:
+            pass  # client loop already closed; drop

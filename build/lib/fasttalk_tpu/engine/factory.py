@@ -1,0 +1,252 @@
+"""Engine construction from service Config.
+
+The device branch the reference routed through _detect_compute_device
+(reference: app/utils/config.py:17-60) plus provider selection
+(websocket_server_vllm.py:74-138) collapse here into one factory: the
+``tpu`` provider builds the in-tree JAX engine on whatever platform JAX
+has (TPU in production, CPU in tests); ``fake`` builds the test engine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fasttalk_tpu.engine.engine import EngineBase, TPUEngine
+from fasttalk_tpu.engine.fake import FakeEngine
+from fasttalk_tpu.engine.tokenizer import load_tokenizer
+from fasttalk_tpu.models.configs import get_model_config
+from fasttalk_tpu.models.loader import find_checkpoint_dir, load_params
+from fasttalk_tpu.utils.config import Config
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("engine.factory")
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+           "float16": jnp.float16}
+
+
+def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
+    """Account weights + KV cache against the HBM budget before any
+    allocation, so a bad TPU_DECODE_SLOTS / TPU_MAX_MODEL_LEN fails with
+    a named message instead of an opaque device OOM mid-load. Wires the
+    TPU_HBM_UTILIZATION knob the way the reference never wired its
+    VLLM_GPU_MEMORY_UTILIZATION passthrough (reference:
+    .env.vllm.example:40 — forwarded to the external container, no
+    in-tree accounting).
+
+    Returns the accounting dict (bytes, per device); raises ValueError
+    when over budget. Skips silently when the backend exposes no memory
+    stats (CPU tests).
+
+    Sharding facts the math encodes (parallel/sharding.py): weights
+    shard over "tp" only (each dp replica holds a full copy); the KV
+    cache shards over both "tp" (kv heads) and "dp" (slots). Int8
+    weights count int8 bytes because quantization happens host-side
+    before placement (ops/quant.py quantizing_put) — HBM never holds
+    the bf16 copy.
+    """
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+    except Exception:
+        limit = None
+    dsize = jnp.dtype(dtype).itemsize
+    tp = max(1, cfg.tp_size)
+    m = model_cfg
+    # Norm scales replicate on every chip (parallel/sharding.py
+    # _LAYER_RULES/_TOP_RULES); everything else — matmuls, embedding,
+    # qkv biases — shards over "tp". Counting replicated leaves at
+    # 1/tp size underestimates per-device bytes near the budget edge.
+    norm_params = (2 * m.num_layers + 1) * m.hidden_size
+    if cfg.quantize == "int8":
+        # Matmul weights AND the embedding quantize (ops/quant.py
+        # QUANTIZED_LEAVES + EMBED_LEAF); norms and biases stay at the
+        # engine dtype. Every quantized tensor gains a float32 scale
+        # vector (per output channel; per vocab row for the embedding).
+        # Row-parallel (wo/w_down) and embed scales replicate; the rest
+        # shard — all are KiB-to-half-MiB scale, so count them all
+        # replicated (conservative).
+        matmul_per_layer = (m.hidden_size * m.q_dim
+                            + 2 * m.hidden_size * m.kv_dim
+                            + m.q_dim * m.hidden_size
+                            + 3 * m.hidden_size * m.intermediate_size)
+        scales_per_layer = (m.q_dim + 2 * m.kv_dim + m.hidden_size
+                            + 2 * m.intermediate_size + m.hidden_size)
+        matmul = m.num_layers * matmul_per_layer
+        scales = m.num_layers * scales_per_layer
+        matmul += m.hidden_size * m.vocab_size  # embedding (row-quant)
+        scales += m.vocab_size
+        if not m.tie_embeddings:
+            matmul += m.hidden_size * m.vocab_size
+            scales += m.vocab_size
+        other_sharded = m.param_count() - matmul - norm_params
+        wbytes_dev = (matmul // tp + other_sharded * dsize // tp
+                      + scales * 4 + norm_params * dsize)
+    else:
+        wbytes_dev = ((m.param_count() - norm_params) * dsize // tp
+                      + norm_params * dsize)
+    kv = (model_cfg.num_layers * cfg.decode_slots * cfg.max_model_len
+          * model_cfg.num_kv_heads * model_cfg.head_dim * 2 * dsize)
+    acct = {
+        "weight_bytes_per_device": wbytes_dev,
+        "kv_cache_bytes_per_device": kv // n_devices,
+        "hbm_limit_bytes": limit,
+        "hbm_utilization": cfg.hbm_util,
+    }
+    if limit:
+        budget = limit * cfg.hbm_util
+        need = acct["weight_bytes_per_device"] + acct["kv_cache_bytes_per_device"]
+        if need > budget:
+            raise ValueError(
+                f"Model + KV cache need {need / 2**30:.2f} GiB/device but the "
+                f"HBM budget is {budget / 2**30:.2f} GiB "
+                f"({limit / 2**30:.2f} GiB x TPU_HBM_UTILIZATION="
+                f"{cfg.hbm_util}). Lower TPU_DECODE_SLOTS "
+                f"({cfg.decode_slots}) or TPU_MAX_MODEL_LEN "
+                f"({cfg.max_model_len}), enable TPU_QUANTIZE=int8, or raise "
+                "TPU_TP_SIZE to shard over more chips.")
+    return acct
+
+
+def build_engine(cfg: Config) -> EngineBase:
+    if cfg.llm_provider == "fake":  # internal/testing
+        return FakeEngine()
+    if cfg.llm_provider in ("vllm", "openai"):
+        # "openai" = any OpenAI-compatible HTTP backend; same wire
+        # protocol as vLLM. (The reference validated 'openai' but had no
+        # handler for it — SURVEY.md §5 config notes.)
+        from fasttalk_tpu.engine.remote import VLLMRemoteEngine
+
+        return VLLMRemoteEngine(cfg.vllm_base_url, cfg.vllm_model,
+                                api_key=cfg.vllm_api_key,
+                                timeout_s=cfg.vllm_timeout)
+    if cfg.llm_provider == "ollama":
+        from fasttalk_tpu.engine.remote import OllamaRemoteEngine
+
+        return OllamaRemoteEngine(cfg.ollama_base_url, cfg.model_name,
+                                  keep_alive=cfg.ollama_keep_alive,
+                                  timeout_s=cfg.ollama_timeout)
+    # Persistent compilation cache before the first compile: warmup's
+    # executables reload from disk on repeat starts of the same config.
+    from fasttalk_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(cfg.compile_cache, cfg.model_path)
+    # Multi-host: bring up the JAX distributed runtime (DCN) before any
+    # device use so meshes can span every host. No-op outside a
+    # configured/pod environment. Lives here (not in the CLI) so bench,
+    # `main.py test`, and library users all inherit it.
+    from fasttalk_tpu.parallel.distributed import maybe_initialize
+
+    maybe_initialize()
+    model_cfg = get_model_config(cfg.model_name, cfg.model_path)
+    dtype = _DTYPES.get(cfg.dtype, jnp.bfloat16)
+    acct = check_hbm_budget(model_cfg, cfg, dtype,
+                            n_devices=max(1, cfg.tp_size * cfg.dp_size
+                                          * cfg.sp_size))
+    log.info("HBM budget check passed",
+             weight_gib=round(acct["weight_bytes_per_device"] / 2**30, 2),
+             kv_gib=round(acct["kv_cache_bytes_per_device"] / 2**30, 2),
+             limit_gib=round((acct["hbm_limit_bytes"] or 0) / 2**30, 2))
+    mesh = put = raw_put = None
+    if cfg.tp_size > 1 or cfg.dp_size > 1 or cfg.sp_size > 1:
+        from fasttalk_tpu.parallel.mesh import make_mesh
+        from fasttalk_tpu.parallel.sharding import param_put
+
+        mesh = make_mesh(dp=cfg.dp_size, sp=cfg.sp_size,
+                         tp=cfg.tp_size)
+        # Weights go straight into their TP shards as they stream off
+        # disk — a 70B checkpoint must never materialise on one chip.
+        put = param_put(mesh, dtype)
+        raw_put = param_put(mesh, None)
+    if cfg.quantize == "int8":
+        from fasttalk_tpu.ops.quant import quantizing_put
+
+        import jax
+
+        if put is None:
+            put = lambda arr, path: jax.device_put(jnp.asarray(arr, dtype))  # noqa: E731
+            raw_put = lambda arr, path: jax.device_put(jnp.asarray(arr))  # noqa: E731
+        # Quantize host-side, tensor by tensor, before placement: device
+        # HBM peaks at int8 bytes, not the transient bf16 copy.
+        put = quantizing_put(put, raw_put)
+
+    ckpt = find_checkpoint_dir(cfg.model_path, model_cfg.name) \
+        if cfg.model_path else None
+    if ckpt:
+        from fasttalk_tpu.models.prepared_cache import (cache_meta,
+                                                        load_prepared,
+                                                        save_prepared)
+
+        quant = cfg.quantize == "int8"
+        params = load_prepared(model_cfg, cfg.model_path, dtype, quant,
+                               mesh, ckpt_dir=ckpt)
+        loaded = True
+        if params is None:
+            params = load_params(model_cfg, ckpt, dtype, put)
+            if quant:
+                log.info("Quantized matmul weights to int8 "
+                         "(per-channel symmetric, host-side per tensor)")
+            # Cache the engine-ready pytree so the next restart skips
+            # the whole safetensors->stack->cast->quantize->shard
+            # pipeline (best-effort).
+            save_prepared(params, cfg.model_path,
+                          cache_meta(model_cfg, dtype, quant, mesh,
+                                     ckpt_dir=ckpt))
+    else:
+        # No checkpoint: random init directly on the device(s) — zero
+        # host->device weight transfer (models/loader.py).
+        from fasttalk_tpu.models.loader import init_params_device
+
+        log.warning(f"No checkpoint for {model_cfg.name!r} under "
+                    f"{cfg.model_path!r}; using random-initialised weights")
+        params, loaded = init_params_device(
+            model_cfg, dtype, mesh=mesh,
+            quantize=cfg.quantize == "int8"), False
+    tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
+                               cfg.tokenizer_path,
+                               template=model_cfg.chat_template)
+    if not loaded and getattr(tokenizer, "vocab_size", 0) <= 512:
+        # WEIGHT-FREE serving only (never when real weights loaded — a
+        # checkpoint missing its tokenizer.json must not be silently
+        # paired with an unrelated vocab): with no checkpoint tokenizer
+        # the byte fallback inflates an English prompt ~6x (1
+        # token/byte), which pushed weight-free benches into prefill
+        # buckets real deployments never hit — burst TTFT then measured
+        # tokenizer inflation, not the serving path
+        # (scripts/profile_ttft.py). Prefer the bundled real 32k BPE
+        # (scripts/make_bench_tokenizer.py) when the model vocab can
+        # hold it.
+        import os
+
+        from fasttalk_tpu.engine.tokenizer import HFTokenizer
+
+        bundled = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "assets", "bench_tokenizer.json")
+        if os.path.isfile(bundled):
+            cand = HFTokenizer(bundled, template=model_cfg.chat_template)
+            if cand.vocab_size <= model_cfg.vocab_size:
+                tokenizer = cand
+    log.info(
+        f"Building TPU engine: model={model_cfg.name} "
+        f"({model_cfg.param_count() / 1e9:.2f}B params, "
+        f"weights {'loaded' if loaded else 'random-init'}), "
+        f"slots={cfg.decode_slots}, max_len={cfg.max_model_len}, "
+        f"dtype={cfg.dtype}, "
+        f"mesh={dict(mesh.shape) if mesh else 'single-device'}")
+    engine = TPUEngine(
+        model_cfg, params, tokenizer,
+        num_slots=cfg.decode_slots, max_len=cfg.max_model_len,
+        prefill_chunk=cfg.prefill_chunk, dtype=dtype,
+        context_window=min(cfg.default_context_window, cfg.max_model_len),
+        mesh=mesh, use_pallas_attention=cfg.use_pallas_attention,
+        use_pallas_int8=cfg.use_pallas_int8,
+        steps_per_call=cfg.decode_steps_per_call,
+        pipeline_depth=cfg.pipeline_depth,
+        sampling_method=cfg.sampling,
+        spec_decode=cfg.spec_decode,
+        spec_draft_len=cfg.spec_draft_len,
+        spec_breakeven=cfg.spec_breakeven,
+        shared_prefix=cfg.shared_prefix)
+    return engine

@@ -1,0 +1,393 @@
+"""Legacy remote backends behind the engine seam: vLLM and Ollama.
+
+Back-compat parity with the reference's L1 handler layer — the vLLM
+OpenAI-SSE client (app/core/vllm_handler.py:117-308) and the Ollama
+NDJSON client (app/core/ollama_handler.py:110-339) — rebuilt as
+EngineBase implementations so the serving layer is provider-pluggable
+(tpu | vllm | ollama) exactly as SURVEY.md §7 prescribes. Fully async
+(aiohttp): no sync-generator-in-async-loop stalls (reference flaw,
+SURVEY.md §3.3), and cancellation closes the HTTP stream immediately.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, AsyncGenerator
+
+import aiohttp
+
+from fasttalk_tpu.engine.engine import (EngineBase, GenerationParams,
+                                        raw_prompt_text)
+from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("engine.remote")
+
+
+class _RemoteEngine(EngineBase):
+    """Shared plumbing: lazy client session, cancel flags, lifecycle."""
+
+    def __init__(self, base_url: str, timeout_s: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._cancelled: set[str] = set()
+        self._session: aiohttp.ClientSession | None = None
+        self._started = False
+
+    def start(self) -> None:
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._started = False
+        session, self._session = self._session, None
+        if session is not None and not session.closed:
+            try:
+                loop = asyncio.get_event_loop()
+                if loop.is_running():
+                    loop.create_task(session.close())
+                else:
+                    loop.run_until_complete(session.close())
+            except RuntimeError:
+                pass
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s,
+                                              sock_connect=10))
+        return self._session
+
+    def cancel(self, request_id: str) -> bool:
+        self._cancelled.add(request_id)
+        return True
+
+    def release_session(self, session_id: str) -> None:
+        pass  # remote backends hold no per-session device state
+
+    def get_stats(self) -> dict:
+        return {"backend": self.base_url,
+                "cancelled_pending": len(self._cancelled)}
+
+    def _sync_get(self, url: str, timeout: float = 3.0) -> Any:
+        import requests
+
+        r = requests.get(url, timeout=timeout)
+        r.raise_for_status()
+        return r
+
+    def _finish_stats(self, chunks: int, started: float,
+                      ttft: float | None,
+                      prompt_tokens: int | None = None,
+                      completion_tokens: int | None = None) -> dict:
+        """Terminal stats for a remote stream.
+
+        A stream CHUNK is not a token (the reference conflated the two —
+        SURVEY.md §5 metrics gap, explicitly on the don't-copy list), so
+        ``tokens_generated``/``tokens_per_second`` are reported only when
+        the backend supplied its own authoritative token counts (vLLM
+        usage via stream_options, Ollama eval_count); otherwise they are
+        None and ``chunks_generated`` carries the honestly-labelled
+        chunk count."""
+        dur = time.monotonic() - started
+        return {
+            "chunks_generated": chunks,
+            "tokens_generated": completion_tokens,
+            "processing_time_ms": dur * 1000,
+            "tokens_per_second": (completion_tokens / dur
+                                  if completion_tokens is not None
+                                  and dur > 0 else None),
+            "ttft_ms": ttft,
+            "prompt_tokens": prompt_tokens,
+        }
+
+
+class VLLMRemoteEngine(_RemoteEngine):
+    """OpenAI-compatible SSE streaming client against an external vLLM
+    (reference: vllm_handler.py — base URL config at config.py:96)."""
+
+    def __init__(self, base_url: str, model: str,
+                 api_key: str = "not-needed", timeout_s: float = 600.0):
+        super().__init__(base_url, timeout_s)
+        self.model = model
+        self.api_key = api_key
+        # Set after a backend 400s on stream_options (pre-0.4.3 vLLM,
+        # strict OpenAI-compatible proxies): dropped for the engine's
+        # lifetime; stats then fall back to chunk counting.
+        self._no_stream_options = False
+        # Same lifecycle for repetition_penalty: vLLM accepts it as a
+        # sampling extension, but strict OpenAI-compatible backends 400
+        # on the unknown param — drop it (not the request) and retry.
+        self._no_repetition_penalty = False
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        client = await self._client()
+        body = {
+            "model": self.model,
+            "temperature": params.temperature,
+            "top_p": params.top_p,
+            "max_tokens": params.max_tokens,
+            "stream": True,
+            # OpenAI-style penalties pass straight through.
+            "presence_penalty": params.presence_penalty,
+            "frequency_penalty": params.frequency_penalty,
+        }
+        if params.repeat_penalty != 1.0 and not self._no_repetition_penalty:
+            body["repetition_penalty"] = params.repeat_penalty
+        if not self._no_stream_options:
+            # Ask the backend for its own token accounting (an OpenAI /
+            # vLLM-supported option): the final chunk then carries
+            # usage.completion_tokens, the only true token count a
+            # remote client can get (chunk != token, SURVEY.md §5).
+            body["stream_options"] = {"include_usage": True}
+        if params.raw_prompt:
+            # /v1/completions passthrough: raw prompt, upstream's own
+            # legacy endpoint (no chat template anywhere).
+            url = f"{self.base_url}/completions"
+            body["prompt"] = raw_prompt_text(messages)
+        else:
+            url = f"{self.base_url}/chat/completions"
+            body["messages"] = messages
+        if params.stop:
+            body["stop"] = params.stop
+        started = time.monotonic()
+        ttft = None
+        chunks = 0
+        prompt_toks: int | None = None
+        completion_toks: int | None = None
+        finish = "stop"
+        try:
+            for _attempt in range(3):
+                async with client.post(
+                        url, json=body,
+                        headers={"Authorization": f"Bearer {self.api_key}"},
+                        ) as resp:
+                    if resp.status != 200:
+                        text = await resp.text()
+                        if resp.status == 400 \
+                                and "stream_options" in body \
+                                and "stream_options" in text:
+                            # The backend names stream_options in its
+                            # 400 (pre-0.4.3 vLLM, strict proxies):
+                            # drop the parameter for this engine's
+                            # lifetime and retry once (stats degrade to
+                            # honest chunk counts). Any OTHER 400 —
+                            # context overflow, bad params — surfaces
+                            # unretried below.
+                            self._no_stream_options = True
+                            del body["stream_options"]
+                            continue
+                        if resp.status == 400 \
+                                and "repetition_penalty" in body \
+                                and "repetition_penalty" in text:
+                            # Strict OpenAI-compatible backend without
+                            # the vLLM sampling extension: serve without
+                            # the penalty rather than failing every
+                            # generation.
+                            self._no_repetition_penalty = True
+                            del body["repetition_penalty"]
+                            continue
+                        raise LLMServiceError(
+                            f"vLLM backend error {resp.status}: "
+                            f"{text[:200]}",
+                            category=ErrorCategory.CONNECTION)
+                    async for raw in resp.content:
+                        if request_id in self._cancelled:
+                            self._cancelled.discard(request_id)
+                            yield {"type": "cancelled",
+                                   "finish_reason": "cancelled",
+                                   "stats": self._finish_stats(
+                                       chunks, started, ttft, prompt_toks,
+                                       completion_toks)}
+                            return
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            break
+                        try:
+                            obj = json.loads(payload)
+                        except json.JSONDecodeError:
+                            continue
+                        usage = obj.get("usage")
+                        if usage:
+                            # include_usage final chunk (empty choices):
+                            # backend-authoritative token counts.
+                            prompt_toks = usage.get("prompt_tokens",
+                                                    prompt_toks)
+                            completion_toks = usage.get(
+                                "completion_tokens", completion_toks)
+                        choices = obj.get("choices") or []
+                        if not choices:
+                            continue
+                        fr = choices[0].get("finish_reason")
+                        if fr:
+                            finish = fr
+                        # chat streams deltas; completions streams text
+                        content = (choices[0].get("text")
+                                   if params.raw_prompt
+                                   else choices[0].get("delta", {})
+                                   .get("content"))
+                        if content:
+                            chunks += 1
+                            if ttft is None:
+                                ttft = (time.monotonic() - started) * 1000
+                            yield {"type": "token", "text": content}
+                break  # stream consumed; no retry
+            yield {"type": "done", "finish_reason": finish,
+                   "stats": self._finish_stats(chunks, started, ttft,
+                                               prompt_toks,
+                                               completion_toks)}
+        except aiohttp.ClientError as e:
+            raise LLMServiceError(f"vLLM connection failed: {e}",
+                                  category=ErrorCategory.CONNECTION) from e
+        finally:
+            self._cancelled.discard(request_id)
+
+    def check_connection(self) -> bool:
+        if not self._started:
+            return False
+        try:
+            root = self.base_url.rsplit("/v1", 1)[0]
+            self._sync_get(f"{root}/health")
+            return True
+        except Exception:
+            return False
+
+    def get_model_info(self) -> dict:
+        # Static (no network): this runs inside async handlers, where a
+        # blocking round-trip would stall the event loop.
+        return {"model": self.model, "backend": "vllm",
+                "base_url": self.base_url}
+
+    def list_available_models(self) -> list[str]:
+        """Network call — do not use from the event loop."""
+        try:
+            r = self._sync_get(f"{self.base_url}/models")
+            return [m.get("id") for m in r.json().get("data", [])]
+        except Exception:
+            return []
+
+
+class OllamaRemoteEngine(_RemoteEngine):
+    """NDJSON streaming client against an external Ollama
+    (reference: ollama_handler.py — base URL config at config.py:116)."""
+
+    def __init__(self, base_url: str, model: str,
+                 keep_alive: str = "5m", timeout_s: float = 600.0):
+        super().__init__(base_url, timeout_s)
+        self.model = model
+        self.keep_alive = keep_alive
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        client = await self._client()
+        body = {
+            "model": self.model,
+            "stream": True,
+            "keep_alive": self.keep_alive,
+            "options": {
+                "temperature": params.temperature,
+                "top_p": params.top_p,
+                "top_k": params.top_k,
+                "num_predict": params.max_tokens,
+                # Explicit where the reference's gateway relied on the
+                # engine default (~1.1): the applied penalty is now in
+                # the request record, not implicit engine state.
+                "repeat_penalty": params.repeat_penalty,
+                "presence_penalty": params.presence_penalty,
+                "frequency_penalty": params.frequency_penalty,
+            },
+        }
+        if params.raw_prompt:
+            # /api/generate with raw=true: Ollama's untemplated path.
+            url = f"{self.base_url}/api/generate"
+            body["prompt"] = raw_prompt_text(messages)
+            body["raw"] = True
+        else:
+            url = f"{self.base_url}/api/chat"
+            body["messages"] = messages
+        if params.stop:
+            body["options"]["stop"] = params.stop
+        started = time.monotonic()
+        ttft = None
+        chunks = 0
+        prompt_toks: int | None = None
+        completion_toks: int | None = None
+        try:
+            async with client.post(url, json=body) as resp:
+                if resp.status != 200:
+                    text = await resp.text()
+                    raise LLMServiceError(
+                        f"Ollama backend error {resp.status}: {text[:200]}",
+                        category=ErrorCategory.CONNECTION)
+                async for raw in resp.content:
+                    if request_id in self._cancelled:
+                        self._cancelled.discard(request_id)
+                        yield {"type": "cancelled",
+                               "finish_reason": "cancelled",
+                               "stats": self._finish_stats(
+                                   chunks, started, ttft, prompt_toks,
+                                   completion_toks)}
+                        return
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    # /api/chat nests under message; /api/generate is flat
+                    content = (obj.get("response") if params.raw_prompt
+                               else (obj.get("message") or {})
+                               .get("content"))
+                    if content:
+                        chunks += 1
+                        if ttft is None:
+                            ttft = (time.monotonic() - started) * 1000
+                        yield {"type": "token", "text": content}
+                    if obj.get("done"):
+                        # Final NDJSON object carries Ollama's own token
+                        # accounting (the reference threw these away and
+                        # counted chunks, ollama_handler.py:233-339).
+                        prompt_toks = obj.get("prompt_eval_count",
+                                              prompt_toks)
+                        completion_toks = obj.get("eval_count",
+                                                  completion_toks)
+                        break
+            yield {"type": "done", "finish_reason": "stop",
+                   "stats": self._finish_stats(chunks, started, ttft,
+                                               prompt_toks,
+                                               completion_toks)}
+        except aiohttp.ClientError as e:
+            raise LLMServiceError(f"Ollama connection failed: {e}",
+                                  category=ErrorCategory.CONNECTION) from e
+        finally:
+            self._cancelled.discard(request_id)
+
+    def check_connection(self) -> bool:
+        if not self._started:
+            return False
+        try:
+            self._sync_get(f"{self.base_url}/")
+            return True
+        except Exception:
+            return False
+
+    def get_model_info(self) -> dict:
+        # Static (no network): see VLLMRemoteEngine.get_model_info.
+        return {"model": self.model, "backend": "ollama",
+                "base_url": self.base_url}
+
+    def list_available_models(self) -> list[str]:
+        """Network call — do not use from the event loop."""
+        try:
+            r = self._sync_get(f"{self.base_url}/api/tags")
+            return [m.get("name") for m in r.json().get("models", [])]
+        except Exception:
+            return []

@@ -1,0 +1,21 @@
+from fasttalk_tpu.models.configs import (
+    ModelConfig,
+    RopeScaling,
+    get_model_config,
+    list_models,
+)
+from fasttalk_tpu.models.llama import (
+    KVCache,
+    forward,
+    init_cache,
+    init_params,
+    param_count,
+    rms_norm,
+)
+from fasttalk_tpu.models.loader import find_checkpoint_dir, load_or_init, load_params
+
+__all__ = [
+    "ModelConfig", "RopeScaling", "get_model_config", "list_models",
+    "KVCache", "forward", "init_cache", "init_params", "param_count",
+    "rms_norm", "find_checkpoint_dir", "load_or_init", "load_params",
+]

@@ -1,0 +1,335 @@
+"""Pure-functional Llama forward pass with in-forward KV cache update.
+
+TPU-first design notes:
+- Per-layer weights are *stacked* along a leading layer axis and the
+  transformer body is a single ``lax.scan`` — one traced layer instead of
+  N, so a 70B/80-layer model compiles as fast as the 1B.
+- The KV cache is threaded through the scan as scan inputs/outputs with
+  matching shapes, so under ``jit(..., donate_argnums=...)`` XLA aliases
+  the buffers and decode updates the cache in place in HBM.
+- All norms/softmax/rope run in float32; matmuls stay in bfloat16 on the
+  MXU (``preferred_element_type`` on the attention contraction).
+- Writes use vmapped ``dynamic_update_slice`` so each batch row (slot)
+  can write at its own position — the primitive continuous batching needs.
+
+This module replaces the model execution that the reference delegated to
+external vLLM/Ollama containers (SURVEY.md §2: in-tree native components
+NONE; engine capability lived in the containers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.quant import embed_lookup, matmul_tied
+from fasttalk_tpu.ops.quant import matmul as qmm
+from fasttalk_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value cache: k, v each [L, B, S, num_kv_heads, head_dim]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int,
+               dtype: jnp.dtype = jnp.bfloat16, device=None) -> KVCache:
+    """``device`` may be a Sharding — the cache is then created directly
+    in its shards (never materialised on a single chip)."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype, device=device),
+                   v=jnp.zeros(shape, dtype, device=device))
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array,
+                dtype: jnp.dtype = jnp.bfloat16) -> Params:
+    """Random init with GPT-style scaled normals (for tests and weight-free
+    benchmarking; real checkpoints come from models/loader.py)."""
+    keys = iter(jax.random.split(rng, 16))
+    d, f, l = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    scale = d ** -0.5
+    params: Params = {
+        "embed": normal(next(keys), (cfg.vocab_size, d), scale),
+        "layers": {
+            "attn_norm": jnp.ones((l, d), dtype),
+            "wq": normal(next(keys), (l, d, cfg.q_dim), scale),
+            "wk": normal(next(keys), (l, d, cfg.kv_dim), scale),
+            "wv": normal(next(keys), (l, d, cfg.kv_dim), scale),
+            "wo": normal(next(keys), (l, cfg.q_dim, d), scale / np.sqrt(2 * l)),
+            "mlp_norm": jnp.ones((l, d), dtype),
+            "w_gate": normal(next(keys), (l, d, f), scale),
+            "w_up": normal(next(keys), (l, d, f), scale),
+            "w_down": normal(next(keys), (l, f, d), f ** -0.5 / np.sqrt(2 * l)),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if cfg.qkv_bias:  # Qwen2-style attention biases
+        params["layers"]["bq"] = jnp.zeros((l, cfg.q_dim), dtype)
+        params["layers"]["bk"] = jnp.zeros((l, cfg.kv_dim), dtype)
+        params["layers"]["bv"] = jnp.zeros((l, cfg.kv_dim), dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(keys), (d, cfg.vocab_size), scale)
+    return params
+
+
+def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (normed * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _write_kv(cache_layer: jnp.ndarray, new: jnp.ndarray,
+              write_start: jnp.ndarray,
+              write_mask: jnp.ndarray | None) -> jnp.ndarray:
+    """Write new [B, T, K, H] into cache [B, S, K, H] at per-row offsets.
+
+    ``write_mask`` [B] bool: rows with False keep their existing cache
+    contents (used by the batched decode step so idle slots can never
+    clobber resident KV of a parked session).
+    """
+    if write_mask is None:
+        def row(c, n, s):
+            return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+        return jax.vmap(row)(cache_layer, new, write_start)
+
+    def row(c, n, s, m):
+        cur = jax.lax.dynamic_slice(c, (s, 0, 0), n.shape)
+        return jax.lax.dynamic_update_slice(c, jnp.where(m, n, cur), (s, 0, 0))
+    return jax.vmap(row)(cache_layer, new, write_start, write_mask)
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, cache: KVCache, write_start: jnp.ndarray,
+            *, blockwise: bool = False,
+            write_mask: jnp.ndarray | None = None,
+            pallas_decode: bool = False,
+            pallas_int8: bool = False,
+            logits_indices: jnp.ndarray | None = None,
+            attn_override: Any = None,
+            override_write: bool = False,
+            cache_attn_override: Any = None,
+            ) -> tuple[jnp.ndarray, KVCache]:
+    """Run the transformer over ``tokens`` [B, T], updating the cache.
+
+    positions [B, T]: absolute position of each token (also its RoPE phase
+    and attention horizon). write_start [B]: cache index where this chunk's
+    K/V are written per row. write_mask [B] (optional): rows with False
+    leave the cache untouched. Works for prefill (T=chunk) and decode
+    (T=1) alike; ``blockwise`` picks the flash-style attention for long
+    chunks, ``pallas_decode`` the length-pruning Pallas kernel for T=1
+    (single-device only — see ops/pallas_attention.py).
+
+    ``logits_indices`` [B] (optional): project the lm_head for ONE
+    position per row instead of the whole chunk. Prefill only consumes
+    the last token's logits, and skipping the rest avoids both the
+    [B, T, vocab] logits buffer and — for int8 tied embeddings — an XLA
+    dequant that would materialise the full bf16 table per chunk; the
+    returned logits are [B, 1, vocab].
+
+    ``attn_override`` (optional): ``fn(q, k, v, positions) -> o`` over
+    the freshly computed q/k/v of the whole block, replacing the
+    cache-read attention — the full-self-attention regime (T == the
+    whole sequence). This is how parallel/ring_attention.py plugs in:
+    K/V rotate over the "sp" ICI ring instead of being all-gathered,
+    so per-chip sequence memory is O(T/sp). Cache writes are skipped
+    by default (training passes a dummy cache); ``override_write=True``
+    additionally writes the fresh K/V into the cache — the serving
+    ring-prefill regime, where decode must later read what the ring
+    attended over.
+
+    ``cache_attn_override`` (optional): ``fn(q, ck, cv, positions) ->
+    o`` replacing the CACHE-READ attention (writes still happen) —
+    how parallel.ring_attention.decode_attention_sharded plugs in for
+    sp-sharded serving decode: per-chip folds over the local KV shard
+    plus a statistics psum, instead of GSPMD's per-step K/V
+    all-gather.
+
+    Returns (logits [B, T, vocab], updated cache). (The decode hot path
+    is ``forward_decode`` below — scatter cache writes + bounded
+    attention reads; this function serves prefill, training, and the
+    TP/mesh decode.)
+    """
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                            cfg.rope_scaling))
+    x = embed_lookup(params["embed"], tokens,
+                     params["final_norm"].dtype)
+    b, t = tokens.shape
+    # The int8 dequant-fused matmul kernel applies in the single-device
+    # T=1 decode regime; its gate (pallas_int8) is independent of the
+    # attention kernel's (pallas_decode) — disabling one must not
+    # silently disable the other.
+    pok = pallas_int8 and t == 1
+
+    def layer(x, scanned):
+        lp, ck, cv = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
+                   qmm(h, lp["wv"], pok))
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        if attn_override is not None:
+            if override_write:
+                ck = _write_kv(ck, k, write_start, write_mask)
+                cv = _write_kv(cv, v, write_start, write_mask)
+            o = attn_override(q, k, v, positions)
+        else:
+            ck = _write_kv(ck, k, write_start, write_mask)
+            cv = _write_kv(cv, v, write_start, write_mask)
+            if cache_attn_override is not None:
+                o = cache_attn_override(q, ck, cv, positions)
+            elif pallas_decode and t == 1:
+                from fasttalk_tpu.ops.pallas_attention import decode_attend
+
+                o = decode_attend(q[:, 0], ck, cv,
+                                  positions[:, 0] + 1)[:, None]
+            else:
+                attn_fn = attend_blockwise if blockwise else attend
+                o = attn_fn(q, ck, cv, positions)
+        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
+        up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    pok_head = pok
+    if logits_indices is not None:
+        x = jnp.take_along_axis(
+            x, logits_indices.astype(jnp.int32)[:, None, None], axis=1)
+        pok_head = pallas_int8  # single row: the T=1 kernels apply
+    if cfg.tie_embeddings:
+        logits = matmul_tied(x, params["embed"],
+                             pok_head).astype(jnp.float32)
+    else:
+        logits = qmm(x, params["lm_head"], pok_head).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+def forward_decode_multi(params: Params, cfg: ModelConfig,
+                         tokens: jnp.ndarray, positions: jnp.ndarray,
+                         cache: KVCache, write_mask: jnp.ndarray, *,
+                         attn_len: int, pallas_int8: bool = False,
+                         ) -> tuple[jnp.ndarray, KVCache]:
+    """Scatter-write decode over a short block: tokens [B, T] ->
+    logits [B, T, V], cache updated IN PLACE.
+
+    The whole cache rides the layer scan's carry (carries alias under
+    donation), each layer scatter-writes only the block's K/V columns
+    ([B, T, Kv, H] — KiB, not the bucket), and attention reads a
+    per-layer dynamic-slice bounded by the static ``attn_len``. T=1 is
+    the plain decode step (``forward_decode`` below); T>1 is the
+    speculative-decoding verify block (engine/spec: current token +
+    draft), causal within the block via absolute-position masking.
+
+    positions [B]: absolute position of tokens[:, 0] per slot (the
+    block occupies positions..positions+T-1). write_mask [B]: rows with
+    False neither write the cache nor advance (their scatter is clamped
+    out of range and dropped).
+    """
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                            cfg.rope_scaling))
+    x = embed_lookup(params["embed"], tokens,
+                     params["final_norm"].dtype)  # [B, T, D]
+    b, t = tokens.shape
+    s_total = cache.max_len
+    pos_mat = positions[:, None] + jnp.arange(t)[None, :]  # [B, T]
+    rows = jnp.arange(b)
+    # Masked rows scatter out of range -> dropped (mode="drop").
+    write_cols = jnp.where(write_mask[:, None], pos_mat, s_total)
+
+    def layer(carry, lp):
+        x, ck_all, cv_all, li = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        pok = pallas_int8
+        q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
+                   qmm(h, lp["wv"], pok))
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, pos_mat, inv_freq)
+        k = apply_rope(k, pos_mat, inv_freq)
+        ck_all = ck_all.at[li, rows[:, None], write_cols].set(
+            k, mode="drop", unique_indices=True)
+        cv_all = cv_all.at[li, rows[:, None], write_cols].set(
+            v, mode="drop", unique_indices=True)
+        ak = jax.lax.dynamic_slice(
+            ck_all, (li, 0, 0, 0, 0),
+            (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+        av = jax.lax.dynamic_slice(
+            cv_all, (li, 0, 0, 0, 0),
+            (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+        o = attend(q, ak, av, pos_mat)
+        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
+        up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
+        return (x, ck_all, cv_all, li + 1), None
+
+    (x, new_k, new_v, _), _ = jax.lax.scan(
+        layer, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    # The T=1 int8 kernels gate themselves on shape inside qmm/
+    # matmul_tied (x.shape[1] == 1), so the verify block transparently
+    # takes the XLA dequant path for its head matmul.
+    if cfg.tie_embeddings:
+        logits = matmul_tied(x, params["embed"],
+                             pallas_int8).astype(jnp.float32)
+    else:
+        logits = qmm(x, params["lm_head"], pallas_int8).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
+                   positions: jnp.ndarray, cache: KVCache,
+                   write_mask: jnp.ndarray, *, attn_len: int,
+                   pallas_int8: bool = False,
+                   ) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step [B] -> logits [B, V], cache updated IN PLACE.
+
+    The throughput-critical specialisation of ``forward`` for T=1 — see
+    ``forward_decode_multi`` for the mechanics. (``forward``'s layer
+    scan threads the cache as scan xs/ys, and XLA materialises the
+    stacked ys every call — a full read+write of the attention region
+    per step, ~1.1 GB/step at a 512 bucket for the 1B model; the
+    scatter form traced at 3.96 vs 4.99 ms/step on v5e-1.)
+    """
+    logits, new_cache = forward_decode_multi(
+        params, cfg, cur[:, None], positions, cache, write_mask,
+        attn_len=attn_len, pallas_int8=pallas_int8)
+    return logits[:, 0], new_cache
+
+
+def param_count(params: Params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

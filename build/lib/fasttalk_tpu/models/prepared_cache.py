@@ -1,0 +1,205 @@
+"""Prepared-weight cache: Orbax store of the engine-ready param pytree.
+
+The reference's only "checkpoint" story was the external engines caching
+raw HF downloads in docker volumes (reference: docker-compose.vllm.yml:
+58-59 vllm_cache volume; SURVEY.md §5 checkpoint/resume: none in-tree).
+Here the expensive part of startup is not the download but the
+transform: safetensors -> transpose -> stack layers -> cast -> (int8
+quantize) -> (TP shard). This module caches the FINAL pytree — already
+stacked, cast, quantized and shard-layout-aware — so a restart restores
+straight into device shards at Orbax/TensorStore speed and skips the
+whole transform pipeline.
+
+Cache key: model name + dtype + quantize + mesh shape (meta.json). Any
+mismatch ignores the cache (it is re-written after the slow load).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.models.llama import init_params
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("models.prepared_cache")
+
+_META = "fasttalk_meta.json"
+
+
+def checkpoint_fingerprint(ckpt_dir: str | None) -> list | None:
+    """Identity of the source checkpoint files (name, size, mtime): a
+    re-downloaded/updated checkpoint must invalidate the prepared cache,
+    or a restart would silently keep serving the stale weights."""
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return None
+    out = []
+    for f in sorted(os.listdir(ckpt_dir)):
+        if f.endswith((".safetensors", ".json")):
+            st = os.stat(os.path.join(ckpt_dir, f))
+            out.append([f, st.st_size, int(st.st_mtime)])
+    return out
+
+
+def cache_meta(cfg: ModelConfig, dtype, quantize: bool, mesh,
+               ckpt_dir: str | None = None) -> dict:
+    return {
+        # 2: int8 now also row-quantizes the embedding (ops/quant.py
+        # EMBED_LEAF) — format bump invalidates r2-era caches whose
+        # pytree lacks the embed {q, s} dict.
+        "format": 2,
+        "model": cfg.name,
+        "dtype": jnp.dtype(dtype).name,
+        "quantize": "int8" if quantize else "none",
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        # Device topology: Orbax sharding metadata references concrete
+        # device names, and restoring under a different topology (e.g.
+        # a store written on 1 CPU device read under a forced 8-device
+        # CPU mesh) spews ERROR-level device-not-found records from
+        # orbax internals even when the fallback succeeds. A topology
+        # mismatch skips the cache and re-transforms instead.
+        "devices": [jax.devices()[0].platform, jax.device_count()],
+        "source": checkpoint_fingerprint(ckpt_dir),
+    }
+
+
+def cache_dir(model_path: str, meta: dict) -> str:
+    mesh = meta["mesh"] or {}
+    tag = "-".join([meta["model"].replace(":", "_"), meta["dtype"],
+                    meta["quantize"],
+                    "x".join(f"{k}{v}" for k, v in sorted(mesh.items()))
+                    or "single"])
+    return os.path.join(model_path, ".prepared", tag)
+
+
+def abstract_params(cfg: ModelConfig, dtype, quantize: bool, mesh) -> Any:
+    """ShapeDtypeStruct pytree (with shardings when meshed) matching what
+    the factory's load path produces — the restore target."""
+    from fasttalk_tpu.ops.quant import QUANTIZED_LEAVES
+
+    shapes = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0), dtype))
+
+    def to_abstract(path, sds):
+        name = str(getattr(path[-1], "key", path[-1]))
+        parent = ""
+        keys = [str(getattr(k, "key", k)) for k in path]
+        if len(keys) >= 2:
+            parent = keys[-2]
+
+        def with_sharding(shape, dt, leaf_name, leaf_parent):
+            sharding = None
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+
+                from fasttalk_tpu.parallel.sharding import _spec_for
+                sharding = NamedSharding(
+                    mesh, _spec_for(leaf_name, len(shape), shape,
+                                    parent=leaf_parent))
+            return jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
+
+        if quantize and name == "lm_head":
+            # Untied head is stored TRANSPOSED row-quantized
+            # ({"qt": int8[V, D], "s": f32[V]} — ops/quant.py
+            # _quantize_head_t); the restore target must match or every
+            # restart silently repays the full load.
+            d, v = sds.shape
+            return {
+                "qt": with_sharding((v, d), jnp.int8, "qt", name),
+                "s": with_sharding((v,), jnp.float32, "s", name),
+            }
+        if quantize and name in QUANTIZED_LEAVES:
+            out = sds.shape[-1]
+            lead = sds.shape[:-2]
+            return {
+                "q": with_sharding(sds.shape, jnp.int8, "q", name),
+                "s": with_sharding(lead + (out,), jnp.float32, "s", name),
+            }
+        if quantize and name == "embed":
+            return {
+                "q": with_sharding(sds.shape, jnp.int8, "q", name),
+                "s": with_sharding(sds.shape[:-1], jnp.float32, "s", name),
+            }
+        return with_sharding(sds.shape, sds.dtype, name, parent)
+
+    return jax.tree_util.tree_map_with_path(to_abstract, shapes)
+
+
+def save_prepared(params: Any, model_path: str, meta: dict,
+                  block: bool = False) -> str | None:
+    """Write the engine-ready pytree; best-effort (serving works without
+    it — the cache only accelerates the next restart). Serialization
+    finishes on a background thread unless ``block`` (tests)."""
+    try:
+        import orbax.checkpoint as ocp
+
+        path = cache_dir(model_path, meta)
+        tmp_ok = os.access(os.path.dirname(os.path.dirname(path))
+                           or ".", os.W_OK)
+        if not tmp_ok:
+            log.warning(f"prepared cache dir not writable: {path}")
+            return None
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path), params, force=True)
+
+        # Serialization of a large pytree takes as long as the disk
+        # write; finish it (and only then publish the meta marker that
+        # makes the cache eligible for restore) off the startup path —
+        # the cache only helps the NEXT boot, so this boot must not
+        # block on it.
+        def _finalize() -> None:
+            try:
+                ckptr.wait_until_finished()
+                with open(os.path.join(path, _META), "w") as f:
+                    json.dump(meta, f)
+                log.info(f"prepared-weight cache written: {path}")
+            except Exception as e:  # pragma: no cover - disk races
+                log.warning(f"prepared cache finalize failed: {e}")
+
+        if block:
+            _finalize()
+        else:
+            import threading
+
+            # Non-daemon: a short-lived process (bench, smoke run) joins
+            # this at exit instead of killing the serialization midway —
+            # otherwise the meta marker never lands and every such run
+            # repays the full slow load.
+            threading.Thread(target=_finalize, name="prepared-cache-save",
+                             daemon=False).start()
+        return path
+    except Exception as e:
+        log.warning(f"prepared cache save failed (continuing): {e}")
+        return None
+
+
+def load_prepared(cfg: ModelConfig, model_path: str, dtype,
+                  quantize: bool, mesh,
+                  ckpt_dir: str | None = None) -> Any | None:
+    """Restore the engine-ready pytree, or None when absent/mismatched."""
+    meta = cache_meta(cfg, dtype, quantize, mesh, ckpt_dir)
+    path = cache_dir(model_path, meta)
+    meta_file = os.path.join(path, _META)
+    if not os.path.isfile(meta_file):
+        return None
+    try:
+        with open(meta_file) as f:
+            have = json.load(f)
+        if have != meta:
+            log.warning(f"prepared cache mismatch at {path}; ignoring")
+            return None
+        import orbax.checkpoint as ocp
+
+        target = abstract_params(cfg, dtype, quantize, mesh)
+        ckptr = ocp.StandardCheckpointer()
+        params = ckptr.restore(os.path.abspath(path), target)
+        log.info(f"restored prepared weights from {path}")
+        return params
+    except Exception as e:
+        log.warning(f"prepared cache restore failed (reloading): {e}")
+        return None

@@ -1,0 +1,3 @@
+from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+__all__ = ["build_monitoring_app"]

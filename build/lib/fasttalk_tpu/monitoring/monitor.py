@@ -1,0 +1,183 @@
+"""Monitoring endpoints on a dedicated port.
+
+Capability parity with the reference's Flask sidecar
+(app/monitoring/service_monitor.py:85-137: /health with psutil system
+stats and threshold warnings, k8s-style /health/ready and /health/live,
+/metrics, /info), rebuilt as a second aiohttp app in the same event loop
+(no extra thread, no Flask) and backed by the ONE process-wide metrics
+registry — fixing the reference gap where the sidecar's counters were
+never wired and /metrics always reported zeros (SURVEY.md §5).
+
+/metrics serves Prometheus text; /metrics.json serves the JSON form.
+
+Tracing (SURVEY.md §5 "TPU equivalent: jax.profiler trace endpoint"):
+POST /profiler/start {"log_dir": ...} and POST /profiler/stop capture an
+XLA device trace viewable in TensorBoard/Perfetto; GET /profiler/memory
+reports live per-device HBM stats. The reference had no profiler at all
+— only wall-clock log lines (logger.py:208-224, never called).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import psutil
+from aiohttp import web
+
+from fasttalk_tpu import __version__
+from fasttalk_tpu.utils.metrics import get_metrics
+
+_profiler_state = {"active": False, "log_dir": None, "started_at": None}
+
+
+def _device_memory() -> list[dict]:
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        out.append({
+            "device": str(d),
+            "platform": d.platform,
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        })
+    return out
+
+
+def build_monitoring_app(ready_check=None) -> web.Application:
+    app = web.Application()
+
+    async def health(request: web.Request) -> web.Response:
+        cpu = psutil.cpu_percent(interval=0)
+        mem = psutil.virtual_memory()
+        m = get_metrics()
+        body = {
+            "status": "healthy",
+            "uptime_seconds": m.uptime(),
+            "system": {
+                "cpu_percent": cpu,
+                "memory_percent": mem.percent,
+                "memory_available_gb": mem.available / (1024 ** 3),
+            },
+            "metrics": m.to_dict(),
+        }
+        warnings = []
+        if cpu > 90:
+            warnings.append("High CPU usage")
+        if mem.percent > 90:
+            warnings.append("High memory usage")
+        if warnings:
+            body["warnings"] = warnings
+        return web.json_response(body)
+
+    async def ready(request: web.Request) -> web.Response:
+        if ready_check is not None and not ready_check():
+            return web.json_response({"status": "not_ready"}, status=503)
+        return web.json_response({"status": "ready"})
+
+    async def live(request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=get_metrics().prometheus(),
+                            content_type="text/plain")
+
+    async def metrics_json(request: web.Request) -> web.Response:
+        return web.json_response(get_metrics().to_dict())
+
+    async def info(request: web.Request) -> web.Response:
+        return web.json_response({
+            "service": "fasttalk-tpu",
+            "version": __version__,
+            "uptime_seconds": get_metrics().uptime(),
+        })
+
+    async def profiler_start(request: web.Request) -> web.Response:
+        import jax
+
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                pass
+        # The monitoring port is unauthenticated: never let the request
+        # choose an arbitrary filesystem path. Traces go under a fixed
+        # base; the body may only name a subdirectory within it.
+        base = os.path.realpath(
+            os.environ.get("PROFILER_TRACE_DIR", "/tmp/fasttalk-tpu-trace"))
+        sub = str(body.get("log_dir", ""))
+        if os.path.isabs(sub):
+            return web.json_response(
+                {"error": "log_dir must be a relative subdirectory of "
+                 f"{base} (set PROFILER_TRACE_DIR to move the base)"},
+                status=400)
+        log_dir = os.path.realpath(os.path.join(base, sub)) if sub else base
+        if log_dir != base and not log_dir.startswith(base + os.sep):
+            return web.json_response(
+                {"error": "log_dir must be a relative subdirectory of "
+                 f"{base}"}, status=400)
+        # Check-and-claim atomically: no await between the active check
+        # and the claim (body parsing above already suspended), so two
+        # concurrent POSTs can't both pass the check — the loser would
+        # otherwise reset active=False in its error path and orphan the
+        # winner's still-running trace.
+        if _profiler_state["active"]:
+            return web.json_response(
+                {"error": "trace already active",
+                 "log_dir": _profiler_state["log_dir"]}, status=409)
+        _profiler_state.update(active=True, log_dir=log_dir,
+                               started_at=time.monotonic())
+        try:
+            # Off the event loop: profiler setup does filesystem work and
+            # this loop is also serving every WebSocket token stream.
+            import asyncio
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.start_trace, log_dir)
+        except Exception as e:
+            _profiler_state.update(active=False, log_dir=None,
+                                   started_at=None)
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"status": "tracing", "log_dir": log_dir})
+
+    async def profiler_stop(request: web.Request) -> web.Response:
+        import jax
+
+        if not _profiler_state["active"]:
+            return web.json_response({"error": "no active trace"}, status=409)
+        duration = time.monotonic() - (_profiler_state["started_at"] or 0)
+        log_dir = _profiler_state["log_dir"]
+        # Release the claim before the awaited stop: a concurrent stop
+        # gets a clean 409 instead of double-calling stop_trace.
+        _profiler_state.update(active=False, log_dir=None, started_at=None)
+        try:
+            # stop_trace serializes the whole trace to disk — keep that
+            # multi-second write off the serving event loop.
+            import asyncio
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.stop_trace)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"status": "stopped", "log_dir": log_dir,
+                                  "duration_seconds": duration})
+
+    async def profiler_memory(request: web.Request) -> web.Response:
+        return web.json_response({"devices": _device_memory()})
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/health/ready", ready)
+    app.router.add_get("/health/live", live)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/metrics.json", metrics_json)
+    app.router.add_get("/info", info)
+    app.router.add_post("/profiler/start", profiler_start)
+    app.router.add_post("/profiler/stop", profiler_stop)
+    app.router.add_get("/profiler/memory", profiler_memory)
+    return app

@@ -1,0 +1,6 @@
+from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.rope import apply_rope, rope_frequencies
+from fasttalk_tpu.ops.sampling import sample_tokens
+
+__all__ = ["attend", "attend_blockwise", "apply_rope", "rope_frequencies",
+           "sample_tokens"]

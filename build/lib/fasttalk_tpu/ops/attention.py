@@ -1,0 +1,124 @@
+"""Grouped-query attention over a preallocated KV cache.
+
+Two XLA paths tuned for the two phases of serving:
+
+- ``attend`` — direct full-softmax attention, used for decode (T=1 per
+  slot): the score tensor is tiny, XLA fuses QK^T → softmax → PV into a
+  few MXU calls.
+- ``attend_blockwise`` — flash-style online-softmax scan over key blocks,
+  used for prefill chunks: bounds the score tensor to
+  [B, T, heads, block] regardless of cache length, so an 8k-context
+  prefill never materialises an O(T·S) buffer in HBM.
+
+Both mask by absolute position: key j is visible to query at absolute
+position p iff j <= p, which simultaneously enforces causality within a
+chunk and hides unwritten/garbage cache tail.
+
+A Pallas kernel with per-slot true lengths lives in
+``fasttalk_tpu.ops.pallas_attention`` and can replace ``attend`` on TPU
+(config: TPU_USE_PALLAS_ATTENTION).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _split_gqa(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[B, T, Nq, D] -> [B, T, Nkv, G, D]."""
+    b, t, nq, d = q.shape
+    return q.reshape(b, t, num_kv_heads, nq // num_kv_heads, d)
+
+
+def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+           q_positions: jnp.ndarray) -> jnp.ndarray:
+    """Full-softmax GQA. q [B,T,Nq,D]; k,v [B,S,Nkv,D]; q_positions [B,T]."""
+    nkv = k.shape[2]
+    scale = q.shape[-1] ** -0.5
+    qg = _split_gqa(q, nkv)
+    scores = jnp.einsum("btkgd,bskd->btkgs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    key_pos = jnp.arange(k.shape[1])
+    mask = key_pos[None, None, :] <= q_positions[:, :, None]  # [B,T,S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("btkgs,bskd->btkgd", probs.astype(v.dtype), v)
+    b, t = q.shape[:2]
+    return out.reshape(b, t, q.shape[2], q.shape[3])
+
+
+def online_softmax_fold(qg: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        q_positions: jnp.ndarray, key_pos: jnp.ndarray,
+                        carry: tuple) -> tuple:
+    """Fold one K/V block into flash-attention online-softmax state.
+
+    The single source of the numerics-critical recurrence, shared by
+    ``attend_blockwise`` (local key blocks) and
+    ``parallel.ring_attention`` (blocks visiting over ICI).
+
+    qg [B, Tq, K, G, D] float32; k/v [B, Tk, K, D] any dtype;
+    q_positions [B, Tq] and key_pos [Tk] are absolute positions;
+    carry = (m [B,Tq,K,G], l [B,Tq,K,G], acc [B,Tq,K,G,D]), all float32.
+    """
+    m, l, acc = carry
+    scale = qg.shape[-1] ** -0.5
+    scores = jnp.einsum("btkgd,bskd->btkgs", qg, k.astype(jnp.float32)) * scale
+    mask = key_pos[None, None, :] <= q_positions[:, :, None]  # [B, Tq, Tk]
+    scores = jnp.where(mask[:, :, None, None, :], scores, _NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum(
+        "btkgs,bskd->btkgd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def fold_init(b: int, t: int, nkv: int, g: int, d: int) -> tuple:
+    """Initial (m, l, acc) state for ``online_softmax_fold``."""
+    return (
+        jnp.full((b, t, nkv, g), _NEG_INF, jnp.float32),
+        jnp.zeros((b, t, nkv, g), jnp.float32),
+        jnp.zeros((b, t, nkv, g, d), jnp.float32),
+    )
+
+
+def fold_finish(carry: tuple, out_dtype) -> jnp.ndarray:
+    """Normalise the accumulated state into [B, T, Nq, D] output."""
+    _, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    b, t, nkv, g, d = acc.shape
+    return out.reshape(b, t, nkv * g, d).astype(out_dtype)
+
+
+def attend_blockwise(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     q_positions: jnp.ndarray, block_size: int = 512
+                     ) -> jnp.ndarray:
+    """Online-softmax GQA over key blocks (flash-attention recurrence)."""
+    b, t, nq, d = q.shape
+    s, nkv = k.shape[1], k.shape[2]
+    block_size = min(block_size, s)
+    if s % block_size:
+        raise ValueError(f"cache length {s} not divisible by block {block_size}")
+    nblocks = s // block_size
+    qg = _split_gqa(q, nkv).astype(jnp.float32)
+
+    kb = k.reshape(b, nblocks, block_size, nkv, d)
+    vb = v.reshape(b, nblocks, block_size, nkv, d)
+    kb = jnp.moveaxis(kb, 1, 0)  # [N, B, blk, Nkv, D]
+    vb = jnp.moveaxis(vb, 1, 0)
+    block_offsets = jnp.arange(nblocks) * block_size
+
+    def step(carry, xs):
+        kblk, vblk, off = xs
+        key_pos = off + jnp.arange(block_size)
+        return online_softmax_fold(qg, kblk, vblk, q_positions, key_pos,
+                                   carry), None
+
+    g = nq // nkv
+    carry, _ = jax.lax.scan(step, fold_init(b, t, nkv, g, d),
+                            (kb, vb, block_offsets))
+    return fold_finish(carry, q.dtype)

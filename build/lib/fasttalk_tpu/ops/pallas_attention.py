@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for batched decode attention with per-slot lengths.
+
+The decode step (one token per slot against the resident KV cache) is
+HBM-bandwidth-bound: its cost is dominated by streaming K/V out of HBM.
+The XLA path (`ops.attention.attend`) must read the whole KV-length
+bucket for every slot and mask the dead tail; this kernel instead
+prefetches the per-slot true lengths as scalars and prunes at the block
+level — a slot at position 600 in an 8192 bucket reads 5 blocks of K/V,
+not 64. Pruned grid steps remap their BlockSpec index to the slot's last
+live block, so Pallas's revisiting rule elides the DMA entirely.
+
+Per-step layout (one grid cell = one (slot, key block); all kv heads of
+the block are processed in one cell, statically unrolled — Mosaic
+requires the last two dims of every block to be (multiples of 8, 128) or
+equal to the array dims, which rules out blocking the kv-head axis to 1):
+
+    q      [B, Nkv, G, D]   VMEM block [1, Nkv, G, D]
+    k, v   [B, S, Nkv, D]   VMEM block [1, blk, Nkv, D]  (cache layout,
+                            no transpose of the resident cache)
+    out    [B, Nkv, G, D]   VMEM block [1, Nkv, G, D]
+
+The kv-block axis is the innermost grid dimension, so the flash-style
+online-softmax state (m, l, acc) lives in VMEM scratch and carries
+across blocks of the same slot; it is initialised at block 0 and
+normalised into the output at the last block.
+
+Replaces capability the reference delegated to vLLM's PagedAttention
+CUDA kernels (SURVEY.md §2: in-tree native components NONE; attention
+lived in the external container). Single-device only: under a TP mesh
+GSPMD cannot partition a custom kernel, so the engine keeps the XLA
+path when a mesh is set (the all-reduce-fused XLA attention is the
+right answer there anyway).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_size: int, scale: float):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nkv = q_ref.shape[1]
+    length = lengths_ref[b]
+    num_live = pl.cdiv(length, block_size)  # blocks this slot must visit
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < num_live)
+    def _fold():
+        key_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        live = key_pos < length
+        for h in range(nkv):  # static unroll: one rank-2 MXU matmul each
+            q = q_ref[0, h].astype(jnp.float32)       # [G, D]
+            k = k_ref[0, :, h].astype(jnp.float32)    # [blk, D]
+            v = v_ref[0, :, h].astype(jnp.float32)    # [blk, D]
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [G, blk]
+            scores = jnp.where(live, scores, _NEG_INF)
+
+            m_prev, l_prev = m_ref[h], l_ref[h]               # [G, 1]
+            m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+            correction = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new)                       # [G, blk]
+            m_ref[h] = m_new
+            l_ref[h] = l_prev * correction + p.sum(axis=-1, keepdims=True)
+            acc_ref[h] = acc_ref[h] * correction + jnp.dot(
+                p, v, preferred_element_type=jnp.float32)     # [G, D]
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def decode_attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  lengths: jnp.ndarray, *, block_size: int = 128,
+                  interpret: bool | None = None) -> jnp.ndarray:
+    """GQA decode attention with block-level length pruning.
+
+    q [B, Nq, D] (the single decode token per slot); k, v [B, S, Nkv, D]
+    in cache layout; lengths [B] = number of valid keys per slot
+    (position + 1). Returns [B, Nq, D]. S must divide by block_size
+    (KV-length buckets are powers of two >= 512).
+    """
+    b, nq, d = q.shape
+    s, nkv = k.shape[1], k.shape[2]
+    g = nq // nkv
+    if s % block_size:
+        raise ValueError(f"cache bucket {s} not divisible by {block_size}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb = s // block_size
+    qg = q.reshape(b, nkv, g, d)
+    lengths = lengths.astype(jnp.int32)
+
+    def q_index(b_, j, lens):  # noqa: ARG001
+        return (b_, 0, 0, 0)
+
+    def kv_index(b_, j, lens):
+        # Pruned blocks revisit the slot's last live block — same index
+        # as the previous grid step, so no DMA is issued for them.
+        num_live = pl.cdiv(lens[b_], block_size)
+        return (b_, jnp.minimum(j, num_live - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, nkv, g, d), q_index),
+            pl.BlockSpec((1, block_size, nkv, d), kv_index),
+            pl.BlockSpec((1, block_size, nkv, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, g, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, g, 1), jnp.float32),   # running max
+            pltpu.VMEM((nkv, g, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((nkv, g, d), jnp.float32),   # running numerator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=block_size,
+                          scale=d ** -0.5),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+        interpret=interpret,
+    )(lengths, qg, k, v)
+    return out.reshape(b, nq, d)

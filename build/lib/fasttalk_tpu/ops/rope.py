@@ -1,0 +1,53 @@
+"""Rotary position embeddings with Llama-3 frequency scaling.
+
+Computed in float32 regardless of activation dtype (rotation of bf16
+values in bf16 loses precision at long context).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:  # import at runtime would cycle: ops → models → ops
+    from fasttalk_tpu.models.configs import RopeScaling
+
+
+def rope_frequencies(head_dim: int, theta: float,
+                     scaling: "RopeScaling | None") -> np.ndarray:
+    """Per-pair inverse frequencies [head_dim/2], float32, host-computed."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling is not None:
+        # Llama-3 rope scaling: keep high-frequency (short wavelength)
+        # components, scale low-frequency ones by 1/factor, smooth between.
+        low_wl = scaling.original_max_position / scaling.low_freq_factor
+        high_wl = scaling.original_max_position / scaling.high_freq_factor
+        wavelen = 2.0 * np.pi / inv_freq
+        smooth = (scaling.original_max_position / wavelen - scaling.low_freq_factor) / (
+            scaling.high_freq_factor - scaling.low_freq_factor)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / scaling.factor
+        blended = (1.0 - smooth) * scaled + smooth * inv_freq
+        inv_freq = np.where(wavelen > low_wl, scaled,
+                            np.where(wavelen < high_wl, inv_freq, blended))
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               inv_freq: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [..., T, H, D] by ``positions`` [..., T].
+
+    Pairs are (x[..., :D/2], x[..., D/2:]) — the HF Llama "rotate_half"
+    convention, so weights loaded from HF checkpoints match.
+    """
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

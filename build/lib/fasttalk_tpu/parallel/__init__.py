@@ -1,0 +1,26 @@
+"""Multi-chip parallelism: device meshes, sharding rules, ring attention.
+
+This package is the TPU-native replacement for everything the reference
+delegated to NCCL inside the external vLLM container (SURVEY.md §2
+parallelism table: `--tensor-parallel-size` passthrough at
+docker-compose.vllm.yml:42 was the reference's entire story). Here the
+collectives are XLA-emitted over ICI from sharding annotations:
+
+- ``mesh``        — build a `jax.sharding.Mesh` over ("dp", "sp", "tp").
+- ``sharding``    — PartitionSpec rules for the Llama param pytree and
+                    the KV cache (Megatron-style TP over heads/ffn).
+- ``ring_attention`` — shard_map + ppermute blockwise attention for
+                    sequence/context parallelism on long sequences.
+- ``train``       — sharded training step (loss/grad/optax) used by the
+                    multi-chip dry run and for fine-tuning.
+"""
+
+from fasttalk_tpu.parallel.mesh import (MeshSpec, best_mesh_shape,
+                                        make_mesh)
+from fasttalk_tpu.parallel.sharding import (cache_pspecs, param_pspecs,
+                                            shard_cache, shard_params)
+
+__all__ = [
+    "MeshSpec", "make_mesh", "best_mesh_shape",
+    "param_pspecs", "cache_pspecs", "shard_params", "shard_cache",
+]

@@ -1,0 +1,75 @@
+"""Device-mesh construction for TPU slices.
+
+Axis conventions (used consistently across the framework):
+
+- ``"dp"`` — data parallel: replicates over batch rows (decode slots in
+  serving, example batch in training).
+- ``"sp"`` — sequence/context parallel: shards the sequence axis of
+  activations and KV (ring attention rides this axis).
+- ``"tp"`` — tensor parallel: shards attention heads and FFN width
+  (Megatron pattern); collectives ride ICI.
+
+The reference exposed exactly one of these, TP, as a flag forwarded to an
+external engine (reference: docker-compose.vllm.yml:42
+``--tensor-parallel-size``, .env.vllm.example:34). Here the mesh is the
+in-tree primitive all parallelism hangs off.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.sp * self.tp
+
+
+def make_mesh(spec: MeshSpec | None = None, *, dp: int = 1, sp: int = 1,
+              tp: int = 1, devices=None) -> Mesh:
+    """Build a ("dp", "sp", "tp") mesh over the given (default: all)
+    devices.
+
+    On a real slice, device order from `jax.devices()` follows the
+    physical ICI topology, so adjacent mesh coordinates are ICI
+    neighbours — which is what ring attention's `ppermute` and TP's
+    all-reduces want.
+    """
+    if spec is None:
+        spec = MeshSpec(dp=dp, sp=sp, tp=tp)
+    devices = list(jax.devices() if devices is None else devices)
+    if spec.size > len(devices):
+        raise ValueError(
+            f"mesh {spec} needs {spec.size} devices, have {len(devices)}")
+    arr = np.array(devices[: spec.size]).reshape(spec.dp, spec.sp, spec.tp)
+    return Mesh(arr, AXES)
+
+
+def best_mesh_shape(n_devices: int, *, model_kv_heads: int = 8,
+                    want_sp: bool = False) -> MeshSpec:
+    """Pick a sensible default mesh for ``n_devices``.
+
+    TP is capped at ``model_kv_heads`` (GQA KV heads must shard evenly;
+    every Llama config in models/configs.py has 8). Remaining factor goes
+    to DP (throughput) or, if ``want_sp``, split with SP for long-context
+    work.
+    """
+    tp = 1
+    while tp * 2 <= min(n_devices, model_kv_heads) and n_devices % (tp * 2) == 0:
+        tp *= 2
+    rest = n_devices // tp
+    if want_sp and rest % 2 == 0:
+        return MeshSpec(dp=rest // 2, sp=2, tp=tp)
+    return MeshSpec(dp=rest, sp=1, tp=tp)

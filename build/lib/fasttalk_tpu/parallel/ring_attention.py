@@ -1,0 +1,142 @@
+"""Ring attention: causal self-attention over a sequence-sharded axis.
+
+Long-context path: each "sp" device holds one contiguous sequence chunk
+of Q/K/V. K/V blocks rotate around the ring via ``ppermute`` (one ICI
+hop per step) while every device folds the visiting block into a
+flash-attention online-softmax accumulator. Peak memory per chip is
+O(T/sp) and the K/V transfer overlaps with the block matmuls — the
+standard TPU recipe for sequences too long for one chip's HBM
+(cf. Liu et al., Ring Attention with Blockwise Transformers; PAPERS.md).
+
+The reference has no sequence parallelism at all — context was capped at
+8k by config (reference: docker-compose.vllm.yml:43 VLLM_MAX_MODEL_LEN,
+app/utils/config.py:124 DEFAULT_CONTEXT_WINDOW) precisely because the
+external engine owned the memory. This module removes that cap.
+
+``ring_attention_sharded`` is the public entry: give it Q/K/V sharded
+[B, T, N, D] on a mesh with an "sp" axis and it handles the shard_map
+plumbing (manual over "sp" only — "dp"/"tp" sharding stays with GSPMD).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fasttalk_tpu.ops.attention import (fold_finish, fold_init,
+                                        online_softmax_fold)
+
+
+def _ring_attend_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       positions: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Per-device body (runs under shard_map, manual over ``axis_name``).
+
+    q [B, Tl, Nq, D], k/v [B, Tl, Nkv, D] — the local sequence chunk.
+    positions [B, Tl]: absolute positions of the local Q (and initial K)
+    chunk. Rotates K/V ``sp`` times; block skipping is not worth the
+    control-flow divergence on TPU (every chip runs all steps in
+    lockstep anyway).
+    """
+    sp = jax.lax.axis_size(axis_name)
+    b, tl, nq, d = q.shape
+    nkv = k.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, tl, nkv, g, d).astype(jnp.float32)
+
+    # pcast marks the accumulators as device-varying along the ring axis
+    # (they start identical everywhere but diverge after the first fold),
+    # which the loop-carry type check requires.
+    init = jax.tree.map(
+        lambda x: jax.lax.pcast(x, (axis_name,), to="varying"),
+        fold_init(b, tl, nkv, g, d))
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(i, state):
+        carry, k, v, k_pos = state
+        carry = online_softmax_fold(qg, k, v, positions, k_pos, carry)
+        # Rotate K/V (and their positions) one hop; the final rotation
+        # restores the original residency and is dropped by DCE only when
+        # sp is static — cheap either way.
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        k_pos = jax.lax.ppermute(k_pos, axis_name, perm)
+        return carry, k, v, k_pos
+
+    # K positions travel with the blocks; start = local positions' row 0
+    # (positions are identical across batch rows for self-attention).
+    carry, _, _, _ = jax.lax.fori_loop(
+        0, sp, step, (init, k, v, positions[0]))
+    return fold_finish(carry, q.dtype)
+
+
+def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           positions: jnp.ndarray, mesh: Mesh,
+                           axis_name: str = "sp") -> jnp.ndarray:
+    """Causal GQA self-attention with Q/K/V sequence-sharded over
+    ``axis_name``. q [B, T, Nq, D]; k/v [B, T, Nkv, D]; positions [B, T]
+    absolute. All inputs sharded on T; output matches q's layout."""
+    body = partial(_ring_attend_local, axis_name=axis_name)
+    seq = P(None, axis_name, None, None)
+    return jax.shard_map(
+        body, mesh=mesh, axis_names=frozenset({axis_name}),
+        in_specs=(seq, seq, seq, P(None, axis_name)),
+        out_specs=seq,
+    )(q, k, v, positions)
+
+
+def _decode_attend_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         q_positions: jnp.ndarray,
+                         axis_name: str) -> jnp.ndarray:
+    """Per-device body for ``decode_attention_sharded``: fold the LOCAL
+    K/V shard with the flash recurrence, then combine the per-(query,
+    head) softmax statistics across the axis with pmax/psum — the
+    cross-chip flash-decoding combine. A shard whose keys are all
+    masked contributes exp(-inf)·0 = 0."""
+    b, t, nq, d = q.shape
+    nkv = k.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, t, nkv, g, d).astype(jnp.float32)
+    local_s = k.shape[1]
+    key_pos = jax.lax.axis_index(axis_name) * local_s \
+        + jnp.arange(local_s)
+    init = jax.tree.map(
+        lambda x: jax.lax.pcast(x, (axis_name,), to="varying"),
+        fold_init(b, t, nkv, g, d))
+    m, l, acc = online_softmax_fold(qg, k, v, q_positions, key_pos, init)
+    m_g = jax.lax.pmax(m, axis_name)
+    corr = jnp.exp(m - m_g)
+    l_g = jax.lax.psum(l * corr, axis_name)
+    acc_g = jax.lax.psum(acc * corr[..., None], axis_name)
+    return fold_finish((m_g, l_g, acc_g), q.dtype)
+
+
+def decode_attention_sharded(q: jnp.ndarray, k: jnp.ndarray,
+                             v: jnp.ndarray, q_positions: jnp.ndarray,
+                             mesh: Mesh, axis_name: str = "sp",
+                             ) -> jnp.ndarray:
+    """Cache-read GQA attention with the KV cache sequence-sharded over
+    ``axis_name`` — the decode-side complement of the ring prefill.
+
+    GSPMD's default lowering of ``ops.attention.attend`` over an
+    sp-sharded cache ALL-GATHERS K/V onto every chip each step — a
+    transient O(S) per-chip working set and O(S) ICI bytes that defeat
+    the sp axis's purpose at decode time. Here each chip folds only
+    its local O(S/sp) shard and the chips exchange just the softmax
+    statistics ([B, T, heads] scalars plus one [B, T, heads, D]
+    accumulator psum): per-chip memory stays O(S/sp) and ICI traffic
+    per step is independent of the sequence length.
+
+    q [B, T, Nq, D] and q_positions [B, T] replicated over the axis;
+    k/v [B, S, Nkv, D] sharded on S. "dp"/"tp" sharding stays with
+    GSPMD (manual axes: only ``axis_name``).
+    """
+    body = partial(_decode_attend_local, axis_name=axis_name)
+    return jax.shard_map(
+        body, mesh=mesh, axis_names=frozenset({axis_name}),
+        in_specs=(P(), P(None, axis_name, None, None),
+                  P(None, axis_name, None, None), P()),
+        out_specs=P(),
+    )(q, k, v, q_positions)

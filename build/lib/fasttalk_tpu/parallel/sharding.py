@@ -1,0 +1,183 @@
+"""Sharding rules for the Llama parameter pytree and KV cache.
+
+Megatron-style tensor parallelism, expressed as PartitionSpecs and left
+to GSPMD to lower into ICI collectives (the idiomatic TPU replacement for
+the NCCL all-reduces inside the reference's vLLM container):
+
+- wq/wk/wv and w_gate/w_up are column-parallel (output axis sharded over
+  "tp") — each chip computes its own heads / FFN slice with no
+  communication.
+- wo and w_down are row-parallel (contraction axis sharded) — XLA emits
+  one all-reduce per block to rejoin the residual stream.
+- The embedding is sharded over the hidden axis, so with tied embeddings
+  the output head is automatically row-parallel (partial logits +
+  all-reduce); an untied lm_head is column-parallel over vocab.
+- KV cache shards over KV heads on "tp" and slots on "dp"; with GQA
+  (8 KV heads on every production config, models/configs.py) TP≤8
+  divides evenly.
+
+Norm scales and rope tables are tiny and stay replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fasttalk_tpu.models.llama import KVCache
+
+# Rules keyed by parameter leaf name; specs include the leading stacked
+# layer axis for everything under "layers".
+_LAYER_RULES: dict[str, P] = {
+    "attn_norm": P(None, None),
+    "mlp_norm": P(None, None),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    # Column-parallel biases shard with their matmul's output axis.
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
+    "wo": P(None, "tp", None),
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),
+}
+_TOP_RULES: dict[str, P] = {
+    "embed": P(None, "tp"),
+    "final_norm": P(None),
+    "lm_head": P(None, "tp"),
+}
+
+
+def _leaf_name(path) -> str:
+    for entry in reversed(path):
+        if hasattr(entry, "key"):
+            return str(entry.key)
+    return ""
+
+
+def _parent_name(path) -> str:
+    keys = [str(e.key) for e in path if hasattr(e, "key")]
+    return keys[-2] if len(keys) >= 2 else ""
+
+
+def _spec_for(name: str, ndim: int, shape=None, parent: str = "") -> P:
+    """The PartitionSpec for a parameter leaf name (unknown: replicate).
+
+    Int8-quantized leaves (ops/quant.py) appear as {"q", "s"} dicts under
+    the weight's name: "q" shards exactly like the original weight; the
+    per-output-channel scale "s" shards like the weight's last axis.
+    """
+    if name in ("q", "qt", "s") and parent:
+        base = _TOP_RULES.get(parent) or _LAYER_RULES.get(parent)
+        if base is not None:
+            if name == "qt":
+                # Transposed untied lm_head [V, D] (ops/quant.py
+                # _quantize_head_t): vocab axis stays TP-sharded,
+                # now leading.
+                spec = P(base[-1], *base[:-1])
+            elif name == "q":
+                spec = base
+            elif parent == "embed":
+                # Embedding quantizes per ROW (ops/quant.py): the scale
+                # indexes the replicated vocab axis, not the TP-sharded
+                # hidden axis — and at [V] f32 it is small enough to
+                # replicate outright.
+                spec = P(None)
+            else:  # scale: leading stacked-layer axis (if any) + out axis
+                spec = P(*base[:ndim - 1], base[-1])
+            if len(spec) != ndim:
+                raise ValueError(
+                    f"spec {spec} rank mismatch for {parent}/{name} "
+                    f"with shape {shape}")
+            return spec
+    spec = _TOP_RULES.get(name) or _LAYER_RULES.get(name)
+    if spec is None:
+        return P(*([None] * ndim))
+    if len(spec) != ndim:
+        raise ValueError(
+            f"spec {spec} rank mismatch for {name} with shape {shape}")
+    return spec
+
+
+def param_pspecs(params: Any) -> Any:
+    """PartitionSpec pytree matching ``params`` (models/llama.py
+    init_params / models/loader.py structure)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _spec_for(_leaf_name(path), leaf.ndim, leaf.shape,
+                                     parent=_parent_name(path)),
+        params)
+
+
+def cache_pspecs() -> KVCache:
+    """Cache layout [L, slots, S, kv_heads, head_dim]: slots over "dp",
+    sequence over "sp", KV heads over "tp"."""
+    spec = P(None, "dp", "sp", "tp", None)
+    return KVCache(k=spec, v=spec)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    specs = param_pspecs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def shard_cache(cache: KVCache, mesh: Mesh) -> KVCache:
+    specs = cache_pspecs()
+    return KVCache(
+        k=jax.device_put(cache.k, NamedSharding(mesh, specs.k)),
+        v=jax.device_put(cache.v, NamedSharding(mesh, specs.v)))
+
+
+def validate_tp(tp: int, num_kv_heads: int, num_heads: int,
+                hidden: int, intermediate: int,
+                vocab: int | None = None) -> None:
+    """Fail fast on meshes the model can't shard evenly (the reference
+    left this to vLLM to discover at container boot)."""
+    dims = [(num_kv_heads, "num_kv_heads"), (num_heads, "num_heads"),
+            (hidden, "hidden_size"), (intermediate, "intermediate_size")]
+    if vocab is not None:
+        dims.append((vocab, "vocab_size"))  # lm_head is vocab-sharded
+    for dim, label in dims:
+        if dim % tp:
+            raise ValueError(f"tp={tp} does not divide {label}={dim}")
+
+
+def validate_mesh(mesh: Mesh, *, num_kv_heads: int, num_heads: int,
+                  hidden: int, intermediate: int, vocab: int,
+                  num_slots: int, max_len: int) -> None:
+    """Validate every mesh axis against the tensors it shards, so a bad
+    TPU_TP_SIZE/TPU_DP_SIZE fails with a named message at engine build
+    instead of an opaque device_put error mid-startup."""
+    validate_tp(mesh.shape.get("tp", 1), num_kv_heads, num_heads, hidden,
+                intermediate, vocab)
+    dp = mesh.shape.get("dp", 1)
+    if num_slots % dp:
+        raise ValueError(
+            f"dp={dp} does not divide decode_slots={num_slots}")
+    sp = mesh.shape.get("sp", 1)
+    if max_len % sp:
+        raise ValueError(f"sp={sp} does not divide max_model_len={max_len}")
+
+
+def param_put(mesh: Mesh, dtype: Any = None):
+    """A ``put(host_array, path) -> jax.Array`` hook for
+    ``models.loader.load_params`` that places each weight directly into
+    its TP shards — each device receives only its slice, so a 70B
+    checkpoint loads onto a v5e-8 without ever materialising a full
+    tensor on one chip. ``dtype`` casts on placement (checkpoint tensors
+    arrive host-side as float32; the engine serves bfloat16)."""
+    import jax.numpy as jnp
+
+    def put(arr, path: str) -> jax.Array:
+        parts = path.split("/")
+        parent = parts[-2] if len(parts) >= 2 else ""
+        spec = _spec_for(parts[-1], arr.ndim, getattr(arr, "shape", None),
+                         parent=parent)
+        return jax.device_put(jnp.asarray(arr, dtype),
+                              NamedSharding(mesh, spec))
+
+    return put

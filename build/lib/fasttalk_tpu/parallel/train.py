@@ -1,0 +1,145 @@
+"""Sharded training/fine-tuning step over a ("dp", "sp", "tp") mesh.
+
+The serving framework's models are trainable with the same param pytree
+and forward pass the engine serves (models/llama.py) — no separate
+"training model". Parallelism is pure sharding annotation:
+
+- params sharded per `parallel.sharding.param_pspecs` (TP);
+- the token batch sharded ("dp" over batch rows, "sp" over sequence);
+- optax state inherits param shardings (`optimizer.init` is
+  `tree_map(zeros_like)`, which preserves placement);
+- GSPMD lowers the rest to ICI collectives: all-reduce of row-parallel
+  matmuls (TP), gradient all-reduce over "dp".
+
+Attention over the "sp"-sharded sequence has two forms, picked by
+sequence length (make_train_step ``ring_min_seq``): short sequences use
+GSPMD's all-gather-K/V lowering (lowest latency), and long sequences
+route through `parallel.ring_attention` — K/V blocks rotate over the
+ICI ring, so per-chip sequence memory is O(T/sp) and context is no
+longer capped by one chip's HBM (the module's reason to exist).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fasttalk_tpu.models.configs import ModelConfig
+from fasttalk_tpu.models.llama import KVCache, forward
+from fasttalk_tpu.parallel.sharding import param_pspecs, shard_params
+
+
+def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray,
+                   loss_mask: jnp.ndarray | None = None,
+                   attn_override: Any = None) -> jnp.ndarray:
+    """Next-token cross-entropy over ``tokens`` [B, T]. ``loss_mask``
+    [B, T-1] weights target positions (1 = count). ``attn_override``
+    swaps the attention implementation (ring attention over "sp" —
+    see make_train_step)."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    b, t = inputs.shape
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    # K/V written from activations; final_norm is never quantized, so
+    # its dtype is the activation dtype even when embed is a {q, s} dict.
+    kv_dtype = params["final_norm"].dtype
+    cache_t = 1 if attn_override is not None else t  # override: unused
+    empty = KVCache(
+        k=jnp.zeros((cfg.num_layers, b, cache_t, cfg.num_kv_heads,
+                     cfg.head_dim), kv_dtype),
+        v=jnp.zeros((cfg.num_layers, b, cache_t, cfg.num_kv_heads,
+                     cfg.head_dim), kv_dtype))
+    logits, _ = forward(params, cfg, inputs, positions, empty,
+                        jnp.zeros((b,), jnp.int32),
+                        attn_override=attn_override)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if loss_mask is None:
+        return losses.mean()
+    return (losses * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)
+
+
+def ring_override(mesh: Mesh):
+    """The ``attn_override`` that routes a train/eval forward through
+    parallel.ring_attention (K/V rotating over the "sp" ICI ring)."""
+    from fasttalk_tpu.parallel.ring_attention import ring_attention_sharded
+
+    def attn(q, k, v, positions):
+        return ring_attention_sharded(q, k, v, positions, mesh)
+
+    return attn
+
+
+def _ring_or_none(mesh: Mesh, ring_min_seq: int, seq_len: int):
+    """Pick ring attention when the mesh has sp > 1, the (static)
+    sequence is long enough to be worth the ppermute latency, and it
+    shards evenly — else None (GSPMD's all-gather form). The single
+    routing predicate for train and eval steps."""
+    sp = mesh.shape.get("sp", 1)
+    if sp > 1 and seq_len >= ring_min_seq and seq_len % sp == 0:
+        return ring_override(mesh)
+    return None
+
+
+def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, ring_min_seq: int = 4096) -> Callable:
+    """Build the jitted sharded train step:
+    ``(params, opt_state, tokens) -> (params, opt_state, loss)``.
+
+    Call with params already sharded (see `init_sharded_training`); the
+    donated params/opt_state keep their layouts across steps, so weights
+    never leave the mesh between updates.
+
+    When the mesh has sp > 1 and the (static) sequence length reaches
+    ``ring_min_seq``, attention runs through
+    parallel.ring_attention instead of GSPMD's all-gather-K/V form:
+    per-chip sequence memory drops from O(T) to O(T/sp), which is the
+    whole point of the "sp" axis — below the threshold the all-gather
+    form is faster (no ppermute latency on tiny blocks). Set
+    ring_min_seq=0 to force ring attention at any length.
+    """
+    batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        override = _ring_or_none(mesh, ring_min_seq, tokens.shape[1] - 1)
+        loss, grads = jax.value_and_grad(causal_lm_loss)(
+            params, cfg, tokens, None, override)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_sharded_training(cfg: ModelConfig, params: Any, mesh: Mesh,
+                          learning_rate: float = 1e-4,
+                          ) -> tuple[Any, Any, optax.GradientTransformation]:
+    """Shard params onto the mesh and build matching optimizer state."""
+    params = shard_params(params, mesh)
+    optimizer = optax.adamw(learning_rate)
+    opt_state = optimizer.init(params)  # zeros_like → inherits shardings
+    return params, opt_state, optimizer
+
+
+def eval_step(cfg: ModelConfig, mesh: Mesh,
+              ring_min_seq: int = 4096) -> Callable:
+    """Jitted sharded eval loss: ``(params, tokens) -> loss`` (same
+    ring-attention routing as make_train_step)."""
+    batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+
+    @jax.jit
+    def step(params, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        override = _ring_or_none(mesh, ring_min_seq, tokens.shape[1] - 1)
+        return causal_lm_loss(params, cfg, tokens, None, override)
+
+    return step
+
+
+__all__ = ["causal_lm_loss", "make_train_step", "init_sharded_training",
+           "eval_step", "ring_override", "param_pspecs"]

@@ -1,0 +1,16 @@
+from fasttalk_tpu.serving.connection import (
+    ConnectionInfo,
+    ConnectionManager,
+    ConnectionState,
+)
+from fasttalk_tpu.serving.conversation import ConversationManager, ConversationState
+from fasttalk_tpu.serving.launcher import ServerLauncher
+from fasttalk_tpu.serving.server import WebSocketLLMServer
+from fasttalk_tpu.serving.text_processor import extract_speakable_chunk, text_similarity
+
+__all__ = [
+    "ConnectionInfo", "ConnectionManager", "ConnectionState",
+    "ConversationManager", "ConversationState",
+    "ServerLauncher", "WebSocketLLMServer",
+    "extract_speakable_chunk", "text_similarity",
+]

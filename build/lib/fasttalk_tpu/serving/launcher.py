@@ -1,0 +1,181 @@
+"""Service lifecycle: build engine, verify, serve, shut down cleanly.
+
+Parity with the reference launcher (app/core/websocket_launcher.py:41-147:
+signal handlers, provider-based server selection, pre-flight backend
+verification, uvicorn run, shutdown cleanup) — rebuilt around one asyncio
+event loop running both the main app and the monitoring app (the
+reference needed a separate Flask thread for monitoring).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+from aiohttp import web
+
+from fasttalk_tpu.engine.engine import EngineBase
+from fasttalk_tpu.engine.factory import build_engine
+from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+from fasttalk_tpu.serving.server import WebSocketLLMServer
+from fasttalk_tpu.utils.config import Config
+from fasttalk_tpu.utils.errors import LLMServiceError
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("serving.launcher")
+
+
+def build_agent(config: Config, engine: EngineBase):
+    """Construct the tool-calling agent when enabled (None otherwise)."""
+    if not (config.enable_agent and config.enable_tools):
+        return None
+    try:
+        from fasttalk_tpu.agents.voice_agent import VoiceAgent
+
+        return VoiceAgent(engine, config)
+    except ImportError:
+        return None
+
+
+def run_spmd_follower(config: Config) -> int:
+    """Multi-host SPMD serving, follower role (TPU_SPMD_ROLE=follower):
+    build the identical engine over the global mesh and replay the
+    leader's device-call stream against this host's shards. No gateway,
+    no engine thread — the leader is the cluster's only decision-maker
+    (parallel/spmd_serving.py)."""
+    from fasttalk_tpu.parallel.spmd_serving import follower_loop
+
+    engine = build_engine(config)
+    host, port = config.spmd_addr.rsplit(":", 1)
+    log.info(f"SPMD follower: replaying leader calls from "
+             f"{host}:{port}")
+    follower_loop(engine, host, int(port))
+    return 0
+
+
+class ServerLauncher:
+    def __init__(self, config: Config, engine: EngineBase | None = None):
+        self.config = config
+        self._spmd_sink = None
+        if config.spmd_role == "leader" and engine is None:
+            # Followers must replay every serving-time device call, so
+            # the sink attaches before any traffic — and warmup (which
+            # is not published) is forced off for the whole cluster.
+            from fasttalk_tpu.parallel.spmd_serving import CallBroadcaster
+
+            if config.warmup not in ("off", "", "none"):
+                log.info("SPMD leader: forcing TPU_WARMUP=off "
+                         "(warmup calls are not replicated)")
+                config.warmup = "off"
+            engine = build_engine(config)
+            host, port = config.spmd_addr.rsplit(":", 1)
+            self._spmd_sink = CallBroadcaster(
+                host, int(port), config.spmd_followers)
+            engine.call_sink = self._spmd_sink
+        self.engine = engine if engine is not None else build_engine(config)
+        self.agent = build_agent(config, self.engine)
+        self.server = WebSocketLLMServer(config, self.engine, self.agent)
+        self._stop = asyncio.Event()
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        self._m_restarts = get_metrics().counter(
+            "engine_restarts_total",
+            "supervised engine restarts after a crash")
+
+    async def _watchdog(self, interval: float = 5.0) -> None:
+        """Supervised in-process recovery: if the engine thread dies,
+        rebuild its device state and restart it (the reference's only
+        recovery at this layer was docker `restart: unless-stopped`).
+        In-flight requests already received terminal error events from
+        the crash; new requests are served after the restart."""
+        while not self._stop.is_set():
+            await asyncio.sleep(interval)
+            if self._stop.is_set() or self.engine.check_connection():
+                continue
+            if self._spmd_sink is not None:
+                # In-place restart is leader-local state surgery and is
+                # not replicated to followers (engine.restart refuses):
+                # an SPMD engine death is fatal to this process so the
+                # orchestrator can restart the CLUSTER, instead of the
+                # gateway serving errors behind a 5s restart-fail loop.
+                log.critical("engine thread died in multi-host SPMD "
+                             "mode; shutting the gateway down for a "
+                             "cluster restart")
+                self._stop.set()
+                return
+            restart = getattr(self.engine, "restart", None)
+            if restart is None or not self.config.engine_auto_restart:
+                continue
+            log.error("engine thread is down; attempting restart")
+            try:
+                ok = await asyncio.get_running_loop().run_in_executor(
+                    None, restart)
+            except Exception as e:
+                log.error(f"engine restart raised: {e}", exc_info=True)
+                ok = False
+            if ok:
+                self._m_restarts.inc()
+            (log.info if ok else log.error)(
+                f"engine restart {'succeeded' if ok else 'failed'}")
+
+    def verify_backend(self) -> None:
+        """Pre-flight: refuse to serve if the engine isn't healthy
+        (reference: websocket_launcher.py:104-105 hard-exits here)."""
+        self.engine.warmup(self.config.warmup)
+        self.engine.start()
+        if not self.engine.check_connection():
+            raise LLMServiceError("Engine failed pre-flight check")
+        log.info("engine pre-flight check passed",
+                 model=self.engine.get_model_info().get("model"))
+
+    async def run(self) -> None:
+        self.verify_backend()
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+
+        main_runner = web.AppRunner(self.server.app)
+        await main_runner.setup()
+        await web.TCPSite(main_runner, self.config.host,
+                          self.config.port).start()
+        log.info(f"WebSocket server on ws://{self.config.host}:"
+                 f"{self.config.port}/ws/llm")
+
+        mon_app = build_monitoring_app(
+            ready_check=self.engine.check_connection)
+        mon_runner = web.AppRunner(mon_app)
+        await mon_runner.setup()
+        await web.TCPSite(mon_runner, self.config.monitoring_host,
+                          self.config.monitoring_port).start()
+        log.info(f"Monitoring on http://{self.config.monitoring_host}:"
+                 f"{self.config.monitoring_port}/health")
+
+        watchdog = asyncio.create_task(self._watchdog())
+        try:
+            await self._stop.wait()
+        finally:
+            log.info("shutting down")
+            watchdog.cancel()
+            await main_runner.cleanup()
+            await mon_runner.cleanup()
+            if self.agent is not None:
+                # Release tool resources (search backend HTTP session) —
+                # otherwise every shutdown leaks its FDs (ADVICE r2).
+                await self.agent.aclose()
+            self.engine.shutdown()
+            if self._spmd_sink is not None:
+                # After engine.shutdown(): the engine thread has
+                # stopped publishing, so the stop frame is the stream's
+                # clean tail.
+                self._spmd_sink.close()
+
+    def start(self) -> None:
+        """Blocking entry point (signal-driven shutdown)."""
+        asyncio.run(self.run())
+
+    def stop(self) -> None:
+        self._stop.set()

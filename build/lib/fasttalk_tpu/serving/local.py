@@ -1,0 +1,34 @@
+"""Local in-process server bootstrap shared by the ops scripts
+(scripts/soak.py, scripts/demo_transcript.py): build engine → warmup →
+start → WebSocketLLMServer → aiohttp site on 127.0.0.1.
+
+bench.py intentionally keeps its own inline copy: it is the driver's
+measurement artifact and narrates each phase's timing to stderr.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from fasttalk_tpu.utils.config import Config
+
+
+async def start_local_server(cfg: Config, *, warmup: str | None = None,
+                             with_agent: bool = True) -> tuple[Any, Any]:
+    """Returns (engine, runner); caller owns cleanup:
+    ``await runner.cleanup(); engine.shutdown()``."""
+    from aiohttp import web
+
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.serving.launcher import build_agent
+    from fasttalk_tpu.serving.server import WebSocketLLMServer
+
+    engine = build_engine(cfg)
+    engine.warmup(warmup if warmup is not None else (cfg.warmup or "fast"))
+    engine.start()
+    agent = build_agent(cfg, engine) if with_agent else None
+    server = WebSocketLLMServer(cfg, engine, agent)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", cfg.port).start()
+    return engine, runner

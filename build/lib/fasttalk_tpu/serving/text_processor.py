@@ -1,0 +1,42 @@
+"""Sentence-boundary text chunking for TTS pipelines.
+
+Capability parity with the reference text processor
+(app/core/text_processor.py:13-88): find the shortest sentence-like
+prefix of a streaming buffer that is safe to hand to a TTS engine, plus
+a word-overlap similarity helper. The reference instantiated this but
+never consumed its output (SURVEY.md §2 — dormant capability); here the
+WebSocket server exposes it behind the session config flag
+``tts_chunking`` so voice clients can opt in.
+"""
+
+from __future__ import annotations
+
+SPLIT_CHARS = ".!?,;:\n-。、"
+
+
+def extract_speakable_chunk(buffer: str, min_chars: int = 12,
+                            min_alnum: int = 4) -> tuple[str, str]:
+    """Split ``buffer`` into (speakable_prefix, remainder).
+
+    The prefix ends at the earliest split character such that the prefix
+    is at least ``min_chars`` long and contains at least ``min_alnum``
+    alphanumeric characters; ("", buffer) if no such point exists yet.
+    """
+    alnum = 0
+    for i, ch in enumerate(buffer):
+        if ch.isalnum():
+            alnum += 1
+        if ch in SPLIT_CHARS and i + 1 >= min_chars and alnum >= min_alnum:
+            return buffer[:i + 1], buffer[i + 1:]
+    return "", buffer
+
+
+def text_similarity(a: str, b: str) -> float:
+    """Jaccard word-overlap similarity in [0, 1]."""
+    wa = set(a.lower().split())
+    wb = set(b.lower().split())
+    if not wa and not wb:
+        return 1.0
+    if not wa or not wb:
+        return 0.0
+    return len(wa & wb) / len(wa | wb)

@@ -1,0 +1,24 @@
+"""In-repo training: synthetic corpus, training loop, HF export.
+
+The reference outsourced everything about model weights to external
+engines; this framework owns a training stack (parallel/train.py) and
+uses it to produce the committed tinychat checkpoint that serving
+demos and tests run against (scripts/train_tiny_chat.py).
+"""
+
+from fasttalk_tpu.training.corpus import (CHAT_TEMPLATE_JINJA, SPECIALS,
+                                          conversations, corpus_texts,
+                                          render)
+from fasttalk_tpu.training.export import export_checkpoint
+from fasttalk_tpu.training.trainer import (greedy_generate, make_eval_loss,
+                                           make_sampled_train_step,
+                                           pack_tokens,
+                                           single_device_mesh,
+                                           train_tokenizer)
+
+__all__ = [
+    "CHAT_TEMPLATE_JINJA", "SPECIALS", "conversations", "corpus_texts",
+    "render", "export_checkpoint", "greedy_generate", "make_eval_loss",
+    "make_sampled_train_step", "pack_tokens", "single_device_mesh",
+    "train_tokenizer",
+]

@@ -1,0 +1,22 @@
+from fasttalk_tpu.utils.config import Config, detect_compute_device, get_config
+from fasttalk_tpu.utils.errors import (
+    CircuitBreaker,
+    CircuitBreakerOpen,
+    CircuitState,
+    ErrorCategory,
+    ErrorHandler,
+    ErrorSeverity,
+    LLMServiceError,
+    RetryManager,
+)
+from fasttalk_tpu.utils.logger import configure_logging, get_logger, request_id_var
+from fasttalk_tpu.utils.metrics import MetricsRegistry, get_metrics, reset_metrics
+
+__all__ = [
+    "Config", "detect_compute_device", "get_config",
+    "CircuitBreaker", "CircuitBreakerOpen", "CircuitState",
+    "ErrorCategory", "ErrorHandler", "ErrorSeverity", "LLMServiceError",
+    "RetryManager",
+    "configure_logging", "get_logger", "request_id_var",
+    "MetricsRegistry", "get_metrics", "reset_metrics",
+]

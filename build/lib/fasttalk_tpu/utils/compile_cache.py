@@ -1,0 +1,65 @@
+"""Persistent XLA compilation cache.
+
+The engine compiles ~6-20 executables at startup (warmup level fast/
+full, engine/engine.py); on a cold process that is 30-60s of XLA work
+that is byte-identical across restarts of the same (model, shapes,
+flags) config. JAX can persist compiled executables to disk and reload
+them in milliseconds — the reference's analogue was hiding its engine
+container's multi-minute cold start behind a 300s health start_period
+(reference: docker-compose.vllm.yml:62-67); here restart cost is paid
+once per configuration, not per process.
+
+Enabled by default. ``TPU_COMPILE_CACHE`` overrides: a path uses that
+directory, ``off``/``0``/``none`` disables. Default location prefers
+the model directory (it is the natural persistent volume in the docker
+stacks) and falls back to a per-user tmp dir.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("compile_cache")
+
+_enabled_dir: str | None = None
+
+
+def default_cache_dir(model_path: str | None) -> str:
+    if model_path and os.path.isdir(model_path) \
+            and os.access(model_path, os.W_OK):
+        return os.path.join(model_path, ".xla_cache")
+    return os.path.join(tempfile.gettempdir(),
+                        f"fasttalk-xla-cache-{os.getuid()}")
+
+
+def enable_compilation_cache(setting: str = "",
+                             model_path: str | None = None) -> str | None:
+    """Turn on JAX's persistent compilation cache. Idempotent; returns
+    the cache dir in use (None when disabled). Must run before the
+    first jit compilation to benefit that compilation, but is safe at
+    any time."""
+    global _enabled_dir
+    if setting.strip().lower() in ("off", "0", "none", "false"):
+        return None
+    if _enabled_dir is not None:
+        return _enabled_dir
+    cache_dir = setting.strip() or default_cache_dir(model_path)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Persist everything: the engine's helper programs (slot-state
+        # patch, sample-place) compile in well under the 1s default
+        # threshold but still cost seconds as a first-request compile.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # never let caching break serving
+        log.warning(f"compilation cache unavailable: {e}")
+        return None
+    _enabled_dir = cache_dir
+    log.info(f"persistent XLA compilation cache at {cache_dir}")
+    return cache_dir

@@ -1,0 +1,400 @@
+"""Environment-driven configuration with first-class TPU device selection.
+
+Parity surface: mirrors the reference config fields and env-var names
+(reference: app/utils/config.py:63-158) so existing ``.env`` files keep
+working, and adds the ``tpu`` branch the reference lacked
+(reference: app/utils/config.py:17-60 only knew cuda|cpu|mps) plus the
+engine-tuning knobs that used to live in the external vLLM container's
+flags (reference: docker-compose.vllm.yml:38-53, .env.vllm.example:32-47).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+VALID_DEVICES = ("tpu", "cuda", "cpu", "mps")
+VALID_PROVIDERS = ("tpu", "vllm", "ollama", "openai", "fake")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.getenv(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.getenv(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"env {name} must be an integer, got {raw!r}") from None
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.getenv(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"env {name} must be a number, got {raw!r}") from None
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    return os.getenv(name, "true" if default else "false").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def detect_compute_device() -> str:
+    """Resolve COMPUTE_DEVICE with availability checking and fallback.
+
+    Order: explicit ``COMPUTE_DEVICE`` env (validated against what is
+    actually available) → auto-detect tpu → cuda → mps → cpu.
+    TPU availability is probed via ``jax.devices()`` so a machine with
+    libtpu but no attached chips still falls back cleanly.
+    """
+    requested = os.getenv("COMPUTE_DEVICE", "").strip().lower()
+    if requested and requested not in VALID_DEVICES:
+        requested = ""
+
+    available = _available_devices()
+    if requested:
+        if requested in available:
+            return requested
+        # Requested device unavailable: fall through to best available.
+    for dev in ("tpu", "cuda", "mps", "cpu"):
+        if dev in available:
+            return dev
+    return "cpu"
+
+
+def _available_devices() -> set[str]:
+    found: set[str] = {"cpu"}
+    try:  # TPU via JAX — the first-class path.
+        import jax
+
+        platforms = {d.platform.lower() for d in jax.devices()}
+        if platforms & {"tpu", "axon"}:
+            found.add("tpu")
+        if "gpu" in platforms or "cuda" in platforms:
+            found.add("cuda")
+    except Exception:
+        pass
+    try:  # torch backends kept for reference back-compat (cuda/mps boxes).
+        import torch
+
+        if torch.cuda.is_available():
+            found.add("cuda")
+        if getattr(torch.backends, "mps", None) and torch.backends.mps.is_available():
+            found.add("mps")
+    except Exception:
+        pass
+    return found
+
+
+@dataclass
+class Config:
+    """All service settings, each overridable via environment variable.
+
+    Reference parity: field/env names follow app/utils/config.py:63-158;
+    new TPU-engine fields are grouped at the bottom.
+    """
+
+    # Compute device — now including "tpu" (the north-star change).
+    compute_device: str = field(default_factory=detect_compute_device)
+
+    # Provider: "tpu" (in-tree JAX engine), or legacy "vllm"/"ollama" HTTP
+    # passthrough for back-compat (reference: config.py:81).
+    llm_provider: str = field(default_factory=lambda: _env_str("LLM_PROVIDER", "tpu"))
+
+    # Model
+    model_name: str = field(default_factory=lambda: _env_str("LLM_MODEL", "llama3.2:1b"))
+    model_path: str = field(default_factory=lambda: _env_str("MODEL_PATH", "/app/models"))
+    tokenizer_path: str = field(default_factory=lambda: _env_str("TOKENIZER_PATH", ""))
+
+    # Legacy backend endpoints (reference: config.py:96-120) — retained so
+    # the provider=vllm/ollama back-compat handlers keep working.
+    vllm_base_url: str = field(
+        default_factory=lambda: _env_str("VLLM_BASE_URL", "http://vllm:8000/v1"))
+    vllm_model: str = field(
+        default_factory=lambda: _env_str(
+            "VLLM_MODEL", "hugging-quants/Meta-Llama-3.1-8B-Instruct-AWQ-INT4"))
+    vllm_api_key: str = field(default_factory=lambda: _env_str("VLLM_API_KEY", "not-needed"))
+    vllm_timeout: float = field(default_factory=lambda: _env_float("VLLM_TIMEOUT", 600.0))
+    ollama_base_url: str = field(
+        default_factory=lambda: _env_str("OLLAMA_BASE_URL", "http://ollama:11434"))
+    ollama_keep_alive: str = field(default_factory=lambda: _env_str("OLLAMA_KEEP_ALIVE", "5m"))
+    ollama_timeout: float = field(default_factory=lambda: _env_float("OLLAMA_TIMEOUT", 600.0))
+
+    # Agent / tools (reference: config.py:102-111)
+    enable_agent: bool = field(default_factory=lambda: _env_bool("ENABLE_PYDANTIC_AI", True))
+    enable_web_search: bool = field(default_factory=lambda: _env_bool("ENABLE_WEB_SEARCH", True))
+    enable_tools: bool = field(default_factory=lambda: _env_bool("ENABLE_TOOLS", True))
+    web_search_rate_limit: float = field(
+        default_factory=lambda: _env_float("DUCKDUCKGO_RATE_LIMIT", 1.0))
+    # auto = live DuckDuckGo with offline fallback; duckduckgo; offline
+    web_search_backend: str = field(
+        default_factory=lambda: _env_str("WEB_SEARCH_BACKEND", "auto"))
+    web_search_timeout: float = field(
+        default_factory=lambda: _env_float("WEB_SEARCH_TIMEOUT", 10.0))
+    system_prompt: str = field(default_factory=lambda: _env_str(
+        "SYSTEM_PROMPT",
+        "You are a helpful voice assistant. Keep responses concise and conversational."))
+
+    # Generation defaults (reference: config.py:122-128)
+    default_temperature: float = field(
+        default_factory=lambda: _env_float("DEFAULT_TEMPERATURE", 0.7))
+    default_max_tokens: int = field(default_factory=lambda: _env_int("DEFAULT_MAX_TOKENS", 2048))
+    default_context_window: int = field(
+        default_factory=lambda: _env_int("DEFAULT_CONTEXT_WINDOW", 8192))
+    default_top_p: float = field(default_factory=lambda: _env_float("DEFAULT_TOP_P", 0.9))
+    default_top_k: int = field(default_factory=lambda: _env_int("DEFAULT_TOP_K", 40))
+    # Unset resolves per provider in __post_init__: 1.1 for the in-tree
+    # engine and Ollama (the engine-side default the reference silently
+    # relied on — its gateway never set a penalty, but the Ollama engine
+    # applied ~1.1 to every generation, reference app/core/
+    # ollama_handler.py:144-162); 1.0 for vllm (vLLM's own default —
+    # and strict OpenAI-compatible backends 400 on the non-standard
+    # repetition_penalty param, so it must not be emitted by default).
+    default_repeat_penalty: float = field(
+        default_factory=lambda: _env_float("DEFAULT_REPEAT_PENALTY", -1.0))
+    default_presence_penalty: float = field(
+        default_factory=lambda: _env_float("DEFAULT_PRESENCE_PENALTY", 0.0))
+    default_frequency_penalty: float = field(
+        default_factory=lambda: _env_float("DEFAULT_FREQUENCY_PENALTY", 0.0))
+
+    # Server (reference: config.py:130-136)
+    host: str = field(default_factory=lambda: _env_str("LLM_HOST", "0.0.0.0"))
+    port: int = field(default_factory=lambda: _env_int("LLM_PORT", 8000))
+    max_connections: int = field(default_factory=lambda: _env_int("LLM_MAX_CONNECTIONS", 50))
+    log_level: str = field(default_factory=lambda: _env_str("LOG_LEVEL", "INFO"))
+
+    # Monitoring (reference: config.py:138-142)
+    monitoring_port: int = field(default_factory=lambda: _env_int("LLM_MONITORING_PORT", 9092))
+    monitoring_host: str = field(
+        default_factory=lambda: _env_str("LLM_MONITORING_HOST", "0.0.0.0"))
+
+    # Session (reference: config.py:149-152)
+    session_timeout: int = field(default_factory=lambda: _env_int("SESSION_TIMEOUT", 3600))
+    # Supervised in-process engine restart after a crash (the in-tree
+    # analogue of the reference's docker `restart: unless-stopped`).
+    engine_auto_restart: bool = field(
+        default_factory=lambda: _env_bool("ENGINE_AUTO_RESTART", True))
+    max_history_length: int = field(default_factory=lambda: _env_int("MAX_HISTORY_LENGTH", 50))
+    log_path: str = field(default_factory=lambda: _env_str("LOG_PATH", "./logs"))
+
+    # ---- TPU engine knobs (replace the external engine's flag surface:
+    # VLLM_MAX_NUM_SEQS / VLLM_MAX_NUM_BATCHED_TOKENS / GPU_MEMORY_UTILIZATION
+    # at .env.vllm.example:32-47) ----
+    decode_slots: int = field(default_factory=lambda: _env_int("TPU_DECODE_SLOTS", 16))
+    max_model_len: int = field(default_factory=lambda: _env_int("TPU_MAX_MODEL_LEN", 8192))
+    prefill_chunk: int = field(default_factory=lambda: _env_int("TPU_PREFILL_CHUNK", 512))
+    dtype: str = field(default_factory=lambda: _env_str("TPU_DTYPE", "bfloat16"))
+    tp_size: int = field(default_factory=lambda: _env_int("TPU_TP_SIZE", 1))
+    dp_size: int = field(default_factory=lambda: _env_int("TPU_DP_SIZE", 1))
+    # Sequence-parallel axis: shards each slot's KV over sp chips.
+    # Long fresh prompts prefill through ring attention and decode
+    # attends via the sharded flash-decoding combine — per-chip serving
+    # memory O(T/sp) (parallel/ring_attention.py).
+    sp_size: int = field(default_factory=lambda: _env_int("TPU_SP_SIZE", 1))
+    # Multi-host SPMD serving role (parallel/spmd_serving.py):
+    # "off" | "leader" (serves the gateway; publishes every device call
+    # to followers over TPU_SPMD_ADDR) | "follower" (replays the
+    # leader's calls against this host's shards; no gateway). Requires
+    # the usual jax.distributed env (TPU_COORDINATOR_ADDR,
+    # TPU_NUM_PROCESSES, TPU_PROCESS_ID) for the device cluster itself.
+    spmd_role: str = field(
+        default_factory=lambda: _env_str("TPU_SPMD_ROLE", "off"))
+    spmd_addr: str = field(
+        default_factory=lambda: _env_str("TPU_SPMD_ADDR",
+                                         "127.0.0.1:8890"))
+    spmd_followers: int = field(
+        default_factory=lambda: _env_int("TPU_SPMD_FOLLOWERS", 1))
+    hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
+    # The length-pruning Pallas decode-attention kernel. Off by default:
+    # profiled on v5e-1 its per-grid-cell cost (8 statically unrolled
+    # tiny GQA matmuls) makes it ~2x SLOWER than the XLA attention over
+    # a bucketed view at chat-scale lengths — it was the hidden reason
+    # r2's int8 measured equal to bf16. Worth enabling only for very
+    # long contexts with short active lengths, where block-level pruning
+    # beats reading the whole bucket.
+    use_pallas_attention: bool = field(
+        default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", False))
+    # Int8 dequant-fused matmul kernel (single-device decode); gates
+    # independently of the attention kernel.
+    use_pallas_int8: bool = field(
+        default_factory=lambda: _env_bool("TPU_USE_PALLAS_INT8", True))
+    # Tokens decoded per device call (lax.scan inside one jitted step) and
+    # number of calls kept in flight. Together these amortise and overlap
+    # per-call host/dispatch latency — the dominant cost when the chip is
+    # reached over a relay, and still a measurable one locally. 32:
+    # donated-buffer aliasing is unavailable on the relayed attach path
+    # (measured: a 1-element update of a donated 1 GiB cache costs a
+    # full-buffer copy), so every decode call pays a KV-cache
+    # boundary copy — more steps per call amortise it. Cost: cancel
+    # granularity coarsens to one call (~130 ms at 32 steps).
+    decode_steps_per_call: int = field(
+        default_factory=lambda: _env_int("TPU_DECODE_STEPS", 32))
+    # At 32 steps/call one call's compute already covers the token-fetch
+    # round trip, so depth 2 reaches full throughput while keeping the
+    # stale-call tail (which delays the NEXT request's first token on the
+    # in-order device queue) as short as possible.
+    pipeline_depth: int = field(
+        default_factory=lambda: _env_int("TPU_PIPELINE_DEPTH", 2))
+    # Cross-session shared-prefix KV: a fresh session whose prompt
+    # starts with rows resident in another slot (common system prompt)
+    # gets them by device copy instead of re-prefill — cuts TTFT and
+    # prefill load at high concurrency (single-device path).
+    shared_prefix: bool = field(
+        default_factory=lambda: _env_bool("TPU_SHARED_PREFIX", True))
+    # Speculative decoding: "off" | "ngram" | "auto". "ngram" is the
+    # always-on self-drafting prompt-lookup (draft from the slot's own
+    # token history on-device, verify draft+1 positions in one
+    # scatter-decode block, accept the longest sampled-equal prefix;
+    # exactly distribution-preserving, see engine/engine.py
+    # _get_spec_decode_fn) — worthwhile on repetitive/structured text,
+    # a measured ~25% regression on incompressible sampled text
+    # (docs/SPEC_DECODE.md). "auto" (default) makes that call per
+    # decode call from the engine's own measured acceptance EMA vs the
+    # break-even (TPU_SPEC_BREAKEVEN, default 1.45 plain-step
+    # equivalents per verify block), probing periodically — no knob
+    # guessing, bounded downside (~1 probe call in 16). Single-device
+    # scatter path only; the mesh path always decodes plain.
+    spec_decode: str = field(
+        default_factory=lambda: _env_str("TPU_SPEC_DECODE", "auto"))
+    # Draft tokens proposed per verify block (block = draft + 1).
+    spec_draft_len: int = field(
+        default_factory=lambda: _env_int("TPU_SPEC_DRAFT", 7))
+    # Auto-mode enable threshold: EMA tokens-per-verify-block above
+    # which speculative calls win (a verify block costs ~1.43 plain
+    # steps on v5e — docs/SPEC_DECODE.md).
+    spec_breakeven: float = field(
+        default_factory=lambda: _env_float("TPU_SPEC_BREAKEVEN", 1.45))
+    # Token sampling candidate preselection: "fast" (block-max, the
+    # approx_max_k algorithm — greedy rows stay exact, measured 2.4x
+    # cheaper than the full-vocab sort which was ~54% of a decode step)
+    # or "exact" (full-vocab lax.top_k).
+    sampling: str = field(
+        default_factory=lambda: _env_str("TPU_SAMPLING", "fast"))
+    # Weight quantization for serving: "none" | "int8" (per-output-channel
+    # symmetric, in-tree replacement for the reference's external AWQ
+    # engine config, .env.vllm.example:21).
+    quantize: str = field(default_factory=lambda: _env_str("TPU_QUANTIZE", "none"))
+    # Persistent XLA compilation cache: "" = on at the default location
+    # (MODEL_PATH/.xla_cache or a per-user tmp dir), a path = on there,
+    # "off" = disabled. Makes warmup a one-time cost per configuration
+    # instead of per process (utils/compile_cache.py).
+    compile_cache: str = field(
+        default_factory=lambda: _env_str("TPU_COMPILE_CACHE", ""))
+    # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
+    # in-tree replacement for the reference's 300s engine-container
+    # health start_period (docker-compose.vllm.yml:62-67). Empty means
+    # provider-dependent: "fast" for the in-tree tpu engine (so the bare
+    # `python main.py websocket` never serves first traffic through
+    # 20-40s XLA compiles), "off" for remote/fake providers which have
+    # nothing to compile.
+    warmup: str = field(default_factory=lambda: _env_str("TPU_WARMUP", ""))
+
+    def __post_init__(self) -> None:
+        if not self.warmup:
+            self.warmup = "fast" if self.llm_provider == "tpu" else "off"
+        if self.default_repeat_penalty < 0:  # unset: provider-resolved
+            self.default_repeat_penalty = \
+                1.0 if self.llm_provider == "vllm" else 1.1
+        self._validate()
+
+    def _validate(self) -> None:
+        errs: list[str] = []
+        if self.compute_device not in VALID_DEVICES:
+            errs.append(f"compute_device must be one of {VALID_DEVICES}")
+        if self.llm_provider not in VALID_PROVIDERS:
+            errs.append(f"llm_provider must be one of {VALID_PROVIDERS}")
+        if not (0.0 <= self.default_temperature <= 2.0):
+            errs.append("default_temperature must be in [0, 2]")
+        if not (0.0 < self.default_top_p <= 1.0):
+            errs.append("default_top_p must be in (0, 1]")
+        if self.default_top_k < 0:
+            errs.append("default_top_k must be >= 0")
+        if self.default_max_tokens <= 0:
+            errs.append("default_max_tokens must be > 0")
+        if not (0.0 < self.default_repeat_penalty <= 2.0):
+            errs.append("default_repeat_penalty must be in (0, 2]")
+        if not (-2.0 <= self.default_presence_penalty <= 2.0):
+            errs.append("default_presence_penalty must be in [-2, 2]")
+        if not (-2.0 <= self.default_frequency_penalty <= 2.0):
+            errs.append("default_frequency_penalty must be in [-2, 2]")
+        if self.port == self.monitoring_port:
+            errs.append("port and monitoring_port must differ")
+        if self.max_connections <= 0:
+            errs.append("max_connections must be > 0")
+        if self.decode_slots <= 0:
+            errs.append("decode_slots must be > 0")
+        if self.max_model_len <= 0:
+            errs.append("max_model_len must be > 0")
+        if self.prefill_chunk <= 0 or self.prefill_chunk & (self.prefill_chunk - 1):
+            errs.append("prefill_chunk must be a positive power of two")
+        if self.tp_size <= 0 or self.dp_size <= 0 or self.sp_size <= 0:
+            errs.append("tp_size, dp_size and sp_size must be >= 1")
+        if self.spmd_role not in ("off", "leader", "follower"):
+            errs.append("spmd_role must be off|leader|follower")
+        if self.spmd_role != "off":
+            if ":" not in self.spmd_addr:
+                errs.append("spmd_addr must be host:port")
+            if self.spmd_followers <= 0:
+                errs.append("spmd_followers must be >= 1")
+        if self.decode_steps_per_call <= 0:
+            errs.append("decode_steps_per_call must be >= 1")
+        if self.spec_decode not in ("off", "ngram", "auto"):
+            errs.append(
+                f"spec_decode must be off|ngram|auto, "
+                f"got {self.spec_decode!r}")
+        if self.spec_decode != "off" and not 1 <= self.spec_draft_len <= 31:
+            errs.append("spec_draft_len must be in 1..31")
+        if self.spec_breakeven <= 0:
+            errs.append("spec_breakeven must be > 0")
+        if self.pipeline_depth <= 0:
+            errs.append("pipeline_depth must be >= 1")
+        if self.sampling not in ("fast", "exact"):
+            errs.append(f"TPU_SAMPLING must be fast|exact, "
+                        f"got {self.sampling!r}")
+        if self.quantize not in ("none", "int8"):
+            errs.append("quantize must be 'none' or 'int8'")
+        if self.warmup not in ("off", "fast", "full"):
+            errs.append("warmup must be 'off', 'fast' or 'full'")
+        if self.default_context_window < self.default_max_tokens:
+            # Reference warns here (config.py:184-187); we keep it a warning.
+            pass
+        if errs:
+            raise ValueError("Invalid configuration: " + "; ".join(errs))
+
+    # Presets mirror reference config.py:270-315 (fast/balanced/quality).
+    def apply_preset(self, name: str) -> None:
+        presets = {
+            "fast": dict(default_temperature=0.5, default_max_tokens=512,
+                         default_top_p=0.85, default_top_k=20),
+            "balanced": dict(default_temperature=0.7, default_max_tokens=2048,
+                             default_top_p=0.9, default_top_k=40),
+            "quality": dict(default_temperature=0.9, default_max_tokens=4096,
+                            default_top_p=0.95, default_top_k=80),
+        }
+        if name not in presets:
+            raise ValueError(f"Unknown preset {name!r}; choose from {sorted(presets)}")
+        for k, v in presets[name].items():
+            setattr(self, k, v)
+        self._validate()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_config: Config | None = None
+
+
+def get_config(reload: bool = False) -> Config:
+    global _config
+    if _config is None or reload:
+        _config = Config()
+    return _config

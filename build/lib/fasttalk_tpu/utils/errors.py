@@ -1,0 +1,250 @@
+"""Error taxonomy, circuit breaker, and retry — wired into the serving path.
+
+Capability parity with the reference error handler (app/utils/error_handler.py:
+18-76 taxonomy, :79-213 CircuitBreaker, :216-264 RetryManager, :349-400
+ErrorHandler), with two deliberate fixes over the reference:
+(1) the breaker and retry manager are actually used around engine calls
+    (the reference constructed them at error_handler.py:285-294 and never
+    wired them — SURVEY.md §5), and
+(2) all state is safe to touch from asyncio + the engine thread.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class ErrorCategory(str, Enum):
+    CONNECTION = "connection_error"
+    TIMEOUT = "timeout_error"
+    MODEL = "model_error"
+    VALIDATION = "validation_error"
+    RATE_LIMIT = "rate_limit_error"
+    RESOURCE = "resource_error"
+    CANCELLED = "cancelled"
+    INTERNAL = "internal_error"
+
+
+class ErrorSeverity(str, Enum):
+    LOW = "low"
+    MEDIUM = "medium"
+    HIGH = "high"
+    CRITICAL = "critical"
+
+
+class LLMServiceError(Exception):
+    """Service error carrying category/severity/recoverability hints that are
+    surfaced to WebSocket clients (reference: error_handler.py:50-76)."""
+
+    def __init__(self, message: str,
+                 category: ErrorCategory = ErrorCategory.INTERNAL,
+                 severity: ErrorSeverity = ErrorSeverity.MEDIUM,
+                 recoverable: bool = True,
+                 retry_after: float | None = None,
+                 details: dict[str, Any] | None = None):
+        super().__init__(message)
+        self.message = message
+        self.category = category
+        self.severity = severity
+        self.recoverable = recoverable
+        self.retry_after = retry_after
+        self.details = details or {}
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "code": self.category.value,
+            "message": self.message,
+            "severity": self.severity.value,
+            "recoverable": self.recoverable,
+        }
+        if self.retry_after is not None:
+            d["retry_after"] = self.retry_after
+        if self.details:
+            d["details"] = self.details
+        return d
+
+
+class CircuitState(str, Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitBreakerOpen(LLMServiceError):
+    def __init__(self, retry_after: float):
+        super().__init__(
+            "Service temporarily unavailable (circuit open)",
+            category=ErrorCategory.RESOURCE, severity=ErrorSeverity.HIGH,
+            recoverable=True, retry_after=retry_after)
+
+
+class CircuitBreaker:
+    """CLOSED → OPEN after ``failure_threshold`` consecutive failures;
+    OPEN → HALF_OPEN after ``reset_timeout``; HALF_OPEN closes after
+    ``half_open_successes`` successes or re-opens on any failure."""
+
+    def __init__(self, failure_threshold: int = 5, reset_timeout: float = 30.0,
+                 half_open_successes: int = 2):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.half_open_successes = half_open_successes
+        self._state = CircuitState.CLOSED
+        self._failures = 0
+        self._successes = 0
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> CircuitState:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (self._state is CircuitState.OPEN
+                and time.monotonic() - self._opened_at >= self.reset_timeout):
+            self._state = CircuitState.HALF_OPEN
+            self._successes = 0
+
+    def check(self) -> None:
+        """Raise CircuitBreakerOpen if calls must not proceed."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state is CircuitState.OPEN:
+                remaining = self.reset_timeout - (time.monotonic() - self._opened_at)
+                raise CircuitBreakerOpen(retry_after=max(0.0, remaining))
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state is CircuitState.HALF_OPEN:
+                self._successes += 1
+                if self._successes >= self.half_open_successes:
+                    self._state = CircuitState.CLOSED
+                    self._failures = 0
+            else:
+                self._failures = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state is CircuitState.HALF_OPEN:
+                self._state = CircuitState.OPEN
+                self._opened_at = time.monotonic()
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._state = CircuitState.OPEN
+                self._opened_at = time.monotonic()
+
+    def to_dict(self) -> dict[str, Any]:
+        with self._lock:
+            self._maybe_half_open()
+            return {"state": self._state.value, "failures": self._failures}
+
+
+class RetryManager:
+    """Exponential backoff with jitter (reference: error_handler.py:216-264)."""
+
+    def __init__(self, max_attempts: int = 3, base_delay: float = 0.5,
+                 max_delay: float = 10.0, jitter: float = 0.25):
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.jitter = jitter
+
+    def delay_for(self, attempt: int) -> float:
+        d = min(self.max_delay, self.base_delay * (2 ** attempt))
+        return d * (1.0 + random.uniform(-self.jitter, self.jitter))
+
+    def retry_with_backoff(self, fn: Callable[[], T],
+                           retryable: tuple[type[BaseException], ...] = (Exception,),
+                           on_retry: Callable[[int, BaseException], None] | None = None,
+                           ) -> T:
+        last: BaseException | None = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn()
+            except retryable as e:  # noqa: PERF203
+                if isinstance(e, LLMServiceError) and not e.recoverable:
+                    raise
+                last = e
+                if attempt + 1 < self.max_attempts:
+                    if on_retry:
+                        on_retry(attempt, e)
+                    time.sleep(self.delay_for(attempt))
+        assert last is not None
+        raise last
+
+
+@dataclass
+class ErrorRecord:
+    ts: float
+    category: str
+    severity: str
+    message: str
+    context: dict[str, Any] = field(default_factory=dict)
+
+
+class ErrorHandler:
+    """Categorizes foreign exceptions and keeps a bounded history
+    (reference: error_handler.py:349-400)."""
+
+    _PATTERNS: list[tuple[tuple[str, ...], ErrorCategory, ErrorSeverity]] = [
+        (("connection", "refused", "unreachable", "reset by peer"),
+         ErrorCategory.CONNECTION, ErrorSeverity.HIGH),
+        (("timeout", "timed out", "deadline"),
+         ErrorCategory.TIMEOUT, ErrorSeverity.MEDIUM),
+        (("out of memory", "oom", "resource exhausted", "hbm"),
+         ErrorCategory.RESOURCE, ErrorSeverity.CRITICAL),
+        (("rate limit", "too many requests"),
+         ErrorCategory.RATE_LIMIT, ErrorSeverity.MEDIUM),
+        (("invalid", "validation", "must be", "expected"),
+         ErrorCategory.VALIDATION, ErrorSeverity.LOW),
+        (("cancel",), ErrorCategory.CANCELLED, ErrorSeverity.LOW),
+    ]
+
+    def __init__(self, history_size: int = 200):
+        self._history: deque[ErrorRecord] = deque(maxlen=history_size)
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def handle_error(self, exc: BaseException,
+                     context: dict[str, Any] | None = None) -> LLMServiceError:
+        if isinstance(exc, LLMServiceError):
+            err = exc
+        else:
+            text = str(exc).lower()
+            category, severity = ErrorCategory.INTERNAL, ErrorSeverity.MEDIUM
+            for needles, cat, sev in self._PATTERNS:
+                if any(n in text for n in needles):
+                    category, severity = cat, sev
+                    break
+            err = LLMServiceError(str(exc) or type(exc).__name__,
+                                  category=category, severity=severity)
+        with self._lock:
+            self._history.append(ErrorRecord(
+                ts=time.time(), category=err.category.value,
+                severity=err.severity.value, message=err.message,
+                context=context or {}))
+            self._counts[err.category.value] = self._counts.get(err.category.value, 0) + 1
+        return err
+
+    def get_error_stats(self) -> dict[str, Any]:
+        with self._lock:
+            recent = [
+                {"ts": r.ts, "category": r.category, "severity": r.severity,
+                 "message": r.message}
+                for r in list(self._history)[-10:]
+            ]
+            return {
+                "total_errors": sum(self._counts.values()),
+                "by_category": dict(self._counts),
+                "recent": recent,
+            }

@@ -1,0 +1,195 @@
+"""Single process-wide metrics registry with Prometheus text output.
+
+Deliberately replaces the reference's three overlapping mechanisms
+(SURVEY.md §5: connection_manager counters + conversation_manager counters +
+the never-wired ServiceMonitor at app/monitoring/service_monitor.py:18-61,
+whose /metrics always reported zeros). One registry, one source of truth,
+real tokenizer token counts.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram; also keeps a bounded sample window so the
+    /stats endpoint can report true percentiles (p50/p95 TTFT etc.)."""
+
+    def __init__(self, name: str, help_: str, buckets: Iterable[float],
+                 window: int = 2048):
+        self.name = name
+        self.help = help_
+        self.buckets = sorted(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._window: deque[float] = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, value)
+            self._counts[i] += 1
+            self._sum += value
+            self._n += 1
+            self._window.append(value)
+
+    @staticmethod
+    def _quantile(sorted_window: list[float], q: float) -> float:
+        if not sorted_window:
+            return 0.0
+        idx = min(len(sorted_window) - 1,
+                  max(0, int(q / 100.0 * len(sorted_window))))
+        return sorted_window[idx]
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            s = sorted(self._window)
+        return self._quantile(s, q)
+
+    def summary(self) -> dict[str, float]:
+        with self._lock:  # one consistent snapshot, one sort
+            n, total = self._n, self._sum
+            s = sorted(self._window)
+        return {
+            "count": n,
+            "sum": total,
+            "mean": total / n if n else 0.0,
+            "p50": self._quantile(s, 50),
+            "p95": self._quantile(s, 95),
+            "p99": self._quantile(s, 99),
+        }
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Iterable[float] = (
+                      1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+                  ) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get_or_create(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def uptime(self) -> float:
+        return time.time() - self.started_at
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"uptime_seconds": self.uptime()}
+        with self._lock:
+            metrics = dict(self._metrics)
+        for name, m in metrics.items():
+            if isinstance(m, Histogram):
+                out[name] = m.summary()
+            else:
+                out[name] = m.value
+        return out
+
+    def prometheus(self) -> str:
+        """Render all metrics in Prometheus exposition text format."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = dict(self._metrics)
+        for name, m in metrics.items():
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {m.value}")
+            else:
+                lines.append(f"# TYPE {name} histogram")
+                acc = 0
+                with m._lock:
+                    counts, total, n = list(m._counts), m._sum, m._n
+                for bound, c in zip(m.buckets, counts):
+                    acc += c
+                    lines.append(f'{name}_bucket{{le="{bound}"}} {acc}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
+                lines.append(f"{name}_sum {total}")
+                lines.append(f"{name}_count {n}")
+        lines.append("")
+        return "\n".join(lines)
+
+
+_registry: MetricsRegistry | None = None
+
+
+def get_metrics() -> MetricsRegistry:
+    global _registry
+    if _registry is None:
+        _registry = MetricsRegistry()
+    return _registry
+
+
+def reset_metrics() -> None:
+    """Test hook: drop the process-wide registry."""
+    global _registry
+    _registry = None
