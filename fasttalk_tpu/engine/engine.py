@@ -43,10 +43,11 @@ Architecture (JetStream-style, XLA-first):
   resident in ANOTHER slot (common system prompt) gets them by device
   copy — cross-session at admission, and intra-batch for cold bursts
   (leader prefills, members stamp; see _prefill_batched_shared).
-- **Speculative decoding** (opt-in): on-device prompt-lookup drafts
-  verified as multi-token scatter-decode blocks, exactly
-  distribution-preserving (see _get_spec_decode_fn and
-  docs/SPEC_DECODE.md).
+- **Speculative decoding** (default "auto"): on-device prompt-lookup
+  drafts verified as multi-token scatter-decode blocks, exactly
+  distribution-preserving; the dispatcher engages them per call from
+  the measured acceptance EMA (see _get_spec_decode_fn,
+  _spec_call_wanted and docs/SPEC_DECODE.md).
 """
 
 from __future__ import annotations
