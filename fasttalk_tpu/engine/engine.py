@@ -71,6 +71,7 @@ from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
                                        init_cache)
+from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
                                        sample_tokens)
 from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
@@ -168,6 +169,14 @@ class _Request:
     first_pending: bool = False  # first sampled token not yet fetched
     cancelled: bool = False
     finished: bool = False
+    # Observability timestamps/accumulators (observability/trace.py):
+    # written only at phase transitions or with O(ns) per-token adds.
+    admitted_at: float | None = None    # popped from the waiting queue
+    decode_started_at: float | None = None  # activation (prefill done)
+    last_token_at: float | None = None  # inter-token gap tracking
+    detok_s: float = 0.0                # cumulative detokenize time
+    spec_accepted: int = 0              # accepted draft tokens
+    spec_drafted: int = 0               # drafts offered to verification
 
 
 class EngineBase:
@@ -412,6 +421,22 @@ class TPUEngine(EngineBase):
             "tokens emitted per speculative verify block (accepted "
             "drafts + 1); 1 means no draft accepted",
             buckets=tuple(range(1, max(2, self.spec_draft + 2))))
+        # Request-phase histograms (ISSUE 1): where a request's latency
+        # lives, as aggregates; the span tracer carries the per-request
+        # breakdown.
+        self._m_queue_wait = m.histogram(
+            "queue_wait_ms",
+            "wait from request submit to slot admission")
+        self._m_prefill_req = m.histogram(
+            "prefill_ms",
+            "prefill wall time per request, admission to first-token "
+            "sample", buckets=(4, 16, 64, 256, 1000, 4000, 16000, 60000))
+        self._m_intertok = m.histogram(
+            "inter_token_ms",
+            "gap between consecutive tokens of one request",
+            buckets=(0.5, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000,
+                     4000))
+        self._tracer = get_tracer()
 
     def _make_cache(self) -> KVCache:
         if self.mesh is None:
@@ -472,7 +497,8 @@ class TPUEngine(EngineBase):
         self._dirty_slots: set[int] = set()
         # In-flight decode calls: (host-copy Future, EXPECTED tokens the
         # call will emit per request, EXPECTED positions it advances,
-        # the (slot index, request) pairs running at dispatch time).
+        # the (slot index, request) pairs running at dispatch time,
+        # dispatch timestamp for step telemetry).
         # Plain calls emit exactly K tokens (both fields == K);
         # speculative calls emit K..K*(G+1) and both fields are
         # EMA-based estimates — the dispatcher's base/bucket math may
@@ -485,7 +511,8 @@ class TPUEngine(EngineBase):
         # retirement — a slot can be re-admitted to a new request while
         # an older call is still in flight.
         self._inflight: deque[
-            tuple[Future, float, int, list[tuple[int, _Request]]]] = deque()
+            tuple[Future, float, int, list[tuple[int, _Request]],
+                  float]] = deque()
         # First sampled tokens whose device→host copy is still in
         # flight: (host-copy Future, [(row, slot_index, request), ...]).
         # Admission emits the first token only when the fetch lands, so
@@ -765,6 +792,11 @@ class TPUEngine(EngineBase):
             out_queue=asyncio.Queue(), loop=asyncio.get_running_loop(),
             detok=StreamDetokenizer(self.tokenizer))
         self._m_requests.inc()
+        # Trace the request's whole lifecycle. The serving layer starts
+        # the trace first (it owns the ws_send spans and the finish);
+        # start() returns True only for engine-seam callers (tests,
+        # BENCH_MODE=engine), who then own the finish here.
+        trace_owned = self._tracer.start(request_id, session_id)
         # Register before enqueueing so an immediate cancel() can't race
         # the engine thread's command drain.
         self._by_id[request_id] = req
@@ -783,6 +815,8 @@ class TPUEngine(EngineBase):
                 # Caller abandoned the stream (e.g. WebSocket dropped):
                 # free the slot instead of decoding to max_tokens.
                 self.cancel(request_id)
+            if trace_owned:
+                self._tracer.finish(request_id)
 
     def cancel(self, request_id: str) -> bool:
         req = self._by_id.get(request_id)
@@ -1597,6 +1631,14 @@ class TPUEngine(EngineBase):
             # for eviction by the next acquire in this same loop.
             req.slot = slot
             slot.active = True
+            req.admitted_at = time.monotonic()
+            self._m_queue_wait.observe(
+                (req.admitted_at - req.submitted_at) * 1000)
+            if self._tracer.enabled:
+                self._tracer.add_span(req.request_id, "queue_wait",
+                                      req.submitted_at, req.admitted_at,
+                                      slot=slot.index)
+                self._tracer.set_phase(req.request_id, "prefill")
             prompt = req.prompt_tokens
             reused = self.slots.reuse_prefix(slot, prompt)
             if reused:
@@ -1923,7 +1965,7 @@ class TPUEngine(EngineBase):
             # past its first token makes this condition false.
             return False
         promised: dict[int, int] = {}
-        for _, min_toks, _, snap in self._inflight:
+        for _, min_toks, _, snap, _ in self._inflight:
             for _, req in snap:
                 promised[id(req)] = promised.get(id(req), 0) + min_toks
         # A first token whose fetch hasn't landed is not yet counted in
@@ -1942,6 +1984,16 @@ class TPUEngine(EngineBase):
         s = slot.index
         slot.active = True
         req.slot = slot
+        req.decode_started_at = time.monotonic()
+        if req.admitted_at is not None:
+            self._m_prefill_req.observe(
+                (req.decode_started_at - req.admitted_at) * 1000)
+            if self._tracer.enabled:
+                self._tracer.add_span(
+                    req.request_id, "prefill", req.admitted_at,
+                    req.decode_started_at, slot=s,
+                    prompt_tokens=len(req.prompt_tokens))
+                self._tracer.set_phase(req.request_id, "decode")
         self._running[s] = req
         self._positions[s] = len(slot.tokens)
         self._active_mask[s] = True
@@ -2076,6 +2128,7 @@ class TPUEngine(EngineBase):
     def _dispatch_decode(self) -> None:
         """Launch one K-step decode call; does not wait for results."""
         self._patch_slot_state()
+        t_disp = time.monotonic()
         active = list(self._running)
         snapshot = list(self._running.items())
         # Short calls while admissions/prefills are pending or a first
@@ -2090,7 +2143,7 @@ class TPUEngine(EngineBase):
         # maximum advances; size the KV bucket for where the device can
         # be at the END of this call.
         base = int(self._positions[active].max()) \
-            + sum(adv for _, _, adv, _ in self._inflight)
+            + sum(adv for _, _, adv, _, _ in self._inflight)
         T = self.spec_draft + 1
         if self.spec_draft and self._spec_call_wanted():
             # Size the KV bucket by the EMA-EXPECTED advance (+1 block
@@ -2135,7 +2188,7 @@ class TPUEngine(EngineBase):
                                       max(1.0, self._spec_ema))
                 self._inflight.append(
                     (self._fetch_pool.submit(np.asarray, toks), promise,
-                     exp_adv, snapshot))
+                     exp_adv, snapshot, t_disp))
                 return
         max_pos = base + steps
         kv_len = next((b for b in _KV_BUCKETS
@@ -2156,7 +2209,7 @@ class TPUEngine(EngineBase):
                 self._freqs_dev, self._rng_dev)
             self._inflight.append(
                 (self._fetch_pool.submit(np.asarray, toks), steps, steps,
-                 snapshot))
+                 snapshot, t_disp))
             return
         fn = self._get_decode_fn(kv_len, steps)
         self._sink("decode", kv_len=kv_len, steps=steps,
@@ -2173,11 +2226,13 @@ class TPUEngine(EngineBase):
         # _fetch_pool note in __init__).
         self._inflight.append(
             (self._fetch_pool.submit(np.asarray, toks), steps, steps,
-             snapshot))
+             snapshot, t_disp))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
-        fut, _, _, snapshot = self._inflight.popleft()
+        fut, _, _, snapshot, t_disp = self._inflight.popleft()
+        gen_before = {id(req): req.generated for _, req in snapshot} \
+            if self._tracer.enabled else {}
         if any(req.first_pending for _, req in snapshot):
             # A request in this call still awaits its first token:
             # emit firsts before any of its decode tokens (the firsts
@@ -2210,6 +2265,10 @@ class TPUEngine(EngineBase):
                         self._m_spec.observe(n)
                         self._spec_ema = (0.9 * self._spec_ema
                                           + 0.1 * n)
+                        # Accept/reject accounting: each verify block
+                        # offered spec_draft drafts and accepted n-1.
+                        req.spec_accepted += n - 1
+                        req.spec_drafted += self.spec_draft
                     for i in range(n):
                         if req.finished \
                                 or self._running.get(s) is not req:
@@ -2228,6 +2287,24 @@ class TPUEngine(EngineBase):
                     self._consume_token(req, int(res[k, s]))
         for _, req in snapshot:
             self._flush_emit(req)
+        if self._tracer.enabled:
+            # One step record per retired call (process-level row) and
+            # one decode_step span per participating request: batch
+            # occupancy and slot utilization AT DISPATCH TIME, which is
+            # what the device actually computed over.
+            t1 = time.monotonic()
+            spec = res.ndim == 3
+            occupancy = round(len(snapshot) / max(1, self.num_slots), 3)
+            self._tracer.step(
+                "engine_step", t_disp, t1, steps=int(res.shape[0]),
+                batch=len(snapshot), slots=self.num_slots,
+                occupancy=occupancy, kind="spec" if spec else "plain")
+            for s, req in snapshot:
+                self._tracer.add_span(
+                    req.request_id, "decode_step", t_disp, t1,
+                    slot=s, batch=len(snapshot), occupancy=occupancy,
+                    tokens=req.generated - gen_before.get(id(req), 0),
+                    kind="spec" if spec else "plain")
 
     def _consume_token(self, req: _Request, token_id: int) -> None:
         """Handle one newly sampled token for a request (host side)."""
@@ -2242,12 +2319,19 @@ class TPUEngine(EngineBase):
         assert slot is not None and req.detok is not None
         slot.tokens.append(token_id)
         req.generated += 1
+        now = time.monotonic()
+        if req.last_token_at is not None:
+            self._m_intertok.observe((now - req.last_token_at) * 1000)
+        req.last_token_at = now
         if req.first_token_at is None:
-            req.first_token_at = time.monotonic()
+            req.first_token_at = now
             self._m_ttft.observe(
                 (req.first_token_at - req.submitted_at) * 1000)
+            self._tracer.event(req.request_id, "first_token")
         self._m_tokens.inc()
+        t_detok = time.monotonic()
         delta = req.detok.push(token_id)
+        req.detok_s += time.monotonic() - t_detok
         if delta:
             self._stream_text(req, delta)
         if req.finished:
@@ -2333,6 +2417,35 @@ class TPUEngine(EngineBase):
             req.emit_buf += text
         req.pending_text = ""
         self._flush_emit(req)
+
+        if self._tracer.enabled:
+            now = time.monotonic()
+            if req.admitted_at is None:
+                # Never admitted (cancelled/errored in the queue): the
+                # whole lifetime was queue wait.
+                self._tracer.add_span(req.request_id, "queue_wait",
+                                      req.submitted_at, now,
+                                      summary=True)
+            if req.decode_started_at is not None:
+                attrs: dict[str, Any] = {
+                    "tokens": req.generated, "finish_reason": reason,
+                    "prompt_tokens": len(req.prompt_tokens)}
+                if req.spec_drafted:
+                    attrs["spec_accepted"] = req.spec_accepted
+                    attrs["spec_rejected"] = (req.spec_drafted
+                                              - req.spec_accepted)
+                self._tracer.add_span(req.request_id, "decode",
+                                      req.decode_started_at, now,
+                                      summary=True, **attrs)
+            if req.detok_s > 0:
+                # Aggregate span: total detokenize time, anchored so it
+                # ends at finish (per-token spans would be absurdly
+                # fine-grained — this keeps the phase visible in the
+                # report and the timeline without per-token overhead).
+                self._tracer.add_span(req.request_id, "detokenize",
+                                      now - req.detok_s, now,
+                                      summary=True, aggregate=True)
+            self._tracer.set_phase(req.request_id, "finishing")
 
         if error is not None:
             self._emit(req, {"type": "error", "error": error,

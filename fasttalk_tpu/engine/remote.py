@@ -20,6 +20,7 @@ import aiohttp
 
 from fasttalk_tpu.engine.engine import (EngineBase, GenerationParams,
                                         raw_prompt_text)
+from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
 from fasttalk_tpu.utils.logger import get_logger
 
@@ -76,6 +77,31 @@ class _RemoteEngine(EngineBase):
         r = requests.get(url, timeout=timeout)
         r.raise_for_status()
         return r
+
+    def _trace_start(self, request_id: str, session_id: str,
+                     backend: str) -> bool:
+        """Register the request with the span tracer (phase: upstream).
+        Returns whether this engine owns the trace's finish (False when
+        the serving layer started it first)."""
+        tracer = get_tracer()
+        owned = tracer.start(request_id, session_id)
+        tracer.set_phase(request_id, "upstream", backend=backend)
+        return owned
+
+    def _trace_end(self, request_id: str, owned: bool, t0: float,
+                   ttft_ms: float | None, chunks: int,
+                   backend: str) -> None:
+        """Close the upstream_stream span (covers connect + the whole
+        body read — a remote engine has no queue/prefill visibility, so
+        this is the request's single engine-side phase)."""
+        tracer = get_tracer()
+        tracer.add_span(request_id, "upstream_stream", t0,
+                        time.monotonic(), summary=True, backend=backend,
+                        chunks=chunks,
+                        **({"ttft_ms": round(ttft_ms, 3)}
+                           if ttft_ms is not None else {}))
+        if owned:
+            tracer.finish(request_id)
 
     def _finish_stats(self, chunks: int, started: float,
                       ttft: float | None,
@@ -159,6 +185,7 @@ class VLLMRemoteEngine(_RemoteEngine):
         prompt_toks: int | None = None
         completion_toks: int | None = None
         finish = "stop"
+        trace_owned = self._trace_start(request_id, session_id, "vllm")
         try:
             for _attempt in range(3):
                 async with client.post(
@@ -236,6 +263,8 @@ class VLLMRemoteEngine(_RemoteEngine):
                             chunks += 1
                             if ttft is None:
                                 ttft = (time.monotonic() - started) * 1000
+                                get_tracer().event(request_id,
+                                                   "first_chunk")
                             yield {"type": "token", "text": content}
                 break  # stream consumed; no retry
             yield {"type": "done", "finish_reason": finish,
@@ -246,6 +275,8 @@ class VLLMRemoteEngine(_RemoteEngine):
             raise LLMServiceError(f"vLLM connection failed: {e}",
                                   category=ErrorCategory.CONNECTION) from e
         finally:
+            self._trace_end(request_id, trace_owned, started, ttft,
+                            chunks, "vllm")
             self._cancelled.discard(request_id)
 
     def check_connection(self) -> bool:
@@ -319,6 +350,7 @@ class OllamaRemoteEngine(_RemoteEngine):
         chunks = 0
         prompt_toks: int | None = None
         completion_toks: int | None = None
+        trace_owned = self._trace_start(request_id, session_id, "ollama")
         try:
             async with client.post(url, json=body) as resp:
                 if resp.status != 200:
@@ -350,6 +382,7 @@ class OllamaRemoteEngine(_RemoteEngine):
                         chunks += 1
                         if ttft is None:
                             ttft = (time.monotonic() - started) * 1000
+                            get_tracer().event(request_id, "first_chunk")
                         yield {"type": "token", "text": content}
                     if obj.get("done"):
                         # Final NDJSON object carries Ollama's own token
@@ -368,6 +401,8 @@ class OllamaRemoteEngine(_RemoteEngine):
             raise LLMServiceError(f"Ollama connection failed: {e}",
                                   category=ErrorCategory.CONNECTION) from e
         finally:
+            self._trace_end(request_id, trace_owned, started, ttft,
+                            chunks, "ollama")
             self._cancelled.discard(request_id)
 
     def check_connection(self) -> bool:

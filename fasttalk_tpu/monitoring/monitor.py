@@ -26,6 +26,8 @@ import psutil
 from aiohttp import web
 
 from fasttalk_tpu import __version__
+from fasttalk_tpu.observability.export import chrome_trace, jsonl_dump
+from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.utils.metrics import get_metrics
 
 _profiler_state = {"active": False, "log_dir": None, "started_at": None}
@@ -171,6 +173,79 @@ def build_monitoring_app(ready_check=None) -> web.Application:
     async def profiler_memory(request: web.Request) -> web.Response:
         return web.json_response({"devices": _device_memory()})
 
+    # ---- request-lifecycle tracing (observability/trace.py) ----
+
+    async def _render_off_loop(build) -> str:
+        """Build + JSON-encode an export on a worker thread: a full
+        ring is hundreds of thousands of event dicts, and this app
+        shares the event loop with every WebSocket token stream — a
+        debug curl must not stall them. The inputs are snapshot lists
+        (tracer.completed/steps copy under the tracer lock), so
+        off-loop access is safe."""
+        import asyncio
+        import json as _json
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: _json.dumps(build()))
+
+    async def debug_requests(request: web.Request) -> web.Response:
+        """In-flight requests with current phase and age."""
+        tracer = get_tracer()
+        return web.json_response({
+            "enabled": tracer.enabled,
+            "requests": tracer.inflight_summary(),
+        })
+
+    async def traces_index(request: web.Request) -> web.Response:
+        """Completed-trace ring: index by default; ?format=chrome for a
+        Perfetto-loadable Chrome trace of the whole ring (+ engine-step
+        telemetry row); ?format=jsonl for the offline-analysis dump
+        scripts/trace_report.py consumes."""
+        tracer = get_tracer()
+        fmt = request.query.get("format", "")
+        completed = tracer.completed()
+        if fmt == "chrome":
+            text = await _render_off_loop(
+                lambda: chrome_trace(tracer, completed, tracer.steps()))
+            return web.Response(text=text,
+                                content_type="application/json")
+        if fmt == "jsonl":
+            import asyncio
+            text = await asyncio.get_running_loop().run_in_executor(
+                None, jsonl_dump, tracer, completed, tracer.steps())
+            return web.Response(text=text,
+                                content_type="application/x-ndjson")
+        if fmt:
+            return web.json_response(
+                {"error": f"unknown format {fmt!r} "
+                 "(expected chrome or jsonl)"}, status=400)
+        return web.json_response({
+            "enabled": tracer.enabled,
+            "completed": [t.request_id for t in completed],
+            "inflight": [t["request_id"]
+                         for t in tracer.inflight_summary()],
+            "engine_steps": len(tracer.steps()),
+        })
+
+    async def trace_one(request: web.Request) -> web.Response:
+        """One request's trace (in-flight or completed): Chrome trace
+        JSON by default, ?format=jsonl for the flat span records."""
+        rid = request.match_info["request_id"]
+        tracer = get_tracer()
+        trace = tracer.get(rid)
+        if trace is None:
+            return web.json_response(
+                {"error": f"unknown request_id {rid!r}"}, status=404)
+        if request.query.get("format") == "jsonl":
+            import asyncio
+            text = await asyncio.get_running_loop().run_in_executor(
+                None, jsonl_dump, tracer, [trace])
+            return web.Response(text=text,
+                                content_type="application/x-ndjson")
+        text = await _render_off_loop(
+            lambda: chrome_trace(tracer, [trace]))
+        return web.Response(text=text, content_type="application/json")
+
     app.router.add_get("/health", health)
     app.router.add_get("/health/ready", ready)
     app.router.add_get("/health/live", live)
@@ -180,4 +255,7 @@ def build_monitoring_app(ready_check=None) -> web.Application:
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)
     app.router.add_get("/profiler/memory", profiler_memory)
+    app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/traces", traces_index)
+    app.router.add_get("/traces/{request_id}", trace_one)
     return app

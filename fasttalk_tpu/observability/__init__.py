@@ -1,0 +1,10 @@
+from fasttalk_tpu.observability.trace import (RequestTrace, Span, Tracer,
+                                              bind_request, get_tracer,
+                                              reset_tracer)
+from fasttalk_tpu.observability.export import (chrome_trace, jsonl_dump,
+                                               load_jsonl)
+
+__all__ = [
+    "Span", "RequestTrace", "Tracer", "get_tracer", "reset_tracer",
+    "bind_request", "chrome_trace", "jsonl_dump", "load_jsonl",
+]

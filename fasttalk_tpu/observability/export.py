@@ -1,0 +1,119 @@
+"""Trace exporters: Chrome trace-event JSON (Perfetto) and JSONL.
+
+Chrome trace-event format (the subset Perfetto and chrome://tracing
+load): a top-level ``{"traceEvents": [...]}`` object whose events are
+complete spans (``"ph": "X"``) with microsecond ``ts``/``dur``, plus
+``"M"`` metadata events naming each row. One pid for the process; one
+tid per request (rows sort by first span), tid 0 reserved for the
+engine-step telemetry row.
+
+JSONL: one flat object per span — the offline-analysis format
+scripts/trace_report.py consumes. Schema per line:
+
+    {"request_id": str|null, "session_id": str, "span": str,
+     "ts": epoch-seconds float, "dur_ms": float, "attrs": {...}}
+
+Engine-step records export with ``request_id: null`` and the span name
+``engine_step`` so per-request phases and process-level call telemetry
+never mix in percentile tables.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable, TextIO
+
+from fasttalk_tpu.observability.trace import (RequestTrace, StepRecord,
+                                              Tracer)
+
+_ENGINE_TID = 0
+
+
+def chrome_trace(tracer: Tracer, traces: Iterable[RequestTrace],
+                 steps: Iterable[StepRecord] = ()) -> dict[str, Any]:
+    """Render traces (+ optional engine-step records) as a Chrome
+    trace-event JSON object loadable in Perfetto."""
+    events: list[dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+        "args": {"name": "fasttalk-tpu"},
+    }, {
+        "name": "thread_name", "ph": "M", "pid": 1, "tid": _ENGINE_TID,
+        "args": {"name": "engine steps"},
+    }]
+    for tid, trace in enumerate(traces, start=1):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": f"req {trace.request_id}"},
+        })
+        for span in trace.spans:
+            events.append({
+                "name": span.name,
+                "ph": "X",
+                "pid": 1,
+                "tid": tid,
+                "ts": tracer.to_wall(span.t0) * 1e6,
+                "dur": max(0.0, (span.t1 - span.t0) * 1e6),
+                "args": {"request_id": trace.request_id,
+                         "session_id": trace.session_id, **span.attrs},
+            })
+        if trace.dropped_spans:
+            events.append({
+                "name": "spans_dropped", "ph": "I", "pid": 1, "tid": tid,
+                "ts": tracer.to_wall(trace.started_mono) * 1e6, "s": "t",
+                "args": {"dropped": trace.dropped_spans},
+            })
+    for rec in steps:
+        events.append({
+            "name": rec.name,
+            "ph": "X",
+            "pid": 1,
+            "tid": _ENGINE_TID,
+            "ts": tracer.to_wall(rec.t0) * 1e6,
+            "dur": max(0.0, (rec.t1 - rec.t0) * 1e6),
+            "args": dict(rec.attrs),
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def jsonl_dump(tracer: Tracer, traces: Iterable[RequestTrace],
+               steps: Iterable[StepRecord] = ()) -> str:
+    """Render traces as JSONL (one span per line; trailing newline)."""
+    lines: list[str] = []
+    for trace in traces:
+        for span in trace.spans:
+            lines.append(json.dumps({
+                "request_id": trace.request_id,
+                "session_id": trace.session_id,
+                "span": span.name,
+                "ts": tracer.to_wall(span.t0),
+                "dur_ms": span.dur_ms,
+                "attrs": span.attrs,
+            }, ensure_ascii=False, default=str))
+    for rec in steps:
+        lines.append(json.dumps({
+            "request_id": None,
+            "session_id": "",
+            "span": rec.name,
+            "ts": tracer.to_wall(rec.t0),
+            "dur_ms": (rec.t1 - rec.t0) * 1000.0,
+            "attrs": rec.attrs,
+        }, ensure_ascii=False, default=str))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def load_jsonl(fp: TextIO) -> list[dict[str, Any]]:
+    """Parse a JSONL trace dump, skipping blank lines; raises ValueError
+    naming the offending line number on malformed input."""
+    records: list[dict[str, Any]] = []
+    for i, line in enumerate(fp, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"line {i}: not valid JSON ({e})") from e
+        if not isinstance(obj, dict) or "span" not in obj:
+            raise ValueError(f"line {i}: not a span record")
+        records.append(obj)
+    return records

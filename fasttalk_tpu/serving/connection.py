@@ -69,6 +69,13 @@ class ConnectionManager:
                                      "connections rejected at admission")
         self._m_active = m.gauge("ws_connections_active",
                                  "currently connected sessions")
+        # Process-lifetime frame counters: the per-connection fields
+        # above die with the connection, so aggregate receive/send rates
+        # were invisible to a scraper.
+        self._m_recv = m.counter("ws_messages_received_total",
+                                 "WS messages received, all sessions")
+        self._m_sent = m.counter("ws_messages_sent_total",
+                                 "WS frames sent, all sessions")
 
     def add_connection(self, session_id: str, websocket: Any,
                        ) -> ConnectionInfo | None:
@@ -98,12 +105,14 @@ class ConnectionManager:
             info.last_activity = time.time()
 
     def record_message_received(self, session_id: str) -> None:
+        self._m_recv.inc()
         info = self._connections.get(session_id)
         if info:
             info.messages_received += 1
             info.last_activity = time.time()
 
     def record_message_sent(self, session_id: str) -> None:
+        self._m_sent.inc()
         info = self._connections.get(session_id)
         if info:
             info.messages_sent += 1

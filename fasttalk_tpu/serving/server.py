@@ -35,6 +35,7 @@ from aiohttp import WSMsgType, web
 
 from fasttalk_tpu import __version__
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.observability.trace import bind_request, get_tracer
 from fasttalk_tpu.serving.connection import ConnectionManager, ConnectionState
 from fasttalk_tpu.serving.conversation import ConversationManager
 from fasttalk_tpu.serving.text_processor import extract_speakable_chunk
@@ -78,6 +79,12 @@ class WebSocketLLMServer:
         m = get_metrics()
         self._m_ws_tokens = m.counter("ws_tokens_streamed_total",
                                       "token frames streamed to clients")
+        self._m_ws_send = m.histogram(
+            "ws_send_ms", "WebSocket frame send wall time (request-"
+            "correlated frames only)",
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250,
+                     1000))
+        self._tracer = get_tracer()
 
         self.app = web.Application()
         self.app.router.add_get("/", self._http_root)
@@ -252,10 +259,22 @@ class WebSocketLLMServer:
         return ws
 
     async def _send(self, session_id: str, ws: web.WebSocketResponse,
-                    payload: dict) -> None:
-        if not ws.closed:
+                    payload: dict, request_id: str | None = None) -> None:
+        """Send one frame; when request-correlated, time the send into
+        the ws_send_ms histogram and the request's trace (backpressure
+        from a slow client shows up exactly here)."""
+        if ws.closed:
+            return
+        if request_id is not None:
+            t0 = time.monotonic()
             await ws.send_json(payload)
-            self.connection_manager.record_message_sent(session_id)
+            t1 = time.monotonic()
+            self._m_ws_send.observe((t1 - t0) * 1000)
+            self._tracer.add_span(request_id, "ws_send", t0, t1,
+                                  frame=payload.get("type"))
+        else:
+            await ws.send_json(payload)
+        self.connection_manager.record_message_sent(session_id)
 
     async def _send_error(self, session_id: str, ws: web.WebSocketResponse,
                           code: str, message: str, **extra: Any) -> None:
@@ -380,6 +399,20 @@ class WebSocketLLMServer:
                         ws: web.WebSocketResponse) -> None:
         request_id = f"{session_id}:{uuid.uuid4().hex[:8]}"
         self._cur_request[session_id] = request_id
+        # The serving layer owns the request trace (the engine only adds
+        # spans to it) and binds the id into the logging ContextVar so
+        # every log line of this generation carries it.
+        self._tracer.start(request_id, session_id)
+        with bind_request(request_id):
+            try:
+                await self._generate_traced(session_id, user_text, ws,
+                                            request_id)
+            finally:
+                self._tracer.finish(request_id)
+
+    async def _generate_traced(self, session_id: str, user_text: str,
+                               ws: web.WebSocketResponse,
+                               request_id: str) -> None:
         start = time.monotonic()
         full_text = ""
         stats: dict[str, Any] = {}
@@ -421,12 +454,14 @@ class WebSocketLLMServer:
                         if chunk:
                             await self._send(session_id, ws, {
                                 "type": "token", "data": chunk,
-                                "speakable": True})
+                                "speakable": True},
+                                request_id=request_id)
                             self._m_ws_tokens.inc()
                     else:
                         await self._send(session_id, ws,
                                          {"type": "token",
-                                          "data": event["text"]})
+                                          "data": event["text"]},
+                                         request_id=request_id)
                         self._m_ws_tokens.inc()
                 elif etype in ("done", "cancelled"):
                     stats = event.get("stats", {})
@@ -435,12 +470,14 @@ class WebSocketLLMServer:
                 elif etype == "tool_call":
                     await self._send(session_id, ws, {
                         "type": "tool_call", "tool": event.get("tool"),
-                        "arguments": event.get("arguments")})
+                        "arguments": event.get("arguments")},
+                        request_id=request_id)
                 elif etype == "error":
                     raise LLMServiceError(event.get("error", "engine error"))
             if tts and tts_buffer:
                 await self._send(session_id, ws, {
-                    "type": "token", "data": tts_buffer, "speakable": True})
+                    "type": "token", "data": tts_buffer,
+                    "speakable": True}, request_id=request_id)
             self.breaker.record_success()
             # Remote backends report tokens_generated=None when the
             # upstream supplied no usage accounting (chunks are not
@@ -481,7 +518,7 @@ class WebSocketLLMServer:
                     else finish_reason,
                     "provider": self.config.llm_provider,
                 },
-            })
+            }, request_id=request_id)
         except asyncio.CancelledError:
             self._backend().cancel(request_id)
             raise

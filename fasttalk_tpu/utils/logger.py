@@ -71,16 +71,27 @@ _configured = False
 
 
 def configure_logging(level: str = "INFO", log_path: str | None = None,
-                      console: bool = True) -> None:
-    """Install handlers on the ``fasttalk`` root logger (idempotent)."""
+                      console: bool = True,
+                      json_console: bool | None = None) -> None:
+    """Install handlers on the ``fasttalk`` root logger (idempotent).
+
+    ``json_console`` switches the console stream to structured JSON
+    lines (one object per record, request-id correlated) — for
+    deployments whose log shipper wants machine-parseable stderr.
+    Defaults from ``LOG_FORMAT=json``; the ANSI console otherwise.
+    """
     global _configured
+    if json_console is None:
+        json_console = os.getenv("LOG_FORMAT", "").strip().lower() in (
+            "json", "jsonl", "structured")
     root = logging.getLogger("fasttalk")
     root.setLevel(getattr(logging, level.upper(), logging.INFO))
     root.handlers.clear()
     root.propagate = False
     if console:
         h = logging.StreamHandler(sys.stderr)
-        h.setFormatter(ConsoleFormatter(color=sys.stderr.isatty()))
+        h.setFormatter(JsonFormatter() if json_console
+                       else ConsoleFormatter(color=sys.stderr.isatty()))
         root.addHandler(h)
     if log_path:
         os.makedirs(log_path, exist_ok=True)

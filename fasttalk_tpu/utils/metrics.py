@@ -10,6 +10,7 @@ real tokenizer token counts.
 from __future__ import annotations
 
 import bisect
+import math
 import threading
 import time
 from collections import deque
@@ -26,6 +27,10 @@ class Counter:
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount
+
+    def clear(self) -> None:
+        with self._lock:
+            self._value = 0.0
 
     @property
     def value(self) -> float:
@@ -51,6 +56,10 @@ class Gauge:
     def dec(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value -= amount
+
+    def clear(self) -> None:
+        with self._lock:
+            self._value = 0.0
 
     @property
     def value(self) -> float:
@@ -81,12 +90,22 @@ class Histogram:
             self._n += 1
             self._window.append(value)
 
+    def clear(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._n = 0
+            self._window.clear()
+
     @staticmethod
     def _quantile(sorted_window: list[float], q: float) -> float:
+        """Nearest-rank percentile: the smallest value with at least
+        q% of the window at or below it (the truncating-index form
+        biased small windows high — p50 of [1..4] picked 3)."""
         if not sorted_window:
             return 0.0
         idx = min(len(sorted_window) - 1,
-                  max(0, int(q / 100.0 * len(sorted_window))))
+                  max(0, math.ceil(q / 100.0 * len(sorted_window)) - 1))
         return sorted_window[idx]
 
     def percentile(self, q: float) -> float:
@@ -150,6 +169,20 @@ class MetricsRegistry:
                 out[name] = m.value
         return out
 
+    @staticmethod
+    def _escape_help(text: str) -> str:
+        """HELP-line escaping per the exposition format: backslash and
+        newline only (a literal newline would truncate the line and the
+        scraper would reject the next one)."""
+        return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+    @staticmethod
+    def _fmt_le(bound: float) -> str:
+        """Bucket bounds render as canonical floats ("1.0", "2.5"),
+        matching prometheus_client — int-vs-float formatting made the
+        same bound render two ways across histograms."""
+        return repr(float(bound))
+
     def prometheus(self) -> str:
         """Render all metrics in Prometheus exposition text format."""
         lines: list[str] = []
@@ -157,7 +190,7 @@ class MetricsRegistry:
             metrics = dict(self._metrics)
         for name, m in metrics.items():
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# HELP {name} {self._escape_help(m.help)}")
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {m.value}")
@@ -171,7 +204,8 @@ class MetricsRegistry:
                     counts, total, n = list(m._counts), m._sum, m._n
                 for bound, c in zip(m.buckets, counts):
                     acc += c
-                    lines.append(f'{name}_bucket{{le="{bound}"}} {acc}')
+                    lines.append(
+                        f'{name}_bucket{{le="{self._fmt_le(bound)}"}} {acc}')
                 lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
                 lines.append(f"{name}_sum {total}")
                 lines.append(f"{name}_count {n}")
@@ -190,6 +224,19 @@ def get_metrics() -> MetricsRegistry:
 
 
 def reset_metrics() -> None:
-    """Test hook: drop the process-wide registry."""
+    """Test hook: zero every metric IN PLACE (and restart the uptime
+    clock), keeping registry and metric object identity.
+
+    Dropping the registry — the old behaviour — orphaned every metric
+    object cached at module/instance construction time (engine._m_*,
+    ConnectionManager counters, ...): they kept incrementing objects no
+    registry would ever render, so tests (and any runtime caller of
+    reset) silently lost all subsequent counts."""
     global _registry
-    _registry = None
+    if _registry is None:
+        return
+    with _registry._lock:
+        metrics = list(_registry._metrics.values())
+    for m in metrics:
+        m.clear()
+    _registry.started_at = time.time()

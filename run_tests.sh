@@ -2,8 +2,33 @@
 # Run the test suite on a pure-CPU 8-virtual-device JAX, immune to the
 # hosting image's axon TPU plugin (PYTHONPATH sitecustomize) — tests must
 # not depend on, or hang on, the TPU tunnel.
+#
+#   ./run_tests.sh            full suite (extra pytest args pass through)
+#   ./run_tests.sh --obs      observability group only: tracer/export/
+#                             monitoring-endpoint tests plus a smoke run
+#                             of scripts/trace_report.py over the
+#                             checked-in sample dump, so the JSONL
+#                             export schema cannot silently drift.
 set -euo pipefail
 cd "$(dirname "$0")"
-exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
-    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python -m pytest tests/ "$@"
+
+PYENV=(env -u PYTHONPATH JAX_PLATFORMS=cpu
+       XLA_FLAGS="--xla_force_host_platform_device_count=8")
+
+if [[ "${1:-}" == "--obs" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_observability.py \
+        tests/test_utils.py "tests/test_engine.py::TestEngineTracing" "$@"
+    echo "--- trace_report smoke (tests/data/sample_trace.jsonl) ---"
+    out="$("${PYENV[@]}" python scripts/trace_report.py \
+        tests/data/sample_trace.jsonl)"
+    echo "$out"
+    # The report must recognise the core request phases by name.
+    for phase in queue_wait prefill decode_step ws_send; do
+        grep -q "$phase" <<<"$out" \
+            || { echo "trace_report smoke: missing phase $phase" >&2; exit 1; }
+    done
+    exit 0
+fi
+
+exec "${PYENV[@]}" python -m pytest tests/ "$@"

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Offline per-phase latency report from a JSONL trace dump.
+
+Input: the JSONL produced by ``GET /traces?format=jsonl`` (or
+``/traces/{request_id}?format=jsonl``) on the monitoring port — one
+span record per line (observability/export.py schema). Output: a
+per-phase table of count / total / p50 / p95 / p99 span durations, the
+thing a perf PR quotes before and after.
+
+Usage:
+    python scripts/trace_report.py dump.jsonl
+    curl -s localhost:9092/traces?format=jsonl | \
+        python scripts/trace_report.py -
+
+Runs stdlib-only (no jax, no aiohttp import at module level) so it
+works on a laptop against a dump scp'd from a TPU VM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from collections import defaultdict
+from typing import Any, Iterable, TextIO
+
+
+def load_records(fp: TextIO) -> list[dict[str, Any]]:
+    """Parse JSONL span records (same validation as
+    observability.export.load_jsonl, inlined to stay stdlib-only)."""
+    records = []
+    for i, line in enumerate(fp, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"line {i}: not valid JSON ({e})") from e
+        if not isinstance(obj, dict) or "span" not in obj:
+            raise ValueError(f"line {i}: not a span record")
+        records.append(obj)
+    return records
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile (matches utils.metrics.Histogram)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, math.ceil(q / 100.0 * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def phase_table(records: Iterable[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Aggregate span durations per phase name, sorted by total time."""
+    by_phase: dict[str, list[float]] = defaultdict(list)
+    for rec in records:
+        by_phase[str(rec["span"])].append(float(rec.get("dur_ms", 0.0)))
+    rows = []
+    for name, durs in by_phase.items():
+        durs.sort()
+        rows.append({
+            "phase": name,
+            "count": len(durs),
+            "total_ms": sum(durs),
+            "p50_ms": percentile(durs, 50),
+            "p95_ms": percentile(durs, 95),
+            "p99_ms": percentile(durs, 99),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def format_table(rows: list[dict[str, Any]]) -> str:
+    headers = ("phase", "count", "total_ms", "p50_ms", "p95_ms", "p99_ms")
+    cells = [[str(r["phase"]), str(r["count"]),
+              f"{r['total_ms']:.1f}", f"{r['p50_ms']:.2f}",
+              f"{r['p95_ms']:.2f}", f"{r['p99_ms']:.2f}"] for r in rows]
+    widths = [max(len(h), *(len(c[i]) for c in cells)) if cells else len(h)
+              for i, h in enumerate(headers)]
+    def fmt(row: list[str]) -> str:
+        return "  ".join(
+            c.ljust(widths[i]) if i == 0 else c.rjust(widths[i])
+            for i, c in enumerate(row))
+    lines = [fmt(list(headers)), fmt(["-" * w for w in widths])]
+    lines.extend(fmt(c) for c in cells)
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="JSONL trace dump path, or - for stdin")
+    args = ap.parse_args(argv)
+    try:
+        if args.dump == "-":
+            records = load_records(sys.stdin)
+        else:
+            with open(args.dump, encoding="utf-8") as fp:
+                records = load_records(fp)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not records:
+        print("error: no span records in dump", file=sys.stderr)
+        return 1
+    requests = {r["request_id"] for r in records
+                if r.get("request_id")}
+    print(f"{len(records)} spans across {len(requests)} requests")
+    print()
+    print(format_table(phase_table(records)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,8 +44,10 @@ def pytest_pyfunc_call(pyfuncitem):
 
 @pytest.fixture(autouse=True)
 def _fresh_metrics():
+    from fasttalk_tpu.observability.trace import reset_tracer
     from fasttalk_tpu.utils.metrics import reset_metrics
 
     reset_metrics()
+    reset_tracer()
     yield
     reset_metrics()

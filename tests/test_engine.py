@@ -302,6 +302,43 @@ class TestTPUEngine:
         assert all(r[-1]["type"] == "done" for r in res)
 
 
+class TestEngineTracing:
+    """Request-lifecycle tracing through the real engine (ISSUE 1).
+    Lives here (not test_observability.py) to reuse the module's
+    compiled engine fixture — the full suite runs near its time
+    budget, and a second tiny-model compile is the avoidable cost."""
+
+    def test_full_request_trace(self, engine):
+        from fasttalk_tpu.observability.trace import get_tracer
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        tracer = get_tracer()
+        events = _collect(engine, "trace-r1", "trace-s1",
+                          [{"role": "user", "content": "hello tracing"}],
+                          GenerationParams(max_tokens=12, **GREEDY))
+        assert events[-1]["type"] == "done"
+        # Engine-seam caller: the engine owned and finished the trace.
+        trace = tracer.get("trace-r1")
+        assert trace is not None and trace.finished
+        names = [s.name for s in trace.spans]
+        for phase in ("queue_wait", "prefill", "first_token",
+                      "decode_step", "decode", "detokenize"):
+            assert phase in names, f"missing span {phase}: {names}"
+        decode = next(s for s in trace.spans if s.name == "decode")
+        assert decode.attrs["tokens"] == \
+            events[-1]["stats"]["tokens_generated"]
+        step = next(s for s in trace.spans if s.name == "decode_step")
+        assert 0 < step.attrs["occupancy"] <= 1
+        assert step.attrs["batch"] >= 1
+        # Engine-step telemetry ring saw the same calls.
+        assert any(r.name == "engine_step" for r in tracer.steps())
+        # Phase histograms fed.
+        m = get_metrics()
+        assert m.histogram("queue_wait_ms").summary()["count"] >= 1
+        assert m.histogram("prefill_ms").summary()["count"] >= 1
+        assert m.histogram("inter_token_ms").summary()["count"] >= 1
+
+
 class TestChatTemplates:
     MSGS = [
         {"role": "system", "content": "be brief"},

@@ -1,0 +1,369 @@
+"""Observability tests: span tracer, exporters, monitoring endpoints,
+engine/server instrumentation, and the metrics satellites of ISSUE 1
+(reset-in-place, nearest-rank percentiles, Prometheus escaping)."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.observability.export import (chrome_trace, jsonl_dump,
+                                               load_jsonl)
+from fasttalk_tpu.observability.trace import (Tracer, bind_request,
+                                              get_tracer)
+from fasttalk_tpu.utils.logger import request_id_var
+from fasttalk_tpu.utils.metrics import (Histogram, get_metrics,
+                                        reset_metrics)
+
+_SPEC = importlib.util.spec_from_file_location(
+    "trace_report",
+    os.path.join(os.path.dirname(__file__), "..", "scripts",
+                 "trace_report.py"))
+trace_report = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(trace_report)
+
+SAMPLE = os.path.join(os.path.dirname(__file__), "data",
+                      "sample_trace.jsonl")
+
+
+class TestTracer:
+    def test_lifecycle_and_ring(self):
+        tr = Tracer(enabled=True, ring_size=2)
+        assert tr.start("r1", "s1") is True
+        assert tr.start("r1", "s1") is False  # already in flight
+        tr.add_span("r1", "queue_wait", 1.0, 2.0, slot=3)
+        assert tr.inflight_summary()[0]["request_id"] == "r1"
+        tr.finish("r1")
+        tr.finish("r1")  # idempotent
+        assert tr.inflight_summary() == []
+        got = tr.get("r1")
+        assert got is not None and got.finished
+        assert got.spans[0].name == "queue_wait"
+        assert got.spans[0].dur_ms == pytest.approx(1000.0)
+        assert got.spans[0].attrs == {"slot": 3}
+        # Ring stays bounded: oldest trace falls off.
+        for i in range(3):
+            tr.start(f"x{i}", "s")
+            tr.finish(f"x{i}")
+        assert tr.get("r1") is None
+        assert len(tr.completed()) == 2
+
+    def test_disabled_is_noop(self):
+        tr = Tracer(enabled=False)
+        assert tr.start("r1", "s1") is False
+        tr.add_span("r1", "a", 0.0, 1.0)
+        tr.step("engine_step", 0.0, 1.0)
+        tr.finish("r1")
+        assert tr.completed() == []
+        assert tr.steps() == []
+        with tr.span("r1", "b"):
+            pass
+
+    def test_span_context_manager_and_phase(self):
+        tr = Tracer(enabled=True)
+        tr.start("r1", "s1")
+        with tr.span("r1", "ws_send", frame="token"):
+            pass
+        tr.set_phase("r1", "decode", slot=1)
+        trace = tr.get("r1")
+        assert trace.phase == "decode"
+        assert trace.spans[0].name == "ws_send"
+        assert trace.spans[0].t1 >= trace.spans[0].t0
+
+    def test_span_cap(self):
+        from fasttalk_tpu.observability import trace as trace_mod
+        tr = Tracer(enabled=True)
+        tr.start("r1", "s1")
+        for i in range(trace_mod._MAX_SPANS_PER_TRACE + 5):
+            tr.add_span("r1", "decode_step", 0.0, 1.0)
+        trace = tr.get("r1")
+        assert len(trace.spans) == trace_mod._MAX_SPANS_PER_TRACE
+        assert trace.dropped_spans == 5
+        # Once-per-request summary spans bypass the cap: a long
+        # generation keeps its phase breakdown.
+        tr.add_span("r1", "decode", 0.0, 2.0, summary=True, tokens=9)
+        assert trace.spans[-1].name == "decode"
+
+    def test_steps_ring(self):
+        tr = Tracer(enabled=True, step_ring_size=3)
+        for i in range(5):
+            tr.step("engine_step", float(i), float(i) + 0.1, batch=i)
+        steps = tr.steps()
+        assert len(steps) == 3
+        assert steps[-1].attrs["batch"] == 4
+
+    def test_bind_request_correlates_logger_var(self):
+        assert request_id_var.get() is None
+        with bind_request("req-42"):
+            assert request_id_var.get() == "req-42"
+        assert request_id_var.get() is None
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv("TRACE_ENABLED", "0")
+        assert Tracer().enabled is False
+        monkeypatch.setenv("TRACE_ENABLED", "1")
+        assert Tracer().enabled is True
+
+
+class TestExport:
+    def _traced(self):
+        tr = Tracer(enabled=True)
+        tr.start("r1", "s1")
+        t = time.monotonic()
+        tr.add_span("r1", "queue_wait", t, t + 0.005)
+        tr.add_span("r1", "prefill", t + 0.005, t + 0.030, slot=0)
+        tr.add_span("r1", "decode_step", t + 0.030, t + 0.050,
+                    batch=2, occupancy=0.5)
+        tr.add_span("r1", "ws_send", t + 0.051, t + 0.052, frame="token")
+        tr.finish("r1")
+        tr.step("engine_step", t + 0.030, t + 0.050, batch=2)
+        return tr
+
+    def test_chrome_trace_valid(self):
+        tr = self._traced()
+        doc = chrome_trace(tr, tr.completed(), tr.steps())
+        json.dumps(doc)  # must serialize
+        events = doc["traceEvents"]
+        complete = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in complete} == {
+            "queue_wait", "prefill", "decode_step", "ws_send",
+            "engine_step"}
+        for e in complete:
+            assert e["dur"] >= 0
+            assert e["ts"] > 0
+            assert isinstance(e["pid"], int)
+            assert isinstance(e["tid"], int)
+        # Request rows carry metadata names; engine steps ride tid 0.
+        meta = [e for e in events if e["ph"] == "M"]
+        assert any(e["args"]["name"] == "req r1" for e in meta)
+        step = next(e for e in complete if e["name"] == "engine_step")
+        assert step["tid"] == 0
+
+    def test_jsonl_round_trip(self, tmp_path):
+        tr = self._traced()
+        text = jsonl_dump(tr, tr.completed(), tr.steps())
+        p = tmp_path / "dump.jsonl"
+        p.write_text(text)
+        with open(p) as fp:
+            records = load_jsonl(fp)
+        assert len(records) == 5
+        spans = {r["span"] for r in records}
+        assert {"queue_wait", "prefill", "decode_step",
+                "ws_send", "engine_step"} <= spans
+        step = next(r for r in records if r["span"] == "engine_step")
+        assert step["request_id"] is None
+        ws = next(r for r in records if r["span"] == "ws_send")
+        assert ws["request_id"] == "r1"
+        assert ws["dur_ms"] == pytest.approx(1.0, rel=0.2)
+
+    def test_load_jsonl_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"span": "a", "dur_ms": 1}\nnot json\n')
+        with open(p) as fp:
+            with pytest.raises(ValueError, match="line 2"):
+                load_jsonl(fp)
+        p.write_text('{"no_span_key": 1}\n')
+        with open(p) as fp:
+            with pytest.raises(ValueError, match="not a span record"):
+                load_jsonl(fp)
+
+
+class TestMetricsSatellites:
+    def test_quantile_nearest_rank_exact(self):
+        # Truncating index biased small windows high: p50 of [1..4]
+        # used to pick 3; nearest-rank picks 2.
+        assert Histogram._quantile([1.0, 2.0, 3.0, 4.0], 50) == 2.0
+        vals = [float(v) for v in range(1, 101)]
+        assert Histogram._quantile(vals, 50) == 50.0
+        assert Histogram._quantile(vals, 95) == 95.0
+        assert Histogram._quantile(vals, 99) == 99.0
+        assert Histogram._quantile(vals, 100) == 100.0
+        assert Histogram._quantile([7.0], 50) == 7.0
+        assert Histogram._quantile([], 95) == 0.0
+
+    def test_reset_clears_in_place(self):
+        m = get_metrics()
+        c = m.counter("stale_total", "x")
+        g = m.gauge("stale_gauge", "x")
+        h = m.histogram("stale_ms", "x")
+        c.inc(5)
+        g.set(3)
+        h.observe(10.0)
+        reset_metrics()
+        # Same registry, same objects, zeroed values: a module that
+        # cached `c` at import keeps feeding the rendered registry.
+        assert get_metrics() is m
+        assert m.counter("stale_total") is c
+        assert c.value == 0 and g.value == 0
+        assert h.summary()["count"] == 0
+        c.inc()
+        assert m.to_dict()["stale_total"] == 1
+
+    def test_prometheus_escaping_and_le_format(self):
+        m = get_metrics()
+        m.counter("esc_total", "line one\nline two \\ backslash").inc()
+        m.histogram("lat_ms", "latency", buckets=(1, 2.5)).observe(2.0)
+        text = m.prometheus()
+        assert "# HELP esc_total line one\\nline two \\\\ backslash" \
+            in text
+        # Every line must be single-line (a raw newline in HELP would
+        # truncate it and corrupt the next line).
+        for line in text.splitlines():
+            assert not line.startswith("line two")
+        assert 'lat_ms_bucket{le="1.0"} 0' in text
+        assert 'lat_ms_bucket{le="2.5"} 1' in text
+        assert 'lat_ms_bucket{le="+Inf"} 1' in text
+
+
+class TestMonitoringEndpoints:
+    async def _client(self, ready=True):
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+        app = build_monitoring_app(ready_check=lambda: ready)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    async def test_metrics_and_health_routes(self):
+        get_metrics().counter("engine_tokens_generated_total").inc(7)
+        client = await self._client()
+        try:
+            r = await client.get("/metrics")
+            assert r.status == 200
+            assert "engine_tokens_generated_total 7" in await r.text()
+
+            r = await client.get("/metrics.json")
+            assert r.status == 200
+            body = await r.json()
+            assert body["engine_tokens_generated_total"] == 7
+            assert "uptime_seconds" in body
+
+            assert (await client.get("/health/ready")).status == 200
+            assert (await client.get("/health/live")).status == 200
+        finally:
+            await client.close()
+
+    async def test_ready_degrades(self):
+        client = await self._client(ready=False)
+        try:
+            r = await client.get("/health/ready")
+            assert r.status == 503
+            assert (await r.json())["status"] == "not_ready"
+            # liveness is independent of readiness
+            assert (await client.get("/health/live")).status == 200
+        finally:
+            await client.close()
+
+    async def test_debug_requests_and_traces(self):
+        tracer = get_tracer()
+        tracer.start("live-req", "sess-a")
+        tracer.set_phase("live-req", "decode")
+        tracer.start("done-req", "sess-b")
+        t = time.monotonic()
+        tracer.add_span("done-req", "queue_wait", t, t + 0.002)
+        tracer.add_span("done-req", "ws_send", t + 0.002, t + 0.003)
+        tracer.finish("done-req")
+        client = await self._client()
+        try:
+            r = await client.get("/debug/requests")
+            body = await r.json()
+            assert body["enabled"] is True
+            live = {x["request_id"]: x for x in body["requests"]}
+            assert live["live-req"]["phase"] == "decode"
+            assert live["live-req"]["age_s"] >= 0
+
+            r = await client.get("/traces")
+            body = await r.json()
+            assert "done-req" in body["completed"]
+            assert "live-req" in body["inflight"]
+
+            r = await client.get("/traces?format=chrome")
+            doc = await r.json()
+            names = {e["name"] for e in doc["traceEvents"]
+                     if e["ph"] == "X"}
+            assert {"queue_wait", "ws_send"} <= names
+
+            r = await client.get("/traces?format=jsonl")
+            assert r.status == 200
+            assert r.content_type == "application/x-ndjson"
+            lines = [json.loads(x) for x in (await r.text()).splitlines()]
+            assert any(x["span"] == "queue_wait" for x in lines)
+
+            assert (await client.get("/traces?format=xml")).status == 400
+
+            r = await client.get("/traces/done-req")
+            doc = await r.json()
+            assert any(e.get("args", {}).get("request_id") == "done-req"
+                       for e in doc["traceEvents"])
+            # An in-flight request is downloadable too.
+            assert (await client.get("/traces/live-req")).status == 200
+            assert (await client.get("/traces/nope")).status == 404
+        finally:
+            await client.close()
+        tracer.finish("live-req")
+
+
+# The TPU-engine integration test for tracing lives in
+# tests/test_engine.py (TestEngineTracing): it reuses that module's
+# already-compiled engine fixture instead of paying a second tiny-model
+# XLA compile here — the full tier-1 suite runs close to its time
+# budget.
+
+
+class TestServerTracing:
+    async def test_ws_roundtrip_records_ws_send_spans(self):
+        from tests.test_serving import (make_config, make_ws_client,
+                                        recv_json)
+        from fasttalk_tpu.engine.fake import FakeEngine
+        from fasttalk_tpu.serving.server import WebSocketLLMServer
+
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false")
+        engine = FakeEngine(delay_s=0.0)
+        engine.start()
+        server = WebSocketLLMServer(config, engine)
+        client = await make_ws_client(server)
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await recv_json(ws)  # session_started
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            while (await recv_json(ws))["type"] != "response_complete":
+                pass
+            await ws.close()
+        finally:
+            await client.close()
+        tracer = get_tracer()
+        done = tracer.completed()
+        assert len(done) == 1
+        spans = [s for s in done[0].spans if s.name == "ws_send"]
+        assert spans, "no ws_send spans recorded"
+        assert all(s.attrs["frame"] in ("token", "response_complete")
+                   for s in spans)
+        m = get_metrics()
+        assert m.histogram("ws_send_ms").summary()["count"] >= len(spans)
+        assert m.counter("ws_messages_received_total").value >= 1
+        assert m.counter("ws_messages_sent_total").value >= 1
+
+
+class TestTraceReportScript:
+    def test_main_on_sample(self, capsys):
+        assert trace_report.main([SAMPLE]) == 0
+        out = capsys.readouterr().out
+        for phase in ("queue_wait", "prefill", "decode_step", "ws_send"):
+            assert phase in out
+        assert "p95_ms" in out
+
+    def test_main_rejects_missing_and_empty(self, tmp_path, capsys):
+        assert trace_report.main([str(tmp_path / "nope.jsonl")]) == 1
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert trace_report.main([str(empty)]) == 1
+
+    def test_percentile_matches_histogram(self):
+        vals = sorted(float(v) for v in range(1, 101))
+        for q in (50, 95, 99):
+            assert trace_report.percentile(vals, q) == \
+                Histogram._quantile(vals, q)
