@@ -11,6 +11,15 @@ engine's async seam).
 ``BENCH_MODE=engine`` falls back to the engine-seam measurement
 (no sockets) for isolating engine regressions.
 
+``BENCH_MODE=overload`` runs the admission-control scenario
+(docs/SCHEDULING.md): an OPEN-LOOP arrival process (one request every
+``BENCH_ARRIVAL_MS`` ms for ``BENCH_OVERLOAD_S`` s, regardless of
+completions — the regime where the r1 unbounded queue grew without
+bound) against a bounded scheduler, reporting shed rate, expiry rate,
+max observed queue depth vs the bound, and admitted-request queue-wait
+p50/p95/p99. The headline value is GOODPUT: streamed tokens/s of
+admitted requests while the excess is being shed with retry_after.
+
 Weights are random-init (no checkpoint in the image): compute cost is
 identical to real weights, which is what throughput measures.
 
@@ -196,6 +205,114 @@ async def bench_ws(cfg) -> dict:
             "agg_tps": agg_tps, "p50_ttft_ms": p50_ttft}
 
 
+# ---------------- overload mode (admission control) ----------------
+
+async def bench_overload(cfg) -> dict:
+    """Open-loop overload: arrivals above service capacity. Reports how
+    the scheduler degrades — who was shed (immediately, with
+    retry_after), who expired in the queue, and what queue wait the
+    admitted requests actually paid."""
+    from fasttalk_tpu.engine.engine import GenerationParams
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.utils.errors import AdmissionRejected
+    from fasttalk_tpu.utils.metrics import get_metrics
+
+    arrival_s = float(os.environ.get("BENCH_ARRIVAL_MS", "25")) / 1000.0
+    duration_s = float(os.environ.get("BENCH_OVERLOAD_S", "20"))
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "2.0"))
+
+    t0 = time.monotonic()
+    engine = build_engine(cfg)
+    log(f"engine built in {time.monotonic() - t0:.1f}s; warming up...")
+    engine.warmup(cfg.warmup)
+    engine.start()
+
+    out = {"arrived": 0, "done": 0, "shed": 0, "expired": 0,
+           "error": 0, "tokens": 0}
+    max_depth = 0
+
+    async def one(i: int) -> None:
+        params = GenerationParams(temperature=0.7, top_k=40, top_p=0.9,
+                                  max_tokens=MAX_TOKENS,
+                                  deadline_s=deadline_s)
+        sid = f"ov-s{i}"
+        try:
+            async for ev in engine.generate(
+                    f"ov-{i}", sid,
+                    [{"role": "user", "content": f"[{i}] {PROMPT}"}],
+                    params):
+                if ev["type"] == "done":
+                    out["done"] += 1
+                    out["tokens"] += ev["stats"]["tokens_generated"]
+                elif ev["type"] == "error":
+                    key = ("expired"
+                           if ev.get("code") == "deadline_expired"
+                           else "error")
+                    out[key] += 1
+        except AdmissionRejected as e:
+            assert e.retry_after is not None  # shed always hints
+            out["shed"] += 1
+        finally:
+            engine.release_session(sid)
+
+    try:
+        log("overload warmup (compile)...")
+        await one(999_999)
+        for k in out:
+            out[k] = 0
+        rate = 1.0 / arrival_s
+        log(f"open loop: {rate:.0f} req/s for {duration_s:.0f}s, "
+            f"deadline {deadline_s}s, queue bound "
+            f"{cfg.sched_queue_bound}...")
+        t1 = time.monotonic()
+        tasks = []
+        i = 0
+        while time.monotonic() - t1 < duration_s:
+            tasks.append(asyncio.create_task(one(i)))
+            out["arrived"] += 1
+            i += 1
+            depth = engine.get_stats()["scheduler"]["depth"]
+            max_depth = max(max_depth, depth)
+            await asyncio.sleep(arrival_s)
+        await asyncio.gather(*tasks)
+        wall = time.monotonic() - t1
+    finally:
+        engine.shutdown()
+
+    qw = get_metrics().histogram("queue_wait_ms")
+    arrived = max(1, out["arrived"])
+    res = {
+        "arrival_rate_rps": round(1.0 / arrival_s, 2),
+        "duration_s": round(wall, 2),
+        "queue_bound": cfg.sched_queue_bound,
+        "max_queue_depth": max_depth,
+        "arrived": out["arrived"],
+        "admitted_done": out["done"],
+        "shed": out["shed"],
+        "expired": out["expired"],
+        "errors": out["error"],
+        "shed_rate": round(out["shed"] / arrived, 4),
+        "expiry_rate": round(out["expired"] / arrived, 4),
+        "goodput_tok_s": round(out["tokens"] / wall, 1),
+        "queue_wait_ms": {"p50": round(qw.percentile(50), 1),
+                          "p95": round(qw.percentile(95), 1),
+                          "p99": round(qw.percentile(99), 1)},
+    }
+    log(f"  {res['arrived']} arrived: {res['admitted_done']} done, "
+        f"{res['shed']} shed ({res['shed_rate']:.1%}), "
+        f"{res['expired']} expired ({res['expiry_rate']:.1%}); "
+        f"max depth {max_depth}/{cfg.sched_queue_bound}; "
+        f"admitted queue-wait p50/p95/p99 "
+        f"{res['queue_wait_ms']['p50']:.0f}/"
+        f"{res['queue_wait_ms']['p95']:.0f}/"
+        f"{res['queue_wait_ms']['p99']:.0f} ms; "
+        f"goodput {res['goodput_tok_s']:.1f} tok/s")
+    if max_depth > cfg.sched_queue_bound:
+        log(f"  WARNING: observed queue depth {max_depth} exceeded the "
+            f"bound {cfg.sched_queue_bound}")
+    return res
+
+
 async def bench_engine(engine) -> dict:
     log("warmup (compiling prefill + decode buckets)...")
     t0 = time.monotonic()
@@ -239,10 +356,20 @@ def main() -> None:
 
     from fasttalk_tpu.utils.config import Config
 
+    extra = {}
+    if MODE == "overload":
+        # Small bound + short deadline so the open-loop scenario
+        # actually exercises shed AND expiry within the run.
+        extra = dict(
+            sched_queue_bound=int(os.environ.get("BENCH_QUEUE_BOUND",
+                                                 "32")),
+            sched_default_deadline_s=float(
+                os.environ.get("BENCH_DEADLINE_S", "2.0")))
     cfg = Config(llm_provider="tpu", model_name=MODEL,
                  decode_slots=NUM_SESSIONS, max_model_len=2048,
                  default_context_window=2048, prefill_chunk=512,
                  dtype="bfloat16", port=PORT, monitoring_port=PORT + 1,
+                 **extra,
                  # Plain chat serving path (no tool-section system
                  # prompt): keeps the measured prompt identical to the
                  # reference's bench conditions; the agent path has its
@@ -254,6 +381,25 @@ def main() -> None:
                  # (ops/pallas_int8.py), and the same config the
                  # README's model table quotes.
                  quantize=os.environ.get("BENCH_QUANTIZE", "int8"))
+    if MODE == "overload":
+        r = asyncio.run(bench_overload(cfg))
+        print(json.dumps({
+            "metric": (f"overload goodput tok/s, {MODEL}: open-loop "
+                       f"{r['arrival_rate_rps']:.0f} req/s x "
+                       f"{r['duration_s']:.0f}s, bound "
+                       f"{r['queue_bound']} (max depth "
+                       f"{r['max_queue_depth']}), shed "
+                       f"{r['shed_rate']:.1%}, expired "
+                       f"{r['expiry_rate']:.1%}, admitted queue-wait "
+                       f"p50/p95/p99 {r['queue_wait_ms']['p50']:.0f}/"
+                       f"{r['queue_wait_ms']['p95']:.0f}/"
+                       f"{r['queue_wait_ms']['p99']:.0f} ms"),
+            "value": r["goodput_tok_s"],
+            "unit": "tok/s",
+            "vs_baseline": round(r["goodput_tok_s"] / BASELINE_TOKS, 2),
+            "overload": r,
+        }), flush=True)
+        return
     if MODE == "ws":
         r = asyncio.run(bench_ws(cfg))
         seam = "WebSocket"

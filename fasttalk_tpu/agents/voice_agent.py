@@ -210,7 +210,7 @@ class VoiceAgent:
                ttft: float | None) -> dict:
         dur = time.monotonic() - started
         toks = agg["tokens_generated"]
-        return {
+        out = {
             "type": terminal["type"],
             "finish_reason": terminal.get("finish_reason", "stop"),
             "stats": {
@@ -221,6 +221,14 @@ class VoiceAgent:
                 "prompt_tokens": agg.get("prompt_tokens", 0),
             },
         }
+        # Error events must keep their payload: the serving layer keys
+        # load-shed handling (deadline_expired → retry_after frame /
+        # 429, breaker untouched) on `code`, and stripping it here made
+        # every agent-path expiry count as a backend failure.
+        for key in ("error", "code", "retry_after"):
+            if key in terminal:
+                out[key] = terminal[key]
+        return out
 
     async def aclose(self) -> None:
         """Release tool resources (search backend HTTP session)."""

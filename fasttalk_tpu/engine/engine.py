@@ -74,7 +74,9 @@ from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
 from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
                                        sample_tokens)
-from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from fasttalk_tpu.scheduling.scheduler import RequestScheduler
+from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
+                                       LLMServiceError)
 from fasttalk_tpu.utils.logger import get_logger
 from fasttalk_tpu.utils.metrics import get_metrics
 
@@ -122,11 +124,31 @@ class GenerationParams:
             raise ValueError("presence_penalty must be finite")
         if not math.isfinite(self.frequency_penalty):
             raise ValueError("frequency_penalty must be finite")
+        if self.priority not in ("interactive", "bulk"):
+            raise ValueError(
+                f"priority must be 'interactive' or 'bulk', "
+                f"got {self.priority!r}")
+        if self.deadline_s is not None:
+            try:
+                ok = math.isfinite(self.deadline_s) and self.deadline_s > 0
+            except TypeError:
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"deadline_s must be a positive number, "
+                    f"got {self.deadline_s!r}")
     # Text-completion mode (/v1/completions): the prompt is the joined
     # message content, tokenized verbatim (BOS + bytes, no chat
     # template). Out of band on purpose — an in-band role sentinel
     # would let chat clients bypass the template.
     raw_prompt: bool = False
+    # Admission-control class and queue TTL (scheduling/scheduler.py):
+    # "interactive" admits before "bulk"; deadline_s bounds how long
+    # the request may wait in the admission queue before it is expired
+    # with a terminal event (None = the scheduler's configured
+    # default). Client-settable per session/request.
+    priority: str = "interactive"
+    deadline_s: float | None = None
 
 
 def raw_prompt_text(messages: list[dict]) -> str:
@@ -216,6 +238,16 @@ class EngineBase:
         """Pre-compile hot shapes before serving traffic (no-op by
         default; the TPU engine overrides)."""
 
+    def begin_drain(self) -> None:
+        """Graceful-drain mode: reject NEW submissions (with a
+        retry_after hint) while in-flight and already-queued requests
+        finish. No-op by default; engines with admission control
+        override. Wired into server shutdown (serving/server.py)."""
+
+    def pending_requests(self) -> int:
+        """Requests still queued or running (drain-progress probe)."""
+        return 0
+
 
 class TPUEngine(EngineBase):
     """The real engine. Owns params, KV cache, tokenizer, decode loop."""
@@ -231,7 +263,10 @@ class TPUEngine(EngineBase):
                  sampling_method: str = "fast",
                  spec_decode: str = "off", spec_draft_len: int = 7,
                  spec_breakeven: float = 1.45,
-                 shared_prefix: bool = True):
+                 shared_prefix: bool = True,
+                 queue_bound: int = 256,
+                 default_deadline_s: float = 30.0,
+                 bulk_aging_s: float = 5.0):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -373,7 +408,16 @@ class TPUEngine(EngineBase):
         self.call_sink: Any = None
 
         self._commands: queue.Queue = queue.Queue()
-        self._waiting: list[_Request] = []
+        # Admission control replaces the r1 unbounded FIFO `_waiting`
+        # list: bounded queue, priority classes, per-session fairness,
+        # deadlines, shed-with-retry_after, graceful drain
+        # (scheduling/scheduler.py, docs/SCHEDULING.md). Submissions go
+        # straight into the scheduler from the asyncio side (so shed
+        # decisions are synchronous); the engine thread pops.
+        self._sched = RequestScheduler(
+            queue_bound=queue_bound,
+            default_deadline_s=default_deadline_s,
+            bulk_aging_s=bulk_aging_s, slots=num_slots)
         self._prefilling: list[_PrefillState] = []  # long prompts, FIFO
         self._running: dict[int, _Request] = {}  # slot index -> request
         self._by_id: dict[str, _Request] = {}
@@ -565,7 +609,11 @@ class TPUEngine(EngineBase):
             if self._thread is not None and self._thread.is_alive():
                 return False  # still tearing down; try again later
             log.warning("engine restart: rebuilding device decode state")
-            self._waiting.clear()
+            # Entries whose requests were terminal-errored by
+            # _abort_all must not be re-admitted; entries submitted in
+            # the crash race window (after the sweep) survive and the
+            # new thread will admit them.
+            self._sched.remove_finished()
             self._prefilling.clear()
             self._running.clear()
             self._release_after.clear()
@@ -797,10 +845,29 @@ class TPUEngine(EngineBase):
         # start() returns True only for engine-seam callers (tests,
         # BENCH_MODE=engine), who then own the finish here.
         trace_owned = self._tracer.start(request_id, session_id)
+        if self._tracer.enabled:
+            self._tracer.set_phase(request_id, "queued",
+                                   priority=params.priority)
         # Register before enqueueing so an immediate cancel() can't race
         # the engine thread's command drain.
         self._by_id[request_id] = req
-        self._commands.put(("submit", req))
+        try:
+            # Admission control: bounded queue, deadline-aware,
+            # drain-aware. A shed raises AdmissionRejected (with
+            # retry_after) synchronously — the caller gets a terminal
+            # signal immediately instead of queueing to time out.
+            self._sched.submit(request_id, session_id,
+                               priority=params.priority,
+                               deadline_s=params.deadline_s, payload=req)
+        except AdmissionRejected:
+            self._by_id.pop(request_id, None)
+            req.finished = True
+            if self._tracer.enabled:
+                self._tracer.event(request_id, "shed")
+            if trace_owned:
+                self._tracer.finish(request_id)
+            raise
+        self._commands.put(("kick", None))  # wake the engine thread
         terminal = False
         try:
             while True:
@@ -829,6 +896,25 @@ class TPUEngine(EngineBase):
     def release_session(self, session_id: str) -> None:
         self._commands.put(("release", session_id))
 
+    def begin_drain(self) -> None:
+        """Stop admitting new submissions (they shed with retry_after);
+        queued and in-flight requests run to completion. Used by server
+        shutdown so a rolling restart finishes its users' sentences."""
+        self._sched.begin_drain()
+        if self._started:
+            self._commands.put(("kick", None))
+
+    def pending_requests(self) -> int:
+        """Requests not yet terminal (queued + prefilling + running):
+        the drain loop polls this toward zero."""
+        return len(self._by_id)
+
+    def scheduler_debug(self) -> dict:
+        """Scheduler state + queued entries (position, priority,
+        remaining deadline) for the monitoring port's /debug/requests."""
+        return {"stats": self._sched.stats(),
+                "queued": self._sched.snapshot()}
+
     def check_connection(self) -> bool:
         return self._started and self._thread is not None \
             and self._thread.is_alive()
@@ -850,7 +936,8 @@ class TPUEngine(EngineBase):
     def get_stats(self) -> dict:
         return {
             "slots": self.slots.stats(),
-            "waiting": len(self._waiting),
+            "waiting": len(self._sched),
+            "scheduler": self._sched.stats(),
             "running": len(self._running),
         }
 
@@ -1460,7 +1547,7 @@ class TPUEngine(EngineBase):
                     and not self._prefilling and not self._pending_firsts
                 if not self._drain_commands(block=idle):
                     break
-                if self._waiting:
+                if len(self._sched):
                     if not self._running and not self._inflight \
                             and not self._prefilling:
                         # Burst coalescing: from idle, the first request
@@ -1513,7 +1600,7 @@ class TPUEngine(EngineBase):
                     # command queue is only read between iterations).
                     self._retire_oldest()
                 self._m_active.set(len(self._running))
-                self._m_queue.set(len(self._waiting)
+                self._m_queue.set(len(self._sched)
                                   + len(self._prefilling))
         except Exception as e:  # engine thread must not die silently
             log.critical(f"engine thread crashed: {e}", exc_info=True)
@@ -1543,7 +1630,7 @@ class TPUEngine(EngineBase):
                 self._emit(req, {"type": "error", "error": reason,
                                  "code": "internal_error"})
         self._by_id.clear()
-        self._waiting.clear()
+        self._sched.clear()
         self._prefilling.clear()
         self._running.clear()
         self._inflight.clear()
@@ -1559,22 +1646,15 @@ class TPUEngine(EngineBase):
             block = False
             if cmd == "stop":
                 return False
-            if cmd == "submit":
-                if arg.finished:
-                    # Already terminal (errored by _abort_all during a
-                    # crash before this drain saw it): admitting it
-                    # would leak a slot on a request nobody consumes.
-                    pass
-                elif arg.cancelled:  # cancelled before the drain saw it
-                    self._finish(arg, "cancelled")
-                else:
-                    self._waiting.append(arg)
+            if cmd == "kick":
+                pass  # submission landed in the scheduler; just wake
             elif cmd == "cancel":
                 req = self._by_id.get(arg)
                 if req is not None:
                     req.cancelled = True
-                    if req in self._waiting:
-                        self._waiting.remove(req)
+                    if self._sched.cancel(arg) is not None:
+                        # Still queued: terminal event now, O(1) (the
+                        # r1 list did a linear remove scan here).
                         self._finish(req, "cancelled")
             elif cmd == "release":
                 slot = self.slots.lookup(arg)
@@ -1583,17 +1663,44 @@ class TPUEngine(EngineBase):
                 else:
                     self.slots.release_session(arg)
 
+    def _expire_queued(self, now: float | None = None) -> None:
+        """Terminal-event every queued request past its deadline — they
+        must never touch the TPU (ISSUE 2: predictable degradation; a
+        request that already blew its latency budget serves nobody)."""
+        now = time.monotonic() if now is None else now
+        for entry in self._sched.take_expired(now):
+            req = entry.payload
+            if req is None or req.finished:
+                continue
+            waited = now - req.submitted_at
+            if self._tracer.enabled:
+                self._tracer.add_span(req.request_id, "queue_wait",
+                                      req.submitted_at, now,
+                                      priority=entry.priority,
+                                      expired=True)
+            self._finish(
+                req, "error",
+                error=f"request expired after {waited:.1f}s in the "
+                f"admission queue (deadline "
+                f"{entry.deadline - entry.submitted_at:.1f}s)",
+                code="deadline_expired",
+                retry_after=self._sched.retry_after())
+
     def _admit(self) -> None:
         """Move waiting requests into free slots.
 
-        Skips (rather than head-of-line blocks on) a request whose session
-        is still generating. Requests whose remaining prompt fits one
-        prefill bucket (the common chat-turn case) are prefetched together
-        in one batched device call — a burst of N arrivals costs one
-        prefill + one sample round-trip instead of 2N (the reference
-        serialised engine-side prefills the same way it serialised
-        everything: one HTTP request at a time).
+        Admission order is the scheduler's: priority class (with bulk
+        aging), round-robin across sessions, deadlines enforced. A
+        request whose session is still generating is skipped in O(1)
+        (rotated, not scanned) rather than head-of-line blocking.
+        Requests whose remaining prompt fits one prefill bucket (the
+        common chat-turn case) are prefetched together in one batched
+        device call — a burst of N arrivals costs one prefill + one
+        sample round-trip instead of 2N (the reference serialised
+        engine-side prefills the same way it serialised everything: one
+        HTTP request at a time).
         """
+        self._expire_queued()
         # The batched path normally caps prompts at prefill_chunk so a
         # long prefill cannot stall running sessions (chunked path
         # interleaves instead). From IDLE there is nobody to stall, and
@@ -1608,16 +1715,27 @@ class TPUEngine(EngineBase):
         allowed = max(self.prefill_chunk, 1024) if idle \
             else self.prefill_chunk
         batch: list[tuple[_Request, Slot, int, list[int]]] = []
-        i = 0
-        while i < len(self._waiting):
-            req = self._waiting[i]
-            slot = self.slots.lookup(req.session_id)
-            if slot is not None and slot.active:
-                i += 1  # session busy; try the next waiting request
+        busy = {s.session_id for s in self.slots.slots
+                if s.active and s.session_id is not None}
+        while True:
+            entry = self._sched.pop(busy)
+            if entry is None:
+                break
+            req = entry.payload
+            if req.finished:
+                # Already terminal (errored by _abort_all during a
+                # crash before this pop saw it): admitting it would
+                # leak a slot on a request nobody consumes.
+                continue
+            if req.cancelled:  # cancelled before the drain saw it
+                self._finish(req, "cancelled")
                 continue
             slot = self.slots.acquire(req.session_id)
             if slot is None:
-                break  # all slots actively decoding
+                # All slots actively decoding: keep the entry at the
+                # head of its session's queue (deadline intact).
+                self._sched.requeue_front(entry)
+                break
             # Re-acquiring a slot still visible in an in-flight call is
             # safe without draining: the donated cache chains every call,
             # so the old call's garbage writes (all at positions >= the
@@ -1625,19 +1743,20 @@ class TPUEngine(EngineBase):
             # this slot's fresh prefill, whose writes then win; the old
             # call's tokens are dropped at retirement by the snapshot
             # ownership check.
-            self._waiting.pop(i)
             # Reserve immediately: activation is deferred to after the
             # batched prefill, and an unreserved slot would be fair game
             # for eviction by the next acquire in this same loop.
             req.slot = slot
             slot.active = True
+            busy.add(req.session_id)  # one admission per session
             req.admitted_at = time.monotonic()
             self._m_queue_wait.observe(
                 (req.admitted_at - req.submitted_at) * 1000)
             if self._tracer.enabled:
                 self._tracer.add_span(req.request_id, "queue_wait",
                                       req.submitted_at, req.admitted_at,
-                                      slot=slot.index)
+                                      slot=slot.index,
+                                      priority=entry.priority)
                 self._tracer.set_phase(req.request_id, "prefill")
             prompt = req.prompt_tokens
             reused = self.slots.reuse_prefix(slot, prompt)
@@ -1688,6 +1807,10 @@ class TPUEngine(EngineBase):
                 self._prefill_batched_shared(batch)
             else:
                 self._prefill_batched(batch)
+        # Entries the pop loop found expired must terminal-event NOW:
+        # diverting the last queued entry drops the queue to empty, so
+        # no later loop iteration would re-enter _admit to drain them.
+        self._expire_queued()
 
     def _advance_prefill(self) -> None:
         """Run ONE chunk of the oldest in-progress long prefill."""
@@ -2135,7 +2258,7 @@ class TPUEngine(EngineBase):
         # token's fetch is still in flight (anything TTFT-critical waits
         # behind the in-order device queue); long calls in steady state
         # (amortise the per-call cache boundary copy).
-        steps = (self.steps_burst if self._waiting or self._prefilling
+        steps = (self.steps_burst if len(self._sched) or self._prefilling
                  or any(req.first_pending
                         for req in self._running.values())
                  else self.steps_per_call)
@@ -2368,10 +2491,16 @@ class TPUEngine(EngineBase):
             req.emit_buf += emit_now
 
     def _finish(self, req: _Request, reason: str, error: str | None = None,
-                suppress_flush: bool = False) -> None:
+                suppress_flush: bool = False, code: str = "model_error",
+                retry_after: float | None = None) -> None:
         if req.finished:
             return
         req.finished = True
+        if req.admitted_at is not None:
+            # Admission→finish wall time feeds the scheduler's
+            # service-time EMA (wait estimates, retry_after hints).
+            self._sched.note_service_time(
+                time.monotonic() - req.admitted_at)
         slot = req.slot
         if slot is not None:
             decoding = self._running.get(slot.index) is req
@@ -2448,8 +2577,10 @@ class TPUEngine(EngineBase):
             self._tracer.set_phase(req.request_id, "finishing")
 
         if error is not None:
-            self._emit(req, {"type": "error", "error": error,
-                             "code": "model_error"})
+            event = {"type": "error", "error": error, "code": code}
+            if retry_after is not None:
+                event["retry_after"] = retry_after
+            self._emit(req, event)
             return
         duration = time.monotonic() - req.submitted_at
         ttft_ms = ((req.first_token_at or time.monotonic())

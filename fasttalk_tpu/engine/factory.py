@@ -121,13 +121,19 @@ def build_engine(cfg: Config) -> EngineBase:
 
         return VLLMRemoteEngine(cfg.vllm_base_url, cfg.vllm_model,
                                 api_key=cfg.vllm_api_key,
-                                timeout_s=cfg.vllm_timeout)
+                                timeout_s=cfg.vllm_timeout,
+                                max_inflight=cfg.remote_max_inflight,
+                                admission_timeout_s=(
+                                    cfg.sched_default_deadline_s))
     if cfg.llm_provider == "ollama":
         from fasttalk_tpu.engine.remote import OllamaRemoteEngine
 
         return OllamaRemoteEngine(cfg.ollama_base_url, cfg.model_name,
                                   keep_alive=cfg.ollama_keep_alive,
-                                  timeout_s=cfg.ollama_timeout)
+                                  timeout_s=cfg.ollama_timeout,
+                                  max_inflight=cfg.remote_max_inflight,
+                                  admission_timeout_s=(
+                                      cfg.sched_default_deadline_s))
     # Persistent compilation cache before the first compile: warmup's
     # executables reload from disk on repeat starts of the same config.
     from fasttalk_tpu.utils.compile_cache import enable_compilation_cache
@@ -248,5 +254,8 @@ def build_engine(cfg: Config) -> EngineBase:
         spec_decode=cfg.spec_decode,
         spec_draft_len=cfg.spec_draft_len,
         spec_breakeven=cfg.spec_breakeven,
-        shared_prefix=cfg.shared_prefix)
+        shared_prefix=cfg.shared_prefix,
+        queue_bound=cfg.sched_queue_bound,
+        default_deadline_s=cfg.sched_default_deadline_s,
+        bulk_aging_s=cfg.sched_bulk_aging_s)
     return engine

@@ -21,21 +21,78 @@ import aiohttp
 from fasttalk_tpu.engine.engine import (EngineBase, GenerationParams,
                                         raw_prompt_text)
 from fasttalk_tpu.observability.trace import get_tracer
-from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
+                                       LLMServiceError)
 from fasttalk_tpu.utils.logger import get_logger
+from fasttalk_tpu.utils.metrics import get_metrics
 
 log = get_logger("engine.remote")
 
 
 class _RemoteEngine(EngineBase):
-    """Shared plumbing: lazy client session, cancel flags, lifecycle."""
+    """Shared plumbing: lazy client session, cancel flags, lifecycle,
+    and bounded upstream concurrency — at most ``max_inflight``
+    requests stream from the backend at once, so the backpressure and
+    shedding discipline of the TPU branch (docs/SCHEDULING.md) applies
+    uniformly here: a waiter that cannot start within
+    ``admission_timeout_s`` is shed with AdmissionRejected +
+    retry_after instead of piling onto a saturated upstream."""
 
-    def __init__(self, base_url: str, timeout_s: float = 600.0):
+    def __init__(self, base_url: str, timeout_s: float = 600.0,
+                 max_inflight: int = 32,
+                 admission_timeout_s: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.max_inflight = max(1, max_inflight)
+        self.admission_timeout_s = admission_timeout_s
+        self._sem = asyncio.Semaphore(self.max_inflight)
+        self._inflight = 0
+        self._draining = False
         self._cancelled: set[str] = set()
         self._session: aiohttp.ClientSession | None = None
         self._started = False
+        m = get_metrics()
+        self._m_shed = m.counter(
+            "remote_shed_total",
+            "remote-backend submissions shed (upstream saturated past "
+            "the admission timeout, or draining)")
+        self._m_inflight = m.gauge(
+            "remote_inflight_requests",
+            "requests currently streaming from the remote backend")
+
+    async def _acquire_upstream(self) -> None:
+        """Take an upstream slot or shed. Raises AdmissionRejected when
+        draining or when ``max_inflight`` streams are already running
+        and none frees up within the admission timeout."""
+        if self._draining:
+            self._m_shed.inc()
+            raise AdmissionRejected(
+                "server is draining: finishing in-flight requests, not "
+                "accepting new ones", retry_after=5.0, reason="draining")
+        try:
+            await asyncio.wait_for(self._sem.acquire(),
+                                   timeout=self.admission_timeout_s)
+        except asyncio.TimeoutError:
+            self._m_shed.inc()
+            raise AdmissionRejected(
+                f"upstream at capacity ({self.max_inflight} requests in "
+                f"flight for {self.admission_timeout_s:.0f}s)",
+                retry_after=min(30.0, max(1.0,
+                                          self.admission_timeout_s / 4)),
+                reason="upstream_saturated") from None
+        self._inflight += 1
+        self._m_inflight.set(self._inflight)
+
+    def _release_upstream(self) -> None:
+        self._inflight -= 1
+        self._m_inflight.set(self._inflight)
+        self._sem.release()
+
+    def begin_drain(self) -> None:
+        self._draining = True
+
+    def pending_requests(self) -> int:
+        return self._inflight
 
     def start(self) -> None:
         self._started = True
@@ -69,7 +126,10 @@ class _RemoteEngine(EngineBase):
 
     def get_stats(self) -> dict:
         return {"backend": self.base_url,
-                "cancelled_pending": len(self._cancelled)}
+                "cancelled_pending": len(self._cancelled),
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "draining": self._draining}
 
     def _sync_get(self, url: str, timeout: float = 3.0) -> Any:
         import requests
@@ -134,8 +194,11 @@ class VLLMRemoteEngine(_RemoteEngine):
     (reference: vllm_handler.py — base URL config at config.py:96)."""
 
     def __init__(self, base_url: str, model: str,
-                 api_key: str = "not-needed", timeout_s: float = 600.0):
-        super().__init__(base_url, timeout_s)
+                 api_key: str = "not-needed", timeout_s: float = 600.0,
+                 max_inflight: int = 32,
+                 admission_timeout_s: float = 30.0):
+        super().__init__(base_url, timeout_s, max_inflight=max_inflight,
+                         admission_timeout_s=admission_timeout_s)
         self.model = model
         self.api_key = api_key
         # Set after a backend 400s on stream_options (pre-0.4.3 vLLM,
@@ -185,6 +248,7 @@ class VLLMRemoteEngine(_RemoteEngine):
         prompt_toks: int | None = None
         completion_toks: int | None = None
         finish = "stop"
+        await self._acquire_upstream()
         trace_owned = self._trace_start(request_id, session_id, "vllm")
         try:
             for _attempt in range(3):
@@ -275,6 +339,7 @@ class VLLMRemoteEngine(_RemoteEngine):
             raise LLMServiceError(f"vLLM connection failed: {e}",
                                   category=ErrorCategory.CONNECTION) from e
         finally:
+            self._release_upstream()
             self._trace_end(request_id, trace_owned, started, ttft,
                             chunks, "vllm")
             self._cancelled.discard(request_id)
@@ -309,8 +374,11 @@ class OllamaRemoteEngine(_RemoteEngine):
     (reference: ollama_handler.py — base URL config at config.py:116)."""
 
     def __init__(self, base_url: str, model: str,
-                 keep_alive: str = "5m", timeout_s: float = 600.0):
-        super().__init__(base_url, timeout_s)
+                 keep_alive: str = "5m", timeout_s: float = 600.0,
+                 max_inflight: int = 32,
+                 admission_timeout_s: float = 30.0):
+        super().__init__(base_url, timeout_s, max_inflight=max_inflight,
+                         admission_timeout_s=admission_timeout_s)
         self.model = model
         self.keep_alive = keep_alive
 
@@ -350,6 +418,7 @@ class OllamaRemoteEngine(_RemoteEngine):
         chunks = 0
         prompt_toks: int | None = None
         completion_toks: int | None = None
+        await self._acquire_upstream()
         trace_owned = self._trace_start(request_id, session_id, "ollama")
         try:
             async with client.post(url, json=body) as resp:
@@ -401,6 +470,7 @@ class OllamaRemoteEngine(_RemoteEngine):
             raise LLMServiceError(f"Ollama connection failed: {e}",
                                   category=ErrorCategory.CONNECTION) from e
         finally:
+            self._release_upstream()
             self._trace_end(request_id, trace_owned, started, ttft,
                             chunks, "ollama")
             self._cancelled.discard(request_id)

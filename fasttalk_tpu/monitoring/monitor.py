@@ -53,8 +53,22 @@ def _device_memory() -> list[dict]:
     return out
 
 
-def build_monitoring_app(ready_check=None) -> web.Application:
+def build_monitoring_app(ready_check=None, sched_info=None,
+                         ) -> web.Application:
+    """``sched_info``: optional zero-arg callable returning the
+    engine's scheduler view ({"stats": ..., "queued": [...]}, see
+    engine.scheduler_debug) — surfaces the admission-control overload
+    state on /health and queued position/deadline on /debug/requests
+    (docs/SCHEDULING.md)."""
     app = web.Application()
+
+    def _sched_view() -> dict | None:
+        if sched_info is None:
+            return None
+        try:
+            return sched_info()
+        except Exception:
+            return None
 
     async def health(request: web.Request) -> web.Response:
         cpu = psutil.cpu_percent(interval=0)
@@ -75,6 +89,13 @@ def build_monitoring_app(ready_check=None) -> web.Application:
             warnings.append("High CPU usage")
         if mem.percent > 90:
             warnings.append("High memory usage")
+        sched = _sched_view()
+        if sched is not None:
+            body["scheduler"] = sched.get("stats")
+            state = (sched.get("stats") or {}).get("state")
+            if state and state != "healthy":
+                body["status"] = state
+                warnings.append(f"Admission control {state}")
         if warnings:
             body["warnings"] = warnings
         return web.json_response(body)
@@ -189,12 +210,29 @@ def build_monitoring_app(ready_check=None) -> web.Application:
             None, lambda: _json.dumps(build()))
 
     async def debug_requests(request: web.Request) -> web.Response:
-        """In-flight requests with current phase and age."""
+        """In-flight requests with current phase and age; queued ones
+        additionally show their admission position, priority and
+        remaining deadline (scheduler view)."""
         tracer = get_tracer()
-        return web.json_response({
+        body = {
             "enabled": tracer.enabled,
             "requests": tracer.inflight_summary(),
-        })
+        }
+        sched = _sched_view()
+        if sched is not None:
+            body["scheduler"] = sched.get("stats")
+            queued = {q["request_id"]: q
+                      for q in sched.get("queued", [])}
+            for r in body["requests"]:
+                extra = queued.pop(r["request_id"], None)
+                if extra is not None:
+                    r.update(queue_position=extra["position"],
+                             priority=extra["priority"],
+                             deadline_in_s=extra["deadline_in_s"])
+            # Entries the tracer doesn't know (tracing disabled, or a
+            # trace evicted) still show up as queued work.
+            body["queued_untraced"] = list(queued.values())
+        return web.json_response(body)
 
     async def traces_index(request: web.Request) -> web.Response:
         """Completed-trace ring: index by default; ?format=chrome for a

@@ -1,0 +1,21 @@
+"""Admission control and request scheduling (docs/SCHEDULING.md)."""
+
+from fasttalk_tpu.scheduling.scheduler import (
+    PRIORITIES,
+    STATE_DRAINING,
+    STATE_HEALTHY,
+    STATE_PRESSURED,
+    STATE_SHEDDING,
+    QueuedRequest,
+    RequestScheduler,
+)
+
+__all__ = [
+    "PRIORITIES",
+    "QueuedRequest",
+    "RequestScheduler",
+    "STATE_DRAINING",
+    "STATE_HEALTHY",
+    "STATE_PRESSURED",
+    "STATE_SHEDDING",
+]

@@ -136,6 +136,18 @@ class ConnectionManager:
     def get_active_count(self) -> int:
         return len(self._connections)
 
+    def retry_after_hint(self) -> float:
+        """Suggested reconnect back-off (seconds) when admission is
+        refused at the connection limit: short when most sessions are
+        idle (likely to churn soon), longer when every connection is
+        mid-generation."""
+        conns = list(self._connections.values())
+        if not conns:
+            return 1.0
+        busy = sum(1 for c in conns
+                   if c.state is ConnectionState.PROCESSING)
+        return round(2.0 + 8.0 * busy / len(conns), 1)
+
     def idle_sessions(self, now: float | None = None) -> list[str]:
         now = now or time.time()
         return [sid for sid, c in self._connections.items()

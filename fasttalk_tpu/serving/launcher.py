@@ -146,7 +146,8 @@ class ServerLauncher:
                  f"{self.config.port}/ws/llm")
 
         mon_app = build_monitoring_app(
-            ready_check=self.engine.check_connection)
+            ready_check=self.engine.check_connection,
+            sched_info=getattr(self.engine, "scheduler_debug", None))
         mon_runner = web.AppRunner(mon_app)
         await mon_runner.setup()
         await web.TCPSite(mon_runner, self.config.monitoring_host,

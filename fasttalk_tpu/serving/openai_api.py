@@ -33,7 +33,8 @@ from fasttalk_tpu.agents.hermes import (
 )
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.engine.remote import _RemoteEngine
-from fasttalk_tpu.utils.errors import CircuitBreaker, CircuitBreakerOpen
+from fasttalk_tpu.utils.errors import (AdmissionRejected, CircuitBreaker,
+                                       CircuitBreakerOpen)
 from fasttalk_tpu.utils.logger import get_logger
 
 log = get_logger("serving.openai")
@@ -55,6 +56,19 @@ def _content_str(content: Any) -> str:
 
 class _BadRequest(ValueError):
     """Client-shape error: surfaces as a 400, never a 500/breaker hit."""
+
+
+def _reject_429(e: AdmissionRejected) -> web.Response:
+    """Load shed at admission → HTTP 429 with both the OpenAI-style
+    error body and a standard Retry-After header (integer seconds,
+    rounded up — a 0 would invite an immediate hot retry)."""
+    import math as _math
+
+    retry_s = max(1, int(_math.ceil(e.retry_after or 1.0)))
+    return web.json_response(
+        {"error": {"message": e.message, "type": "rate_limit_error",
+                   "code": e.reason, "retry_after": e.retry_after}},
+        status=429, headers={"Retry-After": str(retry_s)})
 
 
 def _parse_tools(body: dict) -> tuple[list[dict], str | None]:
@@ -232,6 +246,14 @@ def register_openai_routes(app: web.Application,
                 if "repetition_penalty" in body
                 else defaults.get("repeat_penalty", 1.0)),
             ignore_eos=ignore_eos,
+            # Admission-control extensions (docs/SCHEDULING.md):
+            # priority class + queue deadline; validated by
+            # GenerationParams (bad values → 400, not 500).
+            priority=str(body.get("priority",
+                                  defaults.get("priority",
+                                               "interactive"))),
+            deadline_s=(float(body["deadline_s"])
+                        if body.get("deadline_s") is not None else None),
         )
 
     def _breaker_503() -> web.Response | None:
@@ -257,6 +279,7 @@ def register_openai_routes(app: web.Application,
         try:
             finish_reason = "stop"
             failed = False
+            shed = False
             async for event in engine.generate(completion_id, session_id,
                                                messages, params):
                 if event["type"] == "token":
@@ -266,17 +289,36 @@ def register_openai_routes(app: web.Application,
                         event.get("finish_reason", "stop"))
                 elif event["type"] == "error":
                     failed = True
+                    err_payload = event.get("error")
+                    if event.get("code") == "deadline_expired":
+                        # Queue-deadline expiry = load shedding: the
+                        # frame keeps retry_after and the breaker is
+                        # untouched (a shed is not a backend fault).
+                        shed = True
+                        err_payload = AdmissionRejected \
+                            .from_expiry_event(event).to_dict()
                     await resp.write(
-                        f"data: {json.dumps({'error': event.get('error')})}\n\n"
+                        f"data: {json.dumps({'error': err_payload})}\n\n"
                         .encode())
                     break
             if not failed:
                 finish_reason = await finalize(finish_reason)
             if breaker is not None:
-                (breaker.record_failure if failed
-                 else breaker.record_success)()
+                if failed and not shed:
+                    breaker.record_failure()
+                elif not failed:
+                    breaker.record_success()
             if not failed:
                 await write_finish(finish_reason)
+            await resp.write(b"data: [DONE]\n\n")
+        except AdmissionRejected as e:
+            # Shed at admission: the stream is already committed as
+            # SSE, so the rejection rides an error frame (to_dict
+            # carries retry_after) + [DONE]. NOT a breaker failure —
+            # shedding is self-protection, not a backend fault.
+            await resp.write(
+                f"data: {json.dumps({'error': e.to_dict()})}\n\n"
+                .encode())
             await resp.write(b"data: [DONE]\n\n")
         except Exception:
             if breaker is not None:
@@ -301,6 +343,9 @@ def register_openai_routes(app: web.Application,
                     finish_reason = _oai_finish(
                         event.get("finish_reason", "stop"))
                 elif event["type"] == "error":
+                    if event.get("code") == "deadline_expired":
+                        # Shed, not a failure: caller maps to 429.
+                        raise AdmissionRejected.from_expiry_event(event)
                     if breaker is not None:
                         breaker.record_failure()
                     return stats, finish_reason, web.json_response(
@@ -308,6 +353,8 @@ def register_openai_routes(app: web.Application,
                                    "type": "server_error"}}, status=500)
             if breaker is not None:
                 breaker.record_success()
+        except AdmissionRejected:
+            raise  # shed, not a backend failure: caller maps to 429
         except Exception:
             if breaker is not None:
                 breaker.record_failure()
@@ -450,8 +497,12 @@ def register_openai_routes(app: web.Application,
             tool_calls.extend(_oai_tool_call(c, len(tool_calls))
                               for c in calls if c.name)
 
-        stats, finish_reason, err = await _collect_events(
-            engine, completion_id, session_id, messages, params, on_token)
+        try:
+            stats, finish_reason, err = await _collect_events(
+                engine, completion_id, session_id, messages, params,
+                on_token)
+        except AdmissionRejected as e:
+            return _reject_429(e)
         if err is not None:
             return err
         if parser is not None:
@@ -553,8 +604,12 @@ def register_openai_routes(app: web.Application,
             nonlocal text
             text += t
 
-        stats, finish_reason, err = await _collect_events(
-            engine, completion_id, session_id, messages, params, on_token)
+        try:
+            stats, finish_reason, err = await _collect_events(
+                engine, completion_id, session_id, messages, params,
+                on_token)
+        except AdmissionRejected as e:
+            return _reject_429(e)
         if err is not None:
             return err
         return web.json_response({

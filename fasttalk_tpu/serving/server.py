@@ -31,7 +31,7 @@ import time
 import uuid
 from typing import Any
 
-from aiohttp import WSMsgType, web
+from aiohttp import WSCloseCode, WSMsgType, web
 
 from fasttalk_tpu import __version__
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
@@ -41,6 +41,7 @@ from fasttalk_tpu.serving.conversation import ConversationManager
 from fasttalk_tpu.serving.text_processor import extract_speakable_chunk
 from fasttalk_tpu.utils.config import Config
 from fasttalk_tpu.utils.errors import (
+    AdmissionRejected,
     CircuitBreaker,
     CircuitBreakerOpen,
     ErrorHandler,
@@ -106,7 +107,8 @@ class WebSocketLLMServer:
                       "repeat_penalty": config.default_repeat_penalty,
                       "presence_penalty": config.default_presence_penalty,
                       "frequency_penalty":
-                          config.default_frequency_penalty},
+                          config.default_frequency_penalty,
+                      "priority": config.sched_default_priority},
             breaker=self.breaker)
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
@@ -119,6 +121,15 @@ class WebSocketLLMServer:
     async def _on_cleanup(self, app: web.Application) -> None:
         if self._housekeeping:
             self._housekeeping.cancel()
+        # Graceful drain (docs/SCHEDULING.md): new submissions are
+        # rejected with retry_after from here on, while generations
+        # already streaming (or queued) get up to the drain timeout to
+        # finish before being cancelled.
+        self.engine.begin_drain()
+        pending = [t for t in self._gen_tasks.values() if not t.done()]
+        if pending and self.config.sched_drain_timeout_s > 0:
+            await asyncio.wait(pending,
+                               timeout=self.config.sched_drain_timeout_s)
         for task in list(self._gen_tasks.values()):
             task.cancel()
 
@@ -182,6 +193,16 @@ class WebSocketLLMServer:
                     self.conversation_manager.get_session_count(),
                 "circuit_breaker": self.breaker.to_dict(),
             }
+            # Overload state machine (docs/SCHEDULING.md): load
+            # balancers and operators see pressured/shedding/draining
+            # before the cliff. "healthy" stays 200; overload states
+            # are reported but don't flip the status code — the server
+            # is still serving (that is the whole point of shedding).
+            sched = self.engine.get_stats().get("scheduler")
+            if sched is not None:
+                body["scheduler"] = sched
+                if sched.get("state") != "healthy":
+                    body["status"] = sched["state"]
             return web.json_response(body, status=200 if ok else 503)
         except Exception as e:
             return web.json_response({"status": "unhealthy", "error": str(e)},
@@ -222,13 +243,21 @@ class WebSocketLLMServer:
 
         info = self.connection_manager.add_connection(session_id, ws)
         if info is None:
+            # Counted in ws_connections_rejected_total (connection.py).
+            # The frame carries a retry_after hint and the close uses
+            # the standard 1013 "try again later" code, so clients can
+            # tell capacity rejection from a protocol error and back
+            # off instead of hot-reconnecting.
+            retry_after = self.connection_manager.retry_after_hint()
             await ws.send_json({
                 "type": "error",
                 "error": {"code": "max_connections",
                           "message": "Maximum connections reached",
-                          "severity": "high"},
+                          "severity": "high",
+                          "retry_after": retry_after},
             })
-            await ws.close()
+            await ws.close(code=WSCloseCode.TRY_AGAIN_LATER,
+                           message=b"max connections; retry later")
             return ws
 
         try:
@@ -318,7 +347,8 @@ class WebSocketLLMServer:
     # in the config blob is stored for echo but never splatted inward.
     _GEN_KEYS = ("temperature", "top_p", "top_k", "max_tokens", "stop",
                  "tts_chunking", "repeat_penalty", "presence_penalty",
-                 "frequency_penalty", "ignore_eos")
+                 "frequency_penalty", "ignore_eos", "priority",
+                 "deadline_s")
 
     @classmethod
     def _gen_overrides(cls, cfg: dict) -> dict:
@@ -393,6 +423,14 @@ class WebSocketLLMServer:
                 "frequency_penalty",
                 self.config.default_frequency_penalty)),
             ignore_eos=ignore_eos,
+            # Admission-control knobs (docs/SCHEDULING.md): priority
+            # class and queue deadline, settable per session/request
+            # (GenerationParams validates both — bad values surface as
+            # invalid_config, not a 500).
+            priority=str(over.get("priority",
+                                  self.config.sched_default_priority)),
+            deadline_s=(float(over["deadline_s"])
+                        if over.get("deadline_s") is not None else None),
         )
 
     async def _generate(self, session_id: str, user_text: str,
@@ -473,6 +511,11 @@ class WebSocketLLMServer:
                         "arguments": event.get("arguments")},
                         request_id=request_id)
                 elif etype == "error":
+                    if event.get("code") == "deadline_expired":
+                        # Queue-deadline expiry is load shedding, not a
+                        # backend fault: surface it like a shed (frame
+                        # keeps retry_after; breaker untouched).
+                        raise AdmissionRejected.from_expiry_event(event)
                     raise LLMServiceError(event.get("error", "engine error"))
             if tts and tts_buffer:
                 await self._send(session_id, ws, {
@@ -526,6 +569,16 @@ class WebSocketLLMServer:
             await self._send(session_id, ws,
                              {"type": "error", "error": e.to_dict()})
             self.connection_manager.record_error(session_id)
+        except AdmissionRejected as e:
+            # Load shed at admission (queue bound / overload / drain):
+            # the client must back off — to_dict() carries retry_after.
+            # Deliberately NOT a breaker failure: shedding is the
+            # engine protecting itself, and one overload burst opening
+            # the shared breaker would turn load shedding into a full
+            # outage.
+            self.connection_manager.record_error(session_id)
+            await self._send(session_id, ws,
+                             {"type": "error", "error": e.to_dict()})
         except LLMServiceError as e:
             self.breaker.record_failure()
             self.error_handler.handle_error(e, {"session_id": session_id})

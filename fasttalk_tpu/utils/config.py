@@ -289,6 +289,36 @@ class Config:
     # instead of per process (utils/compile_cache.py).
     compile_cache: str = field(
         default_factory=lambda: _env_str("TPU_COMPILE_CACHE", ""))
+    # ---- Admission control / request scheduling (scheduling/
+    # scheduler.py, docs/SCHEDULING.md) ----
+    # Bound on requests waiting for a decode slot; the excess is shed
+    # immediately with a retry_after hint instead of queueing to
+    # time out.
+    sched_queue_bound: int = field(
+        default_factory=lambda: _env_int("SCHED_QUEUE_BOUND", 256))
+    # Default queue TTL: a request still waiting past this is expired
+    # with a terminal event before it ever touches the TPU. Clients
+    # may override per session/request via the "deadline_s" config key.
+    sched_default_deadline_s: float = field(
+        default_factory=lambda: _env_float("SCHED_DEADLINE_S", 30.0))
+    # Priority class when the client sets none: "interactive" admits
+    # before "bulk" (clients override via the "priority" config key).
+    sched_default_priority: str = field(
+        default_factory=lambda: _env_str("SCHED_DEFAULT_PRIORITY",
+                                         "interactive"))
+    # Starvation guard: a bulk request whose queue wait exceeds this
+    # is promoted ahead of interactive work for one admission.
+    sched_bulk_aging_s: float = field(
+        default_factory=lambda: _env_float("SCHED_AGING_S", 5.0))
+    # Graceful drain: how long server shutdown waits for in-flight and
+    # queued requests to finish before cancelling the stragglers.
+    sched_drain_timeout_s: float = field(
+        default_factory=lambda: _env_float("SCHED_DRAIN_TIMEOUT_S", 30.0))
+    # Remote providers (vllm/ollama/openai): cap on concurrent upstream
+    # requests, so backpressure/shedding applies on the remote branch
+    # too (waiters past the admission deadline shed with retry_after).
+    remote_max_inflight: int = field(
+        default_factory=lambda: _env_int("REMOTE_MAX_INFLIGHT", 32))
     # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
     # in-tree replacement for the reference's 300s engine-container
     # health start_period (docker-compose.vllm.yml:62-67). Empty means
@@ -362,6 +392,19 @@ class Config:
                         f"got {self.sampling!r}")
         if self.quantize not in ("none", "int8"):
             errs.append("quantize must be 'none' or 'int8'")
+        if self.sched_queue_bound <= 0:
+            errs.append("sched_queue_bound must be > 0")
+        if self.sched_default_deadline_s <= 0:
+            errs.append("sched_default_deadline_s must be > 0")
+        if self.sched_default_priority not in ("interactive", "bulk"):
+            errs.append("sched_default_priority must be "
+                        "'interactive' or 'bulk'")
+        if self.sched_bulk_aging_s <= 0:
+            errs.append("sched_bulk_aging_s must be > 0")
+        if self.sched_drain_timeout_s < 0:
+            errs.append("sched_drain_timeout_s must be >= 0")
+        if self.remote_max_inflight <= 0:
+            errs.append("remote_max_inflight must be > 0")
         if self.warmup not in ("off", "fast", "full"):
             errs.append("warmup must be 'off', 'fast' or 'full'")
         if self.default_context_window < self.default_max_tokens:

@@ -72,6 +72,35 @@ class LLMServiceError(Exception):
         return d
 
 
+class AdmissionRejected(LLMServiceError):
+    """Submission shed at admission (scheduling/scheduler.py): queue at
+    bound, estimated wait past the deadline, upstream saturation, or a
+    draining server. Always recoverable and always carries a computed
+    ``retry_after`` — the WS error frame includes it via to_dict() and
+    the OpenAI-compatible route maps it to 429 + Retry-After. Kept as
+    its own type so the serving layer can tell load shedding (client
+    should back off; NOT a backend failure, must not trip the circuit
+    breaker) from genuine engine errors."""
+
+    def __init__(self, message: str, retry_after: float,
+                 reason: str = "shed"):
+        super().__init__(message, category=ErrorCategory.RATE_LIMIT,
+                         severity=ErrorSeverity.MEDIUM, recoverable=True,
+                         retry_after=retry_after,
+                         details={"reason": reason})
+        self.reason = reason
+
+    @classmethod
+    def from_expiry_event(cls, event: dict) -> "AdmissionRejected":
+        """Rebuild from an engine terminal error event carrying
+        ``code == "deadline_expired"`` (the queue-expiry contract,
+        engine._expire_queued) — one definition of the message fallback
+        and retry_after coercion for every serving surface."""
+        return cls(str(event.get("error") or "queue deadline expired"),
+                   retry_after=float(event.get("retry_after") or 1.0),
+                   reason="deadline_expired")
+
+
 class CircuitState(str, Enum):
     CLOSED = "closed"
     OPEN = "open"

@@ -9,6 +9,11 @@
 #                             of scripts/trace_report.py over the
 #                             checked-in sample dump, so the JSONL
 #                             export schema cannot silently drift.
+#   ./run_tests.sh --sched    scheduling group only: admission-control
+#                             queue discipline, overload/shed/drain
+#                             serving surfaces, and the engine-level
+#                             queued-request race tests
+#                             (docs/SCHEDULING.md).
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -29,6 +34,12 @@ if [[ "${1:-}" == "--obs" ]]; then
             || { echo "trace_report smoke: missing phase $phase" >&2; exit 1; }
     done
     exit 0
+fi
+
+if [[ "${1:-}" == "--sched" ]]; then
+    shift
+    exec "${PYENV[@]}" python -m pytest tests/test_scheduling.py \
+        "tests/test_engine.py::TestSchedulerRaces" "$@"
 fi
 
 exec "${PYENV[@]}" python -m pytest tests/ "$@"

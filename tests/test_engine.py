@@ -965,6 +965,171 @@ class TestEngineRestart:
             eng.shutdown()
 
 
+class TestSchedulerRaces:
+    """Queued-request races through the real engine + admission
+    scheduler (ISSUE 2 acceptance): cancel-while-queued,
+    deadline-expiry vs admission, shed-at-bound, and
+    drain-rejects-new-but-finishes-queued. One single-slot engine with
+    queue_bound=1 makes every scenario deterministically reachable;
+    the drain test runs last (drain is irreversible per scheduler
+    instance)."""
+
+    @pytest.fixture(scope="class")
+    def seng(self):
+        import jax
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=1,
+                        max_len=256, prefill_chunk=64, steps_per_call=4,
+                        queue_bound=1)
+        eng.start()
+        yield eng
+        eng.shutdown()
+
+    @staticmethod
+    async def _consume(eng, rid, sid, max_tokens, events, **params):
+        async for ev in eng.generate(
+                rid, sid, [{"role": "user", "content": f"msg {rid}"}],
+                GenerationParams(max_tokens=max_tokens, **GREEDY,
+                                 **params)):
+            events.append(ev)
+        return events
+
+    @staticmethod
+    async def _wait_until(pred, timeout=10.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            await asyncio.sleep(0.01)
+        return False
+
+    async def _occupy_slot(self, eng, rid, sid, max_tokens=512):
+        """Start a generation and wait until it holds the one slot."""
+        events: list = []
+        task = asyncio.create_task(
+            self._consume(eng, rid, sid, max_tokens, events))
+        ok = await self._wait_until(
+            lambda: any(e["type"] == "token" for e in events))
+        assert ok, "slot occupant never produced a token"
+        return task, events
+
+    def test_shed_at_bound_no_silent_hang(self, seng):
+        from fasttalk_tpu.utils.errors import AdmissionRejected
+
+        async def scenario():
+            a_task, _ = await self._occupy_slot(seng, "sb-a", "sb-sa")
+            b_events: list = []
+            b_task = asyncio.create_task(
+                self._consume(seng, "sb-b", "sb-sb", 4, b_events))
+            assert await self._wait_until(
+                lambda: seng.get_stats()["waiting"] >= 1)
+            # The queue is at its bound of 1: the next submission must
+            # shed immediately with retry_after — never hang.
+            shed = None
+            try:
+                async for _ in seng.generate(
+                        "sb-c", "sb-sc",
+                        [{"role": "user", "content": "over"}],
+                        GenerationParams(max_tokens=4, **GREEDY)):
+                    pass
+            except AdmissionRejected as e:
+                shed = e
+            assert shed is not None
+            assert shed.retry_after is not None and shed.retry_after >= 1
+            stats = seng.get_stats()["scheduler"]
+            assert stats["depth"] <= stats["bound"]
+            assert stats["shed_total"] >= 1
+            assert stats["state"] in ("shedding", "pressured")
+            # Freeing the slot admits the queued request: it finishes.
+            seng.cancel("sb-a")
+            await a_task
+            await b_task
+            assert b_events[-1]["type"] == "done"
+
+        asyncio.run(scenario())
+
+    def test_cancel_while_queued_prompt_terminal(self, seng):
+        async def scenario():
+            import time
+
+            a_task, _ = await self._occupy_slot(seng, "cq-a", "cq-sa")
+            b_events: list = []
+            b_task = asyncio.create_task(
+                self._consume(seng, "cq-b", "cq-sb", 4, b_events))
+            assert await self._wait_until(
+                lambda: seng.get_stats()["waiting"] >= 1)
+            t0 = time.monotonic()
+            assert seng.cancel("cq-b") is True
+            await b_task
+            latency = time.monotonic() - t0
+            assert b_events[-1]["type"] == "cancelled"
+            # Terminal promptly — not after the running generation.
+            assert latency < 3.0
+            assert seng.get_stats()["waiting"] == 0
+            seng.cancel("cq-a")
+            await a_task
+
+        asyncio.run(scenario())
+
+    def test_deadline_expiry_vs_admission(self, seng):
+        async def scenario():
+            a_task, a_events = await self._occupy_slot(seng, "dx-a",
+                                                       "dx-sa")
+            b_events: list = []
+            b_task = asyncio.create_task(
+                self._consume(seng, "dx-b", "dx-sb", 4, b_events,
+                              deadline_s=0.2))
+            # B expires in the queue (slot still held): terminal error
+            # event, before it ever touched the TPU.
+            await b_task
+            assert b_events[-1]["type"] == "error"
+            assert b_events[-1]["code"] == "deadline_expired"
+            assert b_events[-1]["retry_after"] >= 1
+            assert seng.get_stats()["scheduler"]["expired_total"] >= 1
+            # The running generation is untouched by the expiry.
+            n_before = len(a_events)
+            await asyncio.sleep(0.1)
+            seng.cancel("dx-a")
+            await a_task
+            assert len(a_events) >= n_before
+
+        asyncio.run(scenario())
+
+    def test_drain_rejects_new_finishes_queued(self, seng):
+        from fasttalk_tpu.utils.errors import AdmissionRejected
+
+        async def scenario():
+            a_task, a_events = await self._occupy_slot(
+                seng, "dr-a", "dr-sa", max_tokens=24)
+            b_events: list = []
+            b_task = asyncio.create_task(
+                self._consume(seng, "dr-b", "dr-sb", 4, b_events))
+            assert await self._wait_until(
+                lambda: seng.get_stats()["waiting"] >= 1)
+            seng.begin_drain()
+            assert seng.get_stats()["scheduler"]["draining"] is True
+            # New submissions shed with retry_after...
+            with pytest.raises(AdmissionRejected) as ei:
+                async for _ in seng.generate(
+                        "dr-c", "dr-sc",
+                        [{"role": "user", "content": "late"}],
+                        GenerationParams(max_tokens=4, **GREEDY)):
+                    pass
+            assert ei.value.retry_after is not None
+            # ...while in-flight AND already-queued requests finish.
+            await a_task
+            await b_task
+            assert a_events[-1]["type"] == "done"
+            assert b_events[-1]["type"] == "done"
+            assert await self._wait_until(
+                lambda: seng.pending_requests() == 0)
+
+        asyncio.run(scenario())
+
+
 def test_raw_prompt_bypasses_chat_template(engine):
     """/v1/completions path: params.raw_prompt tokenizes the prompt as
     BOS + verbatim bytes (no role/template tokens), so prompt_tokens is

@@ -1,0 +1,588 @@
+"""Admission-control scheduler tests: queue discipline (bounds,
+priorities, fairness, aging, deadlines), overload state machine, drain,
+and the serving-layer surfaces (WS error frames, OpenAI 429,
+connection-limit rejection, remote-backend backpressure).
+
+Engine-level race tests (cancel-while-queued, expiry-vs-admission,
+shed-at-bound, drain on the real engine) live in
+tests/test_engine.py::TestSchedulerRaces to reuse that module's engine
+setup."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from fasttalk_tpu.scheduling import (
+    STATE_DRAINING,
+    STATE_HEALTHY,
+    STATE_PRESSURED,
+    STATE_SHEDDING,
+    RequestScheduler,
+)
+from fasttalk_tpu.utils.errors import AdmissionRejected
+
+
+def make_sched(**kw):
+    kw.setdefault("queue_bound", 8)
+    kw.setdefault("default_deadline_s", 30.0)
+    kw.setdefault("bulk_aging_s", 5.0)
+    kw.setdefault("slots", 2)
+    return RequestScheduler(**kw)
+
+
+class TestQueueDiscipline:
+    def test_fifo_within_session(self):
+        s = make_sched()
+        for i in range(3):
+            s.submit(f"r{i}", "A")
+        assert [s.pop().request_id for _ in range(3)] == ["r0", "r1", "r2"]
+        assert s.pop() is None
+
+    def test_round_robin_across_sessions(self):
+        """A session that floods the queue gets one admission per turn;
+        a late-arriving session is served second, not 50th."""
+        s = make_sched(queue_bound=16)
+        for i in range(3):
+            s.submit(f"a{i}", "A")
+        s.submit("b0", "B")
+        order = []
+        while True:
+            e = s.pop()
+            if e is None:
+                break
+            order.append(e.request_id)
+        assert order == ["a0", "b0", "a1", "a2"]
+
+    def test_interactive_before_bulk(self):
+        s = make_sched()
+        s.submit("bulk0", "S1", priority="bulk")
+        s.submit("int0", "S2", priority="interactive")
+        assert s.pop().request_id == "int0"
+        assert s.pop().request_id == "bulk0"
+
+    def test_bulk_aging_prevents_starvation(self):
+        s = make_sched(bulk_aging_s=0.05)
+        s.submit("bulk0", "S1", priority="bulk")
+        time.sleep(0.08)
+        s.submit("int0", "S2", priority="interactive")
+        # The bulk head waited past the aging threshold: it admits
+        # ahead of fresher interactive work for this pop.
+        assert s.pop().request_id == "bulk0"
+        assert s.pop().request_id == "int0"
+
+    def test_invalid_priority_rejected(self):
+        s = make_sched()
+        with pytest.raises(ValueError, match="priority"):
+            s.submit("x", "S", priority="vip")
+
+    def test_busy_session_skipped_not_blocking(self):
+        s = make_sched()
+        s.submit("a0", "A")
+        s.submit("b0", "B")
+        assert s.pop(busy_sessions={"A"}).request_id == "b0"
+        assert s.pop(busy_sessions={"A"}) is None
+        assert len(s) == 1  # a0 still queued
+        assert s.pop().request_id == "a0"
+
+    def test_requeue_front_keeps_turn(self):
+        s = make_sched()
+        s.submit("a0", "A")
+        s.submit("b0", "B")
+        e = s.pop()
+        assert e.request_id == "a0"
+        s.requeue_front(e)  # no slot free: back to the head
+        assert s.pop().request_id == "a0"
+
+
+class TestBoundsAndShedding:
+    def test_shed_at_bound_carries_retry_after(self):
+        s = make_sched(queue_bound=2)
+        s.submit("r0", "A")
+        s.submit("r1", "B")
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit("r2", "C")
+        e = ei.value
+        assert e.retry_after is not None and e.retry_after >= 1.0
+        assert e.reason == "queue_full"
+        assert e.to_dict()["retry_after"] == e.retry_after
+        assert len(s) == 2  # bound never exceeded
+
+    def test_estimated_wait_shed(self):
+        """With a known service time, a submission whose estimated wait
+        already exceeds its deadline is shed at the door."""
+        s = make_sched(queue_bound=100, slots=1)
+        s.note_service_time(10.0)  # 10 s per request, 1 slot
+        s.submit("r0", "A")  # queue empty: est 0, admitted
+        with pytest.raises(AdmissionRejected) as ei:
+            # est wait = depth(1)/slots(1) * 10 s = 10 s > 2 s deadline
+            s.submit("r1", "B", deadline_s=2.0)
+        assert ei.value.reason == "wait_too_long"
+
+    def test_cancel_is_o1_and_frees_depth(self):
+        s = make_sched(queue_bound=2)
+        s.submit("r0", "A")
+        s.submit("r1", "A")
+        assert s.cancel("r0") is not None
+        assert s.cancel("r0") is None  # idempotent
+        assert len(s) == 1
+        s.submit("r2", "B")  # freed capacity admits again
+        assert s.pop().request_id == "r1"  # tombstone skipped
+        assert s.pop().request_id == "r2"
+
+    def test_service_time_ema_updates(self):
+        s = make_sched(slots=1)
+        s.note_service_time(2.0)
+        assert s.stats()["service_time_ema_s"] == 2.0
+        s.note_service_time(4.0)
+        ema = s.stats()["service_time_ema_s"]
+        assert 2.0 < ema < 4.0
+
+
+class TestDeadlines:
+    def test_pop_never_returns_expired(self):
+        s = make_sched(default_deadline_s=0.03)
+        s.submit("r0", "A")
+        time.sleep(0.05)
+        assert s.pop() is None
+        expired = s.take_expired()
+        assert [e.request_id for e in expired] == ["r0"]
+        assert len(s) == 0
+
+    def test_sweep_finds_expired_mid_queue(self):
+        s = make_sched(sweep_interval_s=0.0)
+        s.submit("fast", "A", deadline_s=0.03)
+        s.submit("slow", "A", deadline_s=30.0)
+        time.sleep(0.05)
+        expired = s.take_expired()
+        assert [e.request_id for e in expired] == ["fast"]
+        assert s.pop().request_id == "slow"
+
+    def test_per_request_deadline_overrides_default(self):
+        s = make_sched(default_deadline_s=30.0, sweep_interval_s=0.0)
+        s.submit("r0", "A", deadline_s=0.03)
+        time.sleep(0.05)
+        assert [e.request_id for e in s.take_expired()] == ["r0"]
+
+    def test_expiry_sweep_then_resubmit_keeps_fairness(self):
+        """An expiry sweep empties a session's queue but leaves its sid
+        in the round-robin; resubmitting must NOT give that session two
+        turns per round (duplicate rr entries)."""
+        s = make_sched(sweep_interval_s=0.0, queue_bound=16)
+        s.submit("stale", "A", deadline_s=0.01)
+        s.submit("b0", "B")
+        time.sleep(0.03)
+        assert [e.request_id for e in s.take_expired()] == ["stale"]
+        for rid in ("a1", "a2", "a3"):
+            s.submit(rid, "A")
+        for rid in ("b1", "b2"):
+            s.submit(rid, "B")
+        order = []
+        while True:
+            e = s.pop()
+            if e is None:
+                break
+            order.append(e.request_id)
+        assert order == ["a1", "b0", "a2", "b1", "a3", "b2"], order
+
+    def test_aging_survives_stale_bulk_head(self):
+        """A stale bulk RR head (its queue emptied by an expiry sweep)
+        must not permanently mask the aging promotion."""
+        s = make_sched(bulk_aging_s=0.05, sweep_interval_s=0.0)
+        s.submit("old", "B1", priority="bulk", deadline_s=0.01)
+        time.sleep(0.03)
+        s.take_expired()  # B1's queue gone; sid stale in the bulk RR
+        s.submit("b2", "B2", priority="bulk")
+        time.sleep(0.08)  # b2 ages past the threshold
+        s.submit("i1", "I")
+        assert s.pop().request_id == "b2"
+
+
+class TestOverloadStateMachine:
+    def test_state_transitions(self):
+        s = make_sched(queue_bound=4, shed_hold_s=0.1)
+        assert s.overload_state() == STATE_HEALTHY
+        s.submit("r0", "A")
+        s.submit("r1", "B")
+        assert s.overload_state() == STATE_PRESSURED  # >= half the bound
+        s.submit("r2", "C")
+        s.submit("r3", "D")
+        assert s.overload_state() == STATE_SHEDDING  # at the bound
+        with pytest.raises(AdmissionRejected):
+            s.submit("r4", "E")
+        while s.pop() is not None:
+            pass
+        # Recent shed holds the state at shedding briefly (hysteresis),
+        # then the empty queue reads healthy again.
+        assert s.overload_state() == STATE_SHEDDING
+        time.sleep(0.12)
+        assert s.overload_state() == STATE_HEALTHY
+
+    def test_state_gauge_and_counters_published(self):
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        s = make_sched(queue_bound=1)
+        m = get_metrics()
+        assert m.gauge("sched_queue_bound").value == 1
+        s.submit("r0", "A")
+        assert m.gauge("sched_queue_depth").value == 1
+        shed_before = m.counter("sched_shed_total").value
+        with pytest.raises(AdmissionRejected):
+            s.submit("r1", "B")
+        assert m.counter("sched_shed_total").value == shed_before + 1
+        assert m.gauge("sched_overload_state").value == 2  # shedding
+
+    def test_client_deadline_shed_does_not_flip_state(self):
+        """A wait_too_long shed caused by ONE client's tiny deadline_s
+        must not report the whole server as shedding — only capacity
+        (queue_full) sheds drive the state machine."""
+        s = make_sched(queue_bound=100, slots=1)
+        s.note_service_time(10.0)
+        s.submit("r0", "A")
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit("r1", "B", deadline_s=0.01)
+        assert ei.value.reason == "wait_too_long"
+        assert s.overload_state() == STATE_HEALTHY
+
+    def test_stats_shape(self):
+        s = make_sched()
+        st = s.stats()
+        for key in ("state", "depth", "bound", "draining", "shed_total",
+                    "expired_total", "service_time_ema_s",
+                    "estimated_wait_s"):
+            assert key in st
+
+
+class TestDrain:
+    def test_drain_rejects_new_serves_queued(self):
+        s = make_sched()
+        s.submit("r0", "A")
+        s.begin_drain()
+        assert s.overload_state() == STATE_DRAINING
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit("r1", "B")
+        assert ei.value.reason == "draining"
+        assert s.pop().request_id == "r0"  # queued work still admits
+
+
+class TestSnapshot:
+    def test_positions_follow_admission_order(self):
+        s = make_sched()
+        s.submit("a0", "A")
+        s.submit("a1", "A")
+        s.submit("b0", "B")
+        snap = s.snapshot()
+        by_id = {e["request_id"]: e for e in snap}
+        assert by_id["a0"]["position"] == 0
+        assert by_id["b0"]["position"] == 1  # round-robin: B's turn
+        assert by_id["a1"]["position"] == 2
+        assert by_id["a0"]["deadline_in_s"] > 0
+        assert by_id["a0"]["priority"] == "interactive"
+
+
+class TestRemoteBackpressure:
+    """The remote branch gets the same discipline via a bounded
+    in-flight semaphore (_RemoteEngine._acquire_upstream)."""
+
+    def _engine(self, **kw):
+        from fasttalk_tpu.engine.remote import _RemoteEngine
+
+        return _RemoteEngine("http://upstream:1", **kw)
+
+    async def test_saturated_upstream_sheds_with_retry_after(self):
+        eng = self._engine(max_inflight=1, admission_timeout_s=0.05)
+        await eng._acquire_upstream()  # the one slot is taken
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected) as ei:
+            await eng._acquire_upstream()
+        assert time.monotonic() - t0 < 2.0
+        assert ei.value.reason == "upstream_saturated"
+        assert ei.value.retry_after >= 1.0
+        eng._release_upstream()
+        await eng._acquire_upstream()  # freed slot admits again
+        eng._release_upstream()
+
+    async def test_drain_rejects_before_waiting(self):
+        eng = self._engine(max_inflight=4, admission_timeout_s=5.0)
+        eng.begin_drain()
+        with pytest.raises(AdmissionRejected) as ei:
+            await eng._acquire_upstream()
+        assert ei.value.reason == "draining"
+
+    def test_factory_wires_backpressure_knobs(self):
+        """Remote providers must construct with the config's
+        backpressure knobs (a kwarg mismatch here crashed every remote
+        startup and no test covered the path)."""
+        from fasttalk_tpu.engine.factory import build_engine
+        from fasttalk_tpu.utils.config import Config
+
+        eng = build_engine(Config(llm_provider="vllm",
+                                  remote_max_inflight=7,
+                                  sched_default_deadline_s=3.0))
+        assert eng.max_inflight == 7
+        assert eng.admission_timeout_s == 3.0
+        eng2 = build_engine(Config(llm_provider="ollama",
+                                   remote_max_inflight=9))
+        assert eng2.max_inflight == 9
+
+    async def test_inflight_gauge_tracks(self):
+        eng = self._engine(max_inflight=2, admission_timeout_s=0.05)
+        await eng._acquire_upstream()
+        await eng._acquire_upstream()
+        assert eng.pending_requests() == 2
+        assert eng.get_stats()["inflight"] == 2
+        eng._release_upstream()
+        eng._release_upstream()
+        assert eng.pending_requests() == 0
+
+
+class _SheddingEngine:
+    """EngineBase stub whose generate always sheds — exercises the
+    serving-layer mapping without a real scheduler."""
+
+    def __init__(self):
+        from fasttalk_tpu.engine.fake import FakeEngine
+
+        self._inner = FakeEngine()
+        self._inner.start()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def generate(self, request_id, session_id, messages, params):
+        raise AdmissionRejected("admission queue full (1 waiting)",
+                                retry_after=7.0, reason="queue_full")
+        yield  # pragma: no cover
+
+
+class _ExpiringEngine:
+    """EngineBase stub whose generate yields a deadline-expiry terminal
+    event — exercises the serving-layer mapping (expiry is load
+    shedding: rate_limit frame / 429, breaker untouched)."""
+
+    def __init__(self):
+        from fasttalk_tpu.engine.fake import FakeEngine
+
+        self._inner = FakeEngine()
+        self._inner.start()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def generate(self, request_id, session_id, messages, params):
+        yield {"type": "error", "code": "deadline_expired",
+               "error": "request expired after 2.0s in the admission "
+               "queue (deadline 2.0s)", "retry_after": 3.0}
+
+
+class TestServingSurfaces:
+    async def _server(self, engine, **cfg_env):
+        import os
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from fasttalk_tpu.serving.server import WebSocketLLMServer
+        from fasttalk_tpu.utils.config import Config
+
+        old = {}
+        env = {"LLM_PROVIDER": "fake", "ENABLE_PYDANTIC_AI": "false",
+               **cfg_env}
+        for k, v in env.items():
+            old[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        try:
+            config = Config()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        server = WebSocketLLMServer(config, engine)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        return server, client
+
+    async def test_openai_route_sheds_as_429_with_retry_after(self):
+        engine = _SheddingEngine()
+        server, client = await self._server(engine)
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert resp.status == 429
+            assert resp.headers["Retry-After"] == "7"
+            body = await resp.json()
+            assert body["error"]["type"] == "rate_limit_error"
+            assert body["error"]["retry_after"] == 7.0
+            assert body["error"]["code"] == "queue_full"
+        finally:
+            await client.close()
+
+    async def test_openai_stream_shed_emits_error_frame(self):
+        engine = _SheddingEngine()
+        server, client = await self._server(engine)
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "stream": True})
+            text = (await resp.read()).decode()
+            frames = [json.loads(line[5:]) for line in text.splitlines()
+                      if line.startswith("data:")
+                      and line[5:].strip() != "[DONE]"]
+            err = next(f["error"] for f in frames if "error" in f)
+            assert err["retry_after"] == 7.0
+            assert err["code"] == "rate_limit_error"
+            assert text.rstrip().endswith("data: [DONE]")
+        finally:
+            await client.close()
+
+    async def test_ws_shed_error_frame_does_not_trip_breaker(self):
+        engine = _SheddingEngine()
+        server, client = await self._server(engine)
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            msg = json.loads((await ws.receive()).data)
+            assert msg["type"] == "session_started"
+            await ws.send_json({"type": "start_session", "config": {}})
+            await ws.receive()  # session_configured
+            for _ in range(6):  # past the breaker's failure threshold
+                await ws.send_json({"type": "user_message", "text": "hi"})
+                err = json.loads((await asyncio.wait_for(
+                    ws.receive(), timeout=10)).data)
+                assert err["type"] == "error"
+                assert err["error"]["code"] == "rate_limit_error"
+                assert err["error"]["retry_after"] == 7.0
+            # Shedding is self-protection, not backend failure: the
+            # shared breaker must still be closed.
+            assert server.breaker.to_dict()["state"] == "closed"
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_ws_expiry_maps_to_rate_limit_frame(self):
+        engine = _ExpiringEngine()
+        server, client = await self._server(engine)
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await ws.receive()
+            await ws.send_json({"type": "start_session", "config": {}})
+            await ws.receive()
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            err = json.loads((await asyncio.wait_for(
+                ws.receive(), timeout=10)).data)
+            assert err["type"] == "error"
+            assert err["error"]["code"] == "rate_limit_error"
+            assert err["error"]["retry_after"] == 3.0
+            assert err["error"]["details"]["reason"] == "deadline_expired"
+            # Expiry is shedding, not a backend fault.
+            assert server.breaker.to_dict()["state"] == "closed"
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_openai_expiry_maps_to_429(self):
+        engine = _ExpiringEngine()
+        server, client = await self._server(engine)
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert resp.status == 429
+            assert resp.headers["Retry-After"] == "3"
+            body = await resp.json()
+            assert body["error"]["code"] == "deadline_expired"
+            assert server.breaker.to_dict()["state"] == "closed"
+        finally:
+            await client.close()
+
+    async def test_connection_limit_rejection_hint_and_close_code(self):
+        from aiohttp import WSCloseCode
+
+        from fasttalk_tpu.engine.fake import FakeEngine
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        engine = FakeEngine()
+        engine.start()
+        server, client = await self._server(engine,
+                                            LLM_MAX_CONNECTIONS=1)
+        try:
+            ws1 = await client.ws_connect("/ws/llm")
+            await ws1.receive()  # session_started
+            ws2 = await client.ws_connect("/ws/llm")
+            err = json.loads((await ws2.receive()).data)
+            assert err["error"]["code"] == "max_connections"
+            assert err["error"]["retry_after"] >= 1.0
+            closing = await ws2.receive()
+            assert closing.data == WSCloseCode.TRY_AGAIN_LATER
+            assert get_metrics().counter(
+                "ws_connections_rejected_total").value == 1
+            await ws1.close()
+        finally:
+            await client.close()
+
+    async def test_drain_on_cleanup_finishes_inflight(self):
+        """Server cleanup drains: an in-flight generation finishes (and
+        its frames arrive) even though the engine stops admitting."""
+        from fasttalk_tpu.engine.fake import FakeEngine
+
+        engine = FakeEngine(delay_s=0.01)
+        engine.start()
+        server, client = await self._server(engine)
+        drained = []
+        engine.begin_drain = lambda: drained.append(True)  # observe
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await ws.receive()
+            await ws.send_json({"type": "start_session", "config": {}})
+            await ws.receive()
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            # First token is streaming; now tear the server down.
+            first = json.loads((await ws.receive()).data)
+            assert first["type"] == "token"
+        finally:
+            await client.close()  # triggers on_cleanup → drain
+        assert drained, "server cleanup must begin_drain the engine"
+
+
+def test_agent_final_preserves_error_payload():
+    """VoiceAgent terminal rebuilding must keep error/code/retry_after:
+    the serving layer keys shed handling (deadline_expired → retry_after
+    frame / 429, breaker untouched) on them."""
+    from fasttalk_tpu.agents.voice_agent import VoiceAgent
+
+    terminal = {"type": "error", "error": "request expired in queue",
+                "code": "deadline_expired", "retry_after": 3.0}
+    agg = {"tokens_generated": 0, "prompt_tokens": 0}
+    out = VoiceAgent._final(None, terminal, agg, time.monotonic(), None)
+    assert out["type"] == "error"
+    assert out["code"] == "deadline_expired"
+    assert out["retry_after"] == 3.0
+    assert out["error"] == "request expired in queue"
+
+
+class TestGenerationParamsValidation:
+    def test_priority_validated(self):
+        from fasttalk_tpu.engine.engine import GenerationParams
+
+        with pytest.raises(ValueError, match="priority"):
+            GenerationParams(priority="vip")
+        GenerationParams(priority="bulk")  # ok
+
+    def test_deadline_validated(self):
+        from fasttalk_tpu.engine.engine import GenerationParams
+
+        with pytest.raises(ValueError, match="deadline_s"):
+            GenerationParams(deadline_s=0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            GenerationParams(deadline_s=float("nan"))
+        GenerationParams(deadline_s=2.5)  # ok
+
+    def test_config_knobs_validated(self):
+        from fasttalk_tpu.utils.config import Config
+
+        with pytest.raises(ValueError, match="sched_queue_bound"):
+            Config(llm_provider="fake", sched_queue_bound=0)
+        with pytest.raises(ValueError, match="sched_default_priority"):
+            Config(llm_provider="fake", sched_default_priority="vip")
+        with pytest.raises(ValueError, match="remote_max_inflight"):
+            Config(llm_provider="fake", remote_max_inflight=0)
