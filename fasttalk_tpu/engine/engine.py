@@ -71,6 +71,8 @@ from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
                                        init_cache)
+from fasttalk_tpu.observability.events import get_events
+from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
                                        sample_tokens)
@@ -199,6 +201,11 @@ class _Request:
     detok_s: float = 0.0                # cumulative detokenize time
     spec_accepted: int = 0              # accepted draft tokens
     spec_drafted: int = 0               # drafts offered to verification
+    # Watchdog/SLO stamps (observability/watchdog.py, slo.py):
+    last_progress_at: float | None = None  # any forward progress
+    max_gap_ms: float = 0.0             # worst inter-token gap seen
+    stall_failed: bool = False          # terminated by the watchdog
+    slo_recorded: bool = False          # sample already fed to the SLO
 
 
 class EngineBase:
@@ -414,10 +421,21 @@ class TPUEngine(EngineBase):
         # (scheduling/scheduler.py, docs/SCHEDULING.md). Submissions go
         # straight into the scheduler from the asyncio side (so shed
         # decisions are synchronous); the engine thread pops.
+        self._slo = get_slo()
+        self._events = get_events()
         self._sched = RequestScheduler(
             queue_bound=queue_bound,
             default_deadline_s=default_deadline_s,
-            bulk_aging_s=bulk_aging_s, slots=num_slots)
+            bulk_aging_s=bulk_aging_s, slots=num_slots,
+            # SLO-aware shedding (docs/OBSERVABILITY.md): while the
+            # interactive class is page-burning, incoming bulk is shed
+            # at the door so capacity goes to the broken promise.
+            slo_gate=self._slo.should_shed)
+        # Engine-loop heartbeat (observability/watchdog.py): stamped
+        # once per loop iteration; a stale stamp with pending work is a
+        # hung step (blocked device call) the watchdog turns into a
+        # detected, logged, recoverable incident.
+        self._hb_mono: float | None = None
         self._prefilling: list[_PrefillState] = []  # long prompts, FIFO
         self._running: dict[int, _Request] = {}  # slot index -> request
         self._by_id: dict[str, _Request] = {}
@@ -430,6 +448,12 @@ class TPUEngine(EngineBase):
         # _started=False mid-shutdown and spawn a fresh engine thread
         # after the process believes the engine is down.
         self._lifecycle_lock = threading.Lock()
+        # Serializes terminal-state races between the engine thread
+        # (_finish) and the watchdog thread (force_fail): the
+        # stall-fail flag set and the SLO recorded-once check must be
+        # atomic or a request finishing at the instant it is
+        # force-failed double-records its SLO sample.
+        self._term_lock = threading.Lock()
         self._closed = False
         self._decode_fns: dict[int, Any] = {}
         self._prefill_fns: dict[int, Any] = {}
@@ -609,6 +633,7 @@ class TPUEngine(EngineBase):
             if self._thread is not None and self._thread.is_alive():
                 return False  # still tearing down; try again later
             log.warning("engine restart: rebuilding device decode state")
+            self._events.emit("engine_restart", severity="critical")
             # Entries whose requests were terminal-errored by
             # _abort_all must not be re-admitted; entries submitted in
             # the crash race window (after the sweep) survive and the
@@ -862,6 +887,7 @@ class TPUEngine(EngineBase):
         except AdmissionRejected:
             self._by_id.pop(request_id, None)
             req.finished = True
+            self._slo.record_shed(params.priority)
             if self._tracer.enabled:
                 self._tracer.event(request_id, "shed")
             if trace_owned:
@@ -915,6 +941,83 @@ class TPUEngine(EngineBase):
         return {"stats": self._sched.stats(),
                 "queued": self._sched.snapshot()}
 
+    # ---------------- watchdog surfaces (observability/watchdog.py) ----
+
+    def heartbeat_age(self, now: float | None = None) -> float | None:
+        """Seconds since the engine loop last completed an iteration
+        (None before the first one). A large age with pending work
+        means the thread is blocked inside a device call."""
+        hb = self._hb_mono
+        if hb is None:
+            return None
+        return (time.monotonic() if now is None else now) - hb
+
+    def progress_report(self, now: float | None = None,
+                        ) -> list[dict[str, Any]]:
+        """Admitted, unfinished requests with how long each has gone
+        without forward progress (a token, a prefill chunk, or
+        activation). Queued requests are excluded — the scheduler's
+        deadline sweep already governs them."""
+        now = time.monotonic() if now is None else now
+        out: list[dict[str, Any]] = []
+        # list() over the dict's values is atomic under the GIL; the
+        # engine thread may mutate the dict but never the snapshot.
+        for req in list(self._by_id.values()):
+            if req.finished or req.admitted_at is None:
+                continue
+            last = max(filter(None, (req.last_token_at,
+                                     req.last_progress_at,
+                                     req.admitted_at)))
+            out.append({
+                "request_id": req.request_id,
+                "session_id": req.session_id,
+                "phase": "decode" if req.decode_started_at is not None
+                else "prefill",
+                "no_progress_s": round(now - last, 3),
+            })
+        return out
+
+    def force_fail(self, request_id: str, error: str,
+                   code: str = "stalled") -> bool:
+        """Watchdog termination: emit a terminal error frame NOW, from
+        outside the engine thread — the whole point is that the engine
+        thread may be hung and unable to process a normal cancel. The
+        request is also marked cancelled and a cancel command queued,
+        so a revived engine thread frees the slot through the ordinary
+        _finish path (whose terminal event lands in an already-closed
+        stream and is dropped)."""
+        req = self._by_id.get(request_id)
+        if req is None:
+            return False
+        with self._term_lock:
+            if req.finished or req.stall_failed:
+                return False
+            req.stall_failed = True
+            req.cancelled = True
+        self._record_slo(req, ok=False)
+        self._emit(req, {"type": "error", "error": error, "code": code})
+        self._commands.put(("cancel", request_id))
+        return True
+
+    def _record_slo(self, req: _Request, ok: bool) -> None:
+        """Feed one finished request into the SLO engine (idempotent —
+        the watchdog's force_fail and the engine's _finish can both
+        reach a request, from different threads; the terminal lock
+        makes the recorded-once check atomic)."""
+        with self._term_lock:
+            if req.slo_recorded:
+                return
+            req.slo_recorded = True
+        ttft_ms = ((req.first_token_at - req.submitted_at) * 1000.0
+                   if req.first_token_at is not None else None)
+        qw_ms = ((req.admitted_at - req.submitted_at) * 1000.0
+                 if req.admitted_at is not None else None)
+        # A single-token reply has no inter-token gap to judge.
+        gap_ms = req.max_gap_ms if req.generated >= 2 else None
+        self._slo.record_request(req.params.priority, ok=ok,
+                                 ttft_ms=ttft_ms, queue_wait_ms=qw_ms,
+                                 max_gap_ms=gap_ms)
+
     def check_connection(self) -> bool:
         return self._started and self._thread is not None \
             and self._thread.is_alive()
@@ -948,6 +1051,15 @@ class TPUEngine(EngineBase):
         multi-host call sink (no-op single-host)."""
         if self.call_sink is not None:
             self.call_sink(kind, payload)
+
+    def _note_compile(self, kind: str, **attrs: Any) -> None:
+        """A jitted-executable cache miss while serving traffic is a
+        latency incident (the compile stalls the engine thread for
+        seconds): record it in the event log. Warmup misses (before
+        start()) are the expected cost and are not events."""
+        if self._started:
+            self._events.emit("recompile", severity="warning",
+                              what=kind, **attrs)
 
     def _put(self, arr):
         """Host array (or PRNG key) → device, replicated over the mesh
@@ -1003,6 +1115,7 @@ class TPUEngine(EngineBase):
         fn = self._decode_fns.get((kv_len, steps, with_history))
         if fn is not None:
             return fn
+        self._note_compile("decode", kv_len=kv_len, steps=steps)
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
         scatter = self._scatter_decode and not use_pallas
         rows = jnp.arange(self.num_slots)
@@ -1161,6 +1274,7 @@ class TPUEngine(EngineBase):
         fn = self._spec_fns.get(key)
         if fn is not None:
             return fn
+        self._note_compile("spec_decode", kv_len=kv_len, steps=steps)
         from fasttalk_tpu.models.llama import forward_decode_multi
 
         G = self.spec_draft
@@ -1329,6 +1443,7 @@ class TPUEngine(EngineBase):
         fn = self._prefill_fns.get(chunk)
         if fn is not None:
             return fn
+        self._note_compile("prefill", chunk=chunk)
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: KVCache, tokens, start, slot,
@@ -1440,6 +1555,8 @@ class TPUEngine(EngineBase):
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
+        self._note_compile("batched_prefill", chunk=chunk, group=group,
+                           ctx=ctx)
         replicate = self._replicate_sharding()
 
         @partial(jax.jit, donate_argnums=(1,))
@@ -1543,6 +1660,11 @@ class TPUEngine(EngineBase):
                  max_len=self.max_len)
         try:
             while True:
+                # Watchdog heartbeat: one float store per iteration
+                # (GIL-atomic, no lock). The loop iterates at least
+                # every 50 ms when idle (command-queue timeout), so a
+                # stale stamp means a blocked device call, not idleness.
+                self._hb_mono = time.monotonic()
                 idle = not self._running and not self._inflight \
                     and not self._prefilling and not self._pending_firsts
                 if not self._drain_commands(block=idle):
@@ -1625,10 +1747,13 @@ class TPUEngine(EngineBase):
         """Terminal-event every outstanding request so no caller awaits
         forever after a stop or crash."""
         for req in list(self._by_id.values()):
-            if not req.finished:
+            with self._term_lock:  # see _finish: atomic vs force_fail
+                if req.finished:
+                    continue
                 req.finished = True
-                self._emit(req, {"type": "error", "error": reason,
-                                 "code": "internal_error"})
+            self._record_slo(req, ok=False)
+            self._emit(req, {"type": "error", "error": reason,
+                             "code": "internal_error"})
         self._by_id.clear()
         self._sched.clear()
         self._prefilling.clear()
@@ -1882,6 +2007,15 @@ class TPUEngine(EngineBase):
                 st.start += take
                 slot.kv_written = st.start
                 st.todo = st.todo[take:]
+            # Each completed chunk is forward progress — for EVERY
+            # request in the prefill FIFO, not just the head: the ones
+            # queued behind it are advancing toward service, and
+            # counting their wait as "no progress" would let the
+            # watchdog force-fail healthy requests behind one long
+            # prompt.
+            now = time.monotonic()
+            for waiting in self._prefilling:
+                waiting.req.last_progress_at = now
             if st.todo:
                 return  # next chunk on a later iteration
             self._prefilling.pop(0)
@@ -2108,6 +2242,7 @@ class TPUEngine(EngineBase):
         slot.active = True
         req.slot = slot
         req.decode_started_at = time.monotonic()
+        req.last_progress_at = req.decode_started_at
         if req.admitted_at is not None:
             self._m_prefill_req.observe(
                 (req.decode_started_at - req.admitted_at) * 1000)
@@ -2444,7 +2579,10 @@ class TPUEngine(EngineBase):
         req.generated += 1
         now = time.monotonic()
         if req.last_token_at is not None:
-            self._m_intertok.observe((now - req.last_token_at) * 1000)
+            gap_ms = (now - req.last_token_at) * 1000
+            self._m_intertok.observe(gap_ms)
+            if gap_ms > req.max_gap_ms:
+                req.max_gap_ms = gap_ms  # SLO inter-token SLI
         req.last_token_at = now
         if req.first_token_at is None:
             req.first_token_at = now
@@ -2493,14 +2631,37 @@ class TPUEngine(EngineBase):
     def _finish(self, req: _Request, reason: str, error: str | None = None,
                 suppress_flush: bool = False, code: str = "model_error",
                 retry_after: float | None = None) -> None:
-        if req.finished:
-            return
-        req.finished = True
+        # Atomic check-and-set against the watchdog thread's
+        # force_fail: without the lock, a request completing at the
+        # instant its stall crosses the cancel threshold could get BOTH
+        # a success terminal and a "stalled" error, and an ok=False SLO
+        # sample for a request that actually finished.
+        with self._term_lock:
+            if req.finished:
+                return
+            req.finished = True
         if req.admitted_at is not None:
             # Admission→finish wall time feeds the scheduler's
             # service-time EMA (wait estimates, retry_after hints).
             self._sched.note_service_time(
                 time.monotonic() - req.admitted_at)
+        if reason != "cancelled":
+            # Cancels are the client's choice, not an SLO sample;
+            # watchdog-failed requests were already recorded as errors
+            # by force_fail (idempotent either way). Queue-deadline
+            # expiry is load SHEDDING, same as a submit-time shed: the
+            # request never touched the TPU, and counting it as an SLO
+            # error would page the error-rate objective for exactly
+            # the mechanism that protects the admitted requests'
+            # latency (docs/OBSERVABILITY.md).
+            if code == "deadline_expired" and error is not None:
+                with self._term_lock:
+                    already = req.slo_recorded
+                    req.slo_recorded = True
+                if not already:
+                    self._slo.record_shed(req.params.priority)
+            else:
+                self._record_slo(req, ok=error is None)
         slot = req.slot
         if slot is not None:
             decoding = self._running.get(slot.index) is req

@@ -26,8 +26,11 @@ import psutil
 from aiohttp import web
 
 from fasttalk_tpu import __version__
+from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.observability.export import chrome_trace, jsonl_dump
+from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
+from fasttalk_tpu.observability.watchdog import get_watchdog
 from fasttalk_tpu.utils.metrics import get_metrics
 
 _profiler_state = {"active": False, "log_dir": None, "started_at": None}
@@ -96,6 +99,30 @@ def build_monitoring_app(ready_check=None, sched_info=None,
             if state and state != "healthy":
                 body["status"] = state
                 warnings.append(f"Admission control {state}")
+        # Stall watchdog (observability/watchdog.py): a hung engine
+        # step or token-stalled requests degrade the health surface —
+        # the exact signal the reference's sidecar could never raise.
+        wd = get_watchdog().status()
+        body["watchdog"] = wd
+        if not wd["ok"]:
+            body["status"] = "degraded"
+            if wd["step_stalled"]:
+                warnings.append(
+                    f"Engine step loop stalled "
+                    f"(heartbeat {wd['heartbeat_age_s']}s old)")
+            for rid in wd["token_stalled"]:
+                warnings.append(f"Request {rid} token-stalled")
+        # SLO burn state (observability/slo.py): a page-level burn is a
+        # broken latency promise — degraded even though requests are
+        # still completing.
+        slo = get_slo().alert_summary()
+        if slo:
+            body["slo"] = slo
+            for cls, state in slo.items():
+                if state == "page":
+                    body["status"] = "degraded"
+                if state != "ok":
+                    warnings.append(f"SLO burn {state} for {cls}")
         if warnings:
             body["warnings"] = warnings
         return web.json_response(body)
@@ -109,6 +136,10 @@ def build_monitoring_app(ready_check=None, sched_info=None,
         return web.json_response({"status": "live"})
 
     async def metrics(request: web.Request) -> web.Response:
+        # Cheap scrape-time sample: refresh the engine-step heartbeat
+        # age gauge so stalls are visible to Prometheus even before the
+        # watchdog trips (one getattr + one float subtraction).
+        get_watchdog().sample()
         return web.Response(text=get_metrics().prometheus(),
                             content_type="text/plain")
 
@@ -284,9 +315,37 @@ def build_monitoring_app(ready_check=None, sched_info=None,
             lambda: chrome_trace(tracer, [trace]))
         return web.Response(text=text, content_type="application/json")
 
+    # ---- SLO engine + structured event log (ISSUE 3) ----
+
+    async def slo(request: web.Request) -> web.Response:
+        """Per-class SLO report: objectives, multi-window burn rates,
+        alert state (ok/warn/page) and goodput
+        (observability/slo.py)."""
+        return web.json_response(get_slo().snapshot())
+
+    async def events(request: web.Request) -> web.Response:
+        """Newest-first structured events (?limit=N, ?kind=...,
+        ?min_severity=warning|critical)."""
+        try:
+            limit = int(request.query.get("limit", "100"))
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an integer"}, status=400)
+        log_ = get_events()
+        return web.json_response({
+            "events": log_.recent(
+                limit=limit,
+                kind=request.query.get("kind") or None,
+                min_severity=request.query.get("min_severity") or None),
+            "total_emitted": log_.total_emitted,
+            "ring_size": log_.ring_size,
+        })
+
     app.router.add_get("/health", health)
     app.router.add_get("/health/ready", ready)
     app.router.add_get("/health/live", live)
+    app.router.add_get("/slo", slo)
+    app.router.add_get("/events", events)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/metrics.json", metrics_json)
     app.router.add_get("/info", info)

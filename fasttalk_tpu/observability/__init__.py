@@ -3,8 +3,18 @@ from fasttalk_tpu.observability.trace import (RequestTrace, Span, Tracer,
                                               reset_tracer)
 from fasttalk_tpu.observability.export import (chrome_trace, jsonl_dump,
                                                load_jsonl)
+from fasttalk_tpu.observability.events import (Event, EventLog, get_events,
+                                               reset_events)
+from fasttalk_tpu.observability.slo import (ClassObjectives, SLOEngine,
+                                            get_slo, objectives_from_env,
+                                            reset_slo)
+from fasttalk_tpu.observability.watchdog import (Watchdog, get_watchdog,
+                                                 reset_watchdog)
 
 __all__ = [
     "Span", "RequestTrace", "Tracer", "get_tracer", "reset_tracer",
     "bind_request", "chrome_trace", "jsonl_dump", "load_jsonl",
+    "Event", "EventLog", "get_events", "reset_events",
+    "ClassObjectives", "SLOEngine", "get_slo", "objectives_from_env",
+    "reset_slo", "Watchdog", "get_watchdog", "reset_watchdog",
 ]

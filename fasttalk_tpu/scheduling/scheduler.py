@@ -47,6 +47,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
 
+from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.utils.errors import AdmissionRejected
 from fasttalk_tpu.utils.metrics import get_metrics
 
@@ -87,7 +88,8 @@ class RequestScheduler:
                  slots: int = 16,
                  shed_hold_s: float = 5.0,
                  pressured_frac: float = 0.5,
-                 sweep_interval_s: float = 0.05):
+                 sweep_interval_s: float = 0.05,
+                 slo_gate=None):
         if queue_bound <= 0:
             raise ValueError("queue_bound must be > 0")
         if default_deadline_s <= 0:
@@ -101,6 +103,13 @@ class RequestScheduler:
         self.shed_hold_s = shed_hold_s
         self.pressured_frac = pressured_frac
         self._sweep_interval = sweep_interval_s
+        # Optional SLO consult (observability/slo.py should_shed):
+        # callable(priority) -> True when this class must be shed
+        # because a latency objective is burning. Evaluated OUTSIDE
+        # severity checks the queue itself makes — the SLO engine sees
+        # what the queue cannot (latency of requests already served).
+        self._slo_gate = slo_gate
+        self._events = get_events()
         self._lock = threading.Lock()
         # Per class: round-robin deque of session ids + per-session
         # FIFO deques. A session id may linger in the RR after its
@@ -158,28 +167,51 @@ class RequestScheduler:
                              f"got {priority!r}")
         now = time.monotonic()
         ttl = self.default_deadline_s if deadline_s is None else deadline_s
-        with self._lock:
-            if self._draining:
-                raise self._shed_locked(
-                    now, "server is draining: finishing in-flight "
-                    "requests, not accepting new ones",
-                    reason="draining")
-            if self._depth >= self.queue_bound:
-                raise self._shed_locked(
-                    now, f"admission queue full "
-                    f"({self.queue_bound} waiting)", reason="queue_full")
-            est = self._estimate_wait_locked()
-            if est > ttl:
-                raise self._shed_locked(
-                    now, f"estimated queue wait {est:.1f}s exceeds the "
-                    f"request deadline {ttl:.1f}s", reason="wait_too_long")
-            entry = QueuedRequest(
-                request_id=request_id, session_id=session_id,
-                priority=priority, submitted_at=now, deadline=now + ttl,
-                payload=payload)
-            self._push_locked(entry, front=False)
-            self._update_state_locked(now)
-            return entry
+        # SLO consult BEFORE taking the queue lock: the gate may
+        # evaluate burn windows under its own lock, and nesting it
+        # inside ours would order the two locks both ways round.
+        slo_shed = self._slo_gate is not None \
+            and self._slo_gate(priority)
+        try:
+            with self._lock:
+                if self._draining:
+                    raise self._shed_locked(
+                        now, "server is draining: finishing in-flight "
+                        "requests, not accepting new ones",
+                        reason="draining")
+                if slo_shed:
+                    raise self._shed_locked(
+                        now, f"{priority} submissions are being shed: "
+                        "the service is burning its interactive "
+                        "latency SLO budget", reason="slo_burn")
+                if self._depth >= self.queue_bound:
+                    raise self._shed_locked(
+                        now, f"admission queue full "
+                        f"({self.queue_bound} waiting)",
+                        reason="queue_full")
+                est = self._estimate_wait_locked()
+                if est > ttl:
+                    raise self._shed_locked(
+                        now, f"estimated queue wait {est:.1f}s exceeds "
+                        f"the request deadline {ttl:.1f}s",
+                        reason="wait_too_long")
+                entry = QueuedRequest(
+                    request_id=request_id, session_id=session_id,
+                    priority=priority, submitted_at=now,
+                    deadline=now + ttl, payload=payload)
+                self._push_locked(entry, front=False)
+                self._update_state_locked(now)
+                return entry
+        except AdmissionRejected as e:
+            # One event per shed BURST per reason (coalesced), emitted
+            # outside the queue lock — see _shed_locked.
+            payload_ev = getattr(e, "_shed_event", None)
+            if payload_ev:
+                self._events.emit("shed_burst", severity="warning",
+                                  coalesce_s=5.0,
+                                  coalesce_key=payload_ev["reason"],
+                                  **payload_ev)
+            raise
 
     def _shed_locked(self, now: float, message: str,
                      reason: str) -> AdmissionRejected:
@@ -193,7 +225,15 @@ class RequestScheduler:
         self._m_shed.inc()
         retry = self._retry_after_locked()
         self._update_state_locked(now)
-        return AdmissionRejected(message, retry_after=retry, reason=reason)
+        exc = AdmissionRejected(message, retry_after=retry, reason=reason)
+        # Event payload rides the exception; submit() emits it AFTER
+        # releasing the queue lock — emit() may mirror to a (possibly
+        # slow) EVENTS_JSONL disk, and that write must never serialise
+        # concurrent submitters and the engine's pop against this lock.
+        exc._shed_event = {"reason": reason, "depth": self._depth,
+                           "bound": self.queue_bound,
+                           "retry_after": round(retry, 2)}
+        return exc
 
     def _push_locked(self, entry: QueuedRequest, front: bool) -> None:
         sessions = self._sessions[entry.priority]
@@ -352,8 +392,12 @@ class RequestScheduler:
         """Stop admitting new submissions; queued and in-flight work
         still completes. Irreversible for this scheduler instance."""
         with self._lock:
+            already = self._draining
             self._draining = True
             self._update_state_locked(time.monotonic())
+        if not already:
+            self._events.emit("drain", depth=self._depth,
+                              bound=self.queue_bound)
 
     def clear(self) -> None:
         """Drop every queued entry (engine shutdown/crash: the caller

@@ -35,7 +35,9 @@ from aiohttp import WSCloseCode, WSMsgType, web
 
 from fasttalk_tpu import __version__
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import bind_request, get_tracer
+from fasttalk_tpu.observability.watchdog import get_watchdog
 from fasttalk_tpu.serving.connection import ConnectionManager, ConnectionState
 from fasttalk_tpu.serving.conversation import ConversationManager
 from fasttalk_tpu.serving.text_processor import extract_speakable_chunk
@@ -77,6 +79,14 @@ class WebSocketLLMServer:
         self._gen_tasks: dict[str, asyncio.Task] = {}
         self._cur_request: dict[str, str] = {}
         self._housekeeping: asyncio.Task | None = None
+        # Stall watchdog (observability/watchdog.py): heartbeats the
+        # engine step loop, flags token-stalled requests, cancels the
+        # hopeless ones with a proper terminal error, and degrades
+        # /health. Duck-typed — engines without the progress surfaces
+        # (FakeEngine, remote providers) are simply unwatched.
+        self.watchdog = get_watchdog()
+        self.watchdog.bind_engine(engine)
+        self._watchdog_task: asyncio.Task | None = None
         m = get_metrics()
         self._m_ws_tokens = m.counter("ws_tokens_streamed_total",
                                       "token frames streamed to clients")
@@ -117,10 +127,13 @@ class WebSocketLLMServer:
 
     async def _on_startup(self, app: web.Application) -> None:
         self._housekeeping = asyncio.create_task(self._housekeep())
+        self._watchdog_task = asyncio.create_task(self.watchdog.run())
 
     async def _on_cleanup(self, app: web.Application) -> None:
         if self._housekeeping:
             self._housekeeping.cancel()
+        if self._watchdog_task:
+            self._watchdog_task.cancel()
         # Graceful drain (docs/SCHEDULING.md): new submissions are
         # rejected with retry_after from here on, while generations
         # already streaming (or queued) get up to the drain timeout to
@@ -203,6 +216,20 @@ class WebSocketLLMServer:
                 body["scheduler"] = sched
                 if sched.get("state") != "healthy":
                     body["status"] = sched["state"]
+            # Watchdog + SLO burn state (docs/OBSERVABILITY.md): a hung
+            # engine step, token-stalled requests, or a page-level SLO
+            # burn all degrade the serving-port health too — load
+            # balancers watching this port must see them. Still 200:
+            # the server itself is reachable and serving.
+            wd = self.watchdog.status()
+            if not wd["ok"]:
+                body["status"] = "degraded"
+                body["watchdog"] = wd
+            slo = get_slo().alert_summary()
+            if slo:
+                body["slo"] = slo
+                if any(state == "page" for state in slo.values()):
+                    body["status"] = "degraded"
             return web.json_response(body, status=200 if ok else 503)
         except Exception as e:
             return web.json_response({"status": "unhealthy", "error": str(e)},
@@ -516,6 +543,22 @@ class WebSocketLLMServer:
                         # backend fault: surface it like a shed (frame
                         # keeps retry_after; breaker untouched).
                         raise AdmissionRejected.from_expiry_event(event)
+                    if event.get("code") == "stalled":
+                        # Watchdog-terminated (observability/watchdog
+                        # .py force_fail): a genuine backend fault —
+                        # the breaker counts it — but the frame keeps
+                        # the engine's "stalled" code so clients can
+                        # tell a hung backend from a model error.
+                        self.breaker.record_failure()
+                        self.connection_manager.record_error(session_id)
+                        await self._send(session_id, ws, {
+                            "type": "error",
+                            "error": {"code": "stalled",
+                                      "message": event.get("error", ""),
+                                      "severity": "high",
+                                      "recoverable": True}},
+                            request_id=request_id)
+                        return
                     raise LLMServiceError(event.get("error", "engine error"))
             if tts and tts_buffer:
                 await self._send(session_id, ws, {

@@ -319,6 +319,58 @@ class Config:
     # too (waiters past the admission deadline shed with retry_after).
     remote_max_inflight: int = field(
         default_factory=lambda: _env_int("REMOTE_MAX_INFLIGHT", 32))
+    # ---- SLOs + stall watchdog (observability/slo.py, watchdog.py,
+    # docs/OBSERVABILITY.md). The observability singletons read the
+    # same env knobs at construction; the fields here give operators
+    # one validated, discoverable surface (to_dict / docs). ----
+    # Latency promises for the interactive class (ms); bulk relaxes
+    # the latency targets by SLO_BULK_FACTOR (default 4x) unless
+    # overridden per class (SLO_BULK_TTFT_P95_MS, ...).
+    slo_ttft_p95_ms: float = field(
+        default_factory=lambda: _env_float("SLO_TTFT_P95_MS", 1500.0))
+    slo_inter_token_p99_ms: float = field(
+        default_factory=lambda: _env_float("SLO_INTER_TOKEN_P99_MS",
+                                           250.0))
+    slo_queue_wait_p95_ms: float = field(
+        default_factory=lambda: _env_float("SLO_QUEUE_WAIT_P95_MS",
+                                           1000.0))
+    slo_error_rate: float = field(
+        default_factory=lambda: _env_float("SLO_ERROR_RATE", 0.01))
+    # Burn-rate alert thresholds: page on fast+mid windows burning at
+    # >= page_burn, warn on mid+long windows at >= warn_burn.
+    slo_page_burn: float = field(
+        default_factory=lambda: _env_float("SLO_PAGE_BURN", 10.0))
+    slo_warn_burn: float = field(
+        default_factory=lambda: _env_float("SLO_WARN_BURN", 2.0))
+    # While the interactive class page-burns, shed incoming bulk at
+    # admission (scheduling/scheduler.py slo_gate).
+    slo_shed_bulk_on_page: bool = field(
+        default_factory=lambda: _env_bool("SLO_SHED_BULK_ON_PAGE", True))
+    # Watchdog: a request with no token for token_stall_s is flagged;
+    # past WATCHDOG_CANCEL_STALL_S (default 2x) it is terminated with a
+    # terminal error frame. An engine loop heartbeat older than
+    # step_stall_s with pending work is a hung step.
+    watchdog_token_stall_s: float = field(
+        default_factory=lambda: _env_float("WATCHDOG_TOKEN_STALL_S",
+                                           30.0))
+    watchdog_step_stall_s: float = field(
+        default_factory=lambda: _env_float("WATCHDOG_STEP_STALL_S",
+                                           15.0))
+    # Unset (-1) resolves to 2x the token stall in __post_init__,
+    # matching the watchdog's own env fallback.
+    watchdog_cancel_stall_s: float = field(
+        default_factory=lambda: _env_float("WATCHDOG_CANCEL_STALL_S",
+                                           -1.0))
+    watchdog_interval_s: float = field(
+        default_factory=lambda: _env_float("WATCHDOG_INTERVAL_S", 1.0))
+    watchdog_loop_lag_ms: float = field(
+        default_factory=lambda: _env_float("WATCHDOG_LOOP_LAG_MS",
+                                           500.0))
+    # Percentile-window horizon for /stats histograms (seconds): p95s
+    # reflect the last metrics_window_s, not hours-old requests
+    # (utils/metrics.py). <= 0 restores the pure sample-count window.
+    metrics_window_s: float = field(
+        default_factory=lambda: _env_float("METRICS_WINDOW_S", 300.0))
     # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
     # in-tree replacement for the reference's 300s engine-container
     # health start_period (docker-compose.vllm.yml:62-67). Empty means
@@ -331,6 +383,8 @@ class Config:
     def __post_init__(self) -> None:
         if not self.warmup:
             self.warmup = "fast" if self.llm_provider == "tpu" else "off"
+        if self.watchdog_cancel_stall_s == -1.0:  # unset: 2x token stall
+            self.watchdog_cancel_stall_s = 2.0 * self.watchdog_token_stall_s
         if self.default_repeat_penalty < 0:  # unset: provider-resolved
             self.default_repeat_penalty = \
                 1.0 if self.llm_provider == "vllm" else 1.1
@@ -405,6 +459,20 @@ class Config:
             errs.append("sched_drain_timeout_s must be >= 0")
         if self.remote_max_inflight <= 0:
             errs.append("remote_max_inflight must be > 0")
+        for name in ("slo_ttft_p95_ms", "slo_inter_token_p99_ms",
+                     "slo_queue_wait_p95_ms", "slo_page_burn",
+                     "slo_warn_burn", "watchdog_token_stall_s",
+                     "watchdog_step_stall_s", "watchdog_interval_s",
+                     "watchdog_cancel_stall_s", "watchdog_loop_lag_ms"):
+            if getattr(self, name) <= 0:
+                errs.append(f"{name} must be > 0")
+        if not (0.0 < self.slo_error_rate <= 1.0):
+            errs.append("slo_error_rate must be in (0, 1]")
+        if self.watchdog_cancel_stall_s < self.watchdog_token_stall_s:
+            # Cancellation cannot precede detection; a smaller value
+            # would silently mean max(token, cancel) (watchdog.py).
+            errs.append("watchdog_cancel_stall_s must be >= "
+                        "watchdog_token_stall_s")
         if self.warmup not in ("off", "fast", "full"):
             errs.append("warmup must be 'off', 'fast' or 'full'")
         if self.default_context_window < self.default_max_tokens:

@@ -11,10 +11,23 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 import threading
 import time
 from collections import deque
 from typing import Any, Iterable
+
+
+def _default_window_s() -> float:
+    """Percentile-window horizon (seconds); <= 0 disables time-based
+    eviction (pure sample-count window, the pre-ISSUE-3 behaviour)."""
+    raw = os.getenv("METRICS_WINDOW_S", "").strip()
+    if not raw:
+        return 300.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 300.0
 
 
 class Counter:
@@ -69,17 +82,31 @@ class Gauge:
 
 class Histogram:
     """Fixed-bucket histogram; also keeps a bounded sample window so the
-    /stats endpoint can report true percentiles (p50/p95 TTFT etc.)."""
+    /stats endpoint can report true percentiles (p50/p95 TTFT etc.).
+
+    The percentile window is bounded BOTH ways: at most ``window``
+    samples AND nothing older than ``window_s`` seconds
+    (``METRICS_WINDOW_S``, default 300). The count bound alone meant
+    that under low traffic /stats p95s reflected hours-old requests —
+    an incident stayed "visible" in the percentiles long after it
+    ended, and a quiet regression hid behind yesterday's good samples.
+    The cumulative bucket counts are untouched: Prometheus rate() math
+    needs monotonic counters, and gets them.
+    """
 
     def __init__(self, name: str, help_: str, buckets: Iterable[float],
-                 window: int = 2048):
+                 window: int = 2048, window_s: float | None = None,
+                 clock=time.monotonic):
         self.name = name
         self.help = help_
         self.buckets = sorted(buckets)
+        self.window_s = _default_window_s() if window_s is None \
+            else window_s
+        self._clock = clock
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._n = 0
-        self._window: deque[float] = deque(maxlen=window)
+        self._window: deque[tuple[float, float]] = deque(maxlen=window)
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -88,7 +115,24 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._n += 1
-            self._window.append(value)
+            now = self._clock()
+            self._window.append((now, value))
+            self._prune_locked(now)
+
+    def _prune_locked(self, now: float) -> None:
+        """Drop window samples older than window_s (amortised O(1):
+        entries leave at most once). Bucket counts are cumulative and
+        never pruned."""
+        if self.window_s <= 0:
+            return
+        horizon = now - self.window_s
+        w = self._window
+        while w and w[0][0] < horizon:
+            w.popleft()
+
+    def _window_values_locked(self) -> list[float]:
+        self._prune_locked(self._clock())
+        return [v for _, v in self._window]
 
     def clear(self) -> None:
         with self._lock:
@@ -110,13 +154,13 @@ class Histogram:
 
     def percentile(self, q: float) -> float:
         with self._lock:
-            s = sorted(self._window)
+            s = sorted(self._window_values_locked())
         return self._quantile(s, q)
 
     def summary(self) -> dict[str, float]:
         with self._lock:  # one consistent snapshot, one sort
             n, total = self._n, self._sum
-            s = sorted(self._window)
+            s = sorted(self._window_values_locked())
         return {
             "count": n,
             "sum": total,

@@ -14,6 +14,12 @@
 #                             serving surfaces, and the engine-level
 #                             queued-request race tests
 #                             (docs/SCHEDULING.md).
+#   ./run_tests.sh --slo      SLO/watchdog group: burn-rate windows,
+#                             goodput, the fake-clock stall watchdog,
+#                             /slo + /events endpoints, the strict
+#                             Prometheus validator, plus smoke runs of
+#                             scripts/check_prometheus.py and the
+#                             trace_report --slo CI gate.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -40,6 +46,30 @@ if [[ "${1:-}" == "--sched" ]]; then
     shift
     exec "${PYENV[@]}" python -m pytest tests/test_scheduling.py \
         "tests/test_engine.py::TestSchedulerRaces" "$@"
+fi
+
+if [[ "${1:-}" == "--slo" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_slo.py "$@"
+    echo "--- trace_report --slo gate (tests/data/sample_trace.jsonl) ---"
+    "${PYENV[@]}" python scripts/trace_report.py --slo \
+        tests/data/sample_trace.jsonl
+    echo "--- check_prometheus smoke (registry self-render) ---"
+    "${PYENV[@]}" python - <<'EOF'
+from fasttalk_tpu.utils.metrics import get_metrics
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "check_prometheus", "scripts/check_prometheus.py")
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+m = get_metrics()
+m.counter("smoke_total", "smoke").inc()
+m.histogram("smoke_ms", "smoke").observe(3.0)
+problems = mod.validate(m.prometheus())
+assert not problems, problems
+print("exposition format OK")
+EOF
+    exit 0
 fi
 
 exec "${PYENV[@]}" python -m pytest tests/ "$@"

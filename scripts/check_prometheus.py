@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Strict Prometheus exposition-format validator.
+
+PR 1 fixed a family of silent /metrics regressions (unescaped HELP
+newlines truncating the next line, int-vs-float ``le`` bounds rendering
+the same bucket two ways); this script makes that bug class
+un-reintroducible by validating the full text a scraper would see:
+
+- line grammar: ``# HELP``/``# TYPE`` comments and
+  ``name{labels} value [timestamp]`` samples, nothing else;
+- metric and label names against the Prometheus regexes, label values
+  properly quoted/escaped, values parseable as Go floats;
+- at most one HELP and one TYPE per metric, both BEFORE its samples,
+  and every metric's samples contiguous (interleaving is illegal);
+- no duplicate series (same name + label set twice);
+- histograms: every ``_bucket`` carries ``le``, bounds parse and
+  strictly increase, cumulative counts are non-decreasing, the
+  ``+Inf`` bucket exists and equals ``_count``, and ``_sum``/
+  ``_count`` are present.
+
+Usage:
+    python scripts/check_prometheus.py metrics.txt
+    curl -s localhost:9092/metrics | python scripts/check_prometheus.py -
+    python scripts/check_prometheus.py http://localhost:9092/metrics
+
+Exit 0 when clean; exit 1 listing every problem found. Stdlib-only, and
+importable (``validate(text) -> list[str]``) — tests run it against the
+live monitoring app's /metrics output (run_tests.sh --slo).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_METRIC_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# One label pair: name="value" with \\, \", \n escapes allowed inside.
+_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"     # metric name
+    r"(?:\{(.*)\})?"                   # optional label block
+    r" ([^ ]+)"                        # value
+    r"(?: ([0-9-]+))?$")               # optional timestamp
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _parse_value(raw: str) -> float | None:
+    if raw in ("+Inf", "-Inf", "NaN"):
+        return {"+Inf": float("inf"), "-Inf": float("-inf"),
+                "NaN": float("nan")}[raw]
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _parse_labels(block: str, problems: list[str],
+                  where: str) -> dict[str, str] | None:
+    """Parse a label block strictly: pairs separated by commas, no
+    trailing junk."""
+    labels: dict[str, str] = {}
+    rest = block
+    while rest:
+        m = _PAIR_RE.match(rest)
+        if m is None:
+            problems.append(f"{where}: malformed label block at "
+                            f"{rest[:30]!r}")
+            return None
+        name, value = m.group(1), m.group(2)
+        if not _LABEL_RE.match(name):
+            problems.append(f"{where}: bad label name {name!r}")
+        if name in labels:
+            problems.append(f"{where}: duplicate label {name!r}")
+        labels[name] = value
+        rest = rest[m.end():]
+        if rest.startswith(","):
+            rest = rest[1:]
+        elif rest:
+            problems.append(f"{where}: junk after label pair: "
+                            f"{rest[:30]!r}")
+            return None
+    return labels
+
+
+def _base_name(name: str, typ: str | None) -> str:
+    """Samples of a histogram/summary family live under suffixed
+    names; map them back to the declared family name."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def validate(text: str) -> list[str]:
+    """Validate exposition text; returns a list of problems (empty =
+    clean)."""
+    problems: list[str] = []
+    if text and not text.endswith("\n"):
+        problems.append("exposition must end with a newline")
+    helps: set[str] = set()
+    types: dict[str, str] = {}
+    # family -> list of (labels, value) per suffixed sample name
+    series_seen: set[tuple[str, tuple[tuple[str, str], ...]]] = set()
+    family_order: list[str] = []
+    family_done: set[str] = set()
+    sampled_families: set[str] = set()
+    buckets: dict[str, list[tuple[float, float]]] = {}
+    sums: dict[str, float] = {}
+    counts: dict[str, float] = {}
+    current: str | None = None
+
+    for i, line in enumerate(text.splitlines(), start=1):
+        where = f"line {i}"
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) >= 2 and parts[1] not in ("HELP", "TYPE"):
+                continue  # free comment
+            if len(parts) < 3:
+                problems.append(f"{where}: malformed comment {line!r}")
+                continue
+            kind, name = parts[1], parts[2]
+            if not _METRIC_RE.match(name):
+                problems.append(f"{where}: bad metric name {name!r}")
+            if kind == "HELP":
+                if name in helps:
+                    problems.append(f"{where}: second HELP for {name}")
+                helps.add(name)
+            else:
+                typ = parts[3] if len(parts) > 3 else ""
+                if typ not in _TYPES:
+                    problems.append(f"{where}: bad TYPE {typ!r} "
+                                    f"for {name}")
+                if name in types:
+                    problems.append(f"{where}: second TYPE for {name}")
+                types[name] = typ
+            if name in sampled_families:
+                problems.append(f"{where}: {kind} for {name} after its "
+                                "samples (must precede them)")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"{where}: unparseable sample {line!r}")
+            continue
+        name, label_block, raw_value, _ts = m.groups()
+        if not _METRIC_RE.match(name):
+            problems.append(f"{where}: bad metric name {name!r}")
+        labels = _parse_labels(label_block or "", problems, where)
+        if labels is None:
+            continue
+        value = _parse_value(raw_value)
+        if value is None:
+            problems.append(f"{where}: unparseable value "
+                            f"{raw_value!r}")
+            continue
+        family = _base_name(name, None)
+        if family not in types and name in types:
+            family = name
+        # Contiguity: once another family's samples started, earlier
+        # families must not reappear.
+        if current != family:
+            if family in family_done:
+                problems.append(f"{where}: samples of {family} are "
+                                "interleaved with another metric's")
+            if current is not None:
+                family_done.add(current)
+            if family not in family_order:
+                family_order.append(family)
+            current = family
+        sampled_families.add(family)
+        key = (name, tuple(sorted(labels.items())))
+        if key in series_seen:
+            problems.append(f"{where}: duplicate series {name}"
+                            f"{dict(labels)}")
+        series_seen.add(key)
+        if types.get(family) == "histogram":
+            if name.endswith("_bucket"):
+                le = labels.get("le")
+                if le is None:
+                    problems.append(f"{where}: histogram bucket "
+                                    f"without le label")
+                    continue
+                bound = _parse_value(le)
+                if bound is None:
+                    problems.append(f"{where}: unparseable le "
+                                    f"{le!r}")
+                    continue
+                buckets.setdefault(family, []).append((bound, value))
+            elif name.endswith("_sum"):
+                sums[family] = value
+            elif name.endswith("_count"):
+                counts[family] = value
+            elif name == family:
+                problems.append(f"{where}: bare sample {name} for a "
+                                "histogram (expected _bucket/_sum/"
+                                "_count)")
+
+    for family, typ in types.items():
+        if typ != "histogram":
+            continue
+        bs = buckets.get(family, [])
+        if not bs:
+            problems.append(f"histogram {family}: no buckets")
+            continue
+        bounds = [b for b, _ in bs]
+        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            problems.append(f"histogram {family}: le bounds not "
+                            "strictly increasing")
+        vals = [v for _, v in bs]
+        if any(v2 < v1 for v1, v2 in zip(vals, vals[1:])):
+            problems.append(f"histogram {family}: cumulative bucket "
+                            "counts decrease")
+        if bounds[-1] != float("inf"):
+            problems.append(f"histogram {family}: missing +Inf bucket")
+        if family not in counts:
+            problems.append(f"histogram {family}: missing _count")
+        elif bounds[-1] == float("inf") \
+                and vals[-1] != counts[family]:
+            problems.append(
+                f"histogram {family}: +Inf bucket ({vals[-1]}) != "
+                f"_count ({counts[family]})")
+        if family not in sums:
+            problems.append(f"histogram {family}: missing _sum")
+    return problems
+
+
+def _read(source: str) -> str:
+    if source == "-":
+        return sys.stdin.read()
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(source, timeout=10) as resp:
+            return resp.read().decode("utf-8")
+    with open(source, encoding="utf-8") as fp:
+        return fp.read()
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: check_prometheus.py <file | - | http://...>",
+              file=sys.stderr)
+        return 2
+    try:
+        text = _read(argv[0])
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    problems = validate(text)
+    if problems:
+        for p in problems:
+            print(p, file=sys.stderr)
+        print(f"{len(problems)} problem(s)", file=sys.stderr)
+        return 1
+    print("exposition format OK "
+          f"({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

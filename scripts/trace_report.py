@@ -11,6 +11,22 @@ Usage:
     python scripts/trace_report.py dump.jsonl
     curl -s localhost:9092/traces?format=jsonl | \
         python scripts/trace_report.py -
+    python scripts/trace_report.py --slo dump.jsonl   # CI gate
+
+``--slo`` evaluates the dump against the configured SLO targets
+(``SLO_TTFT_P95_MS`` etc. — same knobs and defaults as
+fasttalk_tpu/observability/slo.py) and exits non-zero on violation, so
+a bench run can gate CI on its own trace dump. Derivations from span
+records (the dump has no per-token data):
+
+- TTFT per request: the ``first_token`` marker minus the request's
+  submit time when present, else queue_wait + prefill durations (the
+  prefill span ends at the first-token sample).
+- queue wait: the ``queue_wait`` span duration.
+- inter-token gap: estimated per ``decode_step`` span as
+  ``dur_ms / tokens`` (the call's amortised per-token pace).
+- error rate: requests whose ``decode`` span carries
+  ``finish_reason: "error"`` or whose ``queue_wait`` is ``expired``.
 
 Runs stdlib-only (no jax, no aiohttp import at module level) so it
 works on a laptop against a dump scp'd from a TPU VM.
@@ -21,9 +37,20 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 from collections import defaultdict
 from typing import Any, Iterable, TextIO
+
+# Mirrors fasttalk_tpu/observability/slo.py DEFAULTS (this script must
+# stay stdlib-only and importable on a bare laptop, so it cannot import
+# the package); tests/test_slo.py pins the two tables equal.
+SLO_DEFAULTS = {
+    "SLO_TTFT_P95_MS": 1500.0,
+    "SLO_INTER_TOKEN_P99_MS": 250.0,
+    "SLO_QUEUE_WAIT_P95_MS": 1000.0,
+    "SLO_ERROR_RATE": 0.01,
+}
 
 
 def load_records(fp: TextIO) -> list[dict[str, Any]]:
@@ -89,9 +116,112 @@ def format_table(rows: list[dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def _slo_target(name: str) -> float:
+    raw = os.environ.get(name, "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return SLO_DEFAULTS[name]
+
+
+def slo_evaluate(records: Iterable[dict[str, Any]],
+                 ) -> tuple[list[dict[str, Any]], bool]:
+    """Evaluate a dump against the SLO targets. Returns (rows, ok);
+    an objective with no evaluable data passes vacuously (n=0)."""
+    by_req: dict[str, list[dict[str, Any]]] = defaultdict(list)
+    for rec in records:
+        rid = rec.get("request_id")
+        if rid:
+            by_req[rid].append(rec)
+    ttfts: list[float] = []
+    waits: list[float] = []
+    gaps: list[float] = []
+    errors = 0
+    shed = 0
+    for rid, spans in by_req.items():
+        named: dict[str, dict[str, Any]] = {}
+        for s in spans:
+            named.setdefault(str(s["span"]), s)
+        qw = named.get("queue_wait")
+        if ((qw or {}).get("attrs") or {}).get("expired"):
+            # Queue-deadline expiry is load SHEDDING: the live SLO
+            # engine records it as a shed, not a sample (engine._finish
+            # / slo.record_shed) — the CI gate must agree, or an
+            # overload bench that /slo calls healthy would fail here.
+            shed += 1
+            continue
+        if qw is not None:
+            waits.append(float(qw.get("dur_ms", 0.0)))
+        first = named.get("first_token")
+        if first is not None:
+            submit = min(float(s["ts"]) for s in spans)
+            ttfts.append((float(first["ts"]) - submit) * 1000.0)
+        elif qw is not None and "prefill" in named:
+            ttfts.append(float(qw.get("dur_ms", 0.0))
+                         + float(named["prefill"].get("dur_ms", 0.0)))
+        for s in spans:
+            if s["span"] == "decode_step":
+                toks = (s.get("attrs") or {}).get("tokens") or 0
+                if toks > 0:
+                    gaps.append(float(s.get("dur_ms", 0.0)) / toks)
+        reason = (named.get("decode", {}).get("attrs") or {}) \
+            .get("finish_reason")
+        if reason == "error":
+            errors += 1
+
+    rows: list[dict[str, Any]] = []
+
+    def check(objective: str, values: list[float], q: float,
+              target: float, unit: str = "ms") -> None:
+        values = sorted(values)
+        observed = percentile(values, q) if values else None
+        rows.append({
+            "objective": objective, "n": len(values),
+            "observed": observed, "target": target, "unit": unit,
+            "ok": observed is None or observed <= target,
+        })
+
+    check("ttft_p95_ms", ttfts, 95, _slo_target("SLO_TTFT_P95_MS"))
+    check("inter_token_p99_ms", gaps, 99,
+          _slo_target("SLO_INTER_TOKEN_P99_MS"))
+    check("queue_wait_p95_ms", waits, 95,
+          _slo_target("SLO_QUEUE_WAIT_P95_MS"))
+    n_req = len(by_req) - shed  # sheds are not SLO samples
+    err_rate = errors / n_req if n_req > 0 else None
+    rows.append({
+        "objective": "error_rate", "n": max(0, n_req),
+        "observed": err_rate,
+        "target": _slo_target("SLO_ERROR_RATE"), "unit": "frac",
+        "ok": err_rate is None
+        or err_rate <= _slo_target("SLO_ERROR_RATE"),
+    })
+    if shed:
+        print(f"note: {shed} deadline-expired request(s) excluded "
+              "(shed, not SLO samples)", file=sys.stderr)
+    return rows, all(r["ok"] for r in rows)
+
+
+def format_slo_table(rows: list[dict[str, Any]]) -> str:
+    lines = [f"{'objective':<22}{'n':>6}{'observed':>12}{'target':>12}"
+             f"  result"]
+    lines.append("-" * len(lines[0]))
+    for r in rows:
+        obs = "-" if r["observed"] is None else f"{r['observed']:.2f}"
+        lines.append(
+            f"{r['objective']:<22}{r['n']:>6}{obs:>12}"
+            f"{r['target']:>12.2f}  "
+            + ("PASS" if r["ok"] else "FAIL"))
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dump", help="JSONL trace dump path, or - for stdin")
+    ap.add_argument("--slo", action="store_true",
+                    help="evaluate the dump against the configured "
+                    "SLO_* targets; exit 1 on violation (CI gate)")
     args = ap.parse_args(argv)
     try:
         if args.dump == "-":
@@ -109,6 +239,14 @@ def main(argv: list[str] | None = None) -> int:
                 if r.get("request_id")}
     print(f"{len(records)} spans across {len(requests)} requests")
     print()
+    if args.slo:
+        rows, ok = slo_evaluate(records)
+        print(format_slo_table(rows))
+        if not ok:
+            print("\nSLO VIOLATION", file=sys.stderr)
+            return 1
+        print("\nall SLO targets met")
+        return 0
     print(format_table(phase_table(records)))
     return 0
 

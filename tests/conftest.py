@@ -44,10 +44,19 @@ def pytest_pyfunc_call(pyfuncitem):
 
 @pytest.fixture(autouse=True)
 def _fresh_metrics():
+    from fasttalk_tpu.observability.events import reset_events
+    from fasttalk_tpu.observability.slo import reset_slo
     from fasttalk_tpu.observability.trace import reset_tracer
+    from fasttalk_tpu.observability.watchdog import reset_watchdog
     from fasttalk_tpu.utils.metrics import reset_metrics
 
     reset_metrics()
     reset_tracer()
+    reset_events()
+    reset_slo()
+    reset_watchdog()
     yield
     reset_metrics()
+    reset_events()
+    reset_slo()
+    reset_watchdog()

@@ -996,7 +996,11 @@ class TestSchedulerRaces:
         return events
 
     @staticmethod
-    async def _wait_until(pred, timeout=10.0):
+    async def _wait_until(pred, timeout=30.0):
+        # 30 s, not 10: the occupant's first token may sit behind a
+        # first-use XLA compile, and on a contended CPU box that
+        # occasionally exceeded 10 s (flaked twice in full tier-1
+        # runs). Success returns immediately — only failures wait.
         import time
 
         deadline = time.monotonic() + timeout

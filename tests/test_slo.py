@@ -1,0 +1,645 @@
+"""SLO engine, stall watchdog, structured event log, and their
+satellites (ISSUE 3): burn-rate window math, goodput accounting,
+fake-clock watchdog detection (stalled engine step + token-stalled
+request) with /events entries and a degraded /health, /slo + /events
+endpoint schemas, the time-aware histogram window, the strict
+Prometheus exposition validator, and the trace_report --slo CI gate.
+
+No real sleeps anywhere: every time-dependent object takes an
+injectable clock.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.observability.events import EventLog, get_events
+from fasttalk_tpu.observability.slo import (ALERT_OK, ALERT_PAGE,
+                                            ALERT_WARN, DEFAULTS,
+                                            SLOEngine, get_slo,
+                                            objectives_from_env)
+from fasttalk_tpu.observability.watchdog import Watchdog, get_watchdog
+from fasttalk_tpu.utils.errors import AdmissionRejected
+from fasttalk_tpu.utils.metrics import Histogram, get_metrics
+
+_HERE = os.path.dirname(__file__)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_HERE, "..", "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace_report = _load_script("trace_report")
+check_prometheus = _load_script("check_prometheus")
+
+SAMPLE = os.path.join(_HERE, "data", "sample_trace.jsonl")
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> "FakeClock":
+        self.t += dt
+        return self
+
+
+# ---------------------------------------------------------------- events
+
+
+class TestEventLog:
+    def test_emit_recent_and_bounding(self):
+        log = EventLog(ring_size=3, clock=FakeClock())
+        for i in range(5):
+            log.emit("kind_a", n=i)
+        recent = log.recent()
+        assert len(recent) == 3
+        assert [e["attrs"]["n"] for e in recent] == [4, 3, 2]  # newest 1st
+        assert log.total_emitted == 5
+        assert recent[0]["seq"] > recent[1]["seq"]
+
+    def test_coalescing(self):
+        clk = FakeClock()
+        log = EventLog(ring_size=16, clock=clk)
+        log.emit("shed_burst", coalesce_s=5.0, reason="queue_full")
+        clk.advance(1.0)
+        log.emit("shed_burst", coalesce_s=5.0, reason="queue_full")
+        clk.advance(1.0)
+        log.emit("other")
+        assert len(log.recent()) == 2
+        burst = log.recent(kind="shed_burst")[0]
+        assert burst["count"] == 2
+        assert burst["last_ts"] > burst["ts"]
+        # Past the window: a NEW event, not a bump.
+        clk.advance(10.0)
+        log.emit("shed_burst", coalesce_s=5.0, reason="queue_full")
+        assert len(log.recent(kind="shed_burst")) == 2
+
+    def test_severity_filter_and_kind_filter(self):
+        log = EventLog(ring_size=16, clock=FakeClock())
+        log.emit("a", severity="info")
+        log.emit("b", severity="warning")
+        log.emit("c", severity="critical")
+        assert [e["kind"] for e in log.recent(min_severity="warning")] \
+            == ["c", "b"]
+        assert [e["kind"] for e in log.recent(kind="b")] == ["b"]
+        assert log.recent(limit=1)[0]["kind"] == "c"
+
+    def test_jsonl_mirror(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        log = EventLog(ring_size=4, jsonl_path=str(path),
+                       clock=FakeClock())
+        log.emit("drain", depth=3)
+        log.emit("stall_detected", severity="critical", stall="token")
+        lines = [json.loads(x)
+                 for x in path.read_text().splitlines()]
+        assert [x["kind"] for x in lines] == ["drain", "stall_detected"]
+        assert lines[0]["attrs"]["depth"] == 3
+
+    def test_clear_in_place(self):
+        log = get_events()
+        log.emit("x")
+        log.clear()
+        assert log.recent() == []
+        assert log.total_emitted == 0
+        assert get_events() is log
+
+
+# ---------------------------------------------------------------- SLO
+
+
+def _slo(clk, **kw):
+    kw.setdefault("windows_s", (60.0, 300.0, 1800.0))
+    kw.setdefault("page_burn", 10.0)
+    kw.setdefault("warn_burn", 2.0)
+    kw.setdefault("min_samples", 5)
+    kw.setdefault("eval_interval_s", 0.0)
+    return SLOEngine(clock=clk, **kw)
+
+
+def _good(slo, clk, n=10, cls="interactive"):
+    for _ in range(n):
+        slo.record_request(cls, ok=True, ttft_ms=100.0,
+                           queue_wait_ms=10.0, max_gap_ms=20.0,
+                           now=clk())
+
+
+def _bad_ttft(slo, clk, n=10, cls="interactive"):
+    for _ in range(n):
+        slo.record_request(cls, ok=True, ttft_ms=60_000.0,
+                           queue_wait_ms=10.0, max_gap_ms=20.0,
+                           now=clk())
+
+
+class TestObjectivesFromEnv:
+    def test_defaults_and_bulk_factor(self, monkeypatch):
+        monkeypatch.delenv("SLO_TTFT_P95_MS", raising=False)
+        o = objectives_from_env("interactive")
+        assert o.ttft_p95_ms == DEFAULTS["SLO_TTFT_P95_MS"]
+        b = objectives_from_env("bulk")
+        assert b.ttft_p95_ms == DEFAULTS["SLO_TTFT_P95_MS"] * 4
+        assert b.error_rate == o.error_rate  # error budget not relaxed
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("SLO_TTFT_P95_MS", "800")
+        monkeypatch.setenv("SLO_BULK_FACTOR", "2")
+        assert objectives_from_env("interactive").ttft_p95_ms == 800
+        assert objectives_from_env("bulk").ttft_p95_ms == 1600
+        monkeypatch.setenv("SLO_BULK_TTFT_P95_MS", "9000")
+        assert objectives_from_env("bulk").ttft_p95_ms == 9000
+
+
+class TestBurnRateWindows:
+    def test_all_good_is_ok_with_full_goodput(self):
+        clk = FakeClock()
+        slo = _slo(clk)
+        _good(slo, clk, n=20)
+        snap = slo.snapshot(now=clk())
+        cls = snap["classes"]["interactive"]
+        assert cls["alert"] == ALERT_OK
+        w = cls["windows"]["1m"]
+        assert w["n"] == 20
+        assert w["goodput"] == 1.0
+        assert w["max_burn"] == 0.0
+        assert cls["totals"]["goodput"] == 1.0
+
+    def test_total_violation_pages_and_emits_events(self):
+        clk = FakeClock()
+        slo = _slo(clk)
+        _bad_ttft(slo, clk, n=20)
+        assert slo.alert_state("interactive", now=clk()) == ALERT_PAGE
+        burn = slo.snapshot(now=clk())["classes"]["interactive"][
+            "windows"]["1m"]["burn"]
+        assert burn["ttft"] == pytest.approx(20.0)  # 100% bad / 5%
+        start = get_events().recent(kind="slo_burn_start")
+        assert start and start[0]["attrs"]["cls"] == "interactive"
+        assert start[0]["attrs"]["state"] == ALERT_PAGE
+        assert start[0]["severity"] == "critical"
+        # Windows slide past the samples -> recovery + burn_stop event.
+        clk.advance(2000.0)
+        assert slo.alert_state("interactive", now=clk()) == ALERT_OK
+        assert get_events().recent(kind="slo_burn_stop")
+
+    def test_partial_violation_warns_not_pages(self):
+        clk = FakeClock()
+        slo = _slo(clk)
+        _good(slo, clk, n=18)
+        _bad_ttft(slo, clk, n=2)  # 10% bad -> burn 2.0
+        snap = slo.snapshot(now=clk())
+        cls = snap["classes"]["interactive"]
+        assert cls["alert"] == ALERT_WARN
+        assert cls["windows"]["5m"]["burn"]["ttft"] == pytest.approx(2.0)
+        assert cls["windows"]["1m"]["goodput"] == pytest.approx(0.9)
+
+    def test_min_samples_gate(self):
+        clk = FakeClock()
+        slo = _slo(clk, min_samples=50)
+        _bad_ttft(slo, clk, n=20)  # every sample violating, but n < 50
+        assert slo.alert_state("interactive", now=clk()) == ALERT_OK
+
+    def test_short_spike_does_not_page_without_mid_window(self):
+        clk = FakeClock()
+        slo = _slo(clk)
+        # Old good traffic fills the mid window; a 1m spike alone must
+        # not page (fast AND mid must both burn).
+        _good(slo, clk, n=200)
+        clk.advance(120.0)
+        _bad_ttft(slo, clk, n=6)
+        snap = slo.snapshot(now=clk())
+        cls = snap["classes"]["interactive"]
+        assert cls["windows"]["1m"]["burn"]["ttft"] >= 10.0
+        assert cls["alert"] != ALERT_PAGE
+
+    def test_error_rate_objective(self):
+        clk = FakeClock()
+        slo = _slo(clk)
+        _good(slo, clk, n=10)
+        for _ in range(10):
+            slo.record_request("interactive", ok=False, ttft_ms=None,
+                               queue_wait_ms=None, max_gap_ms=None,
+                               now=clk())
+        w = slo.snapshot(now=clk())["classes"]["interactive"][
+            "windows"]["1m"]
+        assert w["error_rate"] == pytest.approx(0.5)
+        assert w["burn"]["error"] == pytest.approx(50.0)  # 0.5 / 0.01
+        assert slo.alert_state("interactive", now=clk()) == ALERT_PAGE
+
+    def test_goodput_and_shed_totals_per_class(self):
+        clk = FakeClock()
+        slo = _slo(clk)
+        _good(slo, clk, n=8)
+        _bad_ttft(slo, clk, n=2)
+        _good(slo, clk, n=3, cls="bulk")
+        slo.record_shed("bulk", now=clk())
+        snap = slo.snapshot(now=clk())
+        t = snap["classes"]["interactive"]["totals"]
+        assert (t["requests"], t["good"], t["errors"]) == (10, 8, 0)
+        assert t["goodput"] == pytest.approx(0.8)
+        bt = snap["classes"]["bulk"]["totals"]
+        assert bt["requests"] == 3 and bt["shed"] == 1
+
+    def test_should_shed_gates_bulk_on_interactive_page(self):
+        clk = FakeClock()
+        slo = _slo(clk, shed_bulk_on_page=True)
+        assert slo.should_shed("bulk", now=clk()) is False
+        _bad_ttft(slo, clk, n=20)
+        assert slo.should_shed("bulk", now=clk()) is True
+        assert slo.should_shed("interactive", now=clk()) is False
+        slo.shed_bulk_on_page = False
+        assert slo.should_shed("bulk", now=clk()) is False
+
+
+class TestSchedulerSLOGate:
+    def test_bulk_shed_when_gate_fires(self):
+        from fasttalk_tpu.scheduling.scheduler import RequestScheduler
+
+        sched = RequestScheduler(queue_bound=8, slots=2,
+                                 slo_gate=lambda p: p == "bulk")
+        sched.submit("r1", "s1")  # interactive passes
+        with pytest.raises(AdmissionRejected) as ei:
+            sched.submit("r2", "s2", priority="bulk")
+        assert ei.value.reason == "slo_burn"
+        assert ei.value.retry_after >= 1.0
+        assert get_events().recent(kind="shed_burst")
+
+
+# ---------------------------------------------------------------- watchdog
+
+
+class StubEngine:
+    """Synthetic engine for fake-clock watchdog tests: heartbeat and
+    per-request progress fully scripted."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.hb = clock()
+        self.pending = 0
+        self.report = []
+        self.failed = []
+
+    def heartbeat_age(self, now=None):
+        return (self.clock() if now is None else now) - self.hb
+
+    def pending_requests(self):
+        return self.pending
+
+    def progress_report(self, now=None):
+        return [dict(r) for r in self.report]
+
+    def force_fail(self, request_id, error, code="stalled"):
+        self.failed.append((request_id, error, code))
+        self.report = [r for r in self.report
+                       if r["request_id"] != request_id]
+        return True
+
+
+def _watchdog(clk, **kw):
+    kw.setdefault("token_stall_s", 30.0)
+    kw.setdefault("step_stall_s", 15.0)
+    kw.setdefault("cancel_stall_s", 60.0)
+    kw.setdefault("interval_s", 1.0)
+    return Watchdog(clock=clk, **kw)
+
+
+class TestWatchdogStep:
+    def test_stalled_step_detected_and_cleared(self):
+        clk = FakeClock()
+        eng = StubEngine(clk)
+        wd = _watchdog(clk)
+        wd.bind_engine(eng)
+        eng.pending = 3
+        assert wd.check(now=clk())["ok"] is True
+        clk.advance(20.0)  # heartbeat now 20s old with pending work
+        st = wd.check(now=clk())
+        assert st["step_stalled"] is True and st["ok"] is False
+        ev = get_events().recent(kind="stall_detected")
+        assert ev and ev[0]["attrs"]["stall"] == "engine_step"
+        assert ev[0]["severity"] == "critical"
+        assert get_metrics().gauge("watchdog_degraded").value == 1.0
+        assert wd.status()["step_stalled"] is True
+        # Recovery: heartbeat catches up.
+        eng.hb = clk()
+        st = wd.check(now=clk())
+        assert st["ok"] is True
+        cleared = get_events().recent(kind="stall_cleared")
+        assert cleared and cleared[0]["attrs"]["stall"] == "engine_step"
+        assert get_metrics().gauge("watchdog_degraded").value == 0.0
+
+    def test_idle_engine_never_stalls(self):
+        clk = FakeClock()
+        eng = StubEngine(clk)
+        wd = _watchdog(clk)
+        wd.bind_engine(eng)
+        eng.pending = 0
+        clk.advance(1e6)  # ancient heartbeat but no pending work
+        assert wd.check(now=clk())["ok"] is True
+
+    def test_unwatchable_engine_is_noop(self):
+        clk = FakeClock()
+        wd = _watchdog(clk)
+        wd.bind_engine(object())  # no heartbeat/progress surfaces
+        assert wd.check(now=clk())["ok"] is True
+        assert wd.check(now=clk())["heartbeat_age_s"] is None
+
+
+class TestWatchdogTokenStall:
+    def test_token_stall_detected_then_cancelled(self):
+        clk = FakeClock()
+        eng = StubEngine(clk)
+        wd = _watchdog(clk)
+        wd.bind_engine(eng)
+        eng.report = [{"request_id": "r1", "session_id": "s1",
+                       "phase": "decode", "no_progress_s": 40.0}]
+        st = wd.check(now=clk())
+        assert st["token_stalled"] == [
+            {"request_id": "r1", "no_token_for_s": 40.0}]
+        assert st["ok"] is False
+        ev = get_events().recent(kind="stall_detected")
+        assert ev[0]["attrs"]["stall"] == "token"
+        assert ev[0]["attrs"]["request_id"] == "r1"
+        assert eng.failed == []  # flagged, not yet hopeless
+        # Past the cancel threshold: terminated with a terminal error.
+        eng.report = [{"request_id": "r1", "session_id": "s1",
+                       "phase": "decode", "no_progress_s": 75.0}]
+        st = wd.check(now=clk())
+        assert eng.failed and eng.failed[0][0] == "r1"
+        assert eng.failed[0][2] == "stalled"
+        assert get_events().recent(kind="watchdog_cancel")
+        assert get_metrics().counter(
+            "watchdog_cancelled_total").value == 1
+        # Request is gone from the report -> healthy again.
+        assert wd.check(now=clk())["ok"] is True
+
+    def test_resumed_request_clears(self):
+        clk = FakeClock()
+        eng = StubEngine(clk)
+        wd = _watchdog(clk)
+        wd.bind_engine(eng)
+        eng.report = [{"request_id": "r1", "session_id": "s1",
+                       "phase": "decode", "no_progress_s": 35.0}]
+        assert wd.check(now=clk())["ok"] is False
+        eng.report = [{"request_id": "r1", "session_id": "s1",
+                       "phase": "decode", "no_progress_s": 0.5}]
+        assert wd.check(now=clk())["ok"] is True
+        cleared = get_events().recent(kind="stall_cleared")
+        assert cleared and cleared[0]["attrs"]["request_id"] == "r1"
+
+    def test_loop_lag_metric_and_event(self):
+        clk = FakeClock()
+        wd = _watchdog(clk, loop_lag_warn_ms=500.0)
+        wd.note_loop_lag(20.0)
+        assert not get_events().recent(kind="loop_lag")
+        wd.note_loop_lag(900.0)
+        ev = get_events().recent(kind="loop_lag")
+        assert ev and ev[0]["attrs"]["lag_ms"] == 900.0
+        assert get_metrics().histogram(
+            "event_loop_lag_ms").summary()["count"] == 2
+
+
+# ------------------------------------------------------------ endpoints
+
+
+async def _client():
+    from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+    app = build_monitoring_app(ready_check=lambda: True)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestMonitoringSurfaces:
+    async def test_slo_endpoint_schema(self):
+        slo = get_slo()
+        for _ in range(5):
+            slo.record_request("interactive", ok=True, ttft_ms=100.0,
+                               queue_wait_ms=5.0, max_gap_ms=10.0)
+        client = await _client()
+        try:
+            r = await client.get("/slo")
+            assert r.status == 200
+            body = await r.json()
+            assert body["windows_s"] == list(slo.windows_s)
+            assert {"page_burn", "warn_burn", "min_samples"} \
+                <= set(body["thresholds"])
+            cls = body["classes"]["interactive"]
+            assert cls["alert"] in ("ok", "warn", "page")
+            assert set(cls["objectives"]) == {
+                "ttft_p95_ms", "inter_token_p99_ms",
+                "queue_wait_p95_ms", "error_rate"}
+            for label, w in cls["windows"].items():
+                assert "n" in w and "burn" in w
+            assert cls["totals"]["requests"] == 5
+        finally:
+            await client.close()
+
+    async def test_events_endpoint_schema_and_filters(self):
+        get_events().emit("drain", depth=1)
+        get_events().emit("stall_detected", severity="critical",
+                          stall="token", request_id="r9")
+        client = await _client()
+        try:
+            r = await client.get("/events")
+            body = await r.json()
+            assert body["total_emitted"] >= 2
+            kinds = [e["kind"] for e in body["events"]]
+            assert kinds[0] == "stall_detected"  # newest first
+            assert all({"seq", "kind", "severity", "ts", "count"}
+                       <= set(e) for e in body["events"])
+            r = await client.get("/events?kind=drain&limit=1")
+            body = await r.json()
+            assert [e["kind"] for e in body["events"]] == ["drain"]
+            assert (await client.get("/events?limit=zero")).status == 400
+        finally:
+            await client.close()
+
+    async def test_health_degrades_on_stall_and_page_burn(self):
+        clk = FakeClock()
+        eng = StubEngine(clk)
+        eng.pending = 1
+        wd = get_watchdog()
+        wd.bind_engine(eng)
+        clk.advance(1e4)
+        wd.check(now=clk())  # trips the step stall
+        slo = get_slo()
+        for _ in range(30):
+            slo.record_request("interactive", ok=False, ttft_ms=None,
+                               queue_wait_ms=None, max_gap_ms=None)
+        client = await _client()
+        try:
+            r = await client.get("/health")
+            body = await r.json()
+            assert body["status"] == "degraded"
+            assert body["watchdog"]["step_stalled"] is True
+            assert body["slo"]["interactive"] == "page"
+            assert any("stalled" in w.lower()
+                       for w in body["warnings"])
+            assert any("SLO burn" in w for w in body["warnings"])
+        finally:
+            await client.close()
+
+    async def test_metrics_scrape_samples_heartbeat_gauge(self):
+        clk = FakeClock(t=500.0)
+        eng = StubEngine(clk)
+        wd = get_watchdog()
+        wd.bind_engine(eng)
+        clk.advance(7.0)
+        client = await _client()
+        try:
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "engine_step_heartbeat_age_s 7.0" in text
+        finally:
+            await client.close()
+
+
+# --------------------------------------------------- histogram time window
+
+
+class TestHistogramTimeWindow:
+    def test_old_samples_leave_percentiles_not_buckets(self):
+        clk = FakeClock()
+        h = Histogram("t_ms", "t", buckets=(1, 10, 100), window=128,
+                      window_s=300.0, clock=clk)
+        h.observe(5.0)
+        clk.advance(400.0)
+        h.observe(50.0)
+        s = h.summary()
+        # Cumulative side keeps history (Prometheus rate() math)...
+        assert s["count"] == 2
+        assert s["sum"] == 55.0
+        # ...but the percentile window only sees the fresh sample.
+        assert s["p50"] == 50.0 and s["p95"] == 50.0
+        assert h.percentile(50) == 50.0
+
+    def test_reads_prune_without_new_observations(self):
+        clk = FakeClock()
+        h = Histogram("t_ms", "t", buckets=(1,), window=128,
+                      window_s=60.0, clock=clk)
+        h.observe(5.0)
+        assert h.percentile(50) == 5.0
+        clk.advance(120.0)
+        assert h.percentile(50) == 0.0  # empty window
+        assert h.summary()["count"] == 1
+
+    def test_window_s_zero_disables_time_eviction(self):
+        clk = FakeClock()
+        h = Histogram("t_ms", "t", buckets=(1,), window=128,
+                      window_s=0.0, clock=clk)
+        h.observe(5.0)
+        clk.advance(1e9)
+        assert h.percentile(50) == 5.0
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.setenv("METRICS_WINDOW_S", "123.5")
+        assert Histogram("x", "", buckets=(1,)).window_s == 123.5
+        monkeypatch.setenv("METRICS_WINDOW_S", "garbage")
+        assert Histogram("x", "", buckets=(1,)).window_s == 300.0
+
+
+# ------------------------------------------------- prometheus validator
+
+
+class TestCheckPrometheus:
+    def test_live_metrics_endpoint_is_clean(self):
+        m = get_metrics()
+        m.counter("slo_t_total", "a counter").inc(3)
+        m.gauge("slo_t_gauge", "a gauge\nwith newline").set(1.5)
+        h = m.histogram("slo_t_ms", "a histogram", buckets=(1, 2.5, 10))
+        for v in (0.5, 2.0, 50.0):
+            h.observe(v)
+        problems = check_prometheus.validate(m.prometheus())
+        assert problems == []
+
+    async def test_against_live_endpoint(self):
+        get_metrics().histogram("lat_ms", "lat").observe(3.0)
+        client = await _client()
+        try:
+            r = await client.get("/metrics")
+            assert r.status == 200
+            problems = check_prometheus.validate(await r.text())
+            assert problems == []
+        finally:
+            await client.close()
+
+    def test_catches_the_pr1_bug_classes(self):
+        # Unescaped HELP newline: the continuation line is garbage.
+        bad = "# HELP x_total line one\nline two\n# TYPE x_total counter\nx_total 1\n"
+        assert any("unparseable" in p
+                   for p in check_prometheus.validate(bad))
+        # Missing +Inf bucket.
+        bad = ("# TYPE h_ms histogram\n"
+               'h_ms_bucket{le="1.0"} 1\n'
+               "h_ms_sum 1.0\nh_ms_count 1\n")
+        assert any("+Inf" in p for p in check_prometheus.validate(bad))
+        # Non-cumulative buckets.
+        bad = ("# TYPE h_ms histogram\n"
+               'h_ms_bucket{le="1.0"} 5\n'
+               'h_ms_bucket{le="2.0"} 3\n'
+               'h_ms_bucket{le="+Inf"} 5\n'
+               "h_ms_sum 1.0\nh_ms_count 5\n")
+        assert any("decrease" in p
+                   for p in check_prometheus.validate(bad))
+        # +Inf != count.
+        bad = ("# TYPE h_ms histogram\n"
+               'h_ms_bucket{le="+Inf"} 4\n'
+               "h_ms_sum 1.0\nh_ms_count 5\n")
+        assert any("_count" in p for p in check_prometheus.validate(bad))
+        # Duplicate series.
+        bad = "# TYPE g gauge\ng 1\ng 2\n"
+        assert any("duplicate series" in p
+                   for p in check_prometheus.validate(bad))
+        # Interleaved families.
+        bad = "a 1\nb 1\na 2\n"
+        assert any("interleaved" in p
+                   for p in check_prometheus.validate(bad))
+        # TYPE after samples.
+        bad = "c_total 1\n# TYPE c_total counter\n"
+        assert any("after its samples" in p
+                   for p in check_prometheus.validate(bad))
+        assert check_prometheus.validate("") == []
+
+    def test_cli_main(self, tmp_path, capsys):
+        m = get_metrics()
+        m.counter("cli_total", "x").inc()
+        p = tmp_path / "metrics.txt"
+        p.write_text(m.prometheus())
+        assert check_prometheus.main([str(p)]) == 0
+        bad = tmp_path / "bad.txt"
+        bad.write_text("not a metric line at all !!!\n")
+        assert check_prometheus.main([str(bad)]) == 1
+
+
+# ------------------------------------------------------ trace_report --slo
+
+
+class TestTraceReportSLO:
+    def test_defaults_mirror_slo_module(self):
+        assert trace_report.SLO_DEFAULTS == DEFAULTS
+
+    def test_sample_dump_passes_default_targets(self, capsys):
+        assert trace_report.main(["--slo", SAMPLE]) == 0
+        out = capsys.readouterr().out
+        assert "PASS" in out and "FAIL" not in out
+        assert "all SLO targets met" in out
+
+    def test_tight_targets_gate_nonzero(self, monkeypatch, capsys):
+        monkeypatch.setenv("SLO_TTFT_P95_MS", "1")
+        assert trace_report.main(["--slo", SAMPLE]) == 1
+        captured = capsys.readouterr()
+        assert "FAIL" in captured.out
+        assert "SLO VIOLATION" in captured.err
+
+    def test_plain_report_unchanged(self, capsys):
+        assert trace_report.main([SAMPLE]) == 0
+        assert "p95_ms" in capsys.readouterr().out
