@@ -229,6 +229,137 @@ async def bench_ws(cfg) -> dict:
             "agg_tps": agg_tps, "p50_ttft_ms": p50_ttft}
 
 
+# ---------------- multiturn mode (KV host-offload tier) ----------------
+
+async def _mt_turn(engine, i: int, messages: list[dict],
+                   max_tokens: int) -> tuple[str, float]:
+    """One engine-seam turn; returns (reply text, TTFT ms)."""
+    from fasttalk_tpu.engine.engine import GenerationParams
+
+    t0 = time.monotonic()
+    ttft = None
+    text = ""
+    params = GenerationParams(temperature=0.7, top_k=40, top_p=0.9,
+                              max_tokens=max_tokens)
+    async for ev in engine.generate(
+            f"mt-{i}-{len(messages)}", f"mt-sess-{i}", messages, params):
+        if ev["type"] == "token":
+            if ttft is None:
+                ttft = (time.monotonic() - t0) * 1000.0
+            text += ev["text"]
+        elif ev["type"] == "error":
+            raise RuntimeError(f"generation failed: {ev}")
+    return text, ttft or 0.0
+
+
+async def _mt_phase(cfg, sessions: int, turns: int,
+                    max_tokens: int) -> dict:
+    """One full multiturn scenario against a freshly built engine:
+    ``sessions`` concurrent sessions each running ``turns`` turns under
+    slot pressure (slots < sessions, so every wave evicts residents).
+    Reports follow-up-turn (turn >= 2) TTFT and the pool's stats."""
+    from fasttalk_tpu.engine.factory import build_engine
+
+    engine = build_engine(cfg)
+    engine.warmup(cfg.warmup)
+    engine.start()
+    followup_ttfts: list[float] = []
+    try:
+        histories: list[list[dict]] = [
+            [{"role": "user", "content": f"[session {i}] {PROMPT}"}]
+            for i in range(sessions)]
+        # Warmup wave compiles the prefill/decode shapes the
+        # measurement hits, on session ids outside the measured set.
+        await asyncio.gather(*(
+            _mt_turn(engine, 10_000 + i,
+                     [{"role": "user", "content": f"[warm {i}] hi"}], 8)
+            for i in range(sessions)))
+        for i in range(sessions):
+            engine.release_session(f"mt-sess-{10_000 + i}")
+        reset_slo_after_warmup()
+        for turn in range(turns):
+            results = await asyncio.gather(*(
+                _mt_turn(engine, i, histories[i], max_tokens)
+                for i in range(sessions)))
+            for i, (text, ttft) in enumerate(results):
+                if turn >= 1:
+                    followup_ttfts.append(ttft)
+                histories[i].append({"role": "assistant", "content": text})
+                histories[i].append(
+                    {"role": "user",
+                     "content": f"Continue, please (turn {turn + 2})."})
+        kv = engine.get_stats().get("kv_host", {})
+    finally:
+        engine.shutdown()
+    followup_ttfts.sort()
+    n = len(followup_ttfts)
+    return {
+        "followup_turns": n,
+        "followup_ttft_ms": {
+            "p50": round(statistics.median(followup_ttfts), 1) if n else None,
+            "p95": round(followup_ttfts[min(n - 1, int(0.95 * n))], 1)
+            if n else None,
+        },
+        "restore_hit_ratio": kv.get("restore_hit_ratio"),
+        "restored_total": kv.get("restored_total", 0),
+        "parked_total": kv.get("parked_total", 0),
+    }
+
+
+def _mt_run_phase_subprocess(budget_mb: float) -> dict:
+    """Run one multiturn phase in a CHILD process: two engines (one
+    per phase) in a single process trip an XLA-CPU teardown crash that
+    predates this bench mode, and per-phase processes are better
+    isolation for a comparison anyway (fresh compile caches, no
+    leaked-state asymmetry between the phases)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["BENCH_MT_PHASE"] = "1"
+    env["BENCH_KV_BUDGET_MB"] = str(budget_mb)
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multiturn phase (budget {budget_mb} MB) exited "
+            f"{proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_multiturn() -> dict:
+    """The KV host-offload scenario (docs/KVCACHE.md): N sessions x M
+    turns with fewer slots than sessions, so every follow-up turn
+    returns to an evicted session — measured twice, with the host pool
+    off (KV_HOST_BUDGET_MB=0: follow-ups re-prefill their history) and
+    on (follow-ups restore + delta-prefill). Each phase runs in its
+    own subprocess."""
+    sessions = int(os.environ.get("BENCH_MT_SESSIONS",
+                                  str(NUM_SESSIONS)))
+    turns = int(os.environ.get("BENCH_MT_TURNS", "3"))
+    budget_mb = float(os.environ.get("BENCH_KV_BUDGET_MB", "256"))
+
+    log(f"multiturn: {sessions} sessions x {turns} turns, "
+        f"slots < sessions, pool off vs {budget_mb:.0f} MB...")
+    log("--- phase 1/2: pool OFF (re-prefill path) ---")
+    off = _mt_run_phase_subprocess(0.0)
+    log(f"  off: follow-up TTFT p50/p95 "
+        f"{off['followup_ttft_ms']['p50']}/"
+        f"{off['followup_ttft_ms']['p95']} ms")
+    log("--- phase 2/2: pool ON (park/restore path) ---")
+    on = _mt_run_phase_subprocess(budget_mb)
+    log(f"  on:  follow-up TTFT p50/p95 "
+        f"{on['followup_ttft_ms']['p50']}/"
+        f"{on['followup_ttft_ms']['p95']} ms, restore hit ratio "
+        f"{on['restore_hit_ratio']}")
+    speedup = None
+    if off["followup_ttft_ms"]["p50"] and on["followup_ttft_ms"]["p50"]:
+        speedup = round(off["followup_ttft_ms"]["p50"]
+                        / on["followup_ttft_ms"]["p50"], 2)
+    return {"sessions": sessions, "turns": turns,
+            "kv_budget_mb": budget_mb, "off": off, "on": on,
+            "followup_ttft_p50_speedup": speedup}
+
+
 # ---------------- overload mode (admission control) ----------------
 
 async def bench_overload(cfg) -> dict:
@@ -415,6 +546,53 @@ def main() -> None:
                  # (ops/pallas_int8.py), and the same config the
                  # README's model table quotes.
                  quantize=os.environ.get("BENCH_QUANTIZE", "int8"))
+    if MODE == "multiturn":
+        mt_sessions = int(os.environ.get("BENCH_MT_SESSIONS",
+                                         str(NUM_SESSIONS)))
+        # Slot pressure is the whole scenario: fewer slots than
+        # sessions, so a follow-up turn always returns to an evicted
+        # session.
+        slots = int(os.environ.get("BENCH_MT_SLOTS",
+                                   str(max(1, mt_sessions // 2))))
+        if os.environ.get("BENCH_MT_PHASE"):
+            # Child process: one phase with the budget the parent set.
+            budget = float(os.environ.get("BENCH_KV_BUDGET_MB", "0"))
+            cfg = Config(llm_provider="tpu", model_name=MODEL,
+                         decode_slots=slots, max_model_len=2048,
+                         default_context_window=2048,
+                         prefill_chunk=512, dtype="bfloat16",
+                         port=PORT, monitoring_port=PORT + 1,
+                         enable_agent=False,
+                         kv_host_budget_mb=budget,
+                         quantize=os.environ.get("BENCH_QUANTIZE",
+                                                 "int8"))
+            turns = int(os.environ.get("BENCH_MT_TURNS", "3"))
+            max_tokens = int(os.environ.get("BENCH_MT_MAX_TOKENS",
+                                            "32"))
+            phase = asyncio.run(
+                _mt_phase(cfg, mt_sessions, turns, max_tokens))
+            print(json.dumps(phase), flush=True)
+            return
+
+        r = bench_multiturn()
+        on_p50 = (r["on"]["followup_ttft_ms"] or {}).get("p50")
+        print(json.dumps({
+            "metric": (f"multiturn follow-up-turn TTFT p50 ms, {MODEL}: "
+                       f"{r['sessions']} sessions x {r['turns']} turns "
+                       f"on {slots} slots, host pool "
+                       f"{r['kv_budget_mb']:.0f} MB (off p50 "
+                       f"{r['off']['followup_ttft_ms']['p50']} ms, "
+                       f"restore hit ratio "
+                       f"{r['on']['restore_hit_ratio']}, p50 speedup "
+                       f"{r['followup_ttft_p50_speedup']}x)"),
+            "value": on_p50,
+            "unit": "ms",
+            # For this mode the baseline is the engine's own
+            # re-prefill path: >1 means the restore tier is winning.
+            "vs_baseline": r["followup_ttft_p50_speedup"],
+            "multiturn": r,
+        }), flush=True)
+        return
     if MODE == "overload":
         r = asyncio.run(bench_overload(cfg))
         print(json.dumps({

@@ -66,8 +66,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fasttalk_tpu.engine.slots import Slot, SlotManager
+from fasttalk_tpu.engine.slots import Slot, SlotManager, _lcp
 from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
+from fasttalk_tpu.kvcache import (HostKVPool, KVOffloader, RestorePolicy,
+                                  kv_env_defaults)
+from fasttalk_tpu.kvcache.offload import (kv_bucket, make_kv_restore_fn,
+                                          make_kv_slice_fn)
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
                                        init_cache)
@@ -206,6 +210,9 @@ class _Request:
     max_gap_ms: float = 0.0             # worst inter-token gap seen
     stall_failed: bool = False          # terminated by the watchdog
     slo_recorded: bool = False          # sample already fed to the SLO
+    prefill_tokens: int = 0             # tokens actually prefilled
+    #   (after resident/restored/shared reuse) — feeds the restore
+    #   policy's measured prefill-throughput EMA (kvcache/policy.py)
 
 
 class EngineBase:
@@ -273,7 +280,11 @@ class TPUEngine(EngineBase):
                  shared_prefix: bool = True,
                  queue_bound: int = 256,
                  default_deadline_s: float = 30.0,
-                 bulk_aging_s: float = 5.0):
+                 bulk_aging_s: float = 5.0,
+                 kv_host_budget_mb: float | None = None,
+                 kv_park_ttl_s: float | None = None,
+                 kv_park_idle_s: float | None = None,
+                 kv_restore_min_tokens: int | None = None):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -380,7 +391,33 @@ class TPUEngine(EngineBase):
         self.sample_vocab = min(model_cfg.vocab_size,
                                 getattr(tokenizer, "vocab_size",
                                         model_cfg.vocab_size))
-        self.slots = SlotManager(num_slots, self.max_len)
+        # Session KV host-offload tier (docs/KVCACHE.md): a budgeted
+        # host-RAM pool parks evicted/idle sessions' kept KV rows so a
+        # returning session restores by copy instead of re-prefilling
+        # its whole history. Single-device only, like shared_prefix: on
+        # a mesh the cache is sharded and a host snapshot would bounce
+        # through cross-host collectives. Unset knobs resolve from the
+        # KV_* env (Config passes them explicitly in production).
+        kvdef = kv_env_defaults()
+        budget_mb = kvdef["budget_mb"] if kv_host_budget_mb is None \
+            else kv_host_budget_mb
+        if mesh is not None:
+            budget_mb = 0.0
+        self._kv_pool = HostKVPool(
+            budget_mb=budget_mb,
+            ttl_s=kvdef["ttl_s"] if kv_park_ttl_s is None
+            else kv_park_ttl_s)
+        self._kv_policy = RestorePolicy(
+            min_tokens=int(kvdef["min_tokens"]
+                           if kv_restore_min_tokens is None
+                           else kv_restore_min_tokens))
+        self._kv_offload = KVOffloader(self._kv_pool, self._kv_policy,
+                                       tracer=get_tracer())
+        self._kv_park_idle_s = kvdef["idle_s"] if kv_park_idle_s is None \
+            else kv_park_idle_s
+        self._kv_last_tick = 0.0
+        self.slots = SlotManager(num_slots, self.max_len,
+                                 on_evict=self._park_on_evict)
         self.steps_per_call = max(1, steps_per_call)
         # Burst-mode call length: while admissions or prefills are
         # pending, dispatch SHORT calls so a new arrival's prefill waits
@@ -606,6 +643,7 @@ class TPUEngine(EngineBase):
                 self._stopped.wait(timeout=30)
                 self._started = False
             self._fetch_pool.shutdown(wait=False, cancel_futures=True)
+            self._kv_offload.shutdown()
 
     def restart(self) -> bool:
         """Recover from an engine-thread crash: rebuild the device-side
@@ -633,7 +671,14 @@ class TPUEngine(EngineBase):
             if self._thread is not None and self._thread.is_alive():
                 return False  # still tearing down; try again later
             log.warning("engine restart: rebuilding device decode state")
-            self._events.emit("engine_restart", severity="critical")
+            # Parked host KV intentionally SURVIVES the restart: the
+            # pool holds host memory only, so sessions whose device
+            # residency the crash destroyed still restore their kept
+            # prefix instead of re-prefilling the whole history —
+            # recovery costs one H2D copy per returning session, not
+            # O(history) recompute (docs/KVCACHE.md).
+            self._events.emit("engine_restart", severity="critical",
+                              parked_sessions=len(self._kv_pool))
             # Entries whose requests were terminal-errored by
             # _abort_all must not be re-admitted; entries submitted in
             # the crash race window (after the sweep) survive and the
@@ -653,7 +698,8 @@ class TPUEngine(EngineBase):
             for rid in [rid for rid, r in self._by_id.items()
                         if r.finished]:
                 self._by_id.pop(rid, None)
-            self.slots = SlotManager(self.num_slots, self.max_len)
+            self.slots = SlotManager(self.num_slots, self.max_len,
+                                     on_evict=self._park_on_evict)
             # Release the old KV cache (and the in-flight refs pinning
             # decode-state arrays) BEFORE allocating the fresh one: on
             # host-side crashes the donated buffer was never consumed,
@@ -825,6 +871,23 @@ class TPUEngine(EngineBase):
                         last, self._cur_tokens, self._rng_dev,
                         self._arg(cfg_row))
                 jax.block_until_ready(first)
+        if self._kv_pool.enabled:
+            # Host-offload copy programs (kvcache/offload.py): compile
+            # every power-of-two bucket now, so no park/restore ever
+            # pays a mid-traffic compile stall (the shapes are trivial
+            # slice/update programs — cheap next to the model graphs
+            # above). The warmup restore writes zero rows into slot 0,
+            # which nothing has claimed yet (kv_written stays 0).
+            b = 16
+            while True:
+                k_rows, v_rows = self._get_kv_slice_fn(b)(
+                    self.cache, np.int32(0))
+                self.cache = self._get_kv_restore_fn(b)(
+                    self.cache, k_rows, v_rows, np.int32(0))
+                jax.block_until_ready(self.cache.k)
+                if b >= self.max_len:
+                    break
+                b = min(b * 2, self.max_len)
         jax.block_until_ready(self.cache.k)
         # Warm every fetch worker's first device→host copy: on relayed
         # attach paths a thread's FIRST fetch pays one-time client
@@ -880,10 +943,16 @@ class TPUEngine(EngineBase):
             # Admission control: bounded queue, deadline-aware,
             # drain-aware. A shed raises AdmissionRejected (with
             # retry_after) synchronously — the caller gets a terminal
-            # signal immediately instead of queueing to time out.
+            # signal immediately instead of queueing to time out. A
+            # session with a parked host-KV entry will skip most of its
+            # prefill at admission — the scheduler's wait estimate gets
+            # that saving as a discount so the wait_too_long shed
+            # doesn't turn away requests the restore makes cheap.
             self._sched.submit(request_id, session_id,
                                priority=params.priority,
-                               deadline_s=params.deadline_s, payload=req)
+                               deadline_s=params.deadline_s, payload=req,
+                               wait_discount_s=self._kv_wait_discount(
+                                   session_id, prompt))
         except AdmissionRejected:
             self._by_id.pop(request_id, None)
             req.finished = True
@@ -893,6 +962,12 @@ class TPUEngine(EngineBase):
             if trace_owned:
                 self._tracer.finish(request_id)
             raise
+        if self._kv_pool.enabled:
+            # Best-effort: pre-upload this session's parked KV rows to
+            # the device on the copy thread while the request waits in
+            # the queue, so the restore at admission dispatches against
+            # device-resident arrays (no H2D on the admission path).
+            self._kv_offload.prestage(session_id)
         self._commands.put(("kick", None))  # wake the engine thread
         terminal = False
         try:
@@ -937,9 +1012,12 @@ class TPUEngine(EngineBase):
 
     def scheduler_debug(self) -> dict:
         """Scheduler state + queued entries (position, priority,
-        remaining deadline) for the monitoring port's /debug/requests."""
+        remaining deadline) + parked host-KV sessions for the
+        monitoring port's /debug/requests."""
         return {"stats": self._sched.stats(),
-                "queued": self._sched.snapshot()}
+                "queued": self._sched.snapshot(),
+                "kv_host": self._kv_pool.stats(),
+                "parked_sessions": self._kv_pool.snapshot()}
 
     # ---------------- watchdog surfaces (observability/watchdog.py) ----
 
@@ -1042,6 +1120,8 @@ class TPUEngine(EngineBase):
             "waiting": len(self._sched),
             "scheduler": self._sched.stats(),
             "running": len(self._running),
+            "kv_host": {**self._kv_pool.stats(),
+                        "policy": self._kv_policy.stats()},
         }
 
     # ---------------- jitted steps ----------------
@@ -1439,6 +1519,142 @@ class TPUEngine(EngineBase):
         self._prefill_fns[key] = prefix_copy
         return prefix_copy
 
+    # ---------------- session KV host-offload tier ----------------
+    # (kvcache/: hostpool + offload + policy; docs/KVCACHE.md)
+
+    def _get_kv_slice_fn(self, bucket: int):
+        """Read one slot's leading ``bucket`` KV rows (no donation —
+        the cache chain is untouched; see kvcache/offload.py)."""
+        key = ("kvslice", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            self._note_compile("kv_offload", bucket=bucket)
+            fn = make_kv_slice_fn(self.cfg, bucket)
+            self._prefill_fns[key] = fn
+        return fn
+
+    def _get_kv_restore_fn(self, bucket: int):
+        """Write parked rows back into a slot (donated cache — chains
+        with prefill/decode like every other cache op)."""
+        key = ("kvrestore", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            self._note_compile("kv_restore", bucket=bucket)
+            fn = make_kv_restore_fn(self.cfg, bucket, KVCache)
+            self._prefill_fns[key] = fn
+        return fn
+
+    def _park_on_evict(self, victim: Slot) -> None:
+        """SlotManager eviction hook (engine thread, inside acquire):
+        snapshot the victim's kept KV rows to the host pool before the
+        slot is cleared for its new session. The slice program is
+        dispatched here (ordered before the new occupant's prefill by
+        dispatch order); the blocking device→host fetch runs on the
+        offload thread, so admission never waits on the copy."""
+        if not self._kv_pool.enabled:
+            return
+        kept = min(victim.kv_written, len(victim.tokens))
+        if kept < self._kv_policy.min_tokens:
+            return
+        if self._kv_pool.parked_len(victim.session_id) >= kept \
+                or self._kv_offload.parking(victim.session_id):
+            return  # an up-to-date snapshot is parked or in flight
+        self._park_slot(victim, kept)
+
+    def _park_slot(self, slot: Slot, kept: int) -> None:
+        bucket = kv_bucket(kept, self.max_len)
+        t0 = time.monotonic()
+        k_rows, v_rows = self._get_kv_slice_fn(bucket)(
+            self.cache, np.int32(slot.index))
+        self._kv_offload.park(slot.session_id, list(slot.tokens[:kept]),
+                              kept, bucket, k_rows, v_rows, t0)
+
+    def _try_restore(self, req: _Request, slot: Slot,
+                     prompt: list[int]) -> int:
+        """Restore a returning session's kept prefix from the host pool
+        into its freshly acquired slot. Returns the number of leading
+        prompt tokens now resident (0 = no entry / policy chose
+        prefill; the caller falls through to shared-prefix/full
+        prefill). Engine thread only."""
+        if not self._kv_pool.enabled:
+            return 0
+        entry = self._kv_pool.get(req.session_id)
+        if entry is None:
+            self._kv_pool.note_lookup(False)
+            return 0
+        # Same trust rules as slot-resident reuse: at least one prompt
+        # token must run through the model, and only the matched prefix
+        # is believable KV.
+        match = _lcp(entry.tokens, prompt,
+                     min(entry.kept, len(prompt) - 1))
+        if not self._kv_policy.should_restore(match, entry.nbytes):
+            self._kv_pool.note_lookup(False)
+            return 0  # entry stays parked for a later, longer match
+        t0 = time.monotonic()
+        fn = self._get_kv_restore_fn(entry.bucket)
+        k_arg, v_arg = entry.k_dev, entry.v_dev
+        prestaged = k_arg is not None and v_arg is not None
+        if not prestaged:  # prestage didn't land
+            k_arg, v_arg = self._arg(entry.k), self._arg(entry.v)
+        self.cache = fn(self.cache, k_arg, v_arg, np.int32(slot.index))
+        dt = time.monotonic() - t0
+        slot.tokens = list(entry.tokens[:match])
+        slot.kv_written = match
+        # Consumed: the KV is device-resident again; a later eviction
+        # re-parks the (longer) history.
+        self._kv_pool.take(req.session_id)
+        self._kv_pool.note_lookup(True)
+        self._kv_offload.note_restore(dt)
+        if self._tracer.enabled:
+            self._tracer.add_span(req.request_id, "kv_restore", t0,
+                                  time.monotonic(), tokens=match,
+                                  bytes=entry.nbytes,
+                                  prestaged=prestaged)
+        return match
+
+    def _kv_wait_discount(self, session_id: str,
+                          prompt: list[int]) -> float:
+        """Expected seconds a host-KV restore shaves off this request's
+        service time (0 without a matching parked entry) — consulted by
+        the scheduler's estimated-wait shed decision at submit time
+        (asyncio side; entry token lists are immutable, so the LCP runs
+        safely outside the pool lock)."""
+        if not self._kv_pool.enabled:
+            return 0.0
+        entry = self._kv_pool.get(session_id)
+        if entry is None:
+            return 0.0
+        match = _lcp(entry.tokens, prompt,
+                     min(entry.kept, len(prompt) - 1))
+        return self._kv_policy.restore_saving_s(match, entry.nbytes)
+
+    def _kv_tick(self) -> None:
+        """Once-a-second housekeeping on the engine loop: TTL-sweep the
+        pool and park sessions idle past KV_PARK_IDLE_S. Idle parks
+        keep the slot pinned — the resident KV still serves the fast
+        path; the host copy is insurance, making a later eviction free
+        and the history restorable across engine.restart()."""
+        if not self._kv_pool.enabled:
+            return
+        now = time.monotonic()
+        if now - self._kv_last_tick < 1.0:
+            return
+        self._kv_last_tick = now
+        self._kv_pool.sweep(now)
+        if self._kv_park_idle_s <= 0:
+            return
+        for slot in self.slots.slots:
+            if slot.session_id is None or slot.active:
+                continue
+            kept = min(slot.kv_written, len(slot.tokens))
+            if kept < self._kv_policy.min_tokens \
+                    or now - slot.last_used < self._kv_park_idle_s:
+                continue
+            if self._kv_pool.parked_len(slot.session_id) >= kept \
+                    or self._kv_offload.parking(slot.session_id):
+                continue  # snapshot current or in flight
+            self._park_slot(slot, kept)
+
     def _get_prefill_fn(self, chunk: int):
         fn = self._prefill_fns.get(chunk)
         if fn is not None:
@@ -1724,6 +1940,7 @@ class TPUEngine(EngineBase):
                 self._m_active.set(len(self._running))
                 self._m_queue.set(len(self._sched)
                                   + len(self._prefilling))
+                self._kv_tick()
         except Exception as e:  # engine thread must not die silently
             log.critical(f"engine thread crashed: {e}", exc_info=True)
             if self.call_sink is not None:
@@ -1782,6 +1999,12 @@ class TPUEngine(EngineBase):
                         # r1 list did a linear remove scan here).
                         self._finish(req, "cancelled")
             elif cmd == "release":
+                # The session is over (WS disconnect / end_session):
+                # its parked host KV must go too, or the pool leaks
+                # entries for sessions that can never return (they
+                # would sit until TTL, squeezing live sessions out of
+                # the budget).
+                self._kv_pool.purge(arg)
                 slot = self.slots.lookup(arg)
                 if slot is not None and slot.active:
                     self._release_after.add(arg)
@@ -1856,6 +2079,11 @@ class TPUEngine(EngineBase):
                 self._finish(req, "cancelled")
                 continue
             slot = self.slots.acquire(req.session_id)
+            if slot is not None and self._kv_pool.enabled:
+                # Admission proves the session is alive: clear any
+                # released-tombstone so later parks aren't refused
+                # (engine-seam callers reuse ids after release).
+                self._kv_pool.revive(req.session_id)
             if slot is None:
                 # All slots actively decoding: keep the entry at the
                 # head of its session's queue (deadline intact).
@@ -1887,6 +2115,12 @@ class TPUEngine(EngineBase):
             reused = self.slots.reuse_prefix(slot, prompt)
             if reused:
                 self._m_prefix.inc(reused)
+            elif (restored := self._try_restore(req, slot, prompt)):
+                # Host-offload tier: the session's kept prefix came
+                # back from host RAM — only the token delta prefills
+                # below, composing with the delta path exactly like
+                # slot-resident reuse.
+                reused = restored
             elif self.shared_prefix:
                 # Fresh slot: stamp the longest prefix resident in any
                 # OTHER slot (common system prompt across sessions)
@@ -1908,6 +2142,7 @@ class TPUEngine(EngineBase):
                     reused = share
                     self._m_shared.inc(share)
             todo = prompt[reused:]
+            req.prefill_tokens = len(todo)  # restore-policy cost feed
             if reused + len(todo) > self.usable_len:
                 self._finish(req, "error",
                              error=f"prompt ({len(prompt)} tok) exceeds "
@@ -2246,6 +2481,13 @@ class TPUEngine(EngineBase):
         if req.admitted_at is not None:
             self._m_prefill_req.observe(
                 (req.decode_started_at - req.admitted_at) * 1000)
+            if req.prefill_tokens:
+                # Measured prefill throughput → the restore policy's
+                # cost model (admission-to-activation covers the same
+                # dispatch overheads a restore competes against).
+                self._kv_policy.note_prefill(
+                    req.prefill_tokens,
+                    req.decode_started_at - req.admitted_at)
             if self._tracer.enabled:
                 self._tracer.add_span(
                     req.request_id, "prefill", req.admitted_at,
@@ -2690,6 +2932,7 @@ class TPUEngine(EngineBase):
             if sid is not None and sid in self._release_after:
                 self._release_after.discard(sid)
                 self.slots.release_session(sid)
+                self._kv_pool.purge(sid)  # deferred release: same rule
         self._by_id.pop(req.request_id, None)
 
         if not suppress_flush and req.detok is not None \

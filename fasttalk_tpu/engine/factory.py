@@ -257,5 +257,9 @@ def build_engine(cfg: Config) -> EngineBase:
         shared_prefix=cfg.shared_prefix,
         queue_bound=cfg.sched_queue_bound,
         default_deadline_s=cfg.sched_default_deadline_s,
-        bulk_aging_s=cfg.sched_bulk_aging_s)
+        bulk_aging_s=cfg.sched_bulk_aging_s,
+        kv_host_budget_mb=cfg.kv_host_budget_mb,
+        kv_park_ttl_s=cfg.kv_park_ttl_s,
+        kv_park_idle_s=cfg.kv_park_idle_s,
+        kv_restore_min_tokens=cfg.kv_restore_min_tokens)
     return engine

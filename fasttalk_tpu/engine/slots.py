@@ -58,10 +58,16 @@ class Slot:
 
 
 class SlotManager:
-    def __init__(self, num_slots: int, max_len: int):
+    def __init__(self, num_slots: int, max_len: int, on_evict=None):
         self.slots = [Slot(i) for i in range(num_slots)]
         self.max_len = max_len
         self._by_session: dict[str, Slot] = {}
+        # Called with the victim Slot BEFORE an LRU eviction clears it
+        # (engine hook: snapshot the resident KV to the host pool,
+        # kvcache/offload.py). Only acquire()-driven evictions fire it
+        # — an explicit release_session means the session is done and
+        # its KV is not worth keeping anywhere.
+        self.on_evict = on_evict
 
     def lookup(self, session_id: str) -> Slot | None:
         return self._by_session.get(session_id)
@@ -81,6 +87,8 @@ class SlotManager:
         if not victims:
             return None
         victim = min(victims, key=lambda s: s.last_used)
+        if self.on_evict is not None and victim.session_id is not None:
+            self.on_evict(victim)
         self._unpin(victim)
         return self._pin(victim, session_id)
 

@@ -1,0 +1,22 @@
+"""Session KV host-offload tier (docs/KVCACHE.md).
+
+A paging layer between the engine's fixed HBM decode slots and full
+re-prefill: when a session's slot is evicted (or the session has sat
+idle), its kept KV rows are snapshotted into a budgeted host-RAM pool;
+when the session returns, the rows are copied back and only the token
+delta is prefilled. Concurrent *sessions* stop being bounded by
+*slots*, and a follow-up turn's TTFT drops from O(history prefill) to
+O(host→device copy + delta prefill).
+
+- hostpool.py — the budgeted LRU/TTL pool of parked entries
+- offload.py  — the dedicated copy thread + length-bucketed jitted
+  device↔host copy programs
+- policy.py   — the park/restore decision (copy cost vs prefill cost)
+"""
+
+from fasttalk_tpu.kvcache.hostpool import HostKVPool, ParkedKV
+from fasttalk_tpu.kvcache.offload import KVOffloader
+from fasttalk_tpu.kvcache.policy import RestorePolicy, kv_env_defaults
+
+__all__ = ["HostKVPool", "ParkedKV", "KVOffloader", "RestorePolicy",
+           "kv_env_defaults"]

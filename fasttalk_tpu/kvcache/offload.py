@@ -1,0 +1,249 @@
+"""Async device↔host KV movement for the session offload tier.
+
+Two halves:
+
+- **Length-bucketed jitted copy programs.** ``make_kv_slice_fn`` reads
+  one slot's leading rows out of the cache (no donation — the cache
+  chain is untouched and the result is a fresh buffer the copy thread
+  can fetch at leisure); ``make_kv_restore_fn`` scatters stored rows
+  back into a slot (donated, so it chains with prefill/decode calls
+  like every other cache op). Row lengths are power-of-two buckets
+  (min 16, capped at max_len), the same discipline as the engine's
+  prefill/share granules: the executable set stays at O(log max_len)
+  and no unpredictable compile shape appears mid-traffic.
+
+- **The copy thread.** Device→host fetches (``np.asarray`` of a slice
+  result) block until the device catches up — that wait must never sit
+  on the engine thread between decode dispatches. The engine dispatches
+  the slice program (cheap, async) and hands the result to this thread,
+  which fetches, builds the pool entry, and feeds the measured copy
+  bandwidth back into the policy. ``prestage`` uses the same thread to
+  pre-upload a parked entry's rows to the device while its follow-up
+  request is still waiting in the admission queue, so the restore
+  dispatch pays no host→device transfer on the admission path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from functools import partial
+from typing import Any
+
+from fasttalk_tpu.kvcache.hostpool import HostKVPool, ParkedKV
+from fasttalk_tpu.kvcache.policy import RestorePolicy
+from fasttalk_tpu.utils.logger import get_logger
+from fasttalk_tpu.utils.metrics import get_metrics
+
+log = get_logger("kvcache.offload")
+
+
+def make_kv_slice_fn(cfg, bucket: int):
+    """Jitted read of one slot's leading ``bucket`` KV rows → fresh
+    [L, bucket, Kv, H] arrays. NOT donated: the engine's cache
+    reference stays live; execution is ordered before any later
+    donated call by dispatch order, so the rows read are exactly the
+    pre-eviction values."""
+    import jax
+
+    shape = (cfg.num_layers, 1, bucket, cfg.num_kv_heads, cfg.head_dim)
+
+    @jax.jit
+    def kv_slice(cache, slot):
+        k = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0), shape)
+        v = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0), shape)
+        return k[:, 0], v[:, 0]
+
+    return kv_slice
+
+
+def make_kv_restore_fn(cfg, bucket: int, cache_cls):
+    """Jitted write of stored rows back into a slot's leading region.
+    Donates the cache so it chains in place like prefill/prefix-copy.
+    Rows beyond the restored entry's trusted ``kept`` length carry
+    stale values — harmless, because the caller sets ``kv_written`` to
+    the matched prefix and the delta prefill overwrites from there."""
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def kv_restore(cache, k_rows, v_rows, slot):
+        new_k = jax.lax.dynamic_update_slice(
+            cache.k, k_rows[:, None], (0, slot, 0, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(
+            cache.v, v_rows[:, None], (0, slot, 0, 0, 0))
+        return cache_cls(new_k, new_v)
+
+    return kv_restore
+
+
+def kv_bucket(n: int, max_len: int) -> int:
+    """Smallest power-of-two (min 16) covering ``n``, capped at the
+    cache length — the copy executable set stays bounded at
+    O(log max_len) shapes."""
+    b = 16
+    while b < n:
+        b <<= 1
+    return min(b, max_len)
+
+
+class KVOffloader:
+    """Dedicated copy thread: D2H park fetches and H2D prestaging."""
+
+    def __init__(self, pool: HostKVPool, policy: RestorePolicy,
+                 tracer=None):
+        self.pool = pool
+        self.policy = policy
+        self._tracer = tracer
+        self._jobs: queue.Queue = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        # Sessions with a park snapshot in flight: dedupes the 1 Hz
+        # idle-park tick (and eviction re-parks) while the copy thread
+        # lags — without this a slow D2H fetch got a duplicate slice
+        # dispatch + fetch job per tick, growing the queue unboundedly
+        # on exactly the slow paths the thread exists for.
+        self._parking_lock = threading.Lock()
+        self._parking: set[str] = set()
+        m = get_metrics()
+        self._m_offload = m.histogram(
+            "kv_offload_ms",
+            "device→host snapshot latency per parked session (dispatch "
+            "to host copy landed)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000, 4000))
+        self._m_restore = m.histogram(
+            "kv_restore_ms",
+            "host→device restore dispatch latency per admission",
+            buckets=(0.5, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000))
+
+    # ---------------- thread plumbing ----------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="kv-offload", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            try:
+                job()
+            except Exception as e:  # the copy thread must never die
+                log.error(f"kv offload job failed: {e}", exc_info=True)
+
+    def submit(self, job) -> None:
+        if self._closed:
+            return
+        self._ensure_thread()
+        self._jobs.put(job)
+
+    def shutdown(self) -> None:
+        self._closed = True
+        if self._thread is not None and self._thread.is_alive():
+            self._jobs.put(None)
+            self._thread.join(timeout=5)
+
+    # ---------------- park (D2H) ----------------
+
+    def parking(self, session_id: str) -> bool:
+        """True while a park snapshot for this session is in flight."""
+        with self._parking_lock:
+            return session_id in self._parking
+
+    def park(self, session_id: str, tokens: list[int], kept: int,
+             bucket: int, k_rows: Any, v_rows: Any, t0: float) -> None:
+        """Finish a park off the engine thread: fetch the slice result
+        to host numpy (blocks until the device catches up — the whole
+        reason this runs here), insert into the pool, feed the measured
+        bandwidth to the policy, and record the ``kv_offload`` span.
+        A second park for a session whose snapshot is still in flight
+        is dropped (the caller re-checks parked_len on a later tick)."""
+        with self._parking_lock:
+            if session_id in self._parking:
+                return
+            self._parking.add(session_id)
+
+        def job() -> None:
+            import numpy as np
+
+            try:
+                # Bandwidth sample starts at the FETCH, not the
+                # dispatch: t0 includes the slice program's queue wait
+                # (and its first-use compile), which is not a cost a
+                # restore pays — feeding it into the EMA made the
+                # policy refuse restores that were actually 10-50x
+                # cheaper than the prefill.
+                tf = time.monotonic()
+                # copy=True: on the CPU backend np.asarray of a jax
+                # array can be a zero-copy VIEW of the XLA buffer;
+                # parking that view would pin (and potentially alias
+                # back through a later device_put) device-runtime
+                # memory the pool must own outright.
+                k = np.array(k_rows, copy=True)
+                v = np.array(v_rows, copy=True)
+                t1 = time.monotonic()
+                entry = ParkedKV(session_id=session_id, tokens=tokens,
+                                 kept=kept, bucket=bucket, k=k, v=v,
+                                 nbytes=int(k.nbytes) + int(v.nbytes))
+                if self.pool.put(entry):
+                    self.policy.note_copy(entry.nbytes,
+                                          max(t1 - tf, 1e-6))
+                    self._m_offload.observe(max(t1 - t0, 1e-6) * 1000.0)
+                    if self._tracer is not None and self._tracer.enabled:
+                        # Process-level row (like engine_step): a park
+                        # is not owned by any live request — it usually
+                        # runs during ANOTHER session's admission.
+                        self._tracer.step("kv_offload", t0, t1,
+                                          session_id=session_id,
+                                          tokens=kept,
+                                          bytes=entry.nbytes)
+            finally:
+                with self._parking_lock:
+                    self._parking.discard(session_id)
+
+        self.submit(job)
+        if self._closed:
+            # submit dropped the job (shutdown won): release the
+            # in-flight mark it would have cleared.
+            with self._parking_lock:
+                self._parking.discard(session_id)
+
+    # ---------------- prestage (H2D, best-effort) ----------------
+
+    # Prestaged (host-pool bytes duplicated into HBM awaiting their
+    # restore) may hold at most this fraction of the pool budget:
+    # without a cap, a burst of returning sessions could stage the
+    # whole pool into HBM that is already mostly committed to weights
+    # and the slot cache, and OOM the device mid-traffic.
+    _PRESTAGE_FRACTION = 0.25
+
+    def prestage(self, session_id: str) -> None:
+        """Upload a parked entry's rows to the device while its
+        follow-up request waits in the admission queue. Best-effort:
+        a miss (no entry, the entry consumed/evicted first, or the
+        staged-bytes cap reached) costs nothing — the restore falls
+        back to passing numpy, paying the H2D at dispatch."""
+        def job() -> None:
+            import jax
+
+            entry = self.pool.get(session_id)
+            if entry is None or entry.k_dev is not None:
+                return
+            cap = self.pool.budget_bytes * self._PRESTAGE_FRACTION
+            if self.pool.staged_bytes() + entry.nbytes > cap:
+                return
+            k_dev = jax.device_put(entry.k)
+            v_dev = jax.device_put(entry.v)
+            # Single assignment each (GIL-atomic); the consumer reads
+            # k_dev/v_dev at restore time and either sees both or
+            # treats the entry as unstaged.
+            entry.k_dev = k_dev
+            entry.v_dev = v_dev
+
+        self.submit(job)
+
+    def note_restore(self, seconds: float) -> None:
+        self._m_restore.observe(seconds * 1000.0)

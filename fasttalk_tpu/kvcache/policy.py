@@ -1,0 +1,114 @@
+"""The park/restore decision: copy cost vs prefill cost.
+
+A restore pays one host→device copy of the parked rows; the
+alternative pays recomputing the same rows through the model. Both
+costs are estimated from the engine's OWN measurements — host-copy
+bandwidth from the offload thread's device→host fetches, prefill
+throughput from completed prefills — so the decision tracks the actual
+hardware (a relayed dev attach and a real v5e differ by orders of
+magnitude) instead of a hardcoded constant. Cold start is deliberately
+restore-friendly: until the first prefill is measured, any matched
+prefix above the floor restores (restoring is also what *produces* the
+first copy measurement).
+
+Falling through is always safe: the admission path continues into the
+existing shared-prefix / delta-prefill machinery unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+# Cold-start estimates. Copy bandwidth is deliberately conservative
+# (PCIe-ish, not the relay's worst case); prefill throughput is
+# deliberately low so the first decisions favour restore.
+_DEFAULT_COPY_BPS = 1e9
+_DEFAULT_PREFILL_TPS = 500.0
+
+
+def kv_env_defaults() -> dict[str, float]:
+    """KV_* env knobs with their defaults — the same resolution
+    utils.config.Config performs, for engines constructed directly
+    (tests, bench) without a Config. Invalid values fall back silently
+    here; Config's validated surface is where operators get errors."""
+    def _f(name: str, default: float) -> float:
+        raw = os.getenv(name, "").strip()
+        try:
+            return float(raw) if raw else default
+        except ValueError:
+            return default
+
+    return {
+        "budget_mb": _f("KV_HOST_BUDGET_MB", 0.0),
+        "ttl_s": _f("KV_PARK_TTL_S", 600.0),
+        "idle_s": _f("KV_PARK_IDLE_S", 30.0),
+        "min_tokens": _f("KV_RESTORE_MIN_TOKENS", 32.0),
+    }
+
+
+class RestorePolicy:
+    """EMA-backed cost model deciding restore-vs-prefill."""
+
+    def __init__(self, min_tokens: int = 32):
+        self.min_tokens = max(1, int(min_tokens))
+        self._lock = threading.Lock()
+        self._copy_bps = 0.0      # measured host-copy bytes/s EMA
+        self._prefill_tps = 0.0   # measured prefill tokens/s EMA
+
+    # ---------------- measurement feeds ----------------
+
+    def note_copy(self, nbytes: int, seconds: float) -> None:
+        """One completed device↔host KV copy (offload thread)."""
+        if seconds <= 0 or nbytes <= 0:
+            return
+        bps = nbytes / seconds
+        with self._lock:
+            self._copy_bps = bps if self._copy_bps == 0.0 \
+                else 0.8 * self._copy_bps + 0.2 * bps
+
+    def note_prefill(self, tokens: int, seconds: float) -> None:
+        """One completed prefill (engine thread, at activation)."""
+        if seconds <= 0 or tokens <= 0:
+            return
+        tps = tokens / seconds
+        with self._lock:
+            self._prefill_tps = tps if self._prefill_tps == 0.0 \
+                else 0.8 * self._prefill_tps + 0.2 * tps
+
+    # ---------------- decisions ----------------
+
+    def _costs(self, match_tokens: int, nbytes: int) -> tuple[float, float]:
+        with self._lock:
+            bps = self._copy_bps or _DEFAULT_COPY_BPS
+            tps = self._prefill_tps or _DEFAULT_PREFILL_TPS
+        return nbytes / bps, match_tokens / tps
+
+    def should_restore(self, match_tokens: int, nbytes: int) -> bool:
+        """Restore when the estimated copy beats recomputing the
+        matched prefix. Below the token floor the fixed dispatch cost
+        dominates either estimate — fall through to prefill (where the
+        shared-prefix copy may still serve the rows for free)."""
+        if match_tokens < self.min_tokens:
+            return False
+        copy_s, prefill_s = self._costs(match_tokens, nbytes)
+        return copy_s < prefill_s
+
+    def restore_saving_s(self, match_tokens: int, nbytes: int) -> float:
+        """Expected seconds saved by restoring instead of prefilling
+        the matched prefix (0 when restore would not be chosen) — the
+        scheduler subtracts this from its queue-wait estimate at
+        admission (scheduling/scheduler.py submit)."""
+        if match_tokens < self.min_tokens:
+            return 0.0
+        copy_s, prefill_s = self._costs(match_tokens, nbytes)
+        return max(0.0, prefill_s - copy_s)
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "min_tokens": self.min_tokens,
+                "copy_bytes_per_s": round(self._copy_bps, 1),
+                "prefill_tokens_per_s": round(self._prefill_tps, 1),
+            }

@@ -263,6 +263,13 @@ def build_monitoring_app(ready_check=None, sched_info=None,
             # Entries the tracer doesn't know (tracing disabled, or a
             # trace evicted) still show up as queued work.
             body["queued_untraced"] = list(queued.values())
+            # Sessions parked in the host-KV pool (docs/KVCACHE.md):
+            # not live requests, but state an operator debugging "why
+            # did this follow-up turn TTFT spike" needs next to the
+            # queue — was the session restorable or re-prefilled?
+            if sched.get("parked_sessions") is not None:
+                body["kv_host"] = sched.get("kv_host")
+                body["parked_sessions"] = sched["parked_sessions"]
         return web.json_response(body)
 
     async def traces_index(request: web.Request) -> web.Response:

@@ -158,10 +158,19 @@ class RequestScheduler:
     def submit(self, request_id: str, session_id: str, *,
                priority: str = "interactive",
                deadline_s: float | None = None,
-               payload: Any = None) -> QueuedRequest:
+               payload: Any = None,
+               wait_discount_s: float = 0.0) -> QueuedRequest:
         """Enqueue a request, or raise AdmissionRejected (with a
         computed retry_after) when it must be shed: drain mode, queue
-        at bound, or estimated wait already past the deadline."""
+        at bound, or estimated wait already past the deadline.
+
+        ``wait_discount_s``: expected service-time saving the caller
+        knows about and the queue cannot (the engine passes the
+        estimated prefill a parked host-KV restore will skip,
+        kvcache/policy.py restore_saving_s) — subtracted from the
+        estimated wait before the wait_too_long shed decision, so a
+        cheap-to-serve returning session is not turned away by an
+        estimate calibrated on full prefills."""
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
@@ -189,7 +198,8 @@ class RequestScheduler:
                         now, f"admission queue full "
                         f"({self.queue_bound} waiting)",
                         reason="queue_full")
-                est = self._estimate_wait_locked()
+                est = max(0.0, self._estimate_wait_locked()
+                          - max(0.0, wait_discount_s))
                 if est > ttl:
                     raise self._shed_locked(
                         now, f"estimated queue wait {est:.1f}s exceeds "

@@ -319,6 +319,28 @@ class Config:
     # too (waiters past the admission deadline shed with retry_after).
     remote_max_inflight: int = field(
         default_factory=lambda: _env_int("REMOTE_MAX_INFLIGHT", 32))
+    # ---- Session KV host-offload tier (fasttalk_tpu/kvcache/,
+    # docs/KVCACHE.md) ----
+    # Host-RAM budget for parked session KV (MB). 0 disables the tier
+    # (evictions drop residency and a returning session re-prefills,
+    # the pre-offload behaviour); negative is a config error. Values
+    # above the machine's detectable RAM log a warning.
+    kv_host_budget_mb: float = field(
+        default_factory=lambda: _env_float("KV_HOST_BUDGET_MB", 0.0))
+    # Parked entries idle past this are dropped (host RAM is a cache,
+    # not an archive).
+    kv_park_ttl_s: float = field(
+        default_factory=lambda: _env_float("KV_PARK_TTL_S", 600.0))
+    # Proactively snapshot a pinned-but-idle session after this long
+    # (slot stays pinned; the copy makes a later eviction free and the
+    # history restorable across engine restart). 0 disables idle parks
+    # (eviction-time parks still happen).
+    kv_park_idle_s: float = field(
+        default_factory=lambda: _env_float("KV_PARK_IDLE_S", 30.0))
+    # Matched-prefix floor below which restoring is never worth the
+    # copy dispatch (the shared-prefix/delta-prefill paths serve).
+    kv_restore_min_tokens: int = field(
+        default_factory=lambda: _env_int("KV_RESTORE_MIN_TOKENS", 32))
     # ---- SLOs + stall watchdog (observability/slo.py, watchdog.py,
     # docs/OBSERVABILITY.md). The observability singletons read the
     # same env knobs at construction; the fields here give operators
@@ -459,6 +481,33 @@ class Config:
             errs.append("sched_drain_timeout_s must be >= 0")
         if self.remote_max_inflight <= 0:
             errs.append("remote_max_inflight must be > 0")
+        if self.kv_host_budget_mb < 0:
+            errs.append("kv_host_budget_mb must be >= 0 (0 disables "
+                        "the host-offload tier)")
+        if self.kv_park_ttl_s <= 0:
+            errs.append("kv_park_ttl_s must be > 0")
+        if self.kv_park_idle_s < 0:
+            errs.append("kv_park_idle_s must be >= 0 (0 disables "
+                        "idle parking)")
+        if self.kv_restore_min_tokens < 1:
+            errs.append("kv_restore_min_tokens must be >= 1")
+        if self.kv_host_budget_mb > 0:
+            # Warn (don't fail) when the budget exceeds detectable host
+            # RAM: the pool would page/OOM long before filling.
+            try:
+                import psutil
+
+                total_mb = psutil.virtual_memory().total / (1024 * 1024)
+                if self.kv_host_budget_mb > total_mb:
+                    import logging
+
+                    logging.getLogger("fasttalk.config").warning(
+                        "KV_HOST_BUDGET_MB=%.0f exceeds detectable "
+                        "host RAM (%.0f MB); the pool will hit swap "
+                        "or the OOM killer before its budget",
+                        self.kv_host_budget_mb, total_mb)
+            except Exception:
+                pass
         for name in ("slo_ttft_p95_ms", "slo_inter_token_p99_ms",
                      "slo_queue_wait_p95_ms", "slo_page_burn",
                      "slo_warn_burn", "watchdog_token_stall_s",

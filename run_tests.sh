@@ -14,6 +14,14 @@
 #                             serving surfaces, and the engine-level
 #                             queued-request race tests
 #                             (docs/SCHEDULING.md).
+#   ./run_tests.sh --kv       KV host-offload group: pool LRU/TTL/budget
+#                             discipline, park→restore round-trip
+#                             equivalence on the CPU engine,
+#                             restore-vs-cancel/-deadline races, parked
+#                             KV across engine.restart(), KV_* config
+#                             validation, plus a trace_report smoke
+#                             checking the kv_offload/kv_restore phase
+#                             percentiles (docs/KVCACHE.md).
 #   ./run_tests.sh --slo      SLO/watchdog group: burn-rate windows,
 #                             goodput, the fake-clock stall watchdog,
 #                             /slo + /events endpoints, the strict
@@ -46,6 +54,27 @@ if [[ "${1:-}" == "--sched" ]]; then
     shift
     exec "${PYENV[@]}" python -m pytest tests/test_scheduling.py \
         "tests/test_engine.py::TestSchedulerRaces" "$@"
+fi
+
+if [[ "${1:-}" == "--kv" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_kvcache.py "$@"
+    echo "--- trace_report kv phase smoke ---"
+    tmp="$(mktemp)"
+    trap 'rm -f "$tmp"' EXIT
+    cat > "$tmp" <<'EOF'
+{"request_id": "r1", "session_id": "s1", "span": "queue_wait", "ts": 1.0, "dur_ms": 5.0, "attrs": {}}
+{"request_id": "r1", "session_id": "s1", "span": "kv_restore", "ts": 1.01, "dur_ms": 2.5, "attrs": {"tokens": 512}}
+{"request_id": "r1", "session_id": "s1", "span": "prefill", "ts": 1.02, "dur_ms": 4.0, "attrs": {}}
+{"request_id": null, "session_id": "", "span": "kv_offload", "ts": 1.05, "dur_ms": 3.5, "attrs": {"tokens": 512}}
+EOF
+    out="$("${PYENV[@]}" python scripts/trace_report.py "$tmp")"
+    echo "$out"
+    for phase in kv_restore kv_offload; do
+        grep -q "$phase" <<<"$out" \
+            || { echo "trace_report kv smoke: missing $phase" >&2; exit 1; }
+    done
+    exit 0
 fi
 
 if [[ "${1:-}" == "--slo" ]]; then

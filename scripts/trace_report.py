@@ -116,6 +116,28 @@ def format_table(rows: list[dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def kv_phase_note(records: Iterable[dict[str, Any]]) -> str | None:
+    """Host-KV offload tier percentiles (docs/KVCACHE.md): restore
+    time sits between admission and first token, so the SLO gate's
+    TTFT/queue-wait numbers already include it — this note makes the
+    contribution visible next to the verdict. ``kv_restore`` spans are
+    per-request; ``kv_offload`` records are process-level (parks run
+    during other sessions' admissions)."""
+    parts = []
+    for name in ("kv_restore", "kv_offload"):
+        durs = sorted(float(r.get("dur_ms", 0.0)) for r in records
+                      if r.get("span") == name)
+        if durs:
+            parts.append(
+                f"{name}: n={len(durs)} p50={percentile(durs, 50):.2f} "
+                f"p95={percentile(durs, 95):.2f} "
+                f"p99={percentile(durs, 99):.2f} ms")
+    if not parts:
+        return None
+    return ("host-KV offload (counted inside queue-wait→first-token): "
+            + "; ".join(parts))
+
+
 def _slo_target(name: str) -> float:
     raw = os.environ.get(name, "").strip()
     if raw:
@@ -239,15 +261,20 @@ def main(argv: list[str] | None = None) -> int:
                 if r.get("request_id")}
     print(f"{len(records)} spans across {len(requests)} requests")
     print()
+    kv_note = kv_phase_note(records)
     if args.slo:
         rows, ok = slo_evaluate(records)
         print(format_slo_table(rows))
+        if kv_note:
+            print(f"\n{kv_note}")
         if not ok:
             print("\nSLO VIOLATION", file=sys.stderr)
             return 1
         print("\nall SLO targets met")
         return 0
     print(format_table(phase_table(records)))
+    if kv_note:
+        print(f"\n{kv_note}")
     return 0
 
 

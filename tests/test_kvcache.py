@@ -1,0 +1,442 @@
+"""Session KV host-offload tier (fasttalk_tpu/kvcache/, docs/KVCACHE.md):
+pool discipline (LRU/TTL/budget), restore policy, park→restore
+round-trip equivalence on the CPU engine, restore-vs-cancel and
+restore-vs-deadline races, parked-KV survival across engine.restart(),
+and the release_session purge regression."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+from fasttalk_tpu.kvcache.hostpool import HostKVPool, ParkedKV
+from fasttalk_tpu.kvcache.offload import kv_bucket
+from fasttalk_tpu.kvcache.policy import RestorePolicy
+from fasttalk_tpu.models import get_model_config, init_params
+
+TINY = get_model_config("test-tiny")
+GREEDY = dict(temperature=0.0, top_k=0, top_p=1.0)
+
+
+def _entry(sid, n_tokens=32, nbytes=1024, now=None):
+    kw = {} if now is None else dict(parked_at=now, last_used=now)
+    return ParkedKV(session_id=sid, tokens=list(range(n_tokens)),
+                    kept=n_tokens, bucket=kv_bucket(n_tokens, 256),
+                    k=np.zeros(1), v=np.zeros(1), nbytes=nbytes, **kw)
+
+
+class TestHostKVPool:
+    def test_disabled_pool_rejects(self):
+        pool = HostKVPool(budget_mb=0.0)
+        assert not pool.enabled
+        assert pool.put(_entry("a")) is False
+        assert pool.get("a") is None
+
+    def test_put_get_take_purge(self):
+        pool = HostKVPool(budget_mb=1.0)
+        assert pool.put(_entry("a", nbytes=100))
+        assert pool.get("a").session_id == "a"
+        assert pool.parked_len("a") == 32
+        assert pool.take("a").session_id == "a"
+        assert pool.get("a") is None  # take consumed it
+        assert pool.put(_entry("b"))
+        assert pool.purge("b") is True
+        assert pool.purge("b") is False
+        assert pool.stats()["bytes"] == 0
+
+    def test_replace_same_session_adjusts_bytes(self):
+        pool = HostKVPool(budget_mb=1.0)
+        pool.put(_entry("a", nbytes=100))
+        pool.put(_entry("a", nbytes=300))
+        st = pool.stats()
+        assert st["sessions"] == 1
+        assert st["bytes"] == 300
+
+    def test_budget_lru_eviction_order(self):
+        clock = [0.0]
+        pool = HostKVPool(budget_mb=1.0, clock=lambda: clock[0])
+        half = 512 * 1024
+        pool.put(_entry("old", nbytes=half, now=0.0))
+        clock[0] = 10.0
+        pool.put(_entry("mid", nbytes=half, now=10.0))
+        clock[0] = 20.0
+        pool.get("old")  # touch: old is now more recent than mid
+        pool.put(_entry("new", nbytes=half, now=20.0))
+        # Budget holds two halves: "mid" (LRU) must be the victim.
+        assert pool.get("mid") is None
+        assert pool.get("old") is not None
+        assert pool.get("new") is not None
+        assert pool.stats()["evicted_total"] == 1
+
+    def test_oversized_entry_rejected(self):
+        pool = HostKVPool(budget_mb=1.0)
+        assert pool.put(_entry("big", nbytes=2 * 1024 * 1024)) is False
+        assert pool.stats()["sessions"] == 0
+
+    def test_ttl_sweep_and_expiry_on_get(self):
+        clock = [0.0]
+        pool = HostKVPool(budget_mb=1.0, ttl_s=5.0,
+                          clock=lambda: clock[0])
+        pool.put(_entry("a", now=0.0))
+        pool.put(_entry("b", now=0.0))
+        clock[0] = 3.0
+        pool.get("b")  # keeps b fresh
+        clock[0] = 6.0
+        assert pool.sweep() == 1  # a expired
+        assert pool.get("a") is None
+        assert pool.get("b") is not None
+        clock[0] = 20.0
+        assert pool.get("b") is None  # expiry also enforced on access
+
+    def test_purge_tombstones_inflight_park(self):
+        """A park snapshot still in flight on the copy thread when the
+        release purge runs must not re-insert its entry afterwards —
+        and a session readmitted later is revived."""
+        pool = HostKVPool(budget_mb=1.0)
+        pool.put(_entry("a"))
+        pool.purge("a")
+        assert pool.put(_entry("a")) is False  # late park refused
+        assert pool.get("a") is None
+        pool.revive("a")  # session seen again at admission
+        assert pool.put(_entry("a")) is True
+
+    def test_staged_bytes_accounting(self):
+        pool = HostKVPool(budget_mb=1.0)
+        pool.put(_entry("a", nbytes=100))
+        pool.put(_entry("b", nbytes=50))
+        assert pool.staged_bytes() == 0
+        pool.get("a").k_dev = object()  # prestage landed
+        assert pool.staged_bytes() == 100
+
+    def test_hit_ratio_accounting(self):
+        pool = HostKVPool(budget_mb=1.0)
+        pool.note_lookup(True)
+        pool.note_lookup(False)
+        st = pool.stats()
+        assert st["restore_hits"] == 1
+        assert st["restore_lookups"] == 2
+        assert st["restore_hit_ratio"] == 0.5
+
+
+class TestRestorePolicy:
+    def test_min_tokens_floor(self):
+        p = RestorePolicy(min_tokens=32)
+        assert not p.should_restore(31, nbytes=1)
+        assert p.restore_saving_s(31, nbytes=1) == 0.0
+
+    def test_copy_vs_prefill_decision(self):
+        p = RestorePolicy(min_tokens=1)
+        p.note_copy(1_000_000, 1.0)     # 1 MB/s copies
+        p.note_prefill(1000, 1.0)       # 1000 tok/s prefill
+        # 100 tokens ~ 0.1 s prefill; 50 KB copy ~ 0.05 s -> restore
+        assert p.should_restore(100, nbytes=50_000)
+        # 1 MB copy ~ 1 s > 0.1 s prefill -> fall through
+        assert not p.should_restore(100, nbytes=1_000_000)
+        assert p.restore_saving_s(100, nbytes=50_000) == \
+            pytest.approx(0.05)
+
+    def test_cold_start_favours_restore(self):
+        p = RestorePolicy(min_tokens=16)
+        # No measurements yet: a chat-scale entry must restore.
+        assert p.should_restore(500, nbytes=4 * 1024 * 1024)
+
+
+class TestSchedulerWaitDiscount:
+    def test_discount_admits_cheap_restore(self):
+        from fasttalk_tpu.scheduling.scheduler import RequestScheduler
+        from fasttalk_tpu.utils.errors import AdmissionRejected
+
+        s = RequestScheduler(queue_bound=8, default_deadline_s=30.0,
+                             slots=1)
+        s.note_service_time(10.0)  # EMA: 10 s per request
+        s.submit("r0", "s0")
+        s.submit("r1", "s1")  # depth 2 -> estimated wait 20 s
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit("r2", "s2", deadline_s=15.0)
+        assert ei.value.reason == "wait_too_long"
+        # Same deadline, but a parked-KV restore saves ~8 s of the
+        # estimate: admitted instead of shed.
+        entry = s.submit("r3", "s3", deadline_s=15.0,
+                         wait_discount_s=8.0)
+        assert entry.request_id == "r3"
+
+
+def _make_engine(**kw):
+    import jax
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    defaults = dict(num_slots=2, max_len=256, prefill_chunk=64,
+                    kv_host_budget_mb=64.0, kv_park_ttl_s=600.0,
+                    kv_park_idle_s=0.0, kv_restore_min_tokens=8)
+    defaults.update(kw)
+    eng = TPUEngine(TINY, params, ByteTokenizer(), **defaults)
+    eng.start()
+    return eng
+
+
+def _collect(eng, rid, sid, msgs, max_tokens=8, **params):
+    async def run():
+        out = []
+        async for ev in eng.generate(
+                rid, sid, msgs,
+                GenerationParams(max_tokens=max_tokens, **GREEDY,
+                                 **params)):
+            out.append(ev)
+        return out
+    return asyncio.run(run())
+
+
+def _text(events):
+    return "".join(e.get("text", "") for e in events
+                   if e["type"] == "token")
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+MSG1 = [{"role": "user", "content":
+         "this is a reasonably long first turn message for session A"}]
+FILLER = [{"role": "user", "content": "filler session occupying a slot"}]
+
+
+class TestParkRestoreEngine:
+    """Park on eviction → restore at readmission, against a control
+    engine whose session is never evicted (pool off): restored decode
+    must match never-parked decode token for token."""
+
+    @pytest.fixture(scope="class")
+    def eng(self):
+        e = _make_engine()
+        yield e
+        e.shutdown()
+
+    def test_round_trip_equivalence(self, eng):
+        # Control: same seed, pool disabled, session never evicted.
+        ctl = _make_engine(kv_host_budget_mb=0.0)
+        try:
+            r1c = _text(_collect(ctl, "c1", "A", MSG1))
+            msg2 = MSG1 + [{"role": "assistant", "content": r1c},
+                           {"role": "user", "content": "and a follow-up"}]
+            r2c = _text(_collect(ctl, "c2", "A", msg2))
+            assert not ctl.get_stats()["kv_host"]["enabled"]
+
+            r1 = _text(_collect(eng, "r1", "A", MSG1))
+            assert r1 == r1c
+            # Evict A: two filler sessions on a 2-slot engine.
+            _collect(eng, "rb", "B", FILLER)
+            _collect(eng, "rc", "C", FILLER)
+            assert _wait(lambda: eng._kv_pool.parked_len("A") > 0), \
+                "eviction never parked session A"
+            assert eng.slots.lookup("A") is None  # residency truly gone
+            events = _collect(eng, "r2", "A", msg2)
+            assert events[-1]["type"] == "done"
+            st = eng.get_stats()["kv_host"]
+            assert st["restored_total"] >= 1, st
+            # The acceptance bar: byte-identical to never-parked decode.
+            assert _text(events) == r2c
+        finally:
+            ctl.shutdown()
+
+    def test_pool_disabled_never_parks(self):
+        ctl = _make_engine(kv_host_budget_mb=0.0, num_slots=1)
+        try:
+            _collect(ctl, "d1", "DA", MSG1)
+            _collect(ctl, "d2", "DB", FILLER)  # evicts DA
+            time.sleep(0.3)
+            assert len(ctl._kv_pool) == 0
+            assert ctl.get_stats()["kv_host"]["parked_total"] == 0
+        finally:
+            ctl.shutdown()
+
+    def test_release_session_purges_parked(self, eng):
+        """Regression (ISSUE 4 satellite): releasing a session must
+        also purge its parked host KV — the pool must not accumulate
+        entries for sessions that can never come back."""
+        _collect(eng, "p1", "R", MSG1)
+        _collect(eng, "p2", "F1", FILLER)
+        _collect(eng, "p3", "F2", FILLER)  # R evicted -> parked
+        assert _wait(lambda: eng._kv_pool.parked_len("R") > 0)
+        eng.release_session("R")
+        assert _wait(lambda: eng._kv_pool.parked_len("R") == 0), \
+            "release_session leaked the parked entry"
+
+
+class TestKVRacesAndRestart:
+    """Queued-restore races and crash recovery on a single-slot engine
+    with idle parking enabled (idle parks also cover the proactive
+    snapshot path)."""
+
+    @pytest.fixture(scope="class")
+    def seng(self):
+        e = _make_engine(num_slots=1, steps_per_call=4,
+                         kv_park_idle_s=0.05)
+        yield e
+        e.shutdown()
+
+    def _park_p(self, seng):
+        """Ensure session P has a parked entry (idle park: the slot is
+        pinned and idle, so the 1 Hz engine tick snapshots it)."""
+        if seng._kv_pool.parked_len("P") > 0:
+            return
+        _collect(seng, f"pk{time.monotonic_ns()}", "P", MSG1)
+        assert _wait(lambda: seng._kv_pool.parked_len("P") > 0,
+                     timeout=15.0), "idle park never happened"
+
+    async def _occupy(self, seng):
+        events: list = []
+
+        async def consume():
+            async for ev in seng.generate(
+                    "occ", "occ-s", FILLER,
+                    GenerationParams(max_tokens=512, **GREEDY)):
+                events.append(ev)
+
+        task = asyncio.create_task(consume())
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if any(e["type"] == "token" for e in events):
+                return task
+            await asyncio.sleep(0.01)
+        raise AssertionError("occupant never produced a token")
+
+    def test_restore_vs_cancel_race(self, seng):
+        self._park_p(seng)
+        before = seng.get_stats()["kv_host"]["restored_total"]
+
+        async def scenario():
+            occ = await self._occupy(seng)
+            p_events: list = []
+
+            async def follow_up():
+                async for ev in seng.generate(
+                        "race-c", "P", MSG1,
+                        GenerationParams(max_tokens=4, **GREEDY)):
+                    p_events.append(ev)
+
+            task = asyncio.create_task(follow_up())
+            # P is queued behind the occupant: cancel before admission.
+            deadline = time.monotonic() + 10.0
+            while seng.get_stats()["waiting"] < 1 \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            seng.cancel("race-c")
+            await task
+            assert p_events[-1]["type"] == "cancelled"
+            seng.cancel("occ")
+            await occ
+
+        asyncio.run(scenario())
+        st = seng.get_stats()["kv_host"]
+        # Cancelled before admission: no restore consumed the entry,
+        # and the session (still alive) keeps its parked KV.
+        assert st["restored_total"] == before
+        assert seng._kv_pool.parked_len("P") > 0
+
+    def test_restore_vs_deadline_expiry_race(self, seng):
+        self._park_p(seng)
+        before = seng.get_stats()["kv_host"]["restored_total"]
+
+        async def scenario():
+            occ = await self._occupy(seng)
+            p_events: list = []
+
+            async def follow_up():
+                async for ev in seng.generate(
+                        "race-d", "P", MSG1,
+                        GenerationParams(max_tokens=4, deadline_s=0.2,
+                                         **GREEDY)):
+                    p_events.append(ev)
+
+            await asyncio.create_task(follow_up())
+            assert p_events[-1]["type"] == "error"
+            assert p_events[-1]["code"] == "deadline_expired"
+            seng.cancel("occ")
+            await occ
+
+        asyncio.run(scenario())
+        assert seng.get_stats()["kv_host"]["restored_total"] == before
+        assert seng._kv_pool.parked_len("P") > 0
+
+    def test_parked_kv_survives_restart(self, seng):
+        self._park_p(seng)
+        before = seng.get_stats()["kv_host"]["restored_total"]
+
+        def boom():
+            raise RuntimeError("injected crash")
+
+        orig = seng._dispatch_decode
+        seng._dispatch_decode = boom
+        try:
+            events = _collect(seng, "r-crash", "s-crash", FILLER)
+            assert events[-1]["type"] == "error"
+            assert seng._stopped.wait(timeout=10)
+            seng._thread.join(timeout=10)
+        finally:
+            seng._dispatch_decode = orig
+        assert seng.restart()
+        # Device residency is gone; the host pool is not.
+        assert seng._kv_pool.parked_len("P") > 0
+        events = _collect(seng, "r-after", "P", MSG1)
+        assert events[-1]["type"] == "done"
+        st = seng.get_stats()["kv_host"]
+        assert st["restored_total"] == before + 1, \
+            "post-restart follow-up did not restore from host KV"
+
+
+class TestKVConfig:
+    def test_negative_budget_rejected(self):
+        from fasttalk_tpu.utils.config import Config
+
+        with pytest.raises(ValueError, match="kv_host_budget_mb"):
+            Config(kv_host_budget_mb=-1.0)
+
+    def test_bad_ttl_idle_min_tokens_rejected(self):
+        from fasttalk_tpu.utils.config import Config
+
+        with pytest.raises(ValueError, match="kv_park_ttl_s"):
+            Config(kv_park_ttl_s=0.0)
+        with pytest.raises(ValueError, match="kv_park_idle_s"):
+            Config(kv_park_idle_s=-1.0)
+        with pytest.raises(ValueError, match="kv_restore_min_tokens"):
+            Config(kv_restore_min_tokens=0)
+
+    def test_budget_over_host_ram_warns(self):
+        # The project logger doesn't propagate to pytest's caplog
+        # handler; attach one directly.
+        import logging
+
+        from fasttalk_tpu.utils.config import Config
+
+        records: list = []
+
+        class _Cap(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        lg = logging.getLogger("fasttalk.config")
+        h = _Cap(level=logging.WARNING)
+        lg.addHandler(h)
+        try:
+            Config(kv_host_budget_mb=10.0 ** 9)
+        finally:
+            lg.removeHandler(h)
+        assert any("KV_HOST_BUDGET_MB" in r.getMessage()
+                   for r in records)
+
+    def test_defaults_valid_and_surfaced(self):
+        from fasttalk_tpu.utils.config import Config
+
+        cfg = Config()
+        d = cfg.to_dict()
+        for key in ("kv_host_budget_mb", "kv_park_ttl_s",
+                    "kv_park_idle_s", "kv_restore_min_tokens"):
+            assert key in d  # `main.py config --show` surface
