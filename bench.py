@@ -66,6 +66,18 @@ def reset_slo_after_warmup() -> None:
     reset_slo()
 
 
+def perf_attribution() -> dict | None:
+    """Step-ledger digest (observability/perf.py) over the measured
+    window: occupancy, padding-waste fraction, wall-time decomposition
+    and MFU next to the tok/s headline, so BENCH_*.json says not just
+    how fast but WHERE the remaining time went. None when the engine
+    recorded no step telemetry (tracing disabled / remote provider)."""
+    from fasttalk_tpu.observability.perf import get_perf
+
+    s = get_perf().summary()
+    return s if s.get("device_busy_frac") is not None else None
+
+
 BASELINE_TOKS = 150.0  # reference llama3.2:1b on RTX 3090 (README.md:474)
 # Env overrides are for smoke-testing on CPU; the driver runs defaults.
 MODEL = os.environ.get("BENCH_MODEL", "llama3.2:1b")
@@ -595,6 +607,7 @@ def main() -> None:
         return
     if MODE == "overload":
         r = asyncio.run(bench_overload(cfg))
+        r["perf"] = perf_attribution()
         print(json.dumps({
             "metric": (f"overload goodput tok/s, {MODEL}: open-loop "
                        f"{r['arrival_rate_rps']:.0f} req/s x "
@@ -635,6 +648,13 @@ def main() -> None:
     slo_goodput, _ = slo_goodput_summary()
     slo_note = "" if slo_goodput is None \
         else f"; SLO goodput {fmt_goodput(slo_goodput)}"
+    perf = perf_attribution()
+    if perf is not None:
+        log(f"  perf attribution: busy {perf['device_busy_frac']:.0%} "
+            f"/ host gap {perf['host_gap_frac']:.0%} / idle "
+            f"{perf['idle_frac']:.0%}; occupancy "
+            f"{perf['occupancy_mean']}; padding waste "
+            f"{perf['padding_waste_frac']}; MFU {perf['mfu']}")
     print(json.dumps({
         "metric": (f"{seam} output tok/s, {MODEL}, "
                    f"{NUM_SESSIONS} concurrent sessions (p50 TTFT "
@@ -645,6 +665,7 @@ def main() -> None:
         "vs_baseline": round(r["agg_tps"] / BASELINE_TOKS, 2),
         **({} if slo_goodput is None
            else {"slo_goodput": slo_goodput}),
+        **({} if perf is None else {"perf": perf}),
     }), flush=True)
 
 

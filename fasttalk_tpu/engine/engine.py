@@ -76,6 +76,7 @@ from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
                                        init_cache)
 from fasttalk_tpu.observability.events import get_events
+from fasttalk_tpu.observability.perf import get_perf
 from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
@@ -542,6 +543,12 @@ class TPUEngine(EngineBase):
             buckets=(0.5, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000,
                      4000))
         self._tracer = get_tracer()
+        # Attribution ledger (observability/perf.py): binds the served
+        # model's FLOP cost estimate so step records can carry per-call
+        # FLOPs and /perf can report achieved-vs-peak MFU.
+        self._perf = get_perf()
+        self._perf.bind_model(model_cfg, num_slots,
+                              jnp.dtype(dtype).name)
 
     def _make_cache(self) -> KVCache:
         if self.mesh is None:
@@ -603,7 +610,8 @@ class TPUEngine(EngineBase):
         # In-flight decode calls: (host-copy Future, EXPECTED tokens the
         # call will emit per request, EXPECTED positions it advances,
         # the (slot index, request) pairs running at dispatch time,
-        # dispatch timestamp for step telemetry).
+        # dispatch timestamp for step telemetry, KV bucket length —
+        # the attribution ledger's attention-cost horizon).
         # Plain calls emit exactly K tokens (both fields == K);
         # speculative calls emit K..K*(G+1) and both fields are
         # EMA-based estimates — the dispatcher's base/bucket math may
@@ -617,7 +625,7 @@ class TPUEngine(EngineBase):
         # an older call is still in flight.
         self._inflight: deque[
             tuple[Future, float, int, list[tuple[int, _Request]],
-                  float]] = deque()
+                  float, int]] = deque()
         # First sampled tokens whose device→host copy is still in
         # flight: (host-copy Future, [(row, slot_index, request), ...]).
         # Admission emits the first token only when the fetch lands, so
@@ -1136,7 +1144,10 @@ class TPUEngine(EngineBase):
         """A jitted-executable cache miss while serving traffic is a
         latency incident (the compile stalls the engine thread for
         seconds): record it in the event log. Warmup misses (before
-        start()) are the expected cost and are not events."""
+        start()) are the expected cost and are not events — but every
+        miss lands in the perf ledger's compile table either way, so
+        /perf answers "which shapes compiled, and when"."""
+        self._perf.note_compile(kind, serving=self._started, **attrs)
         if self._started:
             self._events.emit("recompile", severity="warning",
                               what=kind, **attrs)
@@ -2193,6 +2204,7 @@ class TPUEngine(EngineBase):
         try:
             ring_bucket = self._ring_prefill_eligible(st.start,
                                                       len(st.todo))
+            t0p = time.monotonic()
             if ring_bucket:
                 # Whole prompt in ONE ring-attention call: per-chip
                 # attention memory O(T/sp) instead of the all-gather
@@ -2210,6 +2222,10 @@ class TPUEngine(EngineBase):
                 st.start = n
                 slot.kv_written = n
                 st.todo = []
+                self._tracer.step(
+                    "engine_prefill", t0p, time.monotonic(),
+                    bucket=ring_bucket, tokens=n, rows=ring_bucket,
+                    kind="ring", flops=self._perf.call_flops(n, n))
             else:
                 take = min(len(st.todo), self.prefill_chunk)
                 bucket = next(b for b in _PREFILL_BUCKETS if b >= take)
@@ -2242,6 +2258,15 @@ class TPUEngine(EngineBase):
                 st.start += take
                 slot.kv_written = st.start
                 st.todo = st.todo[take:]
+                # Attribution: one padded-bucket chunk (rows computed =
+                # the bucket; useful = the chunk) against the KV
+                # horizon it attended. The interval covers dispatch —
+                # the device compute overlaps later step records.
+                self._tracer.step(
+                    "engine_prefill", t0p, time.monotonic(),
+                    bucket=bucket, tokens=take, rows=bucket,
+                    kind="chunk",
+                    flops=self._perf.call_flops(take, st.start))
             # Each completed chunk is forward progress — for EVERY
             # request in the prefill FIFO, not just the head: the ones
             # queued behind it are advancing toward service, and
@@ -2424,9 +2449,19 @@ class TPUEngine(EngineBase):
         # async — the engine thread dispatches the first decode call
         # without waiting for the round trip; text is emitted when the
         # fetch lands.
+        t0p = time.monotonic()
         self.cache, firsts_dev, self._cur_tokens, self._rng_dev = fn(
             self.params, self.cache, self._arg(tokens), self._arg(rowcfg),
             self._cur_tokens, self._rng_dev)
+        # Attribution row: the call computed gp × bucket token rows
+        # (padding rows + per-row bucket padding included); useful =
+        # the real prompt tokens. Interval covers dispatch only — the
+        # device compute overlaps the following step records.
+        real = sum(len(todo) for _, _, _, todo in sub)
+        self._tracer.step(
+            "engine_prefill", t0p, time.monotonic(), bucket=bucket,
+            tokens=real, rows=gp * bucket, kind="batched", group=g,
+            flops=self._perf.call_flops(real, ctx))
         entries = []
         for j, (req, slot, start, todo) in enumerate(sub):
             slot.tokens.extend(todo)
@@ -2457,7 +2492,7 @@ class TPUEngine(EngineBase):
             # past its first token makes this condition false.
             return False
         promised: dict[int, int] = {}
-        for _, min_toks, _, snap, _ in self._inflight:
+        for _, min_toks, _, snap, _, _ in self._inflight:
             for _, req in snap:
                 promised[id(req)] = promised.get(id(req), 0) + min_toks
         # A first token whose fetch hasn't landed is not yet counted in
@@ -2643,7 +2678,7 @@ class TPUEngine(EngineBase):
         # maximum advances; size the KV bucket for where the device can
         # be at the END of this call.
         base = int(self._positions[active].max()) \
-            + sum(adv for _, _, adv, _, _ in self._inflight)
+            + sum(adv for _, _, adv, _, _, _ in self._inflight)
         T = self.spec_draft + 1
         if self.spec_draft and self._spec_call_wanted():
             # Size the KV bucket by the EMA-EXPECTED advance (+1 block
@@ -2688,7 +2723,7 @@ class TPUEngine(EngineBase):
                                       max(1.0, self._spec_ema))
                 self._inflight.append(
                     (self._fetch_pool.submit(np.asarray, toks), promise,
-                     exp_adv, snapshot, t_disp))
+                     exp_adv, snapshot, t_disp, kv_len))
                 return
         max_pos = base + steps
         kv_len = next((b for b in _KV_BUCKETS
@@ -2709,7 +2744,7 @@ class TPUEngine(EngineBase):
                 self._freqs_dev, self._rng_dev)
             self._inflight.append(
                 (self._fetch_pool.submit(np.asarray, toks), steps, steps,
-                 snapshot, t_disp))
+                 snapshot, t_disp, kv_len))
             return
         fn = self._get_decode_fn(kv_len, steps)
         self._sink("decode", kv_len=kv_len, steps=steps,
@@ -2726,11 +2761,11 @@ class TPUEngine(EngineBase):
         # _fetch_pool note in __init__).
         self._inflight.append(
             (self._fetch_pool.submit(np.asarray, toks), steps, steps,
-             snapshot, t_disp))
+             snapshot, t_disp, kv_len))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
-        fut, _, _, snapshot, t_disp = self._inflight.popleft()
+        fut, _, _, snapshot, t_disp, kv_len = self._inflight.popleft()
         gen_before = {id(req): req.generated for _, req in snapshot} \
             if self._tracer.enabled else {}
         if any(req.first_pending for _, req in snapshot):
@@ -2751,6 +2786,7 @@ class TPUEngine(EngineBase):
         # vs 166 ms when all requests land in one group).
         if self._pending_firsts:
             self._drain_firsts(block=False)
+        consumed = 0  # tokens actually fed to requests (perf ledger)
         if res.ndim == 3:
             # Speculative call [K, S, T+1]: per row, columns :T are the
             # sampled tokens and column T is n_out; the first n_out
@@ -2774,6 +2810,7 @@ class TPUEngine(EngineBase):
                                 or self._running.get(s) is not req:
                             break
                         self._positions[s] += 1
+                        consumed += 1
                         self._consume_token(req, int(res[k, s, i]))
         else:
             for k in range(res.shape[0]):
@@ -2784,6 +2821,7 @@ class TPUEngine(EngineBase):
                         # the token.
                         continue
                     self._positions[s] += 1
+                    consumed += 1
                     self._consume_token(req, int(res[k, s]))
         for _, req in snapshot:
             self._flush_emit(req)
@@ -2791,14 +2829,22 @@ class TPUEngine(EngineBase):
             # One step record per retired call (process-level row) and
             # one decode_step span per participating request: batch
             # occupancy and slot utilization AT DISPATCH TIME, which is
-            # what the device actually computed over.
+            # what the device actually computed over. The perf ledger's
+            # extras: token rows the fixed shapes computed (all S slots
+            # every step; spec calls verify T = draft+1 positions per
+            # step), tokens actually consumed, the call's KV bucket and
+            # the FLOP estimate both imply.
             t1 = time.monotonic()
             spec = res.ndim == 3
             occupancy = round(len(snapshot) / max(1, self.num_slots), 3)
+            rows = int(res.shape[0]) * self.num_slots \
+                * (res.shape[2] - 1 if spec else 1)
             self._tracer.step(
                 "engine_step", t_disp, t1, steps=int(res.shape[0]),
                 batch=len(snapshot), slots=self.num_slots,
-                occupancy=occupancy, kind="spec" if spec else "plain")
+                occupancy=occupancy, kind="spec" if spec else "plain",
+                tokens=consumed, rows=rows, kv_len=kv_len,
+                flops=self._perf.call_flops(consumed, kv_len))
             for s, req in snapshot:
                 self._tracer.add_span(
                     req.request_id, "decode_step", t_disp, t1,

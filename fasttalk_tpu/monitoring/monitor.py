@@ -28,6 +28,8 @@ from aiohttp import web
 from fasttalk_tpu import __version__
 from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.observability.export import chrome_trace, jsonl_dump
+from fasttalk_tpu.observability.flight import get_flight
+from fasttalk_tpu.observability.perf import get_perf
 from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.observability.watchdog import get_watchdog
@@ -136,10 +138,13 @@ def build_monitoring_app(ready_check=None, sched_info=None,
         return web.json_response({"status": "live"})
 
     async def metrics(request: web.Request) -> web.Response:
-        # Cheap scrape-time sample: refresh the engine-step heartbeat
-        # age gauge so stalls are visible to Prometheus even before the
-        # watchdog trips (one getattr + one float subtraction).
+        # Cheap scrape-time samples: refresh the engine-step heartbeat
+        # age gauge (one getattr + one float subtraction) and the
+        # perf_* attribution gauges (one pass over the bounded step
+        # ring) so stalls and wall-time decomposition are visible to
+        # Prometheus without any background sampler.
         get_watchdog().sample()
+        get_perf().sample()
         return web.Response(text=get_metrics().prometheus(),
                             content_type="text/plain")
 
@@ -322,6 +327,32 @@ def build_monitoring_app(ready_check=None, sched_info=None,
             lambda: chrome_trace(tracer, [trace]))
         return web.Response(text=text, content_type="application/json")
 
+    # ---- perf attribution + flight recorder (ISSUE 6) ----
+
+    async def perf(request: web.Request) -> web.Response:
+        """Performance attribution report: wall-time decomposition
+        (device busy / host gap / idle), padding waste, occupancy,
+        useful-token throughput, MFU vs the device roofline, and the
+        compile ledger (observability/perf.py)."""
+        return web.json_response(get_perf().report())
+
+    async def debug_bundle(request: web.Request) -> web.Response:
+        """Manually capture a flight-recorder debug bundle (same
+        contents as the automatic incident captures; bypasses the rate
+        limit but not the one-writer-at-a-time guard)."""
+        flight = get_flight()
+        if not flight.enabled:
+            return web.json_response(
+                {"error": "flight recorder disabled "
+                 "(FLIGHT_ENABLED=0)"}, status=409)
+        path = flight.trigger("manual", force=True)
+        if path is None:
+            return web.json_response(
+                {"error": "a bundle write is already in progress",
+                 **flight.stats()}, status=429)
+        return web.json_response({**flight.stats(),
+                                  "status": "writing", "dir": path})
+
     # ---- SLO engine + structured event log (ISSUE 3) ----
 
     async def slo(request: web.Request) -> web.Response:
@@ -352,6 +383,8 @@ def build_monitoring_app(ready_check=None, sched_info=None,
     app.router.add_get("/health/ready", ready)
     app.router.add_get("/health/live", live)
     app.router.add_get("/slo", slo)
+    app.router.add_get("/perf", perf)
+    app.router.add_post("/debug/bundle", debug_bundle)
     app.router.add_get("/events", events)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/metrics.json", metrics_json)

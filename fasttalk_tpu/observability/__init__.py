@@ -10,6 +10,10 @@ from fasttalk_tpu.observability.slo import (ClassObjectives, SLOEngine,
                                             reset_slo)
 from fasttalk_tpu.observability.watchdog import (Watchdog, get_watchdog,
                                                  reset_watchdog)
+from fasttalk_tpu.observability.perf import (PerfLedger, get_perf,
+                                             reset_perf)
+from fasttalk_tpu.observability.flight import (FlightRecorder, get_flight,
+                                               reset_flight)
 
 __all__ = [
     "Span", "RequestTrace", "Tracer", "get_tracer", "reset_tracer",
@@ -17,4 +21,6 @@ __all__ = [
     "Event", "EventLog", "get_events", "reset_events",
     "ClassObjectives", "SLOEngine", "get_slo", "objectives_from_env",
     "reset_slo", "Watchdog", "get_watchdog", "reset_watchdog",
+    "PerfLedger", "get_perf", "reset_perf",
+    "FlightRecorder", "get_flight", "reset_flight",
 ]

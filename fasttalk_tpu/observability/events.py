@@ -112,6 +112,22 @@ class EventLog:
         # (coalescing handle; O(1) instead of scanning the ring).
         self._last_by_key: dict[tuple[str, str], Event] = {}
         self._jsonl_warned = False
+        # Subscribers (observability/flight.py): called outside the
+        # lock on every emit (including coalesce bumps). A listener
+        # must be cheap or hand off to its own thread — it runs on the
+        # EMITTER's thread (engine, asyncio loop, scheduler callers).
+        self._listeners: list[Any] = []
+
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(event)`` to every emit (idempotent)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def emit(self, kind: str, severity: str = "info",
              coalesce_s: float = 0.0, coalesce_key: str = "",
@@ -164,6 +180,13 @@ class EventLog:
         # engine thread against the asyncio loop on the event lock.
         if self.jsonl_path and mirror_ev is not None:
             self._mirror(mirror_ev)
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception as e:  # a broken listener must not take
+                log.error(f"event listener failed: {e}")  # emit() down
         return ev
 
     def _mirror(self, ev: Event) -> None:

@@ -1,0 +1,404 @@
+"""Performance attribution ledger: where engine wall time actually went.
+
+PR 1 records *that* a decode call happened (the tracer's engine-step
+ring) and PR 3 pages *when* latency promises break — neither explains
+the gap between achieved throughput and what the hardware could do.
+This module closes that gap with a rolling attribution report over the
+engine's step/prefill telemetry:
+
+- **Wall-time decomposition.** The step ring's records are intervals
+  on the engine clock (dispatch → retirement for decode calls,
+  dispatch for prefill calls). Their union is *device-busy* time; the
+  gaps between them split into *host gap* (short — dispatch overhead,
+  host-side token handling, admission work between calls) and *idle*
+  (long — no work to run), by the ``PERF_IDLE_GAP_MS`` threshold
+  (default 250). busy + host_gap + idle == the report window, exactly.
+- **Padding waste.** Fixed shapes buy compile stability by computing
+  rows that are thrown away: decode calls advance all S slots whether
+  active or not (and speculative verify blocks compute draft+1
+  positions of which only the accepted prefix is kept), and prefill
+  pads prompts up to power-of-two buckets and group sizes. Every
+  record carries the token rows it computed and the tokens that were
+  actually useful; the waste fraction is 1 - useful/computed.
+- **Occupancy-weighted useful-token throughput.** Useful tokens per
+  wall second and per device-busy second, next to the duration-
+  weighted mean batch occupancy — the number that says whether low
+  tok/s is an empty batch or a slow step.
+- **MFU.** Records carry a per-call FLOP estimate from the bound
+  model config (2·params per token plus the attention term at the
+  call's KV bucket); achieved FLOP/s over the window against the
+  device's peak (detected from the device kind, overridable with
+  ``PERF_PEAK_TFLOPS``) is the achieved-vs-peak roofline number the
+  ROADMAP's "as fast as the hardware allows" is judged by.
+- **Compile ledger.** Every ``_note_compile`` signature (warmup and
+  serving-time) is counted per key, so "why did p99 spike" can be
+  answered with "the 2048 prefill bucket compiled at 14:03" instead
+  of a profiler session.
+
+Exposed as ``GET /perf`` on the monitoring port, ``perf_*`` Prometheus
+gauges (refreshed at scrape time), a ``--perf`` section in
+``scripts/trace_report.py`` (offline, from a JSONL dump), and a
+``perf`` block in bench.py's JSON output.
+
+Same design constraints as the tracer: cheap (reads the existing ring;
+recording adds one dict update per compile), thread-safe, clearable in
+place for tests, fake-clock drivable (``report(now=...)``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+from fasttalk_tpu.observability.events import env_float
+from fasttalk_tpu.utils.metrics import get_metrics
+
+DEFAULT_WINDOW_S = 60.0
+DEFAULT_IDLE_GAP_MS = 250.0
+
+# Peak dense bf16 TFLOP/s per chip by device-kind substring (public
+# spec sheets); the roofline denominator when PERF_PEAK_TFLOPS is
+# unset. Unknown kinds (CPU, new chips) report mfu: null rather than a
+# made-up number.
+PEAK_TFLOPS_BF16 = (
+    ("v6e", 918.0), ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0), ("v5 lite", 197.0), ("v5litepod", 197.0),
+    ("v4", 275.0),
+)
+
+# Step-ring record names this ledger aggregates (engine/engine.py):
+# decode calls (dispatch → retirement) and prefill calls (dispatch).
+_STEP = "engine_step"
+_PREFILL = "engine_prefill"
+
+
+def detect_peak_tflops() -> tuple[float, str]:
+    """(peak bf16 TFLOP/s per local device set, device kind). 0.0 when
+    the platform has no table entry — MFU then reports null."""
+    try:
+        import jax
+
+        devs = jax.local_devices()
+    except Exception:
+        return 0.0, "unknown"
+    if not devs:
+        return 0.0, "unknown"
+    kind = getattr(devs[0], "device_kind", "") or devs[0].platform
+    low = str(kind).lower()
+    for key, peak in PEAK_TFLOPS_BF16:
+        if key in low:
+            return peak * len(devs), str(kind)
+    return 0.0, str(kind)
+
+
+class PerfLedger:
+    """Rolling attribution report over the tracer's step ring."""
+
+    def __init__(self, *, tracer: Any = None,
+                 window_s: float | None = None,
+                 idle_gap_ms: float | None = None,
+                 peak_tflops: float | None = None,
+                 clock=time.monotonic):
+        self.window_s = window_s if window_s is not None \
+            else max(1.0, env_float("PERF_WINDOW_S", DEFAULT_WINDOW_S))
+        self.idle_gap_ms = idle_gap_ms if idle_gap_ms is not None \
+            else max(0.0, env_float("PERF_IDLE_GAP_MS",
+                                    DEFAULT_IDLE_GAP_MS))
+        # 0 = detect from the device kind lazily (first report).
+        self._peak_override = peak_tflops if peak_tflops is not None \
+            else env_float("PERF_PEAK_TFLOPS", 0.0)
+        self._peak: tuple[float, str] | None = None
+        self._tracer = tracer
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Model cost estimate (bind_model): FLOPs/token = _flops_base +
+        # _flops_per_ctx * kv_len.
+        self._model_name = ""
+        self._num_slots = 0
+        self._dtype = ""
+        self._params = 0
+        self._flops_base = 0.0
+        self._flops_per_ctx = 0.0
+        # Compile ledger: key -> {kind, count, serving, first/last ts}.
+        self._compiles: dict[str, dict[str, Any]] = {}
+        m = get_metrics()
+        self._m_busy = m.gauge(
+            "perf_device_busy_frac",
+            "fraction of the attribution window covered by engine "
+            "device calls (decode dispatch-to-retirement union)")
+        self._m_gap = m.gauge(
+            "perf_host_gap_frac",
+            "fraction of the attribution window spent in short gaps "
+            "between device calls (host dispatch/consume overhead)")
+        self._m_idle = m.gauge(
+            "perf_idle_frac",
+            "fraction of the attribution window with no device call "
+            "and no work (gaps above PERF_IDLE_GAP_MS)")
+        self._m_waste = m.gauge(
+            "perf_padding_waste_frac",
+            "fraction of computed token rows discarded as padding "
+            "(inactive decode slots, rejected draft positions, "
+            "prefill bucket/group padding)")
+        self._m_occ = m.gauge(
+            "perf_occupancy",
+            "duration-weighted mean batch occupancy of decode calls")
+        self._m_tok_s = m.gauge(
+            "perf_useful_tok_s",
+            "useful tokens per wall second over the attribution window "
+            "(decode tokens consumed + prompt tokens prefilled)")
+        self._m_mfu = m.gauge(
+            "perf_mfu",
+            "achieved model FLOP utilisation vs the device peak "
+            "(0 when the peak is unknown; see perf_peak_tflops)")
+        self._m_peak = m.gauge(
+            "perf_peak_tflops",
+            "roofline peak used for perf_mfu (0 = unknown device kind "
+            "and PERF_PEAK_TFLOPS unset)")
+        self._m_compiles = m.counter(
+            "perf_serving_compiles_total",
+            "jitted-executable compiles observed while serving traffic")
+
+    # ---------------- wiring ----------------
+
+    def _get_tracer(self):
+        if self._tracer is None:
+            from fasttalk_tpu.observability.trace import get_tracer
+
+            self._tracer = get_tracer()
+        return self._tracer
+
+    def bind_model(self, model_cfg: Any, num_slots: int,
+                   dtype: str = "") -> None:
+        """Attach the served model's cost estimate (engine __init__).
+        FLOPs/token = 2·params (every weight partakes in one multiply-
+        accumulate) + 4·layers·q_dim·kv_len (QKᵀ and A·V per head)."""
+        with self._lock:
+            self._model_name = getattr(model_cfg, "name", "")
+            self._num_slots = num_slots
+            self._dtype = dtype
+            self._params = int(model_cfg.param_count())
+            self._flops_base = 2.0 * self._params
+            self._flops_per_ctx = 4.0 * model_cfg.num_layers \
+                * model_cfg.q_dim
+
+    def call_flops(self, tokens: int, ctx: int) -> float:
+        """FLOP estimate for one device call that computed ``tokens``
+        useful tokens against a KV horizon of ``ctx`` (0.0 unbound)."""
+        return tokens * (self._flops_base + self._flops_per_ctx * ctx)
+
+    def note_compile(self, kind: str, serving: bool = False,
+                     **attrs: Any) -> None:
+        """Count one jitted-executable cache miss under its signature
+        (the same kind+attrs key engine._note_compile events carry)."""
+        key = kind + "".join(f" {k}={attrs[k]}" for k in sorted(attrs))
+        now = time.time()
+        with self._lock:
+            entry = self._compiles.get(key)
+            if entry is None:
+                entry = {"key": key, "kind": kind, "count": 0,
+                         "serving": 0, "first_ts": now, "last_ts": now}
+                self._compiles[key] = entry
+            entry["count"] += 1
+            entry["last_ts"] = now
+            if serving:
+                entry["serving"] += 1
+        if serving:
+            self._m_compiles.inc()
+
+    # ---------------- the report ----------------
+
+    def _peak_tflops(self) -> tuple[float, str]:
+        if self._peak_override > 0:
+            return self._peak_override, "PERF_PEAK_TFLOPS"
+        if self._peak is None:
+            self._peak = detect_peak_tflops()
+        return self._peak
+
+    def report(self, now: float | None = None) -> dict[str, Any]:
+        """The ``GET /perf`` body. ``now`` is on the step records'
+        clock (time.monotonic in production; fake in tests)."""
+        tracer = self._get_tracer()
+        now = self._clock() if now is None else now
+        records = [r for r in tracer.steps()
+                   if r.name in (_STEP, _PREFILL)]
+        horizon = now - self.window_s
+        records = [r for r in records if r.t1 > horizon]
+        records.sort(key=lambda r: r.t0)
+        peak, device = self._peak_tflops()
+        with self._lock:
+            compiles = [dict(e) for e in self._compiles.values()]
+        compiles.sort(key=lambda e: -e["last_ts"])
+        out: dict[str, Any] = {
+            "enabled": tracer.enabled,
+            "window_s": self.window_s,
+            "idle_gap_ms": self.idle_gap_ms,
+            "n_decode_calls": sum(1 for r in records
+                                  if r.name == _STEP),
+            "n_prefill_calls": sum(1 for r in records
+                                   if r.name == _PREFILL),
+            "model": {"name": self._model_name, "params": self._params,
+                      "slots": self._num_slots, "dtype": self._dtype},
+            "compiles": {
+                "total": sum(e["count"] for e in compiles),
+                "serving": sum(e["serving"] for e in compiles),
+                "by_key": compiles,
+            },
+        }
+        if not records:
+            out["wall"] = None
+            out["tokens"] = None
+            out["mfu"] = {"peak_tflops": peak or None,
+                          "device": device, "mfu": None}
+            return out
+
+        # Wall-time decomposition: union the (clipped) call intervals,
+        # then classify every gap by the idle threshold. The window
+        # starts at the first visible record (or the horizon, whichever
+        # is later) so a freshly started process is not reported as
+        # mostly idle.
+        start = max(horizon, records[0].t0)
+        intervals = [(max(r.t0, start), min(r.t1, now)) for r in records]
+        intervals = [(a, b) for a, b in intervals if b > a]
+        merged: list[tuple[float, float]] = []
+        for a, b in intervals:
+            if merged and a <= merged[-1][1]:
+                if b > merged[-1][1]:
+                    merged[-1] = (merged[-1][0], b)
+            else:
+                merged.append((a, b))
+        busy = sum(b - a for a, b in merged)
+        gap_thresh = self.idle_gap_ms / 1000.0
+        host_gap = idle = 0.0
+        cursor = start
+        for a, b in merged:
+            g = a - cursor
+            if g > 0:
+                if g > gap_thresh:
+                    idle += g
+                else:
+                    host_gap += g
+            cursor = max(cursor, b)
+        tail = now - cursor
+        if tail > 0:
+            if tail > gap_thresh:
+                idle += tail
+            else:
+                host_gap += tail
+        window = now - start
+        frac = (lambda x: round(x / window, 4)) if window > 0 \
+            else (lambda x: 0.0)
+        out["wall"] = {
+            "window_s": round(window, 4),
+            "device_busy_s": round(busy, 4),
+            "host_gap_s": round(host_gap, 4),
+            "idle_s": round(idle, 4),
+            "device_busy_frac": frac(busy),
+            "host_gap_frac": frac(host_gap),
+            "idle_frac": frac(idle),
+        }
+
+        # Useful tokens vs computed rows, occupancy, FLOPs.
+        decode_tokens = prefill_tokens = 0
+        computed_rows = 0
+        occ_weight = occ_sum = 0.0
+        flops = 0.0
+        for r in records:
+            a = r.attrs
+            flops += float(a.get("flops", 0.0))
+            if r.name == _STEP:
+                decode_tokens += int(a.get("tokens", 0))
+                computed_rows += int(a.get("rows",
+                                           int(a.get("steps", 0))
+                                           * int(a.get("slots", 0))))
+                dur = max(0.0, r.t1 - r.t0)
+                occ_weight += dur
+                occ_sum += dur * float(a.get("occupancy", 0.0))
+            else:
+                prefill_tokens += int(a.get("tokens", 0))
+                computed_rows += int(a.get("rows", a.get("tokens", 0)))
+        useful = decode_tokens + prefill_tokens
+        out["tokens"] = {
+            "decode_tokens": decode_tokens,
+            "prefill_tokens": prefill_tokens,
+            "computed_token_rows": computed_rows,
+            "padding_waste_frac": round(1.0 - useful / computed_rows, 4)
+            if computed_rows > 0 else None,
+            "useful_tok_s": round(useful / window, 2)
+            if window > 0 else None,
+            "busy_tok_s": round(useful / busy, 2) if busy > 0 else None,
+            "occupancy_mean": round(occ_sum / occ_weight, 4)
+            if occ_weight > 0 else None,
+        }
+        achieved = flops / window / 1e12 if window > 0 else 0.0
+        out["mfu"] = {
+            "flops": flops,
+            # Not rounded to fixed decimals: a tiny test model's real
+            # achieved TFLOP/s (~1e-5) must not collapse to 0.
+            "achieved_tflops": achieved,
+            "peak_tflops": peak or None,
+            "device": device,
+            "mfu": round(achieved / peak, 6) if peak > 0 else None,
+        }
+        return out
+
+    def summary(self, now: float | None = None) -> dict[str, Any]:
+        """Compact one-level digest (bench.py's JSON output)."""
+        rep = self.report(now)
+        wall = rep.get("wall") or {}
+        toks = rep.get("tokens") or {}
+        mfu = rep.get("mfu") or {}
+        return {
+            "device_busy_frac": wall.get("device_busy_frac"),
+            "host_gap_frac": wall.get("host_gap_frac"),
+            "idle_frac": wall.get("idle_frac"),
+            "occupancy_mean": toks.get("occupancy_mean"),
+            "padding_waste_frac": toks.get("padding_waste_frac"),
+            "useful_tok_s": toks.get("useful_tok_s"),
+            "mfu": mfu.get("mfu"),
+            "achieved_tflops": mfu.get("achieved_tflops"),
+            "serving_compiles": rep["compiles"]["serving"],
+        }
+
+    def sample(self, now: float | None = None) -> None:
+        """Refresh the perf_* gauges from a fresh report (called by the
+        monitoring app before rendering /metrics, like the watchdog's
+        heartbeat gauge)."""
+        rep = self.report(now)
+        wall = rep.get("wall") or {}
+        toks = rep.get("tokens") or {}
+        mfu = rep.get("mfu") or {}
+        self._m_busy.set(wall.get("device_busy_frac") or 0.0)
+        self._m_gap.set(wall.get("host_gap_frac") or 0.0)
+        self._m_idle.set(wall.get("idle_frac") or 0.0)
+        self._m_waste.set(toks.get("padding_waste_frac") or 0.0)
+        self._m_occ.set(toks.get("occupancy_mean") or 0.0)
+        self._m_tok_s.set(toks.get("useful_tok_s") or 0.0)
+        self._m_mfu.set(mfu.get("mfu") or 0.0)
+        self._m_peak.set(mfu.get("peak_tflops") or 0.0)
+
+    def clear(self) -> None:
+        """Test hook: drop the compile ledger IN PLACE. The model
+        binding is construction-time wiring from a live engine (like
+        cached metric objects) and survives — clearing it would orphan
+        that engine's per-call FLOP feed for the rest of the process."""
+        with self._lock:
+            self._compiles.clear()
+
+
+_perf: PerfLedger | None = None
+
+
+def get_perf() -> PerfLedger:
+    global _perf
+    if _perf is None:
+        _perf = PerfLedger()
+    return _perf
+
+
+def reset_perf() -> None:
+    """Test hook: clear the process-wide ledger in place."""
+    if _perf is not None:
+        _perf.clear()

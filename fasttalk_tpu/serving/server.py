@@ -87,6 +87,14 @@ class WebSocketLLMServer:
         self.watchdog = get_watchdog()
         self.watchdog.bind_engine(engine)
         self._watchdog_task: asyncio.Task | None = None
+        # Flight recorder (observability/flight.py): subscribe to the
+        # event log so SLO pages, stalls, restarts and recompile
+        # bursts snapshot their evidence (events/traces/metrics/perf/
+        # config) the moment they are detected — no by-hand repro
+        # before /profiler/start is useful.
+        from fasttalk_tpu.observability.flight import get_flight
+
+        get_flight().install()
         m = get_metrics()
         self._m_ws_tokens = m.counter("ws_tokens_streamed_total",
                                       "token frames streamed to clients")
@@ -311,7 +319,7 @@ class WebSocketLLMServer:
             self._backend().release_session(session_id)
             self.connection_manager.remove_connection(session_id)
             self.conversation_manager.end_session(session_id)
-            log.log_connection(session_id, "closed")
+            log.log_connection(session_id, "closed", level="debug")
         return ws
 
     async def _send(self, session_id: str, ws: web.WebSocketResponse,

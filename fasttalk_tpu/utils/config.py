@@ -393,6 +393,49 @@ class Config:
     # (utils/metrics.py). <= 0 restores the pure sample-count window.
     metrics_window_s: float = field(
         default_factory=lambda: _env_float("METRICS_WINDOW_S", 300.0))
+    # ---- Performance attribution ledger (observability/perf.py,
+    # GET /perf + perf_* gauges) ----
+    # Rolling window the attribution report covers (seconds).
+    perf_window_s: float = field(
+        default_factory=lambda: _env_float("PERF_WINDOW_S", 60.0))
+    # Gap between device calls longer than this counts as idle (no
+    # work); shorter gaps are host overhead between dispatches.
+    perf_idle_gap_ms: float = field(
+        default_factory=lambda: _env_float("PERF_IDLE_GAP_MS", 250.0))
+    # Roofline peak for MFU (total bf16 TFLOP/s across local devices).
+    # 0 = detect from the device kind; unknown kinds report mfu: null.
+    perf_peak_tflops: float = field(
+        default_factory=lambda: _env_float("PERF_PEAK_TFLOPS", 0.0))
+    # ---- Incident flight recorder (observability/flight.py,
+    # POST /debug/bundle) ----
+    flight_enabled: bool = field(
+        default_factory=lambda: _env_bool("FLIGHT_ENABLED", True))
+    flight_dir: str = field(
+        default_factory=lambda: _env_str("FLIGHT_DIR",
+                                         "/tmp/fasttalk-tpu-flight"))
+    # Retention: only the newest N bundle directories are kept.
+    flight_max_bundles: int = field(
+        default_factory=lambda: _env_int("FLIGHT_MAX_BUNDLES", 8))
+    # Rate limit: at most one automatic bundle per this many seconds
+    # (a page storm produces one bundle, not a disk-filling flood).
+    flight_min_interval_s: float = field(
+        default_factory=lambda: _env_float("FLIGHT_MIN_INTERVAL_S",
+                                           120.0))
+    # > 0: each bundle additionally captures a timed jax.profiler
+    # device trace of the next N seconds (off the event loop).
+    flight_autoprof_s: float = field(
+        default_factory=lambda: _env_float("FLIGHT_AUTOPROF_S", 0.0))
+    # This many serving-time recompile events within
+    # flight_recompile_window_s counts as a shape-churn incident and
+    # triggers a bundle.
+    flight_recompile_burst: int = field(
+        default_factory=lambda: _env_int("FLIGHT_RECOMPILE_BURST", 5))
+    flight_recompile_window_s: float = field(
+        default_factory=lambda: _env_float("FLIGHT_RECOMPILE_WINDOW_S",
+                                           60.0))
+    # How many newest-first events each bundle's events.json carries.
+    flight_events_tail: int = field(
+        default_factory=lambda: _env_int("FLIGHT_EVENTS_TAIL", 256))
     # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
     # in-tree replacement for the reference's 300s engine-container
     # health start_period (docker-compose.vllm.yml:62-67). Empty means
@@ -517,6 +560,29 @@ class Config:
                 errs.append(f"{name} must be > 0")
         if not (0.0 < self.slo_error_rate <= 1.0):
             errs.append("slo_error_rate must be in (0, 1]")
+        if self.perf_window_s <= 0:
+            errs.append("perf_window_s must be > 0")
+        if self.perf_idle_gap_ms <= 0:
+            errs.append("perf_idle_gap_ms must be > 0")
+        if self.perf_peak_tflops < 0:
+            errs.append("perf_peak_tflops must be >= 0 (0 = detect "
+                        "from the device kind)")
+        if not self.flight_dir.strip():
+            errs.append("flight_dir must be a non-empty path")
+        if self.flight_max_bundles < 1:
+            errs.append("flight_max_bundles must be >= 1")
+        if self.flight_min_interval_s < 0:
+            errs.append("flight_min_interval_s must be >= 0")
+        if self.flight_autoprof_s < 0:
+            errs.append("flight_autoprof_s must be >= 0 (0 disables "
+                        "the automatic profiler capture)")
+        if self.flight_recompile_burst < 2:
+            errs.append("flight_recompile_burst must be >= 2 (one "
+                        "recompile is an event, not an incident)")
+        if self.flight_recompile_window_s <= 0:
+            errs.append("flight_recompile_window_s must be > 0")
+        if self.flight_events_tail < 1:
+            errs.append("flight_events_tail must be >= 1")
         if self.watchdog_cancel_stall_s < self.watchdog_token_stall_s:
             # Cancellation cannot precede detection; a smaller value
             # would silently mean max(token, cancel) (watchdog.py).

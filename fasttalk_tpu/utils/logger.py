@@ -142,9 +142,14 @@ class StructuredLogger:
             session_id=session_id, tokens=tokens, duration_s=duration_s,
             tokens_per_second=tok_s, ttft_ms=ttft_ms, **extra)
 
-    def log_connection(self, session_id: str, event: str, **extra: Any) -> None:
-        self.info(f"[{session_id}] connection {event}", session_id=session_id,
-                  event=event, **extra)
+    def log_connection(self, session_id: str, event: str,
+                       level: str = "info", **extra: Any) -> None:
+        # Per-connection close lines are DEBUG at the call site: at 16+
+        # concurrent bench sessions the INFO tail was nothing but
+        # "connection closed" lines burying the throughput summary.
+        getattr(self, level, self.info)(
+            f"[{session_id}] connection {event}", session_id=session_id,
+            event=event, **extra)
 
     def log_performance(self, name: str, duration_ms: float, **extra: Any) -> None:
         self.debug(f"perf {name}: {duration_ms:.1f}ms", perf=name,

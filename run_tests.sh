@@ -28,6 +28,13 @@
 #                             Prometheus validator, plus smoke runs of
 #                             scripts/check_prometheus.py and the
 #                             trace_report --slo CI gate.
+#   ./run_tests.sh --perf     perf-attribution/flight-recorder group:
+#                             the step ledger (wall-time decomposition,
+#                             padding waste, MFU, compile ledger),
+#                             GET /perf + perf_* gauge exposition,
+#                             fake-clock flight-bundle triggers, the
+#                             profiler endpoints, and a trace_report
+#                             --perf smoke (docs/OBSERVABILITY.md).
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -98,6 +105,26 @@ problems = mod.validate(m.prometheus())
 assert not problems, problems
 print("exposition format OK")
 EOF
+    exit 0
+fi
+
+if [[ "${1:-}" == "--perf" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_perf.py \
+        "tests/test_observability.py::TestProfilerEndpoints" "$@"
+    echo "--- trace_report --perf smoke ---"
+    tmp="$(mktemp)"
+    trap 'rm -f "$tmp"' EXIT
+    cat > "$tmp" <<'EOF'
+{"request_id": null, "session_id": "", "span": "engine_step", "ts": 100.0, "dur_ms": 1000.0, "attrs": {"steps": 8, "batch": 2, "slots": 4, "occupancy": 0.5, "tokens": 16, "rows": 32, "kv_len": 512, "flops": 1e9}}
+{"request_id": null, "session_id": "", "span": "engine_prefill", "ts": 101.1, "dur_ms": 100.0, "attrs": {"bucket": 64, "tokens": 40, "rows": 64}}
+EOF
+    out="$("${PYENV[@]}" python scripts/trace_report.py --perf "$tmp")"
+    echo "$out"
+    for want in "perf attribution" "padding waste" "device busy"; do
+        grep -q "$want" <<<"$out" \
+            || { echo "trace_report --perf smoke: missing '$want'" >&2; exit 1; }
+    done
     exit 0
 fi
 

@@ -138,6 +138,114 @@ def kv_phase_note(records: Iterable[dict[str, Any]]) -> str | None:
             + "; ".join(parts))
 
 
+def perf_attribution(records: Iterable[dict[str, Any]],
+                     idle_gap_ms: float | None = None,
+                     peak_tflops: float | None = None,
+                     ) -> dict[str, Any] | None:
+    """Offline step-ledger attribution over a dump's process-level
+    rows (``engine_step`` dispatch→retirement intervals and
+    ``engine_prefill`` dispatch rows) — the stdlib mirror of
+    observability/perf.py's report, covering the dump's whole span:
+    wall-time decomposition (device busy / host gap / idle via the
+    PERF_IDLE_GAP_MS threshold), padding waste, occupancy, useful
+    tok/s, and MFU when the rows carry FLOP estimates and a roofline
+    is configured (PERF_PEAK_TFLOPS). None when the dump has no
+    engine rows."""
+    if idle_gap_ms is None:
+        raw = os.environ.get("PERF_IDLE_GAP_MS", "").strip()
+        try:
+            idle_gap_ms = float(raw) if raw else 250.0
+        except ValueError:
+            idle_gap_ms = 250.0
+    if peak_tflops is None:
+        raw = os.environ.get("PERF_PEAK_TFLOPS", "").strip()
+        try:
+            peak_tflops = float(raw) if raw else 0.0
+        except ValueError:
+            peak_tflops = 0.0
+    rows = [r for r in records
+            if r.get("span") in ("engine_step", "engine_prefill")]
+    if not rows:
+        return None
+    ivals = sorted((float(r["ts"]),
+                    float(r["ts"]) + float(r.get("dur_ms", 0.0)) / 1e3)
+                   for r in rows)
+    start, end = ivals[0][0], max(b for _, b in ivals)
+    merged: list[list[float]] = []
+    for a, b in ivals:
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    busy = sum(b - a for a, b in merged)
+    thresh = idle_gap_ms / 1e3
+    host_gap = idle = 0.0
+    cursor = start
+    for a, b in merged:
+        g = a - cursor
+        if g > 0:
+            idle, host_gap = (idle + g, host_gap) if g > thresh \
+                else (idle, host_gap + g)
+        cursor = max(cursor, b)
+    window = end - start
+    decode_toks = prefill_toks = computed = 0
+    occ_w = occ_s = flops = 0.0
+    for r in rows:
+        a = r.get("attrs") or {}
+        flops += float(a.get("flops", 0.0))
+        if r["span"] == "engine_step":
+            decode_toks += int(a.get("tokens", 0))
+            computed += int(a.get("rows", 0))
+            dur = float(r.get("dur_ms", 0.0))
+            occ_w += dur
+            occ_s += dur * float(a.get("occupancy", 0.0))
+        else:
+            prefill_toks += int(a.get("tokens", 0))
+            computed += int(a.get("rows", a.get("tokens", 0)))
+    useful = decode_toks + prefill_toks
+    achieved = flops / window / 1e12 if window > 0 else 0.0
+    return {
+        "n_rows": len(rows),
+        "window_s": window,
+        "device_busy_frac": busy / window if window > 0 else None,
+        "host_gap_frac": host_gap / window if window > 0 else None,
+        "idle_frac": idle / window if window > 0 else None,
+        "decode_tokens": decode_toks,
+        "prefill_tokens": prefill_toks,
+        "padding_waste_frac": 1.0 - useful / computed
+        if computed > 0 else None,
+        "useful_tok_s": useful / window if window > 0 else None,
+        "occupancy_mean": occ_s / occ_w if occ_w > 0 else None,
+        "achieved_tflops": achieved,
+        "mfu": achieved / peak_tflops if peak_tflops > 0 else None,
+    }
+
+
+def format_perf(p: dict[str, Any]) -> str:
+    def pct(v: float | None) -> str:
+        return "-" if v is None else f"{v:.1%}"
+
+    def num(v: float | None, fmt: str = "{:.2f}") -> str:
+        return "-" if v is None else fmt.format(v)
+
+    lines = [
+        f"perf attribution ({p['n_rows']} engine rows over "
+        f"{p['window_s']:.2f}s)",
+        f"  wall time: device busy {pct(p['device_busy_frac'])}  "
+        f"host gap {pct(p['host_gap_frac'])}  "
+        f"idle {pct(p['idle_frac'])}",
+        f"  tokens: {p['decode_tokens']} decode + "
+        f"{p['prefill_tokens']} prefill useful "
+        f"({num(p['useful_tok_s'], '{:.1f}')} tok/s); "
+        f"padding waste {pct(p['padding_waste_frac'])}; "
+        f"occupancy {num(p['occupancy_mean'])}",
+        f"  flops: {p['achieved_tflops']:.4f} TFLOP/s achieved"
+        + ("" if p["mfu"] is None else f"; MFU {p['mfu']:.2%}"
+           " (PERF_PEAK_TFLOPS roofline)"),
+    ]
+    return "\n".join(lines)
+
+
 def _slo_target(name: str) -> float:
     raw = os.environ.get(name, "").strip()
     if raw:
@@ -244,6 +352,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--slo", action="store_true",
                     help="evaluate the dump against the configured "
                     "SLO_* targets; exit 1 on violation (CI gate)")
+    ap.add_argument("--perf", action="store_true",
+                    help="append the step-ledger attribution section "
+                    "(wall-time decomposition, padding waste, "
+                    "occupancy, MFU) computed from the dump's "
+                    "engine_step/engine_prefill rows")
     args = ap.parse_args(argv)
     try:
         if args.dump == "-":
@@ -262,11 +375,14 @@ def main(argv: list[str] | None = None) -> int:
     print(f"{len(records)} spans across {len(requests)} requests")
     print()
     kv_note = kv_phase_note(records)
+    perf = perf_attribution(records) if args.perf else None
     if args.slo:
         rows, ok = slo_evaluate(records)
         print(format_slo_table(rows))
         if kv_note:
             print(f"\n{kv_note}")
+        if perf is not None:
+            print(f"\n{format_perf(perf)}")
         if not ok:
             print("\nSLO VIOLATION", file=sys.stderr)
             return 1
@@ -275,6 +391,12 @@ def main(argv: list[str] | None = None) -> int:
     print(format_table(phase_table(records)))
     if kv_note:
         print(f"\n{kv_note}")
+    if args.perf:
+        if perf is None:
+            print("\nperf attribution: no engine_step/engine_prefill "
+                  "rows in dump")
+        else:
+            print(f"\n{format_perf(perf)}")
     return 0
 
 

@@ -45,6 +45,8 @@ def pytest_pyfunc_call(pyfuncitem):
 @pytest.fixture(autouse=True)
 def _fresh_metrics():
     from fasttalk_tpu.observability.events import reset_events
+    from fasttalk_tpu.observability.flight import reset_flight
+    from fasttalk_tpu.observability.perf import reset_perf
     from fasttalk_tpu.observability.slo import reset_slo
     from fasttalk_tpu.observability.trace import reset_tracer
     from fasttalk_tpu.observability.watchdog import reset_watchdog
@@ -55,8 +57,12 @@ def _fresh_metrics():
     reset_events()
     reset_slo()
     reset_watchdog()
+    reset_perf()
+    reset_flight()
     yield
     reset_metrics()
     reset_events()
     reset_slo()
     reset_watchdog()
+    reset_perf()
+    reset_flight()

@@ -338,6 +338,44 @@ class TestEngineTracing:
         assert m.histogram("prefill_ms").summary()["count"] >= 1
         assert m.histogram("inter_token_ms").summary()["count"] >= 1
 
+    def test_perf_attribution_from_real_engine(self, engine):
+        """ISSUE 6: step records carry the attribution annotations
+        (consumed tokens, computed rows, KV bucket, FLOPs) and the
+        ledger decomposes them into a sums-to-one report."""
+        from fasttalk_tpu.observability.perf import get_perf
+        from fasttalk_tpu.observability.trace import get_tracer
+
+        events = _collect(engine, "perf-r1", "perf-s1",
+                          [{"role": "user", "content": "attribute me"}],
+                          GenerationParams(max_tokens=12, **GREEDY))
+        assert events[-1]["type"] == "done"
+        steps = [r for r in get_tracer().steps()
+                 if r.name == "engine_step"]
+        assert steps
+        rec = steps[-1]
+        assert rec.attrs["tokens"] >= 1
+        assert rec.attrs["rows"] >= rec.attrs["tokens"]
+        assert rec.attrs["kv_len"] >= 1
+        assert rec.attrs["flops"] > 0  # model cost estimate bound
+        prefills = [r for r in get_tracer().steps()
+                    if r.name == "engine_prefill"]
+        assert prefills, "batched prefill left no attribution row"
+        assert prefills[-1].attrs["tokens"] >= 1
+        assert prefills[-1].attrs["rows"] >= prefills[-1].attrs["tokens"]
+        rep = get_perf().report()
+        wall = rep["wall"]
+        assert wall is not None
+        assert wall["device_busy_frac"] + wall["host_gap_frac"] \
+            + wall["idle_frac"] == pytest.approx(1.0, abs=0.01)
+        assert 0.0 <= rep["tokens"]["padding_waste_frac"] < 1.0
+        # Executable cache misses land in the compile ledger under
+        # their signature (the fixture's warmup compiles were cleared
+        # by the per-test reset; probe the seam directly).
+        engine._note_compile("decode", kv_len=512, steps=8)
+        rep = get_perf().report()
+        assert any(e["kind"] == "decode" and e["count"] >= 1
+                   for e in rep["compiles"]["by_key"])
+
 
 class TestChatTemplates:
     MSGS = [

@@ -348,6 +348,134 @@ class TestServerTracing:
         assert m.counter("ws_messages_sent_total").value >= 1
 
 
+class TestProfilerEndpoints:
+    """The three XLA-profiler endpoints (ISSUE 6 satellite: previously
+    zero coverage): start/stop lifecycle with the 409 double-start
+    path, the trace-dir sandbox, failure recovery, and /profiler/
+    memory. jax.profiler is stubbed — these test the HTTP surface, not
+    XLA."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_profiler_state(self):
+        from fasttalk_tpu.monitoring import monitor
+
+        monitor._profiler_state.update(active=False, log_dir=None,
+                                       started_at=None)
+        yield
+        monitor._profiler_state.update(active=False, log_dir=None,
+                                       started_at=None)
+
+    @pytest.fixture
+    def prof(self, monkeypatch, tmp_path):
+        import jax
+
+        calls = {"start": [], "stop": 0, "raise_on_start": None}
+
+        def fake_start(log_dir):
+            if calls["raise_on_start"] is not None:
+                raise calls["raise_on_start"]
+            calls["start"].append(log_dir)
+
+        def fake_stop():
+            calls["stop"] += 1
+
+        monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+        monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+        monkeypatch.setenv("PROFILER_TRACE_DIR", str(tmp_path))
+        return calls
+
+    async def _client(self):
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+        client = TestClient(TestServer(build_monitoring_app()))
+        await client.start_server()
+        return client
+
+    async def test_start_stop_roundtrip_and_double_start(
+            self, prof, tmp_path):
+        client = await self._client()
+        try:
+            r = await client.post("/profiler/start",
+                                  json={"log_dir": "run1"})
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "tracing"
+            # The requested subdirectory resolved under the sandbox
+            # base — and that resolved dir is what reached jax.
+            assert body["log_dir"] == os.path.realpath(
+                os.path.join(str(tmp_path), "run1"))
+            assert prof["start"] == [body["log_dir"]]
+
+            # Double start: 409 naming the active trace dir, and the
+            # loser must NOT clobber the winner's claim.
+            r = await client.post("/profiler/start", json={})
+            assert r.status == 409
+            assert (await r.json())["log_dir"] == body["log_dir"]
+            assert len(prof["start"]) == 1
+
+            r = await client.post("/profiler/stop")
+            assert r.status == 200
+            stop = await r.json()
+            assert stop["status"] == "stopped"
+            assert stop["log_dir"] == body["log_dir"]
+            assert stop["duration_seconds"] >= 0
+            assert prof["stop"] == 1
+
+            # No active trace: stop is a clean 409, not a double call.
+            assert (await client.post("/profiler/stop")).status == 409
+            assert prof["stop"] == 1
+
+            # The claim is released: a fresh start works (defaults to
+            # the base dir when the body names no subdirectory).
+            r = await client.post("/profiler/start")
+            assert r.status == 200
+            assert (await r.json())["log_dir"] == os.path.realpath(
+                str(tmp_path))
+        finally:
+            await client.close()
+
+    async def test_trace_dir_sandbox(self, prof, tmp_path):
+        """The monitoring port is unauthenticated: absolute paths and
+        base-escaping subdirectories must be rejected before any
+        profiler call."""
+        client = await self._client()
+        try:
+            for bad in ("/etc/evil", "../escape",
+                        "a/../../outside"):
+                r = await client.post("/profiler/start",
+                                      json={"log_dir": bad})
+                assert r.status == 400, bad
+            assert prof["start"] == []
+        finally:
+            await client.close()
+
+    async def test_start_failure_releases_claim(self, prof):
+        prof["raise_on_start"] = RuntimeError("no backend")
+        client = await self._client()
+        try:
+            r = await client.post("/profiler/start")
+            assert r.status == 500
+            assert "no backend" in (await r.json())["error"]
+            # The failed claim was rolled back: retry succeeds.
+            prof["raise_on_start"] = None
+            assert (await client.post("/profiler/start")).status == 200
+        finally:
+            await client.close()
+
+    async def test_profiler_memory(self):
+        client = await self._client()
+        try:
+            r = await client.get("/profiler/memory")
+            assert r.status == 200
+            devices = (await r.json())["devices"]
+            assert devices, "no devices reported"
+            for d in devices:
+                assert "device" in d and "platform" in d
+                assert "bytes_in_use" in d
+        finally:
+            await client.close()
+
+
 class TestTraceReportScript:
     def test_main_on_sample(self, capsys):
         assert trace_report.main([SAMPLE]) == 0
