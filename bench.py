@@ -11,6 +11,13 @@ engine's async seam).
 ``BENCH_MODE=engine`` falls back to the engine-seam measurement
 (no sockets) for isolating engine regressions.
 
+``BENCH_MODE=fleet`` runs the router scale-out scenario
+(docs/ROUTER.md): N in-process CPU replicas behind a FleetRouter behind
+the real WS server vs a single replica with the same per-replica slot
+count — aggregate tok/s measures what scaling out buys — then kills the
+most-loaded replica mid-stream and reports failover-resume latency
+(every affected stream must see a ``resumed`` frame, never an error).
+
 ``BENCH_MODE=overload`` runs the admission-control scenario
 (docs/SCHEDULING.md): an OPEN-LOOP arrival process (one request every
 ``BENCH_ARRIVAL_MS`` ms for ``BENCH_OVERLOAD_S`` s, regardless of
@@ -372,6 +379,201 @@ def bench_multiturn() -> dict:
             "followup_ttft_p50_speedup": speedup}
 
 
+# ---------------- fleet mode (router scale-out) ----------------
+
+async def _fleet_failover(http, router, handles, max_tokens) -> dict:
+    """Failover-resume latency scenario: long sessions stream across
+    the fleet, the most-loaded replica's engine is shut down mid-stream,
+    and every affected session must resume on a survivor (a `resumed`
+    frame, then tokens — never an error frame). Reports the kill→resumed
+    and kill→next-token latencies of the affected sessions."""
+    n = len(handles) * 2
+    shared = [dict(tokens=0, resumed_ms=None, next_token_ms=None,
+                   error=None, done=False) for _ in range(n)]
+    state = {"kill_t": None}
+
+    async def victim(i):
+        got = shared[i]
+        async with http.ws_connect(
+                f"ws://127.0.0.1:{PORT}/ws/llm") as ws:
+            json.loads((await ws.receive()).data)  # session_started
+            await ws.send_json({
+                "type": "start_session",
+                "config": {"max_tokens": max_tokens * 4,
+                           "ignore_eos": IGNORE_EOS}})
+            await ws.receive()  # session_configured
+            await ws.send_json({"type": "user_message",
+                                "text": f"[failover {i}] {PROMPT}"})
+            resumed = False
+            while True:
+                msg = json.loads((await ws.receive()).data)
+                if msg["type"] == "token":
+                    got["tokens"] += 1
+                    if resumed and got["next_token_ms"] is None \
+                            and state["kill_t"] is not None:
+                        got["next_token_ms"] = (
+                            time.monotonic() - state["kill_t"]) * 1000
+                elif msg["type"] == "resumed":
+                    resumed = True
+                    if state["kill_t"] is not None:
+                        got["resumed_ms"] = (
+                            time.monotonic() - state["kill_t"]) * 1000
+                elif msg["type"] == "response_complete":
+                    got["done"] = True
+                    return
+                elif msg["type"] == "error":
+                    got["error"] = msg.get("error")
+                    return
+
+    tasks = [asyncio.create_task(victim(i)) for i in range(n)]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:  # all sessions streaming?
+        if all(v["tokens"] >= 2 for v in shared):
+            break
+        await asyncio.sleep(0.02)
+    # Kill the replica carrying the most live streams.
+    owners = [h for _, h in router._routes.values()]
+    target = max(handles, key=owners.count)
+    affected = owners.count(target)
+    log(f"  killing {target.replica_id} with {affected} live streams...")
+    state["kill_t"] = time.monotonic()
+    await asyncio.get_running_loop().run_in_executor(
+        None, target.engine.shutdown)
+    await asyncio.gather(*tasks)
+    errors = [v["error"] for v in shared if v["error"]]
+    resumed = sorted(v["resumed_ms"] for v in shared
+                     if v["resumed_ms"] is not None)
+    next_tok = sorted(v["next_token_ms"] for v in shared
+                      if v["next_token_ms"] is not None)
+    out = {
+        "sessions": n,
+        "affected": affected,
+        "resumed": len(resumed),
+        "errors": len(errors),
+        "resume_latency_ms": {
+            "p50": round(statistics.median(resumed), 1) if resumed
+            else None,
+            "max": round(resumed[-1], 1) if resumed else None,
+        },
+        "next_token_after_kill_ms": {
+            "p50": round(statistics.median(next_tok), 1) if next_tok
+            else None,
+        },
+    }
+    log(f"  failover: {len(resumed)}/{affected} resumed, "
+        f"{len(errors)} errors, resume p50 "
+        f"{out['resume_latency_ms']['p50']} ms")
+    return out
+
+
+async def _fleet_phase(cfg, replicas: int, sessions: int,
+                       max_tokens: int) -> dict:
+    """One fleet scenario in THIS process: N in-proc replicas behind a
+    FleetRouter behind the real WebSocket server; measure aggregate
+    WS tok/s, then (fleets only) the failover-resume scenario."""
+    import aiohttp
+    from aiohttp import web
+
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.router import FleetRouter, ReplicaHandle
+    from fasttalk_tpu.serving.server import WebSocketLLMServer
+
+    handles = []
+    for i in range(replicas):
+        t0 = time.monotonic()
+        eng = build_engine(cfg)
+        eng.warmup(cfg.warmup)
+        handles.append(ReplicaHandle(f"inproc-{i}", eng))
+        log(f"  replica {i} built+warmed in "
+            f"{time.monotonic() - t0:.1f}s")
+    router = FleetRouter(handles, probe_interval_s=1.0)
+    router.start()
+    server = WebSocketLLMServer(cfg, router, None)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", PORT).start()
+    out: dict = {"replicas": replicas, "sessions": sessions}
+    async with aiohttp.ClientSession() as http:
+        log("  protocol warmup...")
+        await asyncio.gather(*(ws_session(http, 900 + i, 8)
+                               for i in range(sessions)))
+        reset_slo_after_warmup()
+        t0 = time.monotonic()
+        results = await asyncio.gather(
+            *(ws_session(http, i, max_tokens)
+              for i in range(sessions)))
+        wall = time.monotonic() - t0
+        total = sum(r["tokens"] for r in results)
+        out["agg_tps"] = round(total / wall, 2)
+        out["p50_ttft_ms"] = round(statistics.median(
+            r["ttft_ms"] for r in results), 1)
+        log(f"  {replicas} replica(s): {total} tok in {wall:.2f}s = "
+            f"{out['agg_tps']} tok/s aggregate")
+        if replicas > 1:
+            out["failover"] = await _fleet_failover(http, router,
+                                                    handles, max_tokens)
+    await runner.cleanup()
+    # Deliberately NO engine shutdown: multiple warmed XLA-CPU engines
+    # in one process trip a pre-existing teardown crash (see the
+    # multiturn notes); the child prints its JSON and hard-exits.
+    return out
+
+
+def _fleet_run_phase_subprocess(replicas: int) -> dict:
+    """Each fleet size runs in its own child process (fresh XLA state,
+    no teardown-order hazards between phases)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["BENCH_FLEET_PHASE"] = str(replicas)
+    # Two in-proc engines racing the shared persistent XLA compile
+    # cache segfault the XLA-CPU client (observed deterministic);
+    # disable it for BOTH phases so the comparison stays fair.
+    env["TPU_COMPILE_CACHE"] = "off"
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"fleet phase ({replicas} replicas) exited "
+                           f"{proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_fleet(replicas: int, sessions: int, slots: int) -> dict:
+    """The scale-out scenario (docs/ROUTER.md): ``sessions`` concurrent
+    WS sessions against 1 replica vs ``replicas`` replicas, each
+    replica holding ``slots`` decode slots — the single-replica phase
+    is slot-starved (sessions > slots), the fleet serves them all
+    concurrently, so aggregate tok/s measures what scaling out buys.
+    The fleet phase then kills its most-loaded replica mid-stream and
+    reports failover-resume latency."""
+    import os as _os
+
+    cores = _os.cpu_count() or 1
+    log(f"fleet: {sessions} sessions, {slots} slots/replica, "
+        f"1 vs {replicas} replicas on {cores} core(s)...")
+    if cores < 2:
+        # In-proc CPU replicas share the host's cores: on ONE core a
+        # compute-bound decode cannot aggregate faster than a single
+        # replica (scale-out buys tok/s only with a core/chip per
+        # replica) — the fleet's single-host win is then queue-wait/
+        # TTFT, which the report carries alongside.
+        log("  WARNING: 1 CPU core — fleet aggregate tok/s cannot "
+            "exceed single-replica here; watch p50_ttft_speedup")
+    log("--- phase 1/2: single replica ---")
+    single = _fleet_run_phase_subprocess(1)
+    log("--- phase 2/2: fleet ---")
+    fleet = _fleet_run_phase_subprocess(replicas)
+    speedup = (round(fleet["agg_tps"] / single["agg_tps"], 2)
+               if single.get("agg_tps") else None)
+    ttft_speedup = (round(single["p50_ttft_ms"] / fleet["p50_ttft_ms"],
+                          2)
+                    if fleet.get("p50_ttft_ms") else None)
+    return {"sessions": sessions, "slots_per_replica": slots,
+            "cores": cores, "single": single, "fleet": fleet,
+            "agg_tps_speedup": speedup,
+            "p50_ttft_speedup": ttft_speedup}
+
+
 # ---------------- overload mode (admission control) ----------------
 
 async def bench_overload(cfg) -> dict:
@@ -603,6 +805,53 @@ def main() -> None:
             # re-prefill path: >1 means the restore tier is winning.
             "vs_baseline": r["followup_ttft_p50_speedup"],
             "multiturn": r,
+        }), flush=True)
+        return
+    if MODE == "fleet":
+        replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
+        sessions = int(os.environ.get("BENCH_FLEET_SESSIONS", "8"))
+        slots = int(os.environ.get("BENCH_FLEET_SLOTS",
+                                   str(max(1, sessions // replicas))))
+        max_tokens = int(os.environ.get("BENCH_FLEET_MAX_TOKENS", "32"))
+        if os.environ.get("BENCH_FLEET_PHASE"):
+            # Child process: one fleet size, then hard-exit (no XLA
+            # multi-engine teardown).
+            n = int(os.environ["BENCH_FLEET_PHASE"])
+            cfg = Config(llm_provider="tpu", model_name=MODEL,
+                         decode_slots=slots, max_model_len=2048,
+                         default_context_window=2048,
+                         prefill_chunk=512, dtype="bfloat16",
+                         port=PORT, monitoring_port=PORT + 1,
+                         enable_agent=False,
+                         quantize=os.environ.get("BENCH_QUANTIZE",
+                                                 "int8"))
+            phase = asyncio.run(_fleet_phase(cfg, n, sessions,
+                                             max_tokens))
+            print(json.dumps(phase), flush=True)
+            sys.stdout.flush()
+            os._exit(0)
+        r = bench_fleet(replicas, sessions, slots)
+        fo = (r["fleet"].get("failover") or {})
+        print(json.dumps({
+            "metric": (f"fleet aggregate WS tok/s, {MODEL}: "
+                       f"{r['sessions']} sessions on "
+                       f"{r['fleet']['replicas']} replicas x "
+                       f"{r['slots_per_replica']} slots, "
+                       f"{r['cores']} core(s) (single-replica"
+                       f" {r['single']['agg_tps']} tok/s, speedup "
+                       f"{r['agg_tps_speedup']}x, p50 TTFT speedup "
+                       f"{r['p50_ttft_speedup']}x; failover resumed "
+                       f"{fo.get('resumed')}/{fo.get('affected')} "
+                       f"streams, {fo.get('errors')} errors, resume "
+                       f"p50 "
+                       f"{(fo.get('resume_latency_ms') or {}).get('p50')}"
+                       f" ms)"),
+            "value": r["fleet"]["agg_tps"],
+            "unit": "tok/s",
+            # For this mode the baseline is the single-replica run:
+            # >1 means scaling out is buying capacity.
+            "vs_baseline": r["agg_tps_speedup"],
+            "fleet": r,
         }), flush=True)
         return
     if MODE == "overload":

@@ -8,8 +8,17 @@ exercise (session_started → start_session → session_configured →
 user_message → token stream → response_complete → end_session), exit
 code 0/1.
 
+Back-off discipline (docs/SCHEDULING.md, docs/ROUTER.md): capacity
+rejections — an error frame carrying ``retry_after`` (429-class
+shedding, connection limit) or a WebSocket close with code 1013 — are
+honoured with reconnect-and-backoff instead of exiting, so the client
+survives a routed failover or an overload burst the way a production
+caller should. Mid-stream ``resumed`` frames (fleet failover moved the
+stream to a surviving replica) are informational: the stream continues.
+
 Usage: python client.py [--url ws://localhost:8000/ws/llm]
                         [--prompt "..."] [--max-tokens N] [--quiet]
+                        [--retries N]
 """
 
 from __future__ import annotations
@@ -17,9 +26,23 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import random
 import sys
 
 import aiohttp
+
+# WS close code 1013 "try again later" — the server's connection-limit
+# rejection (serving/server.py) closes with this after the error frame.
+TRY_AGAIN_LATER = 1013
+
+
+class Backoff(Exception):
+    """A capacity rejection carrying the server's retry_after hint."""
+
+    def __init__(self, retry_after: float, why: str):
+        super().__init__(why)
+        self.retry_after = retry_after
+        self.why = why
 
 
 async def check_health(base_url: str, quiet: bool) -> bool:
@@ -37,11 +60,33 @@ async def check_health(base_url: str, quiet: bool) -> bool:
         return False
 
 
+def _maybe_backoff(msg: dict) -> None:
+    """Raise Backoff when an error frame is a capacity rejection (it
+    carries retry_after: shed, connection limit, breaker open)."""
+    err = msg.get("error") or {}
+    retry_after = err.get("retry_after")
+    if retry_after is not None:
+        raise Backoff(float(retry_after),
+                      f"{err.get('code', 'rejected')}: "
+                      f"{err.get('message', '')}")
+
+
 async def run_session(ws_url: str, prompt: str, max_tokens: int,
                       quiet: bool) -> bool:
     async with aiohttp.ClientSession() as session:
         async with session.ws_connect(ws_url) as ws:
-            msg = json.loads((await ws.receive()).data)
+            first = await ws.receive()
+            if first.type != aiohttp.WSMsgType.TEXT:
+                # Closed before the greeting: treat 1013 as backoff.
+                if ws.close_code == TRY_AGAIN_LATER:
+                    raise Backoff(5.0, "server closed 1013 (try later)")
+                print(f"unexpected frame: {first.type}", file=sys.stderr)
+                return False
+            msg = json.loads(first.data)
+            if msg["type"] == "error":
+                _maybe_backoff(msg)  # connection-limit rejection
+                print(f"error: {msg.get('error')}", file=sys.stderr)
+                return False
             assert msg["type"] == "session_started", msg
             if not quiet:
                 print(f"session: {msg['session_id']} "
@@ -63,6 +108,9 @@ async def run_session(ws_url: str, prompt: str, max_tokens: int,
             while True:
                 raw = await ws.receive()
                 if raw.type != aiohttp.WSMsgType.TEXT:
+                    if ws.close_code == TRY_AGAIN_LATER:
+                        raise Backoff(5.0,
+                                      "server closed 1013 (try later)")
                     print(f"unexpected frame: {raw.type}", file=sys.stderr)
                     return False
                 msg = json.loads(raw.data)
@@ -70,10 +118,17 @@ async def run_session(ws_url: str, prompt: str, max_tokens: int,
                     tokens += 1
                     if not quiet:
                         print(msg.get("data", ""), end="", flush=True)
+                elif msg["type"] == "resumed":
+                    # Fleet failover: the stream moved to a surviving
+                    # replica; keep reading — this is not an error.
+                    if not quiet:
+                        print(f"\n[resumed on {msg.get('replica')}] ",
+                              end="", flush=True)
                 elif msg["type"] == "response_complete":
                     stats = msg.get("stats", {})
                     break
                 elif msg["type"] == "error":
+                    _maybe_backoff(msg)
                     print(f"\nerror: {msg.get('error')}", file=sys.stderr)
                     return False
             if not quiet:
@@ -87,13 +142,41 @@ async def run_session(ws_url: str, prompt: str, max_tokens: int,
             return True
 
 
+async def run_with_backoff(ws_url: str, prompt: str, max_tokens: int,
+                           quiet: bool, retries: int) -> bool:
+    """run_session, honouring server retry_after hints: sleep and
+    reconnect up to ``retries`` times before giving up."""
+    for attempt in range(retries + 1):
+        try:
+            return await run_session(ws_url, prompt, max_tokens, quiet)
+        except Backoff as b:
+            if attempt >= retries:
+                print(f"giving up after {retries} retries: {b.why}",
+                      file=sys.stderr)
+                return False
+            # Honour the hint, bounded, with jitter so a shed burst of
+            # clients doesn't reconnect in lockstep.
+            delay = min(30.0, max(0.1, b.retry_after))
+            delay *= 1.0 + random.uniform(0.0, 0.25)
+            print(f"backing off {delay:.1f}s ({b.why})", file=sys.stderr)
+            await asyncio.sleep(delay)
+        except aiohttp.ClientError as e:
+            if attempt >= retries:
+                print(f"connection failed: {e}", file=sys.stderr)
+                return False
+            delay = min(5.0, 0.5 * (2 ** attempt))
+            print(f"reconnecting in {delay:.1f}s ({e})", file=sys.stderr)
+            await asyncio.sleep(delay)
+    return False
+
+
 async def amain(args: argparse.Namespace) -> int:
     base = args.url.replace("ws://", "http://").replace(
         "wss://", "https://").rsplit("/ws/", 1)[0]
     if not await check_health(base, args.quiet):
         return 1
-    ok = await run_session(args.url, args.prompt, args.max_tokens,
-                           args.quiet)
+    ok = await run_with_backoff(args.url, args.prompt, args.max_tokens,
+                                args.quiet, args.retries)
     if ok and not args.quiet:
         print("E2E OK")
     return 0 if ok else 1
@@ -105,6 +188,9 @@ def main() -> int:
     p.add_argument("--prompt", default="Write a haiku about oceans.")
     p.add_argument("--max-tokens", type=int, default=64)
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--retries", type=int, default=3,
+                   help="reconnect-and-backoff attempts on capacity "
+                        "rejections (retry_after / close 1013)")
     return asyncio.run(amain(p.parse_args()))
 
 

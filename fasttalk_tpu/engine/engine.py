@@ -2027,7 +2027,11 @@ class TPUEngine(EngineBase):
         must never touch the TPU (ISSUE 2: predictable degradation; a
         request that already blew its latency budget serves nobody)."""
         now = time.monotonic() if now is None else now
-        for entry in self._sched.take_expired(now):
+        # No explicit now to the sweep: expiry must be judged on the
+        # SCHEDULER's clock (injectable for deterministic race tests),
+        # which set the deadlines in the first place. The engine-side
+        # `now` below only formats the waited-time message/span.
+        for entry in self._sched.take_expired():
             req = entry.payload
             if req is None or req.finished:
                 continue

@@ -124,7 +124,9 @@ def build_engine(cfg: Config) -> EngineBase:
                                 timeout_s=cfg.vllm_timeout,
                                 max_inflight=cfg.remote_max_inflight,
                                 admission_timeout_s=(
-                                    cfg.sched_default_deadline_s))
+                                    cfg.sched_default_deadline_s),
+                                connect_retries=(
+                                    cfg.remote_connect_retries))
     if cfg.llm_provider == "ollama":
         from fasttalk_tpu.engine.remote import OllamaRemoteEngine
 
@@ -133,7 +135,9 @@ def build_engine(cfg: Config) -> EngineBase:
                                   timeout_s=cfg.ollama_timeout,
                                   max_inflight=cfg.remote_max_inflight,
                                   admission_timeout_s=(
-                                      cfg.sched_default_deadline_s))
+                                      cfg.sched_default_deadline_s),
+                                  connect_retries=(
+                                      cfg.remote_connect_retries))
     # Persistent compilation cache before the first compile: warmup's
     # executables reload from disk on repeat starts of the same config.
     from fasttalk_tpu.utils.compile_cache import enable_compilation_cache

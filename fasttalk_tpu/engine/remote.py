@@ -40,11 +40,17 @@ class _RemoteEngine(EngineBase):
 
     def __init__(self, base_url: str, timeout_s: float = 600.0,
                  max_inflight: int = 32,
-                 admission_timeout_s: float = 30.0):
+                 admission_timeout_s: float = 30.0,
+                 connect_retries: int = 2):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.max_inflight = max(1, max_inflight)
         self.admission_timeout_s = admission_timeout_s
+        # Bounded retries for idempotent upstream failures: a connect
+        # error or 5xx BEFORE the first streamed chunk left nothing
+        # client-visible, so retrying is safe; after the first chunk a
+        # failure surfaces (the fleet router owns mid-stream recovery).
+        self.connect_retries = max(0, connect_retries)
         self._sem = asyncio.Semaphore(self.max_inflight)
         self._inflight = 0
         self._draining = False
@@ -59,6 +65,54 @@ class _RemoteEngine(EngineBase):
         self._m_inflight = m.gauge(
             "remote_inflight_requests",
             "requests currently streaming from the remote backend")
+        self._m_retries = m.counter(
+            "remote_connect_retries_total",
+            "pre-first-token upstream failures retried (connect "
+            "errors and 5xx before any output)")
+
+    def _connect_retry_delay(self, attempt: int, chunks: int,
+                             ) -> float | None:
+        """Backoff before retrying a pre-first-token upstream failure,
+        or None when the failure must surface: something was already
+        streamed (the retry is no longer idempotent) or the bounded
+        attempts are exhausted. Jittered exponential, capped at 2 s —
+        the same shape as the serving layer's RetryManager, scaled for
+        a client inside a live request."""
+        import random
+
+        if chunks > 0 or attempt >= self.connect_retries:
+            return None
+        base = min(2.0, 0.25 * (2 ** attempt))
+        return base * (1.0 + random.uniform(-0.25, 0.25))
+
+    def _upstream_retry_delay(self, e: BaseException, attempt: int,
+                              chunks: int, request_id: str,
+                              name: str) -> float:
+        """Classify one streaming failure: return the backoff delay
+        when it is a retryable pre-first-token failure (connect error
+        or upstream 5xx with nothing streamed), or raise what must
+        surface — a 4xx is the request's fault and will 4xx again, and
+        anything after the first chunk is no longer idempotent. Shared
+        by every provider client so the idempotency rule cannot drift
+        between them."""
+        is_5xx = (isinstance(e, LLMServiceError)
+                  and e.details.get("status", 0) >= 500)
+        if isinstance(e, LLMServiceError) and not is_5xx:
+            raise e
+        delay = self._connect_retry_delay(attempt, chunks)
+        if delay is None:
+            if isinstance(e, LLMServiceError):
+                e.retry_after = e.retry_after or 2.0
+                raise e
+            raise LLMServiceError(
+                f"{name} connection failed: {e}",
+                category=ErrorCategory.CONNECTION,
+                retry_after=2.0) from e
+        self._m_retries.inc()
+        log.warning(f"[{request_id}] upstream failed pre-first-token "
+                    f"({e}); retry {attempt + 1}/"
+                    f"{self.connect_retries} in {delay:.2f}s")
+        return delay
 
     async def _acquire_upstream(self) -> None:
         """Take an upstream slot or shed. Raises AdmissionRejected when
@@ -196,9 +250,11 @@ class VLLMRemoteEngine(_RemoteEngine):
     def __init__(self, base_url: str, model: str,
                  api_key: str = "not-needed", timeout_s: float = 600.0,
                  max_inflight: int = 32,
-                 admission_timeout_s: float = 30.0):
+                 admission_timeout_s: float = 30.0,
+                 connect_retries: int = 2):
         super().__init__(base_url, timeout_s, max_inflight=max_inflight,
-                         admission_timeout_s=admission_timeout_s)
+                         admission_timeout_s=admission_timeout_s,
+                         connect_retries=connect_retries)
         self.model = model
         self.api_key = api_key
         # Set after a backend 400s on stream_options (pre-0.4.3 vLLM,
@@ -250,94 +306,115 @@ class VLLMRemoteEngine(_RemoteEngine):
         finish = "stop"
         await self._acquire_upstream()
         trace_owned = self._trace_start(request_id, session_id, "vllm")
+        retry_attempt = 0
         try:
-            for _attempt in range(3):
-                async with client.post(
-                        url, json=body,
-                        headers={"Authorization": f"Bearer {self.api_key}"},
-                        ) as resp:
-                    if resp.status != 200:
-                        text = await resp.text()
-                        if resp.status == 400 \
-                                and "stream_options" in body \
-                                and "stream_options" in text:
-                            # The backend names stream_options in its
-                            # 400 (pre-0.4.3 vLLM, strict proxies):
-                            # drop the parameter for this engine's
-                            # lifetime and retry once (stats degrade to
-                            # honest chunk counts). Any OTHER 400 —
-                            # context overflow, bad params — surfaces
-                            # unretried below.
-                            self._no_stream_options = True
-                            del body["stream_options"]
-                            continue
-                        if resp.status == 400 \
-                                and "repetition_penalty" in body \
-                                and "repetition_penalty" in text:
-                            # Strict OpenAI-compatible backend without
-                            # the vLLM sampling extension: serve without
-                            # the penalty rather than failing every
-                            # generation.
-                            self._no_repetition_penalty = True
-                            del body["repetition_penalty"]
-                            continue
-                        raise LLMServiceError(
-                            f"vLLM backend error {resp.status}: "
-                            f"{text[:200]}",
-                            category=ErrorCategory.CONNECTION)
-                    async for raw in resp.content:
-                        if request_id in self._cancelled:
-                            self._cancelled.discard(request_id)
-                            yield {"type": "cancelled",
-                                   "finish_reason": "cancelled",
-                                   "stats": self._finish_stats(
-                                       chunks, started, ttft, prompt_toks,
-                                       completion_toks)}
-                            return
-                        line = raw.decode("utf-8", "replace").strip()
-                        if not line.startswith("data:"):
-                            continue
-                        payload = line[5:].strip()
-                        if payload == "[DONE]":
-                            break
-                        try:
-                            obj = json.loads(payload)
-                        except json.JSONDecodeError:
-                            continue
-                        usage = obj.get("usage")
-                        if usage:
-                            # include_usage final chunk (empty choices):
-                            # backend-authoritative token counts.
-                            prompt_toks = usage.get("prompt_tokens",
-                                                    prompt_toks)
-                            completion_toks = usage.get(
-                                "completion_tokens", completion_toks)
-                        choices = obj.get("choices") or []
-                        if not choices:
-                            continue
-                        fr = choices[0].get("finish_reason")
-                        if fr:
-                            finish = fr
-                        # chat streams deltas; completions streams text
-                        content = (choices[0].get("text")
-                                   if params.raw_prompt
-                                   else choices[0].get("delta", {})
-                                   .get("content"))
-                        if content:
-                            chunks += 1
-                            if ttft is None:
-                                ttft = (time.monotonic() - started) * 1000
-                                get_tracer().event(request_id,
-                                                   "first_chunk")
-                            yield {"type": "token", "text": content}
-                break  # stream consumed; no retry
+            while True:  # pre-first-token connect/5xx retry loop
+                try:
+                    for _attempt in range(3):
+                        async with client.post(
+                                url, json=body,
+                                headers={"Authorization":
+                                         f"Bearer {self.api_key}"},
+                                ) as resp:
+                            if resp.status != 200:
+                                text = await resp.text()
+                                if resp.status == 400 \
+                                        and "stream_options" in body \
+                                        and "stream_options" in text:
+                                    # The backend names stream_options
+                                    # in its 400 (pre-0.4.3 vLLM,
+                                    # strict proxies): drop the
+                                    # parameter for this engine's
+                                    # lifetime and retry once (stats
+                                    # degrade to honest chunk counts).
+                                    # Any OTHER 400 — context overflow,
+                                    # bad params — surfaces unretried
+                                    # below.
+                                    self._no_stream_options = True
+                                    del body["stream_options"]
+                                    continue
+                                if resp.status == 400 \
+                                        and "repetition_penalty" in body \
+                                        and "repetition_penalty" in text:
+                                    # Strict OpenAI-compatible backend
+                                    # without the vLLM sampling
+                                    # extension: serve without the
+                                    # penalty rather than failing every
+                                    # generation.
+                                    self._no_repetition_penalty = True
+                                    del body["repetition_penalty"]
+                                    continue
+                                err = LLMServiceError(
+                                    f"vLLM backend error {resp.status}: "
+                                    f"{text[:200]}",
+                                    category=ErrorCategory.CONNECTION,
+                                    details={"status": resp.status})
+                                raise err
+                            async for raw in resp.content:
+                                if request_id in self._cancelled:
+                                    self._cancelled.discard(request_id)
+                                    yield {"type": "cancelled",
+                                           "finish_reason": "cancelled",
+                                           "stats": self._finish_stats(
+                                               chunks, started, ttft,
+                                               prompt_toks,
+                                               completion_toks)}
+                                    return
+                                line = raw.decode("utf-8",
+                                                  "replace").strip()
+                                if not line.startswith("data:"):
+                                    continue
+                                payload = line[5:].strip()
+                                if payload == "[DONE]":
+                                    break
+                                try:
+                                    obj = json.loads(payload)
+                                except json.JSONDecodeError:
+                                    continue
+                                usage = obj.get("usage")
+                                if usage:
+                                    # include_usage final chunk (empty
+                                    # choices): backend-authoritative
+                                    # token counts.
+                                    prompt_toks = usage.get(
+                                        "prompt_tokens", prompt_toks)
+                                    completion_toks = usage.get(
+                                        "completion_tokens",
+                                        completion_toks)
+                                choices = obj.get("choices") or []
+                                if not choices:
+                                    continue
+                                fr = choices[0].get("finish_reason")
+                                if fr:
+                                    finish = fr
+                                # chat streams deltas; completions
+                                # streams text
+                                content = (choices[0].get("text")
+                                           if params.raw_prompt
+                                           else choices[0].get("delta",
+                                                               {})
+                                           .get("content"))
+                                if content:
+                                    chunks += 1
+                                    if ttft is None:
+                                        ttft = (time.monotonic()
+                                                - started) * 1000
+                                        get_tracer().event(request_id,
+                                                           "first_chunk")
+                                    yield {"type": "token",
+                                           "text": content}
+                        break  # stream consumed; no param retry
+                    break  # success: leave the connect-retry loop
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        LLMServiceError) as e:
+                    delay = self._upstream_retry_delay(
+                        e, retry_attempt, chunks, request_id, "vLLM")
+                    retry_attempt += 1
+                    await asyncio.sleep(delay)
             yield {"type": "done", "finish_reason": finish,
                    "stats": self._finish_stats(chunks, started, ttft,
                                                prompt_toks,
                                                completion_toks)}
-        except aiohttp.ClientError as e:
-            raise LLMServiceError(f"vLLM connection failed: {e}",
-                                  category=ErrorCategory.CONNECTION) from e
         finally:
             self._release_upstream()
             self._trace_end(request_id, trace_owned, started, ttft,
@@ -376,9 +453,11 @@ class OllamaRemoteEngine(_RemoteEngine):
     def __init__(self, base_url: str, model: str,
                  keep_alive: str = "5m", timeout_s: float = 600.0,
                  max_inflight: int = 32,
-                 admission_timeout_s: float = 30.0):
+                 admission_timeout_s: float = 30.0,
+                 connect_retries: int = 2):
         super().__init__(base_url, timeout_s, max_inflight=max_inflight,
-                         admission_timeout_s=admission_timeout_s)
+                         admission_timeout_s=admission_timeout_s,
+                         connect_retries=connect_retries)
         self.model = model
         self.keep_alive = keep_alive
 
@@ -420,55 +499,69 @@ class OllamaRemoteEngine(_RemoteEngine):
         completion_toks: int | None = None
         await self._acquire_upstream()
         trace_owned = self._trace_start(request_id, session_id, "ollama")
+        retry_attempt = 0
         try:
-            async with client.post(url, json=body) as resp:
-                if resp.status != 200:
-                    text = await resp.text()
-                    raise LLMServiceError(
-                        f"Ollama backend error {resp.status}: {text[:200]}",
-                        category=ErrorCategory.CONNECTION)
-                async for raw in resp.content:
-                    if request_id in self._cancelled:
-                        self._cancelled.discard(request_id)
-                        yield {"type": "cancelled",
-                               "finish_reason": "cancelled",
-                               "stats": self._finish_stats(
-                                   chunks, started, ttft, prompt_toks,
-                                   completion_toks)}
-                        return
-                    line = raw.decode("utf-8", "replace").strip()
-                    if not line:
-                        continue
-                    try:
-                        obj = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    # /api/chat nests under message; /api/generate is flat
-                    content = (obj.get("response") if params.raw_prompt
-                               else (obj.get("message") or {})
-                               .get("content"))
-                    if content:
-                        chunks += 1
-                        if ttft is None:
-                            ttft = (time.monotonic() - started) * 1000
-                            get_tracer().event(request_id, "first_chunk")
-                        yield {"type": "token", "text": content}
-                    if obj.get("done"):
-                        # Final NDJSON object carries Ollama's own token
-                        # accounting (the reference threw these away and
-                        # counted chunks, ollama_handler.py:233-339).
-                        prompt_toks = obj.get("prompt_eval_count",
-                                              prompt_toks)
-                        completion_toks = obj.get("eval_count",
-                                                  completion_toks)
-                        break
+            while True:  # pre-first-token connect/5xx retry loop
+                try:
+                    async with client.post(url, json=body) as resp:
+                        if resp.status != 200:
+                            text = await resp.text()
+                            raise LLMServiceError(
+                                f"Ollama backend error {resp.status}: "
+                                f"{text[:200]}",
+                                category=ErrorCategory.CONNECTION,
+                                details={"status": resp.status})
+                        async for raw in resp.content:
+                            if request_id in self._cancelled:
+                                self._cancelled.discard(request_id)
+                                yield {"type": "cancelled",
+                                       "finish_reason": "cancelled",
+                                       "stats": self._finish_stats(
+                                           chunks, started, ttft,
+                                           prompt_toks, completion_toks)}
+                                return
+                            line = raw.decode("utf-8", "replace").strip()
+                            if not line:
+                                continue
+                            try:
+                                obj = json.loads(line)
+                            except json.JSONDecodeError:
+                                continue
+                            # /api/chat nests under message;
+                            # /api/generate is flat
+                            content = (obj.get("response")
+                                       if params.raw_prompt
+                                       else (obj.get("message") or {})
+                                       .get("content"))
+                            if content:
+                                chunks += 1
+                                if ttft is None:
+                                    ttft = (time.monotonic()
+                                            - started) * 1000
+                                    get_tracer().event(request_id,
+                                                       "first_chunk")
+                                yield {"type": "token", "text": content}
+                            if obj.get("done"):
+                                # Final NDJSON object carries Ollama's
+                                # own token accounting (the reference
+                                # threw these away and counted chunks,
+                                # ollama_handler.py:233-339).
+                                prompt_toks = obj.get(
+                                    "prompt_eval_count", prompt_toks)
+                                completion_toks = obj.get(
+                                    "eval_count", completion_toks)
+                                break
+                    break  # success: leave the connect-retry loop
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        LLMServiceError) as e:
+                    delay = self._upstream_retry_delay(
+                        e, retry_attempt, chunks, request_id, "Ollama")
+                    retry_attempt += 1
+                    await asyncio.sleep(delay)
             yield {"type": "done", "finish_reason": "stop",
                    "stats": self._finish_stats(chunks, started, ttft,
                                                prompt_toks,
                                                completion_toks)}
-        except aiohttp.ClientError as e:
-            raise LLMServiceError(f"Ollama connection failed: {e}",
-                                  category=ErrorCategory.CONNECTION) from e
         finally:
             self._release_upstream()
             self._trace_end(request_id, trace_owned, started, ttft,

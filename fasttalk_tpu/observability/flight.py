@@ -171,7 +171,10 @@ class FlightRecorder:
                 self.trigger(f"slo_page:{ev.attrs.get('cls', '?')}",
                              kind=kind)
         elif kind in ("stall_detected", "watchdog_cancel",
-                      "engine_restart"):
+                      "engine_restart", "router_failover"):
+            # router_failover: a replica died with a stream on it — the
+            # evidence (events, traces, per-replica stats) is exactly
+            # what the post-mortem needs and is gone minutes later.
             self.trigger(kind, kind=kind)
         elif kind == "recompile":
             now = self._clock()

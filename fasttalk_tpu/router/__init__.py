@@ -1,0 +1,9 @@
+from fasttalk_tpu.router.policy import AffinityMap, PlacementPolicy
+from fasttalk_tpu.router.replica import (RemoteReplicaHandle,
+                                         ReplicaHandle)
+from fasttalk_tpu.router.router import FleetRouter, build_fleet
+
+__all__ = [
+    "AffinityMap", "PlacementPolicy", "ReplicaHandle",
+    "RemoteReplicaHandle", "FleetRouter", "build_fleet",
+]

@@ -1,0 +1,130 @@
+"""Placement policy: session affinity first, weighted-least-loaded else.
+
+Affinity is the fleet-level mirror of the engine's KV residency story
+(docs/KVCACHE.md): a session's resident or host-parked KV lives on ONE
+replica, so routing its next turn anywhere else throws away the PR-4
+restore path and re-prefills the whole history. The map is therefore
+sticky for ``ttl_s`` of idleness (default matches KV_PARK_TTL_S — once
+the parked entry has expired server-side there is nothing left to be
+sticky to) and is dropped on release_session, replica death, and
+replica drain.
+
+New sessions place by weighted least-loaded: each candidate's
+``load_score()`` (queue depth + live in-flight + overload/SLO
+penalties, replica.py) is compared and the minimum wins; ties break by
+rotation so equal replicas share arrivals instead of all landing on
+index 0.
+
+Thread-safety: placement runs on the asyncio loop while the probe
+thread reads for pruning — one lock, a few dict ops.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable
+
+from fasttalk_tpu.router.replica import ReplicaHandle
+
+
+class AffinityMap:
+    """session_id → (replica_id, last_used) with TTL eviction."""
+
+    def __init__(self, ttl_s: float = 600.0, clock=time.monotonic):
+        self.ttl_s = ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._map: dict[str, tuple[str, float]] = {}
+
+    def get(self, session_id: str) -> str | None:
+        now = self._clock()
+        with self._lock:
+            entry = self._map.get(session_id)
+            if entry is None:
+                return None
+            replica_id, last = entry
+            if now - last > self.ttl_s:
+                del self._map[session_id]
+                return None
+            return replica_id
+
+    def set(self, session_id: str, replica_id: str) -> None:
+        with self._lock:
+            self._map[session_id] = (replica_id, self._clock())
+
+    def touch(self, session_id: str) -> None:
+        with self._lock:
+            entry = self._map.get(session_id)
+            if entry is not None:
+                self._map[session_id] = (entry[0], self._clock())
+
+    def drop(self, session_id: str) -> None:
+        with self._lock:
+            self._map.pop(session_id, None)
+
+    def drop_replica(self, replica_id: str,
+                     keep: Iterable[str] = ()) -> list[str]:
+        """Forget every session pinned to ``replica_id`` except those in
+        ``keep`` (sessions with a stream still finishing there during a
+        drain). Returns the dropped session ids."""
+        keep = set(keep)
+        with self._lock:
+            dropped = [sid for sid, (rid, _) in self._map.items()
+                       if rid == replica_id and sid not in keep]
+            for sid in dropped:
+                del self._map[sid]
+            return dropped
+
+    def prune(self) -> int:
+        """TTL sweep (probe-thread housekeeping). Returns #evicted."""
+        now = self._clock()
+        with self._lock:
+            stale = [sid for sid, (_, last) in self._map.items()
+                     if now - last > self.ttl_s]
+            for sid in stale:
+                del self._map[sid]
+            return len(stale)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def snapshot(self) -> dict[str, str]:
+        with self._lock:
+            return {sid: rid for sid, (rid, _) in self._map.items()}
+
+
+class PlacementPolicy:
+    """Affinity-then-least-loaded placement over a replica list."""
+
+    def __init__(self, affinity: AffinityMap):
+        self.affinity = affinity
+        self._rr = 0  # tie-break rotation counter
+        self._lock = threading.Lock()
+
+    def place(self, session_id: str, replicas: list[ReplicaHandle],
+              exclude: frozenset[str] | set[str] = frozenset(),
+              ) -> tuple[ReplicaHandle | None, bool]:
+        """Pick a replica for one request. Returns (handle, affine) —
+        ``affine`` True when the session's pinned replica served (KV
+        reuse preserved); None when no replica is placeable."""
+        by_id = {h.replica_id: h for h in replicas}
+        pinned = self.affinity.get(session_id)
+        if pinned is not None and pinned not in exclude:
+            h = by_id.get(pinned)
+            if h is not None and h.available():
+                self.affinity.touch(session_id)
+                return h, True
+        candidates = [h for h in replicas
+                      if h.available() and h.replica_id not in exclude]
+        if not candidates:
+            return None, False
+        scored = [(h.load_score(), h) for h in candidates]
+        best = min(s for s, _ in scored)
+        tied = [h for s, h in scored if s == best]
+        with self._lock:
+            h = tied[self._rr % len(tied)]
+            self._rr += 1
+        self.affinity.set(session_id, h.replica_id)
+        return h, False

@@ -1,0 +1,294 @@
+"""Replica handles: one engine replica as the router sees it.
+
+A handle wraps one backend — an in-process ``EngineBase`` (the common
+CPU-fleet/bench shape, and the dp-style multi-engine shape on real
+hardware) or a remote FastTalk server reached over HTTP (the same
+``remote.py`` client protocol the legacy providers speak) — and keeps
+the router-side view of it: health state, the latest probe's load
+signals, and the set of requests currently routed here.
+
+Health is a small state machine:
+
+    healthy ⇄ degraded      (probe signals: overload state, SLO burn)
+    any     → dead          (``dead_probes`` consecutive probe failures,
+                             or a stream failing while check_connection()
+                             is already False — fast-path detection so a
+                             mid-stream death never waits a probe period)
+    dead    → healthy       (a later probe finds the engine back — e.g.
+                             the launcher's supervised engine restart)
+
+``draining`` is orthogonal to health: a draining replica finishes what
+it has but takes no new placements (docs/ROUTER.md).
+
+Probes are synchronous by design — the router runs them on its own
+daemon thread (in-proc probes are a few dict reads; remote probes are
+one short HTTP GET), never on the serving event loop. ``clock`` is
+injectable for deterministic tests, like the scheduler's.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from fasttalk_tpu.engine.engine import EngineBase
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("router.replica")
+
+STATE_HEALTHY = "healthy"
+STATE_DEGRADED = "degraded"
+STATE_DEAD = "dead"
+
+# Load-score weights: a replica's score is the expected queueing cost of
+# placing one more request on it. Queue depth dominates (each queued
+# request is one service time of wait); overload states add the
+# scheduler's own judgement; an SLO page means the replica is already
+# breaking promises. Lower score wins.
+_OVERLOAD_PENALTY = {"healthy": 0.0, "pressured": 2.0,
+                     "shedding": 8.0, "draining": float("inf")}
+_SLO_PENALTY = {"ok": 0.0, "warn": 2.0, "page": 8.0}
+
+
+class ReplicaHandle:
+    """One in-process engine replica, as the router tracks it."""
+
+    def __init__(self, replica_id: str, engine: EngineBase, *,
+                 dead_probes: int = 2, clock=time.monotonic):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.dead_probes = max(1, dead_probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = STATE_HEALTHY
+        self.draining = False
+        self._consec_failures = 0
+        self.last_probe: dict[str, Any] = {}
+        self.last_probe_at: float | None = None
+        # Request ids currently streaming from this replica (router-side
+        # bookkeeping; feeds the load score between probes).
+        self.inflight: set[str] = set()
+        self.placements = 0          # lifetime placements (stats)
+        self.failovers = 0           # streams that died here (stats)
+
+    # ---------------- probing ----------------
+
+    def probe_now(self) -> dict[str, Any]:
+        """One synchronous health/load probe. Updates ``state`` and
+        ``last_probe``; returns the signal dict. Never raises."""
+        try:
+            alive = self.engine.check_connection()
+        except Exception:
+            alive = False
+        if not alive:
+            return self._probe_failed("backend not connected")
+        try:
+            signals = self._collect_signals()
+        except Exception as e:  # a flaky stats surface is not a death
+            signals = {"error": f"stats probe failed: {e}"}
+        with self._lock:
+            self._consec_failures = 0
+            recovered = self.state == STATE_DEAD
+            self.state = (STATE_DEGRADED
+                          if signals.get("overload_state")
+                          in ("pressured", "shedding")
+                          or signals.get("slo_alert") == "page"
+                          else STATE_HEALTHY)
+            self.last_probe = signals
+            self.last_probe_at = self._clock()
+        if recovered:
+            log.info(f"replica {self.replica_id} recovered "
+                     f"(state {self.state})")
+        return signals
+
+    def _collect_signals(self) -> dict[str, Any]:
+        """Load signals from an in-proc engine's own stats surface —
+        the same numbers /health and /stats publish, read directly.
+
+        Deliberately NO slo_alert here: in-proc replicas share the
+        process-wide SLO engine, so its alert state is identical for
+        every replica and carries no per-replica routing information.
+        The SLO placement penalty applies to remote replicas, whose
+        /health body reports their own burn state."""
+        stats = self.engine.get_stats() or {}
+        sched = stats.get("scheduler") or {}
+        slots = stats.get("slots") or {}
+        return {
+            "alive": True,
+            "waiting": stats.get("waiting", 0) or 0,
+            "running": (stats.get("running", slots.get("active", 0))
+                        or 0),
+            "slots_total": slots.get("total_slots"),
+            "overload_state": sched.get("state", "healthy"),
+            "estimated_wait_s": sched.get("estimated_wait_s", 0.0),
+            "draining_backend": bool(sched.get("draining", False)),
+        }
+
+    def _probe_failed(self, reason: str) -> dict[str, Any]:
+        with self._lock:
+            self._consec_failures += 1
+            died = (self.state != STATE_DEAD
+                    and self._consec_failures >= self.dead_probes)
+            if died:
+                self.state = STATE_DEAD
+            self.last_probe = {"alive": False, "error": reason}
+            self.last_probe_at = self._clock()
+        if died:
+            log.warning(f"replica {self.replica_id} marked dead: "
+                        f"{reason}")
+        return self.last_probe
+
+    def note_stream_failure(self) -> bool:
+        """Fast-path death detection: a stream just failed here. If the
+        backend is also unreachable, mark dead NOW instead of waiting
+        out ``dead_probes`` probe periods. Returns True when this call
+        transitioned the replica to dead."""
+        try:
+            alive = self.engine.check_connection()
+        except Exception:
+            alive = False
+        with self._lock:
+            self.failovers += 1
+            if not alive and self.state != STATE_DEAD:
+                self.state = STATE_DEAD
+                self._consec_failures = self.dead_probes
+                log.warning(f"replica {self.replica_id} marked dead "
+                            "(stream failed and backend unreachable)")
+                return True
+        return False
+
+    # ---------------- placement view ----------------
+
+    def alive(self) -> bool:
+        try:
+            return bool(self.engine.check_connection())
+        except Exception:
+            return False
+
+    def available(self) -> bool:
+        """Eligible for NEW placements: not dead, not draining."""
+        return self.state != STATE_DEAD and not self.draining
+
+    def load_score(self) -> float:
+        """Expected cost of placing one more request here (lower is
+        better). Uses the latest probe's signals plus the router's own
+        live in-flight count, so the score moves between probes."""
+        with self._lock:
+            p = dict(self.last_probe)
+            inflight = len(self.inflight)
+        if self.draining or p.get("draining_backend"):
+            return float("inf")
+        score = float(p.get("waiting", 0) or 0) + float(inflight)
+        slots = p.get("slots_total")
+        if slots:
+            score += float(p.get("running", 0) or 0) / float(slots)
+        score += _OVERLOAD_PENALTY.get(p.get("overload_state", "healthy"),
+                                       0.0)
+        score += _SLO_PENALTY.get(p.get("slo_alert", "ok"), 0.0)
+        return score
+
+    def to_dict(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "replica_id": self.replica_id,
+                "state": self.state,
+                "draining": self.draining,
+                "inflight": len(self.inflight),
+                "placements": self.placements,
+                "failovers": self.failovers,
+                "load_score": None,  # filled by caller outside the lock
+                "last_probe": dict(self.last_probe),
+                "last_probe_at": self.last_probe_at,
+            }
+
+
+class RemoteReplicaHandle(ReplicaHandle):
+    """A replica reached over HTTP: another FastTalk server (its
+    OpenAI-compatible /v1 surface carries generations via the existing
+    ``remote.py`` client; its /health carries the probe signals).
+
+    ``base_url`` is the serving root, e.g. ``http://replica-2:8000``.
+    """
+
+    def __init__(self, replica_id: str, base_url: str, model: str, *,
+                 dead_probes: int = 2, probe_timeout_s: float = 3.0,
+                 timeout_s: float = 600.0, max_inflight: int = 32,
+                 admission_timeout_s: float = 30.0,
+                 connect_retries: int = 2, clock=time.monotonic):
+        from fasttalk_tpu.engine.remote import VLLMRemoteEngine
+
+        self.base_url = base_url.rstrip("/")
+        self.probe_timeout_s = probe_timeout_s
+        engine = VLLMRemoteEngine(
+            f"{self.base_url}/v1", model, timeout_s=timeout_s,
+            max_inflight=max_inflight,
+            admission_timeout_s=admission_timeout_s,
+            connect_retries=connect_retries)
+        super().__init__(replica_id, engine, dead_probes=dead_probes,
+                         clock=clock)
+
+    def probe_now(self) -> dict[str, Any]:
+        import requests
+
+        try:
+            r = requests.get(f"{self.base_url}/health",
+                             timeout=self.probe_timeout_s)
+            body = r.json() if r.content else {}
+        except Exception as e:
+            return self._probe_failed(f"health probe failed: {e}")
+        if r.status_code >= 500:
+            return self._probe_failed(f"health returned {r.status_code}")
+        sched = body.get("scheduler") or {}
+        slo = body.get("slo") or {}
+        signals = {
+            "alive": True,
+            "status": body.get("status"),
+            "waiting": sched.get("depth", 0) or 0,
+            "running": body.get("active_sessions", 0) or 0,
+            "slots_total": None,
+            "overload_state": sched.get("state", "healthy"),
+            "estimated_wait_s": sched.get("estimated_wait_s", 0.0),
+            "draining_backend": bool(sched.get("draining", False)),
+            # Worst class alert ("page" beats "warn" beats "ok").
+            "slo_alert": max(slo.values(), default="ok",
+                             key=("ok", "warn", "page").index)
+            if all(v in ("ok", "warn", "page") for v in slo.values())
+            else "ok",
+        }
+        with self._lock:
+            self._consec_failures = 0
+            recovered = self.state == STATE_DEAD
+            self.state = (STATE_DEGRADED
+                          if signals["overload_state"]
+                          in ("pressured", "shedding")
+                          or signals["slo_alert"] == "page"
+                          else STATE_HEALTHY)
+            self.last_probe = signals
+            self.last_probe_at = self._clock()
+        if recovered:
+            log.info(f"replica {self.replica_id} recovered")
+        return signals
+
+    def alive(self) -> bool:
+        # The remote engine's check_connection() probes /health itself;
+        # state from the last probe is the cheaper, equivalent signal.
+        return self.state != STATE_DEAD
+
+    def note_stream_failure(self) -> bool:
+        """No blocking liveness probe here — the base implementation's
+        check_connection() would be a synchronous HTTP GET executed on
+        the asyncio event loop mid-failover, freezing every other live
+        stream for the TCP timeout. A stream failing against a remote
+        replica (after the client's own pre-first-token retries) marks
+        it dead immediately; the probe thread recovers it as soon as
+        /health answers again."""
+        with self._lock:
+            self.failovers += 1
+            if self.state != STATE_DEAD:
+                self.state = STATE_DEAD
+                self._consec_failures = self.dead_probes
+                log.warning(f"replica {self.replica_id} marked dead "
+                            "(stream failed)")
+                return True
+        return False

@@ -1,0 +1,566 @@
+"""FleetRouter: health-aware routing across N engine replicas.
+
+The scale-OUT tier the ROADMAP north star requires: one EngineBase-
+shaped front that spreads sessions across a fleet of engine replicas
+(in-process engines and/or remote FastTalk servers), the way
+JetStream/llm-d-style deployments front their model servers. Because
+the router IS an ``EngineBase``, the entire serving stack — WebSocket
+server, OpenAI routes, breaker, drain-on-shutdown — runs unchanged on
+top of it; the router slots in where a single engine used to be.
+
+What it adds over a bare engine (docs/ROUTER.md):
+
+- **Replica registry + probes.** A daemon thread probes every replica
+  each ``probe_interval_s`` using the signals the stack already
+  publishes (check_connection / get_stats for in-proc replicas, the
+  /health body for remote ones) — no new health protocol.
+- **Session affinity.** A session sticks to the replica holding its
+  resident or host-parked KV (policy.py), so the PR-4 restore path
+  keeps paying off across the fleet; new sessions place
+  weighted-least-loaded (queue depth, overload state, SLO burn).
+- **Failover.** A replica dying mid-stream triggers resume-on-survivor:
+  the transcript re-prefills on a healthy replica, already-delivered
+  text is trimmed from the new stream, and the client sees one
+  ``resumed`` event — not an error. Pre-first-token failures re-route
+  silently (nothing was delivered, the retry is idempotent); when no
+  healthy replica remains the request sheds with ``retry_after``.
+- **Coordinated drain.** ``drain_replica()`` stops placement to one
+  replica, lets its in-flight streams finish, and migrates its idle
+  parked sessions' affinity (their next turn places fresh elsewhere)
+  — the fleet keeps serving through a rolling restart.
+
+Resume caveat: the survivor re-generates from the transcript, so with
+temperature > 0 the continuation may diverge from what the dead replica
+would have said; with greedy sampling it is identical. The overlap trim
+is by character count of delivered text.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, AsyncGenerator
+
+from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.observability.events import get_events
+from fasttalk_tpu.observability.trace import get_tracer
+from fasttalk_tpu.router.policy import AffinityMap, PlacementPolicy
+from fasttalk_tpu.router.replica import (STATE_DEAD, ReplicaHandle,
+                                         RemoteReplicaHandle)
+from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
+                                       LLMServiceError)
+from fasttalk_tpu.utils.logger import get_logger
+from fasttalk_tpu.utils.metrics import get_metrics
+
+log = get_logger("router")
+
+# LLMServiceError categories that indicate the REPLICA failed (connect
+# refused, timeout, OOM) rather than the request being malformed —
+# these are failover-eligible; validation/model errors propagate.
+_FAULT_CATEGORIES = (ErrorCategory.CONNECTION, ErrorCategory.TIMEOUT,
+                     ErrorCategory.RESOURCE)
+
+
+class FleetRouter(EngineBase):
+    """Engine-shaped front over a fleet of replicas."""
+
+    def __init__(self, replicas: list[ReplicaHandle], *,
+                 probe_interval_s: float = 2.0,
+                 affinity_ttl_s: float = 600.0,
+                 failover_retries: int = 2,
+                 resume: bool = True,
+                 clock=time.monotonic):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        ids = [h.replica_id for h in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.replicas = list(replicas)
+        self.probe_interval_s = probe_interval_s
+        self.failover_retries = max(0, failover_retries)
+        self.resume_enabled = resume
+        self._clock = clock
+        self.affinity = AffinityMap(ttl_s=affinity_ttl_s, clock=clock)
+        self.policy = PlacementPolicy(self.affinity)
+        self._routes: dict[str, tuple[str, ReplicaHandle]] = {}
+        self._cancelled: set[str] = set()
+        self._draining = False
+        self._started = False
+        self._probe_thread: threading.Thread | None = None
+        self._probe_stop = threading.Event()
+        self._events = get_events()
+        self._tracer = get_tracer()
+        m = get_metrics()
+        self._m_replicas = m.gauge(
+            "router_replicas", "replicas registered with the router")
+        self._m_available = m.gauge(
+            "router_replicas_available",
+            "replicas currently placeable (not dead, not draining)")
+        self._m_placements = m.counter(
+            "router_placements_total", "requests placed on a replica")
+        self._m_affinity_hits = m.counter(
+            "router_affinity_hits_total",
+            "placements that reused the session's pinned replica")
+        self._m_failovers = m.counter(
+            "router_failovers_total",
+            "streams that failed on a replica and were re-routed")
+        self._m_resumes = m.counter(
+            "router_resumes_total",
+            "mid-stream failovers resumed on a survivor (client saw a "
+            "resumed event, not an error)")
+        self._m_sheds = m.counter(
+            "router_sheds_total",
+            "requests shed by the router (no placeable replica)")
+        self._m_replicas.set(len(self.replicas))
+
+    # ---------------- lifecycle ----------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for h in self.replicas:
+            try:
+                h.engine.start()
+            except Exception as e:
+                log.error(f"replica {h.replica_id} failed to start: {e}")
+        self.probe_once()
+        if self.probe_interval_s > 0:
+            self._probe_stop.clear()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="router-probe", daemon=True)
+            self._probe_thread.start()
+
+    def shutdown(self) -> None:
+        self._started = False
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
+        for h in self.replicas:
+            try:
+                h.engine.shutdown()
+            except Exception as e:
+                log.error(f"replica {h.replica_id} shutdown error: {e}")
+
+    def warmup(self, level: str = "off") -> None:
+        for h in self.replicas:
+            h.engine.warmup(level)
+
+    def begin_drain(self) -> None:
+        """Fleet-wide drain (server shutdown): every replica stops
+        admitting; queued and in-flight work finishes."""
+        self._draining = True
+        self._events.emit("router_drain", severity="warning",
+                          scope="fleet", replicas=len(self.replicas))
+        for h in self.replicas:
+            h.draining = True
+            try:
+                h.engine.begin_drain()
+            except Exception as e:
+                log.error(f"replica {h.replica_id} drain error: {e}")
+
+    def drain_replica(self, replica_id: str) -> dict[str, Any]:
+        """Coordinated single-replica drain (rolling restart): stop
+        placement here, let in-flight streams finish, and migrate idle
+        sessions — their affinity is dropped (next turn places fresh on
+        a healthy replica) and their parked KV on this replica is
+        released so the pool frees. Sessions with a stream still
+        running here keep their pin until it completes.
+
+        Returns a summary dict; raises KeyError for an unknown id."""
+        handle = self._handle(replica_id)
+        handle.draining = True
+        try:
+            handle.engine.begin_drain()
+        except Exception as e:
+            log.error(f"replica {replica_id} drain error: {e}")
+        busy_sessions = {sid for sid, h
+                         in list(self._routes.values())
+                         if h is handle}
+        migrated = self.affinity.drop_replica(replica_id,
+                                              keep=busy_sessions)
+        for sid in migrated:
+            # Idle parked sessions: purge their parked KV on the
+            # draining replica (their next turn re-prefills elsewhere;
+            # keeping the entry would only pin host RAM on a replica
+            # that is going away).
+            try:
+                handle.engine.release_session(sid)
+            except Exception:
+                pass
+        self._events.emit("router_drain", severity="warning",
+                          scope="replica", replica=replica_id,
+                          migrated_sessions=len(migrated),
+                          busy_sessions=len(busy_sessions))
+        self._update_gauges()
+        return {"replica_id": replica_id, "draining": True,
+                "migrated_sessions": len(migrated),
+                "busy_sessions": sorted(busy_sessions)}
+
+    def pending_requests(self) -> int:
+        return sum(self._safe(h, "pending_requests", 0)
+                   for h in self.replicas)
+
+    # ---------------- probing ----------------
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.wait(self.probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception as e:  # the probe loop must never die
+                log.error(f"router probe failed: {e}", exc_info=True)
+
+    def probe_once(self) -> None:
+        """Probe every replica once and refresh gauges/affinity.
+        Public and synchronous so tests drive health transitions
+        deterministically without the probe thread."""
+        for h in self.replicas:
+            before = h.state
+            h.probe_now()
+            if h.state != before:
+                self._events.emit(
+                    "router_replica_dead" if h.state == STATE_DEAD
+                    else "router_replica_state",
+                    severity=("critical" if h.state == STATE_DEAD
+                              else "info"),
+                    replica=h.replica_id, was=before, now=h.state)
+                if h.state == STATE_DEAD:
+                    # Idle sessions pinned to a dead replica re-place
+                    # fresh; sessions with live streams are already in
+                    # the failover path.
+                    busy = {sid for sid, hh
+                            in list(self._routes.values())
+                            if hh is h}
+                    self.affinity.drop_replica(h.replica_id, keep=busy)
+        self.affinity.prune()
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        self._m_available.set(
+            sum(1 for h in self.replicas if h.available()))
+
+    # ---------------- routing ----------------
+
+    def _handle(self, replica_id: str) -> ReplicaHandle:
+        for h in self.replicas:
+            if h.replica_id == replica_id:
+                return h
+        raise KeyError(f"unknown replica {replica_id!r}")
+
+    def _place(self, session_id: str,
+               exclude: set[str]) -> ReplicaHandle:
+        handle, affine = self.policy.place(session_id, self.replicas,
+                                           exclude)
+        if handle is None:
+            self._m_sheds.inc()
+            raise AdmissionRejected(
+                "no healthy replica available"
+                + (" (fleet is draining)" if self._draining else ""),
+                retry_after=max(1.0, self.probe_interval_s or 1.0),
+                reason="no_replica")
+        self._m_placements.inc()
+        if affine:
+            self._m_affinity_hits.inc()
+        return handle
+
+    async def generate(self, request_id: str, session_id: str,
+                       messages: list[dict], params: GenerationParams,
+                       ) -> AsyncGenerator[dict, None]:
+        if self._draining:
+            self._m_sheds.inc()
+            raise AdmissionRejected(
+                "fleet is draining: finishing in-flight requests, not "
+                "accepting new ones", retry_after=5.0, reason="draining")
+        excluded: set[str] = set()
+        delivered = 0          # chars already yielded to the caller
+        attempt = 0
+        resumed_total = 0
+        pending_resume = False
+        try:
+            while True:
+                # A cancel can land while no replica owns the stream —
+                # between attempts, or while the generator is suspended
+                # yielding the resumed frame. Check at every point we
+                # regain control with no replica-side stream to carry
+                # the cancel for us.
+                if request_id in self._cancelled:
+                    yield {"type": "cancelled",
+                           "finish_reason": "cancelled", "stats": {}}
+                    return
+                handle = self._place(session_id, excluded)
+                if pending_resume:
+                    pending_resume = False
+                    resumed_total += 1
+                    self._m_resumes.inc()
+                    yield {"type": "resumed",
+                           "replica": handle.replica_id,
+                           "attempt": attempt}
+                    if request_id in self._cancelled:
+                        yield {"type": "cancelled",
+                               "finish_reason": "cancelled",
+                               "stats": {}}
+                        return
+                self._routes[request_id] = (session_id, handle)
+                handle.inflight.add(request_id)
+                handle.placements += 1
+                failure: str | None = None
+                skip = delivered
+                t0 = self._clock()
+                try:
+                    async for ev in handle.engine.generate(
+                            request_id, session_id, messages, params):
+                        et = ev.get("type")
+                        if et == "token":
+                            text = ev.get("text", "")
+                            if skip > 0:  # resume overlap trim
+                                if len(text) <= skip:
+                                    skip -= len(text)
+                                    continue
+                                text = text[skip:]
+                                skip = 0
+                            if not text:
+                                continue
+                            delivered += len(text)
+                            yield {**ev, "text": text}
+                        elif et in ("done", "cancelled"):
+                            if resumed_total:
+                                ev = {**ev,
+                                      "stats": {**(ev.get("stats") or {}),
+                                                "resumed": resumed_total}}
+                            yield ev
+                            return
+                        elif et == "error":
+                            # code "internal_error" is emitted ONLY by
+                            # the engine's crash/shutdown abort path
+                            # (_abort_all) — a replica fault even when
+                            # check_connection() hasn't flipped yet
+                            # (the abort events race the thread's
+                            # teardown). Anything else is judged by
+                            # liveness: deadline_expired / stalled /
+                            # validation errors from a live replica
+                            # propagate.
+                            if ev.get("code") == "internal_error" \
+                                    or not handle.alive():
+                                failure = str(ev.get("error", ""))
+                                break
+                            yield ev  # genuine request error: propagate
+                            return
+                        else:
+                            yield ev  # tool_call etc.: pass through
+                except asyncio.CancelledError:
+                    handle.engine.cancel(request_id)
+                    raise
+                except AdmissionRejected:
+                    # This replica's queue shed us. A fresh request can
+                    # try a less-loaded replica; a resumed stream (or a
+                    # fully-excluded fleet) must surface the shed with
+                    # its retry_after.
+                    excluded.add(handle.replica_id)
+                    if delivered == 0 and len(excluded) < len(
+                            self.replicas):
+                        continue
+                    raise
+                except LLMServiceError as e:
+                    if e.category in _FAULT_CATEGORIES \
+                            or not handle.alive():
+                        failure = str(e)
+                    else:
+                        raise
+                except Exception as e:
+                    if not handle.alive():
+                        failure = str(e)
+                    else:
+                        raise
+                finally:
+                    handle.inflight.discard(request_id)
+                if failure is None:
+                    # Stream ended with no terminal event (a replica
+                    # torn down mid-yield can do this): same treatment
+                    # as an explicit failure.
+                    failure = "stream ended without a terminal event"
+                # ---------- failover ----------
+                died = handle.note_stream_failure()
+                self._m_failovers.inc()
+                self._tracer.event(request_id, "failover")
+                self._events.emit(
+                    "router_failover", severity="critical",
+                    replica=handle.replica_id, request=request_id,
+                    session=session_id, mid_stream=delivered > 0,
+                    attempt=attempt, error=failure[:200])
+                if died:
+                    busy = {sid for sid, hh
+                            in list(self._routes.values())
+                            if hh is handle}
+                    self.affinity.drop_replica(handle.replica_id,
+                                               keep=busy)
+                self._update_gauges()
+                log.warning(
+                    f"[{request_id}] replica {handle.replica_id} failed "
+                    f"{'mid-stream' if delivered else 'pre-token'} "
+                    f"(attempt {attempt}): {failure}")
+                if request_id in self._cancelled:
+                    yield {"type": "cancelled",
+                           "finish_reason": "cancelled", "stats": {}}
+                    return
+                excluded.add(handle.replica_id)
+                attempt += 1
+                if attempt > self.failover_retries:
+                    yield {"type": "error",
+                           "error": f"replica {handle.replica_id} "
+                           f"failed and failover retries exhausted: "
+                           f"{failure}",
+                           "code": "replica_failed"}
+                    return
+                if delivered > 0:
+                    if not self.resume_enabled:
+                        yield {"type": "error",
+                               "error": f"replica {handle.replica_id} "
+                               f"died mid-stream (resume disabled): "
+                               f"{failure}",
+                               "code": "replica_failed"}
+                        return
+                    # Affinity moves with the resume: the survivor
+                    # re-prefills the transcript and becomes the
+                    # session's home.
+                    pending_resume = True
+                self._tracer.add_span(request_id, "failover", t0,
+                                      self._clock(),
+                                      replica=handle.replica_id,
+                                      mid_stream=delivered > 0)
+        finally:
+            self._routes.pop(request_id, None)
+            self._cancelled.discard(request_id)
+
+    # ---------------- EngineBase surface ----------------
+
+    def cancel(self, request_id: str) -> bool:
+        # Mark first: a cancel landing between failover attempts (no
+        # replica owns the stream at that instant) must still terminate
+        # the retry loop.
+        self._cancelled.add(request_id)
+        route = self._routes.get(request_id)
+        if route is not None:
+            try:
+                return bool(route[1].engine.cancel(request_id))
+            except Exception:
+                return False
+        return False
+
+    def release_session(self, session_id: str) -> None:
+        self.affinity.drop(session_id)
+        # Fan out: a failed-over session may have parked KV on more
+        # than one replica (release is idempotent everywhere).
+        for h in self.replicas:
+            try:
+                h.engine.release_session(session_id)
+            except Exception:
+                pass
+
+    def check_connection(self) -> bool:
+        return self._started and any(h.available() and h.alive()
+                                     for h in self.replicas)
+
+    def get_model_info(self) -> dict:
+        info: dict[str, Any] = {}
+        for h in self.replicas:
+            try:
+                info = dict(h.engine.get_model_info())
+                break
+            except Exception:
+                continue
+        info["fleet_size"] = len(self.replicas)
+        info["router"] = True
+        return info
+
+    def get_stats(self) -> dict:
+        per_replica = {}
+        waiting = running = 0
+        for h in self.replicas:
+            stats = self._safe(h, "get_stats", {}) or {}
+            per_replica[h.replica_id] = {
+                "state": h.state, "draining": h.draining,
+                "inflight": len(h.inflight),
+                "waiting": stats.get("waiting", 0),
+            }
+            waiting += int(stats.get("waiting", 0) or 0)
+            running += int(stats.get("running", 0) or 0)
+        return {
+            "router": {
+                "replicas": len(self.replicas),
+                "available": sum(1 for h in self.replicas
+                                 if h.available()),
+                "dead": sum(1 for h in self.replicas
+                            if h.state == STATE_DEAD),
+                "affinity_sessions": len(self.affinity),
+                "placements": self._m_placements.value,
+                "affinity_hits": self._m_affinity_hits.value,
+                "failovers": self._m_failovers.value,
+                "resumes": self._m_resumes.value,
+                "sheds": self._m_sheds.value,
+                "draining": self._draining,
+            },
+            "per_replica": per_replica,
+            "waiting": waiting,
+            "running": running,
+        }
+
+    def fleet_stats(self) -> dict:
+        """The /fleet endpoint's body: registry view with live scores."""
+        replicas = []
+        for h in self.replicas:
+            d = h.to_dict()
+            score = h.load_score()
+            d["load_score"] = (None if score == float("inf")
+                               else round(score, 3))
+            replicas.append(d)
+        return {
+            "replicas": replicas,
+            "affinity_sessions": len(self.affinity),
+            "draining": self._draining,
+            "counters": {
+                "placements": self._m_placements.value,
+                "affinity_hits": self._m_affinity_hits.value,
+                "failovers": self._m_failovers.value,
+                "resumes": self._m_resumes.value,
+                "sheds": self._m_sheds.value,
+            },
+        }
+
+    @staticmethod
+    def _safe(h: ReplicaHandle, method: str, default):
+        try:
+            return getattr(h.engine, method)()
+        except Exception:
+            return default
+
+
+def build_fleet(cfg) -> FleetRouter:
+    """Construct the configured fleet: ``FLEET_REPLICAS`` in-process
+    engine replicas (each its own engine instance — CPU fleets for
+    test/bench, or dp-style multi-engine on real hardware) plus one
+    remote replica per ``ROUTER_BACKENDS`` URL (other FastTalk servers,
+    reached through the existing remote.py client protocol)."""
+    from fasttalk_tpu.engine.factory import build_engine
+
+    handles: list[ReplicaHandle] = []
+    for i in range(cfg.fleet_replicas):
+        handles.append(ReplicaHandle(
+            f"inproc-{i}", build_engine(cfg),
+            dead_probes=cfg.router_dead_probes))
+    for i, url in enumerate(u.strip() for u in
+                            cfg.router_backends.split(",") if u.strip()):
+        handles.append(RemoteReplicaHandle(
+            f"remote-{i}", url, cfg.model_name,
+            dead_probes=cfg.router_dead_probes,
+            timeout_s=cfg.vllm_timeout,
+            max_inflight=cfg.remote_max_inflight,
+            admission_timeout_s=cfg.sched_default_deadline_s,
+            connect_retries=cfg.remote_connect_retries))
+    return FleetRouter(
+        handles,
+        probe_interval_s=cfg.router_probe_interval_s,
+        affinity_ttl_s=cfg.router_affinity_ttl_s,
+        failover_retries=cfg.router_failover_retries,
+        resume=cfg.router_resume)

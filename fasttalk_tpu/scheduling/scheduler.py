@@ -89,7 +89,8 @@ class RequestScheduler:
                  shed_hold_s: float = 5.0,
                  pressured_frac: float = 0.5,
                  sweep_interval_s: float = 0.05,
-                 slo_gate=None):
+                 slo_gate=None,
+                 clock=time.monotonic):
         if queue_bound <= 0:
             raise ValueError("queue_bound must be > 0")
         if default_deadline_s <= 0:
@@ -103,6 +104,11 @@ class RequestScheduler:
         self.shed_hold_s = shed_hold_s
         self.pressured_frac = pressured_frac
         self._sweep_interval = sweep_interval_s
+        # Injectable time source (must be monotonic): every deadline,
+        # aging and state decision reads THIS clock, so tests drive
+        # expiry-vs-admission races deterministically by warping it
+        # (the fake-clock pattern of slo.py/watchdog.py).
+        self._clock = clock
         # Optional SLO consult (observability/slo.py should_shed):
         # callable(priority) -> True when this class must be shed
         # because a latency objective is burning. Evaluated OUTSIDE
@@ -174,7 +180,7 @@ class RequestScheduler:
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
-        now = time.monotonic()
+        now = self._clock()
         ttl = self.default_deadline_s if deadline_s is None else deadline_s
         # SLO consult BEFORE taking the queue lock: the gate may
         # evaluate burn windows under its own lock, and nesting it
@@ -272,7 +278,7 @@ class RequestScheduler:
             entry.cancelled = True
             self._depth -= 1
             self._m_depth.set(self._depth)
-            self._update_state_locked(time.monotonic())
+            self._update_state_locked(self._clock())
             return entry
 
     # ---------------- admission side (engine thread) ----------------
@@ -283,7 +289,7 @@ class RequestScheduler:
         aging), per-session round-robin, deadlines and tombstones.
         Sessions in ``busy_sessions`` are skipped but stay queued.
         Entries found expired are diverted to take_expired()."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             for priority in self._class_order_locked(now):
                 entry = self._pop_class_locked(priority, busy_sessions,
@@ -367,7 +373,7 @@ class RequestScheduler:
         queue at most every ``sweep_interval_s`` (bounded by
         queue_bound, so the engine loop never pays an unbounded scan)
         and drains entries pop() found expired."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             if now - self._last_sweep >= self._sweep_interval:
                 self._last_sweep = now
@@ -404,7 +410,7 @@ class RequestScheduler:
         with self._lock:
             already = self._draining
             self._draining = True
-            self._update_state_locked(time.monotonic())
+            self._update_state_locked(self._clock())
         if not already:
             self._events.emit("drain", depth=self._depth,
                               bound=self.queue_bound)
@@ -420,7 +426,7 @@ class RequestScheduler:
             self._depth = 0
             self._expired_pending.clear()
             self._m_depth.set(0)
-            self._update_state_locked(time.monotonic())
+            self._update_state_locked(self._clock())
 
     def remove_finished(self) -> None:
         """Drop entries whose payload already carries a terminal state
@@ -479,7 +485,7 @@ class RequestScheduler:
             return self._retry_after_locked()
 
     def overload_state(self, now: float | None = None) -> str:
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             return self._state_locked(now)
 
@@ -499,7 +505,7 @@ class RequestScheduler:
     # ---------------- read side ----------------
 
     def stats(self) -> dict[str, Any]:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             return {
                 "state": self._state_locked(now),
@@ -516,7 +522,7 @@ class RequestScheduler:
     def snapshot(self, now: float | None = None) -> list[dict[str, Any]]:
         """Queued entries in approximate admission order, with position
         and remaining deadline — /debug/requests."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         out: list[dict[str, Any]] = []
         with self._lock:
             pos = 0

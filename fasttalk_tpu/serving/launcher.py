@@ -72,6 +72,13 @@ class ServerLauncher:
             self._spmd_sink = CallBroadcaster(
                 host, int(port), config.spmd_followers)
             engine.call_sink = self._spmd_sink
+        if engine is None and config.router_enabled:
+            # Router-backed mode (docs/ROUTER.md): the "engine" is a
+            # FleetRouter fronting N replicas; the serving stack above
+            # is unchanged (the router speaks the engine seam).
+            from fasttalk_tpu.router.router import build_fleet
+
+            engine = build_fleet(config)
         self.engine = engine if engine is not None else build_engine(config)
         self.agent = build_agent(config, self.engine)
         self.server = WebSocketLLMServer(config, self.engine, self.agent)

@@ -287,6 +287,13 @@ def register_openai_routes(app: web.Application,
                 elif event["type"] in ("done", "cancelled"):
                     finish_reason = _oai_finish(
                         event.get("finish_reason", "stop"))
+                elif event["type"] == "resumed":
+                    # Fleet failover resumed on a survivor: surface as
+                    # an SSE comment line — spec-compliant clients
+                    # ignore it, curl-level debugging sees it.
+                    await resp.write(
+                        f": resumed on {event.get('replica')}\n\n"
+                        .encode())
                 elif event["type"] == "error":
                     failed = True
                     err_payload = event.get("error")

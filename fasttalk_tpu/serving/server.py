@@ -111,6 +111,13 @@ class WebSocketLLMServer:
         self.app.router.add_get("/stats", self._http_stats)
         self.app.router.add_get("/models", self._http_models)
         self.app.router.add_get("/ws/llm", self.handle_websocket)
+        # Router-backed mode (docs/ROUTER.md): when the engine is a
+        # FleetRouter, expose the fleet registry and the coordinated
+        # single-replica drain used for rolling restarts.
+        if hasattr(engine, "fleet_stats"):
+            self.app.router.add_get("/fleet", self._http_fleet)
+            self.app.router.add_post("/fleet/drain/{replica_id}",
+                                     self._http_fleet_drain)
         from fasttalk_tpu.serving.openai_api import register_openai_routes
 
         register_openai_routes(
@@ -219,11 +226,25 @@ class WebSocketLLMServer:
             # before the cliff. "healthy" stays 200; overload states
             # are reported but don't flip the status code — the server
             # is still serving (that is the whole point of shedding).
-            sched = self.engine.get_stats().get("scheduler")
+            engine_stats = self.engine.get_stats()
+            sched = engine_stats.get("scheduler")
             if sched is not None:
                 body["scheduler"] = sched
                 if sched.get("state") != "healthy":
                     body["status"] = sched["state"]
+            # Router-backed mode: load balancers watching this port see
+            # the fleet's placement capacity, not just liveness — a
+            # fleet with dead replicas still serves (that is the whole
+            # point of failover), but operators must see it shrink.
+            fleet = engine_stats.get("router")
+            if fleet is not None:
+                body["fleet"] = fleet
+                # Degrade on DEATH only: a draining replica (rolling
+                # restart) also isn't placeable, but that is planned —
+                # paging a load balancer on every drain would punish
+                # the operator for using the drain endpoint.
+                if fleet.get("dead", 0) > 0:
+                    body["status"] = "degraded"
             # Watchdog + SLO burn state (docs/OBSERVABILITY.md): a hung
             # engine step, token-stalled requests, or a page-level SLO
             # burn all degrade the serving-port health too — load
@@ -266,6 +287,21 @@ class WebSocketLLMServer:
             return web.json_response(source.get_model_info())
         except Exception as e:
             return web.json_response({"error": str(e)})
+
+    async def _http_fleet(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.engine.fleet_stats))
+
+    async def _http_fleet_drain(self, request: web.Request,
+                                ) -> web.Response:
+        replica_id = request.match_info["replica_id"]
+        try:
+            summary = await asyncio.to_thread(self.engine.drain_replica,
+                                              replica_id)
+        except KeyError:
+            return web.json_response(
+                {"error": f"unknown replica {replica_id!r}"}, status=404)
+        return web.json_response(summary)
 
     # ---------------- WebSocket ----------------
 
@@ -544,6 +580,15 @@ class WebSocketLLMServer:
                     await self._send(session_id, ws, {
                         "type": "tool_call", "tool": event.get("tool"),
                         "arguments": event.get("arguments")},
+                        request_id=request_id)
+                elif etype == "resumed":
+                    # Fleet failover (docs/ROUTER.md): the stream moved
+                    # to a surviving replica mid-generation. Informative,
+                    # not an error — tokens keep flowing after it.
+                    await self._send(session_id, ws, {
+                        "type": "resumed",
+                        "replica": event.get("replica"),
+                        "attempt": event.get("attempt")},
                         request_id=request_id)
                 elif etype == "error":
                     if event.get("code") == "deadline_expired":

@@ -319,6 +319,52 @@ class Config:
     # too (waiters past the admission deadline shed with retry_after).
     remote_max_inflight: int = field(
         default_factory=lambda: _env_int("REMOTE_MAX_INFLIGHT", 32))
+    # Bounded jittered retries for idempotent (pre-first-token) remote
+    # upstream failures — connect errors and 5xx before any output.
+    # 0 disables (first failure surfaces immediately).
+    remote_connect_retries: int = field(
+        default_factory=lambda: _env_int("REMOTE_CONNECT_RETRIES", 2))
+    # ---- Fleet router (fasttalk_tpu/router/, docs/ROUTER.md) ----
+    # Front a fleet of engine replicas behind this server instead of a
+    # single engine: session-affinity routing, health probes, failover
+    # with mid-stream resume, coordinated drain.
+    router_enabled: bool = field(
+        default_factory=lambda: _env_bool("ROUTER_ENABLED", False))
+    # In-process engine replicas the router builds (each a full engine
+    # instance: CPU fleets for test/bench, dp-style multi-engine on
+    # real hardware). May be 0 when ROUTER_BACKENDS supplies the fleet.
+    fleet_replicas: int = field(
+        default_factory=lambda: _env_int("FLEET_REPLICAS", 2))
+    # Comma-separated serving roots of remote FastTalk replicas
+    # (e.g. "http://replica-1:8000,http://replica-2:8000"): generations
+    # go through their /v1 surface via the existing remote.py client;
+    # probes read their /health body.
+    router_backends: str = field(
+        default_factory=lambda: _env_str("ROUTER_BACKENDS", ""))
+    # Health/load probe cadence (seconds); 0 disables the probe thread
+    # (probes then only run on demand — tests).
+    router_probe_interval_s: float = field(
+        default_factory=lambda: _env_float("ROUTER_PROBE_INTERVAL_S",
+                                           2.0))
+    # How long an idle session stays pinned to its replica. Default
+    # matches KV_PARK_TTL_S: once the parked KV has expired server-side
+    # there is nothing left to be sticky to.
+    router_affinity_ttl_s: float = field(
+        default_factory=lambda: _env_float("ROUTER_AFFINITY_TTL_S",
+                                           600.0))
+    # Replica failures one request will route around before giving up.
+    router_failover_retries: int = field(
+        default_factory=lambda: _env_int("ROUTER_FAILOVER_RETRIES", 2))
+    # Resume mid-stream failovers on a survivor (re-prefill from the
+    # transcript; client sees a `resumed` event). Off = mid-stream
+    # replica death surfaces as a terminal error instead.
+    router_resume: bool = field(
+        default_factory=lambda: _env_bool("ROUTER_RESUME", True))
+    # Consecutive failed probes before a replica is marked dead (a
+    # stream failing while the backend is unreachable marks it dead
+    # immediately, independent of this).
+    router_dead_probes: int = field(
+        default_factory=lambda: _env_int("ROUTER_DEAD_PROBES", 2))
     # ---- Session KV host-offload tier (fasttalk_tpu/kvcache/,
     # docs/KVCACHE.md) ----
     # Host-RAM budget for parked session KV (MB). 0 disables the tier
@@ -524,6 +570,32 @@ class Config:
             errs.append("sched_drain_timeout_s must be >= 0")
         if self.remote_max_inflight <= 0:
             errs.append("remote_max_inflight must be > 0")
+        if self.remote_connect_retries < 0:
+            errs.append("remote_connect_retries must be >= 0 "
+                        "(0 disables the pre-first-token retry)")
+        if self.fleet_replicas < 0:
+            errs.append("fleet_replicas must be >= 0")
+        if self.router_probe_interval_s < 0:
+            errs.append("router_probe_interval_s must be >= 0 "
+                        "(0 disables the probe thread)")
+        if self.router_affinity_ttl_s <= 0:
+            errs.append("router_affinity_ttl_s must be > 0")
+        if self.router_failover_retries < 0:
+            errs.append("router_failover_retries must be >= 0")
+        if self.router_dead_probes < 1:
+            errs.append("router_dead_probes must be >= 1")
+        if self.router_enabled:
+            n_remote = len([u for u in self.router_backends.split(",")
+                            if u.strip()])
+            if self.fleet_replicas + n_remote < 1:
+                errs.append("router_enabled needs at least one replica "
+                            "(FLEET_REPLICAS >= 1 or ROUTER_BACKENDS)")
+            if self.spmd_role != "off":
+                errs.append("router_enabled is incompatible with "
+                            "multi-host SPMD serving (spmd_role must "
+                            "be 'off'; an SPMD cluster is ONE logical "
+                            "replica — front it via ROUTER_BACKENDS "
+                            "from a separate router process)")
         if self.kv_host_budget_mb < 0:
             errs.append("kv_host_budget_mb must be >= 0 (0 disables "
                         "the host-offload tier)")

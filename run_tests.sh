@@ -28,6 +28,15 @@
 #                             Prometheus validator, plus smoke runs of
 #                             scripts/check_prometheus.py and the
 #                             trace_report --slo CI gate.
+#   ./run_tests.sh --router   fleet-router group: replica registry /
+#                             probe health transitions, affinity +
+#                             weighted placement, failover races
+#                             (cancel-during-failover, drain-vs-new-
+#                             session, death mid-prefill vs mid-decode,
+#                             affinity across park/restore), the WS
+#                             `resumed` integration, /fleet endpoints,
+#                             and the remote-client pre-first-token
+#                             retry discipline (docs/ROUTER.md).
 #   ./run_tests.sh --perf     perf-attribution/flight-recorder group:
 #                             the step ledger (wall-time decomposition,
 #                             padding waste, MFU, compile ledger),
@@ -104,6 +113,36 @@ m.histogram("smoke_ms", "smoke").observe(3.0)
 problems = mod.validate(m.prometheus())
 assert not problems, problems
 print("exposition format OK")
+EOF
+    exit 0
+fi
+
+if [[ "${1:-}" == "--router" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_router.py \
+        "tests/test_remote_engines.py::TestConnectRetry" "$@"
+    echo "--- client.py reconnect-backoff smoke (no server: importable"
+    echo "    + backoff path unit-exercised inline) ---"
+    "${PYENV[@]}" python - <<'EOF'
+import asyncio
+import importlib.util
+
+spec = importlib.util.spec_from_file_location("ft_client", "client.py")
+client = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(client)
+
+# The backoff classifier must honour retry_after frames...
+try:
+    client._maybe_backoff({"error": {"code": "rate_limit_error",
+                                     "message": "shed",
+                                     "retry_after": 2.5}})
+    raise SystemExit("expected Backoff")
+except client.Backoff as b:
+    assert b.retry_after == 2.5
+# ...and pass through non-capacity errors.
+client._maybe_backoff({"error": {"code": "model_error",
+                                 "message": "boom"}})
+print("client backoff classifier OK")
 EOF
     exit 0
 fi

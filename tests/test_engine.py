@@ -1118,12 +1118,30 @@ class TestSchedulerRaces:
 
     def test_deadline_expiry_vs_admission(self, seng):
         async def scenario():
+            import time
+
             a_task, a_events = await self._occupy_slot(seng, "dx-a",
                                                        "dx-sa")
+            # Deterministic expiry via the scheduler's injectable
+            # clock (the fake-clock pattern of slo.py/watchdog.py):
+            # the old version gave B a 0.2 s WALL deadline and raced
+            # it against A finishing — on a fast box A's remaining
+            # decode could complete first, B got ADMITTED, and the
+            # test flaked. Now B gets a generous deadline and we warp
+            # the scheduler's clock past it the moment B is queued:
+            # expiry beats admission regardless of decode speed. The
+            # offset is additive and PERMANENT (the fixture is
+            # class-scoped; winding the clock back would break
+            # monotonicity for the remaining tests).
+            offset = [0.0]
+            seng._sched._clock = lambda: time.monotonic() + offset[0]
             b_events: list = []
             b_task = asyncio.create_task(
                 self._consume(seng, "dx-b", "dx-sb", 4, b_events,
-                              deadline_s=0.2))
+                              deadline_s=5.0))
+            assert await self._wait_until(
+                lambda: seng.get_stats()["waiting"] >= 1)
+            offset[0] = 10.0  # past B's deadline; A still holds the slot
             # B expires in the queue (slot still held): terminal error
             # event, before it ever touched the TPU.
             await b_task

@@ -348,15 +348,35 @@ class TestKVRacesAndRestart:
         async def scenario():
             occ = await self._occupy(seng)
             p_events: list = []
+            # Deterministic expiry via the scheduler's injectable
+            # clock (same pattern as test_engine.py TestSchedulerRaces
+            # test_deadline_expiry_vs_admission): a wall-clock 0.2 s
+            # deadline raced the occupant finishing — on a fast box the
+            # follow-up got ADMITTED (and restored) instead of
+            # expiring, and this test flaked. Warp the scheduler's
+            # clock past a generous deadline once the follow-up is
+            # queued; the offset is additive and permanent (class-
+            # scoped fixture; winding back would break monotonicity).
+            offset = [0.0]
+            import time as _t
+
+            seng._sched._clock = lambda: _t.monotonic() + offset[0]
 
             async def follow_up():
                 async for ev in seng.generate(
                         "race-d", "P", MSG1,
-                        GenerationParams(max_tokens=4, deadline_s=0.2,
+                        GenerationParams(max_tokens=4, deadline_s=5.0,
                                          **GREEDY)):
                     p_events.append(ev)
 
-            await asyncio.create_task(follow_up())
+            task = asyncio.create_task(follow_up())
+            deadline = _t.monotonic() + 30.0
+            while _t.monotonic() < deadline:
+                if seng.get_stats()["waiting"] >= 1:
+                    break
+                await asyncio.sleep(0.005)
+            offset[0] = 10.0  # past the deadline; occupant holds the slot
+            await task
             assert p_events[-1]["type"] == "error"
             assert p_events[-1]["code"] == "deadline_expired"
             seng.cancel("occ")

@@ -400,3 +400,183 @@ async def test_openai_route_passthrough_preserves_tool_call_id():
         await client.close()
         eng.shutdown()
         await upstream.close()
+
+
+class TestConnectRetry:
+    """Pre-first-token retry discipline (docs/ROUTER.md satellite): a
+    connect error or 5xx BEFORE any streamed output retries with
+    bounded jittered backoff; anything after the first chunk — or any
+    4xx — surfaces immediately."""
+
+    async def _flaky_vllm(self, fail_times: int, status: int = 503):
+        calls = {"n": 0}
+        app = web.Application()
+
+        async def chat(request: web.Request) -> web.StreamResponse:
+            calls["n"] += 1
+            if calls["n"] <= fail_times:
+                return web.Response(status=status,
+                                    text="upstream restarting")
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            chunk = {"choices": [{"delta": {"content": "ok"},
+                                  "finish_reason": "stop"}]}
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        return server, calls
+
+    async def test_5xx_before_first_token_retries(self):
+        server, calls = await self._flaky_vllm(fail_times=2)
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1",
+                connect_retries=2)
+            eng.start()
+            events = []
+            async for ev in eng.generate(
+                    "r1", "s1", [{"role": "user", "content": "x"}],
+                    GenerationParams()):
+                events.append(ev)
+            assert calls["n"] == 3  # two 503s retried, third streamed
+            text = "".join(e.get("text", "") for e in events
+                           if e["type"] == "token")
+            assert text == "ok"
+            assert events[-1]["type"] == "done"
+            from fasttalk_tpu.utils.metrics import get_metrics
+            assert get_metrics().counter(
+                "remote_connect_retries_total").value >= 2
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_retries_exhausted_surfaces_with_retry_after(self):
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        server, calls = await self._flaky_vllm(fail_times=99)
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1",
+                connect_retries=1)
+            eng.start()
+            try:
+                async for _ in eng.generate(
+                        "r1", "s1", [{"role": "user", "content": "x"}],
+                        GenerationParams()):
+                    pass
+                raise AssertionError("expected LLMServiceError")
+            except LLMServiceError as e:
+                assert e.category.value == "connection_error"
+                assert e.retry_after is not None
+            assert calls["n"] == 2  # initial + 1 bounded retry
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_4xx_never_retried(self):
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        server, calls = await self._flaky_vllm(fail_times=99,
+                                               status=422)
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1",
+                connect_retries=3)
+            eng.start()
+            try:
+                async for _ in eng.generate(
+                        "r1", "s1", [{"role": "user", "content": "x"}],
+                        GenerationParams()):
+                    pass
+                raise AssertionError("expected LLMServiceError")
+            except LLMServiceError as e:
+                assert "422" in str(e)
+            assert calls["n"] == 1  # the request's fault: no retry
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_mid_stream_failure_not_retried(self):
+        """After the first chunk the retry is no longer idempotent:
+        a mid-stream drop surfaces (fleet-level failover owns it)."""
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        calls = {"n": 0}
+        app = web.Application()
+
+        async def chat(request: web.Request) -> web.StreamResponse:
+            calls["n"] += 1
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            chunk = {"choices": [{"delta": {"content": "partial"},
+                                  "finish_reason": None}]}
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            request.transport.close()  # abrupt mid-stream death
+            return resp
+
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1",
+                connect_retries=3)
+            eng.start()
+            got = []
+            try:
+                async for ev in eng.generate(
+                        "r1", "s1", [{"role": "user", "content": "x"}],
+                        GenerationParams()):
+                    got.append(ev)
+                raise AssertionError("expected LLMServiceError")
+            except LLMServiceError as e:
+                assert e.category.value == "connection_error"
+            assert calls["n"] == 1  # no retry after output started
+            assert any(e["type"] == "token" for e in got)
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_ollama_5xx_retries_pre_first_token(self):
+        calls = {"n": 0}
+        app = web.Application()
+
+        async def chat(request: web.Request) -> web.StreamResponse:
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return web.Response(status=500, text="loading model")
+            resp = web.StreamResponse()
+            await resp.prepare(request)
+            await resp.write((json.dumps(
+                {"message": {"content": "ok"}, "done": False})
+                + "\n").encode())
+            await resp.write((json.dumps(
+                {"message": {"content": ""}, "done": True,
+                 "eval_count": 1, "prompt_eval_count": 2})
+                + "\n").encode())
+            return resp
+
+        app.router.add_post("/api/chat", chat)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            eng = OllamaRemoteEngine(
+                f"http://127.0.0.1:{server.port}", "llama3.2:1b",
+                connect_retries=2)
+            eng.start()
+            events = []
+            async for ev in eng.generate(
+                    "r1", "s1", [{"role": "user", "content": "x"}],
+                    GenerationParams()):
+                events.append(ev)
+            assert calls["n"] == 2
+            assert events[-1]["type"] == "done"
+            eng.shutdown()
+        finally:
+            await server.close()

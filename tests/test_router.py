@@ -1,0 +1,760 @@
+"""Fleet-router tests (docs/ROUTER.md): registry health transitions,
+affinity, weighted placement, failover races — cancel-during-failover,
+drain-vs-new-session placement, replica death mid-prefill vs mid-decode,
+affinity across park/restore — and the serving-layer integration (the
+WS client sees a ``resumed`` frame, never an error; /fleet endpoints).
+
+All fleets here are FakeEngine-based: the races are protocol- and
+routing-level, so they run in milliseconds with no device. The
+real-two-engine fleet is exercised by ``BENCH_MODE=fleet`` (bench.py),
+which isolates each fleet in a subprocess (two warmed engines in one
+process trip a pre-existing XLA-CPU teardown crash — see bench.py
+multiturn notes).
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.engine.engine import GenerationParams
+from fasttalk_tpu.engine.fake import FakeEngine
+from fasttalk_tpu.router import (AffinityMap, FleetRouter,
+                                 PlacementPolicy, ReplicaHandle)
+from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
+                                       LLMServiceError)
+
+GREEDY = dict(temperature=0.0, top_k=1)
+
+
+class MortalEngine(FakeEngine):
+    """A FakeEngine that can die: before the first token
+    (``die_before_first`` — the mid-prefill shape) or after N tokens
+    (``die_after_tokens`` — the mid-decode shape), or externally via
+    ``kill()``. Death raises a CONNECTION-category error and flips
+    check_connection() False, exactly like a crashed engine thread."""
+
+    def __init__(self, reply: str = "alpha beta gamma delta epsilon "
+                 "zeta eta theta", delay_s: float = 0.0):
+        super().__init__(reply=reply, n_repeats=1, delay_s=delay_s)
+        self.dead = False
+        self.die_before_first = False
+        self.die_after_tokens: int | None = None
+
+    def kill(self) -> None:
+        self.dead = True
+        self._started = False
+
+    def check_connection(self) -> bool:
+        return not self.dead and super().check_connection()
+
+    async def generate(self, request_id, session_id, messages, params):
+        self.requests_seen.append({
+            "request_id": request_id, "session_id": session_id,
+            "messages": messages, "params": params,
+        })
+        if self.dead or self.die_before_first:
+            self.kill()
+            raise LLMServiceError("replica down (pre-first-token)",
+                                  category=ErrorCategory.CONNECTION)
+        words = self.reply.split(" ")
+        n = 0
+        self._active.add(request_id)
+        try:
+            for i, w in enumerate(words):
+                if self.dead:
+                    raise LLMServiceError(
+                        "replica died mid-stream",
+                        category=ErrorCategory.CONNECTION)
+                if self.die_after_tokens is not None \
+                        and n >= self.die_after_tokens:
+                    self.kill()
+                    raise LLMServiceError(
+                        "replica died mid-stream",
+                        category=ErrorCategory.CONNECTION)
+                if request_id in self._cancelled:
+                    yield {"type": "cancelled",
+                           "finish_reason": "cancelled", "stats": {}}
+                    return
+                if n >= params.max_tokens:
+                    break
+                await asyncio.sleep(self.delay_s)
+                n += 1
+                yield {"type": "token",
+                       "text": w + (" " if i < len(words) - 1 else "")}
+            yield {"type": "done", "finish_reason": "stop",
+                   "stats": {"tokens_generated": n,
+                             "processing_time_ms": 1.0,
+                             "tokens_per_second": 100.0,
+                             "ttft_ms": 1.0, "prompt_tokens": 5}}
+        finally:
+            self._active.discard(request_id)
+            self._cancelled.discard(request_id)
+
+
+def make_fleet(n=2, engine_cls=MortalEngine, clock=None, **router_kw):
+    engines = [engine_cls() for _ in range(n)]
+    handles = [ReplicaHandle(f"r{i}", e, dead_probes=1)
+               for i, e in enumerate(engines)]
+    kw = dict(probe_interval_s=0, failover_retries=2)
+    kw.update(router_kw)
+    if clock is not None:
+        kw["clock"] = clock
+        for h in handles:
+            h._clock = clock
+    router = FleetRouter(handles, **kw)
+    router.start()
+    return router, engines, handles
+
+
+async def collect(router, rid, sid, max_tokens=64, **params):
+    events = []
+    async for ev in router.generate(
+            rid, sid, [{"role": "user", "content": "hi"}],
+            GenerationParams(max_tokens=max_tokens, **GREEDY, **params)):
+        events.append(ev)
+    return events
+
+
+def text_of(events):
+    return "".join(e.get("text", "") for e in events
+                   if e["type"] == "token")
+
+
+FULL_TEXT = "alpha beta gamma delta epsilon zeta eta theta"
+
+
+class TestAffinityMap:
+    def test_ttl_expiry_with_fake_clock(self):
+        now = [0.0]
+        m = AffinityMap(ttl_s=10.0, clock=lambda: now[0])
+        m.set("s1", "r0")
+        assert m.get("s1") == "r0"
+        now[0] = 9.0
+        assert m.get("s1") == "r0"  # touch... get() does not refresh
+        now[0] = 25.0
+        assert m.get("s1") is None  # expired
+
+    def test_touch_refreshes(self):
+        now = [0.0]
+        m = AffinityMap(ttl_s=10.0, clock=lambda: now[0])
+        m.set("s1", "r0")
+        now[0] = 8.0
+        m.touch("s1")
+        now[0] = 15.0
+        assert m.get("s1") == "r0"  # refreshed at t=8, fresh until 18
+
+    def test_drop_replica_keeps_busy(self):
+        m = AffinityMap(ttl_s=100.0)
+        m.set("s1", "r0")
+        m.set("s2", "r0")
+        m.set("s3", "r1")
+        dropped = m.drop_replica("r0", keep={"s2"})
+        assert dropped == ["s1"]
+        assert m.get("s2") == "r0"
+        assert m.get("s3") == "r1"
+
+    def test_prune(self):
+        now = [0.0]
+        m = AffinityMap(ttl_s=10.0, clock=lambda: now[0])
+        m.set("s1", "r0")
+        m.set("s2", "r1")
+        now[0] = 11.0
+        assert m.prune() == 2
+        assert len(m) == 0
+
+
+class TestPlacement:
+    def test_least_loaded_wins(self):
+        policy = PlacementPolicy(AffinityMap(ttl_s=100.0))
+        h0 = ReplicaHandle("r0", FakeEngine())
+        h1 = ReplicaHandle("r1", FakeEngine())
+        h0.last_probe = {"waiting": 5, "overload_state": "healthy"}
+        h1.last_probe = {"waiting": 0, "overload_state": "healthy"}
+        h, affine = policy.place("fresh", [h0, h1])
+        assert h is h1 and not affine
+
+    def test_overload_penalty_beats_small_queue(self):
+        policy = PlacementPolicy(AffinityMap(ttl_s=100.0))
+        h0 = ReplicaHandle("r0", FakeEngine())
+        h1 = ReplicaHandle("r1", FakeEngine())
+        h0.last_probe = {"waiting": 0, "overload_state": "shedding"}
+        h1.last_probe = {"waiting": 3, "overload_state": "healthy"}
+        h, _ = policy.place("fresh", [h0, h1])
+        assert h is h1  # 3 < 0 + shedding penalty (8)
+
+    def test_tie_break_rotates(self):
+        policy = PlacementPolicy(AffinityMap(ttl_s=100.0))
+        hs = [ReplicaHandle(f"r{i}", FakeEngine()) for i in range(2)]
+        picked = {policy.place(f"s{i}", hs)[0].replica_id
+                  for i in range(4)}
+        assert picked == {"r0", "r1"}  # equal replicas share arrivals
+
+    def test_affinity_wins_over_load(self):
+        policy = PlacementPolicy(AffinityMap(ttl_s=100.0))
+        h0 = ReplicaHandle("r0", FakeEngine())
+        h1 = ReplicaHandle("r1", FakeEngine())
+        h0.last_probe = {"waiting": 50}  # heavily loaded
+        policy.affinity.set("sess", "r0")
+        h, affine = policy.place("sess", [h0, h1])
+        assert h is h0 and affine  # KV reuse beats load balance
+
+    def test_draining_and_dead_excluded(self):
+        policy = PlacementPolicy(AffinityMap(ttl_s=100.0))
+        h0 = ReplicaHandle("r0", FakeEngine())
+        h1 = ReplicaHandle("r1", FakeEngine())
+        h0.draining = True
+        h1.state = "dead"
+        h, _ = policy.place("s", [h0, h1])
+        assert h is None
+
+    def test_affinity_to_draining_replica_replaces(self):
+        policy = PlacementPolicy(AffinityMap(ttl_s=100.0))
+        h0 = ReplicaHandle("r0", FakeEngine())
+        h1 = ReplicaHandle("r1", FakeEngine())
+        policy.affinity.set("sess", "r0")
+        h0.draining = True
+        h, affine = policy.place("sess", [h0, h1])
+        assert h is h1 and not affine
+
+
+class TestRegistry:
+    def test_probe_collects_engine_signals(self):
+        router, engines, handles = make_fleet()
+        try:
+            router.probe_once()
+            p = handles[0].last_probe
+            assert p["alive"] is True
+            assert "waiting" in p and "overload_state" in p
+        finally:
+            router.shutdown()
+
+    def test_death_needs_consecutive_probes_then_recovers(self):
+        router, engines, handles = make_fleet()
+        handles[0].dead_probes = 2
+        try:
+            engines[0].kill()
+            router.probe_once()
+            assert handles[0].state != "dead"  # one failure: not yet
+            router.probe_once()
+            assert handles[0].state == "dead"
+            from fasttalk_tpu.observability.events import get_events
+            kinds = [e["kind"] for e in get_events().recent()]
+            assert "router_replica_dead" in kinds
+            # Recovery: the supervised restart brings the engine back.
+            engines[0].dead = False
+            engines[0]._started = True
+            router.probe_once()
+            assert handles[0].state == "healthy"
+        finally:
+            router.shutdown()
+
+    def test_dead_replica_affinity_dropped(self):
+        router, engines, handles = make_fleet()
+        handles[0].dead_probes = 1
+        try:
+            router.affinity.set("idle-sess", "r0")
+            engines[0].kill()
+            router.probe_once()
+            assert router.affinity.get("idle-sess") is None
+        finally:
+            router.shutdown()
+
+
+class TestFailover:
+    async def test_mid_decode_death_resumes_on_survivor(self):
+        """Replica dies mid-decode: client sees tokens, ONE resumed
+        event, then the rest of the text — no error, and the combined
+        text equals what a healthy engine would have produced."""
+        router, engines, handles = make_fleet()
+        try:
+            router.affinity.set("s1", "r0")
+            engines[0].die_after_tokens = 3
+            events = await collect(router, "q1", "s1")
+            types = [e["type"] for e in events]
+            assert "error" not in types
+            assert types.count("resumed") == 1
+            assert events[-1]["type"] == "done"
+            assert events[-1]["stats"]["resumed"] == 1
+            assert text_of(events) == FULL_TEXT
+            # The survivor replayed the transcript (re-prefill path).
+            assert len(engines[1].requests_seen) == 1
+            # Affinity moved with the resume.
+            assert router.affinity.get("s1") == "r1"
+            assert handles[0].state == "dead"
+        finally:
+            router.shutdown()
+
+    async def test_mid_prefill_death_reroutes_silently(self):
+        """Replica dies before the first token: nothing was delivered,
+        so the re-route is silent — no resumed event, full text."""
+        router, engines, handles = make_fleet()
+        try:
+            router.affinity.set("s1", "r0")
+            engines[0].die_before_first = True
+            events = await collect(router, "q1", "s1")
+            types = [e["type"] for e in events]
+            assert "error" not in types and "resumed" not in types
+            assert text_of(events) == FULL_TEXT
+            assert events[-1]["type"] == "done"
+            assert "resumed" not in events[-1]["stats"]
+        finally:
+            router.shutdown()
+
+    async def test_cancel_during_failover_terminal_cancelled(self):
+        """Cancel landing in the failover window (the stream has no
+        owning replica at that instant) still terminates promptly with
+        a cancelled event — and the survivor never sees the request."""
+        router, engines, handles = make_fleet()
+        try:
+            router.affinity.set("s1", "r0")
+            engines[0].die_after_tokens = 2
+            events = []
+            tokens = 0
+            async for ev in router.generate(
+                    "q1", "s1", [{"role": "user", "content": "hi"}],
+                    GenerationParams(max_tokens=64, **GREEDY)):
+                events.append(ev)
+                if ev["type"] == "token":
+                    tokens += 1
+                    if tokens == 2:
+                        # r0 will die raising for token 3; the cancel
+                        # is already marked when the failover path runs.
+                        router.cancel("q1")
+            assert events[-1]["type"] == "cancelled"
+            assert [e["type"] for e in events].count("resumed") == 0
+            assert len(engines[1].requests_seen) == 0
+        finally:
+            router.shutdown()
+
+    async def test_cancel_at_resumed_frame_terminal_cancelled(self):
+        """Cancel landing while the router is suspended yielding the
+        `resumed` frame (no replica owns the stream at that instant)
+        must terminate with cancelled — not run the full generation on
+        the survivor (review finding: the flag used to be consulted
+        only in the failure path)."""
+        router, engines, handles = make_fleet()
+        try:
+            router.affinity.set("s1", "r0")
+            engines[0].die_after_tokens = 2
+            events = []
+            async for ev in router.generate(
+                    "q1", "s1", [{"role": "user", "content": "hi"}],
+                    GenerationParams(max_tokens=64, **GREEDY)):
+                events.append(ev)
+                if ev["type"] == "resumed":
+                    router.cancel("q1")
+            assert events[-1]["type"] == "cancelled"
+            # No token followed the cancel: the survivor never streamed.
+            resumed_at = [e["type"] for e in events].index("resumed")
+            assert all(e["type"] != "token"
+                       for e in events[resumed_at:])
+        finally:
+            router.shutdown()
+
+    async def test_all_replicas_dead_sheds_with_retry_after(self):
+        router, engines, handles = make_fleet()
+        try:
+            for e in engines:
+                e.die_before_first = True
+            with pytest.raises(AdmissionRejected) as ei:
+                await collect(router, "q1", "s1")
+            assert ei.value.retry_after is not None
+            assert ei.value.retry_after >= 1
+        finally:
+            router.shutdown()
+
+    async def test_mid_stream_retries_exhausted_is_error(self):
+        """Every replica dies mid-stream: after the retry budget the
+        client gets a terminal error (not a hang, not a bare raise)."""
+        router, engines, handles = make_fleet(failover_retries=1)
+        try:
+            engines[0].die_after_tokens = 2
+            engines[1].die_after_tokens = 2
+            router.affinity.set("s1", "r0")
+            events = await collect(router, "q1", "s1")
+            assert events[-1]["type"] == "error"
+            assert events[-1]["code"] == "replica_failed"
+        finally:
+            router.shutdown()
+
+    async def test_resume_disabled_surfaces_error(self):
+        router, engines, handles = make_fleet(resume=False)
+        try:
+            router.affinity.set("s1", "r0")
+            engines[0].die_after_tokens = 2
+            events = await collect(router, "q1", "s1")
+            assert events[-1]["type"] == "error"
+            assert "resumed" not in [e["type"] for e in events]
+        finally:
+            router.shutdown()
+
+    async def test_replica_shed_tries_next_replica(self):
+        """AdmissionRejected from one replica's queue re-routes a fresh
+        request instead of surfacing the shed."""
+        class SheddingEngine(MortalEngine):
+            async def generate(self, rid, sid, messages, params):
+                raise AdmissionRejected("queue full", retry_after=3.0)
+                yield  # pragma: no cover
+
+        shed = SheddingEngine()
+        ok = MortalEngine()
+        handles = [ReplicaHandle("shed", shed), ReplicaHandle("ok", ok)]
+        router = FleetRouter(handles, probe_interval_s=0)
+        router.start()
+        try:
+            router.affinity.set("s1", "shed")
+            events = await collect(router, "q1", "s1")
+            assert events[-1]["type"] == "done"
+            assert text_of(events) == FULL_TEXT
+        finally:
+            router.shutdown()
+
+    async def test_request_shape_errors_propagate_not_failover(self):
+        """A VALIDATION error is the request's fault: the router must
+        NOT burn a healthy replica or retry it elsewhere."""
+        class PickyEngine(MortalEngine):
+            async def generate(self, rid, sid, messages, params):
+                raise LLMServiceError(
+                    "prompt too long",
+                    category=ErrorCategory.VALIDATION,
+                    recoverable=False)
+                yield  # pragma: no cover
+
+        picky = PickyEngine()
+        other = MortalEngine()
+        handles = [ReplicaHandle("p", picky), ReplicaHandle("o", other)]
+        router = FleetRouter(handles, probe_interval_s=0)
+        router.start()
+        try:
+            router.affinity.set("s1", "p")
+            with pytest.raises(LLMServiceError) as ei:
+                await collect(router, "q1", "s1")
+            assert ei.value.category == ErrorCategory.VALIDATION
+            assert len(other.requests_seen) == 0
+            assert handles[0].state == "healthy"
+        finally:
+            router.shutdown()
+
+
+class TestDrain:
+    async def test_drain_vs_new_session_placement(self):
+        """Draining a replica stops NEW placements there immediately,
+        while a stream already running on it finishes in place."""
+        router, engines, handles = make_fleet()
+        engines[0].delay_s = 0.01
+        try:
+            router.affinity.set("s-busy", "r0")
+            busy_events = []
+            busy = asyncio.create_task(
+                self._run(router, "q-busy", "s-busy", busy_events))
+            # Wait for the busy stream to start on r0.
+            for _ in range(200):
+                if any(e["type"] == "token" for e in busy_events):
+                    break
+                await asyncio.sleep(0.005)
+            summary = router.drain_replica("r0")
+            assert summary["draining"] is True
+            assert "s-busy" in summary["busy_sessions"]
+            # New session places on the survivor...
+            new_events = await collect(router, "q-new", "s-new")
+            assert new_events[-1]["type"] == "done"
+            assert len(engines[1].requests_seen) == 1
+            assert router.affinity.get("s-new") == "r1"
+            # ...while the busy stream finishes on r0, un-failed.
+            await busy
+            assert busy_events[-1]["type"] == "done"
+            assert "resumed" not in [e["type"] for e in busy_events]
+        finally:
+            router.shutdown()
+
+    @staticmethod
+    async def _run(router, rid, sid, sink):
+        async for ev in router.generate(
+                rid, sid, [{"role": "user", "content": "hi"}],
+                GenerationParams(max_tokens=64, **GREEDY)):
+            sink.append(ev)
+
+    async def test_drain_migrates_idle_parked_sessions(self):
+        """Idle sessions pinned to the drained replica lose their pin
+        (next turn places fresh elsewhere) and their parked KV there is
+        released; the fleet keeps serving."""
+        router, engines, handles = make_fleet()
+        try:
+            router.affinity.set("s-idle", "r0")
+            summary = router.drain_replica("r0")
+            assert summary["migrated_sessions"] == 1
+            assert router.affinity.get("s-idle") is None
+            assert "s-idle" in engines[0].released_sessions
+            events = await collect(router, "q2", "s-idle")
+            assert events[-1]["type"] == "done"
+            assert len(engines[1].requests_seen) == 1
+        finally:
+            router.shutdown()
+
+    async def test_fleet_drain_sheds_new(self):
+        router, engines, handles = make_fleet()
+        try:
+            router.begin_drain()
+            with pytest.raises(AdmissionRejected) as ei:
+                await collect(router, "q1", "s1")
+            assert ei.value.retry_after is not None
+        finally:
+            router.shutdown()
+
+
+class TestAffinityAcrossParkRestore:
+    async def test_affinity_survives_idle_gap_inside_ttl(self):
+        """A session that goes idle (its KV parked server-side) and
+        returns inside the affinity TTL lands on the SAME replica, so
+        the engine-level restore path can pay off."""
+        now = [0.0]
+        router, engines, handles = make_fleet(clock=lambda: now[0],
+                                              affinity_ttl_s=600.0)
+        try:
+            await collect(router, "q1", "park-sess")
+            first = [len(e.requests_seen) for e in engines].index(1)
+            hits0 = router._m_affinity_hits.value
+            now[0] = 500.0  # long idle park, still inside the TTL
+            await collect(router, "q2", "park-sess")
+            assert len(engines[first].requests_seen) == 2
+            assert router._m_affinity_hits.value == hits0 + 1
+        finally:
+            router.shutdown()
+
+    async def test_affinity_expires_with_park_ttl(self):
+        now = [0.0]
+        router, engines, handles = make_fleet(clock=lambda: now[0],
+                                              affinity_ttl_s=600.0)
+        try:
+            await collect(router, "q1", "park-sess")
+            hits0 = router._m_affinity_hits.value
+            now[0] = 700.0  # parked KV long gone; nothing to stick to
+            await collect(router, "q2", "park-sess")
+            assert router._m_affinity_hits.value == hits0  # re-placed
+        finally:
+            router.shutdown()
+
+    async def test_release_session_drops_pin_everywhere(self):
+        router, engines, handles = make_fleet()
+        try:
+            await collect(router, "q1", "s1")
+            assert router.affinity.get("s1") is not None
+            router.release_session("s1")
+            assert router.affinity.get("s1") is None
+            for e in engines:
+                assert "s1" in e.released_sessions
+        finally:
+            router.shutdown()
+
+
+def make_config(**env):
+    import os
+
+    from fasttalk_tpu.utils.config import Config
+    old = {}
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    try:
+        return Config()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+async def recv_json(ws):
+    msg = await asyncio.wait_for(ws.receive(), timeout=10)
+    return json.loads(msg.data)
+
+
+class TestRouterServing:
+    """The acceptance integration: a 2-replica fleet behind the REAL
+    WebSocket server; killing one replica mid-stream resumes every
+    affected session on the survivor with no client-visible error."""
+
+    async def _setup(self, **router_kw):
+        from fasttalk_tpu.serving.server import WebSocketLLMServer
+
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false")
+        router, engines, handles = make_fleet(**router_kw)
+        server = WebSocketLLMServer(config, router)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        return router, engines, handles, server, client
+
+    async def _open_session(self, client):
+        ws = await client.ws_connect("/ws/llm")
+        started = await recv_json(ws)
+        assert started["type"] == "session_started"
+        await ws.send_json({"type": "start_session", "config": {}})
+        configured = await recv_json(ws)
+        assert configured["type"] == "session_configured"
+        return ws
+
+    async def test_two_sessions_affine_and_failover_resumes_all(self):
+        router, engines, handles, server, client = await self._setup()
+        for e in engines:
+            e.delay_s = 0.01
+        try:
+            # Pin both sessions to r0: r1 drains during placement.
+            handles[1].draining = True
+            ws1 = await self._open_session(client)
+            ws2 = await self._open_session(client)
+            await ws1.send_json({"type": "user_message", "text": "one"})
+            await ws2.send_json({"type": "user_message", "text": "two"})
+
+            async def pump(ws, sink):
+                while True:
+                    msg = await recv_json(ws)
+                    sink.append(msg)
+                    if msg["type"] in ("response_complete", "error"):
+                        return
+
+            f1, f2 = [], []
+            t1 = asyncio.ensure_future(pump(ws1, f1))
+            t2 = asyncio.ensure_future(pump(ws2, f2))
+            # Both streams live on r0 — now open r1 and kill r0.
+            for _ in range(400):
+                if any(m["type"] == "token" for m in f1) \
+                        and any(m["type"] == "token" for m in f2):
+                    break
+                await asyncio.sleep(0.005)
+            handles[1].draining = False
+            engines[0].kill()
+            await asyncio.gather(t1, t2)
+            for frames in (f1, f2):
+                types = [m["type"] for m in frames]
+                assert "error" not in types, frames[-1]
+                assert types.count("resumed") == 1
+                assert types[-1] == "response_complete"
+            # Every affected session resumed on the survivor.
+            assert len(engines[1].requests_seen) == 2
+            for ws in (ws1, ws2):
+                await ws.send_json({"type": "end_session"})
+                ended = await recv_json(ws)
+                assert ended["type"] == "session_ended"
+        finally:
+            await client.close()
+            router.shutdown()
+
+    async def test_session_affinity_across_turns(self):
+        router, engines, handles, server, client = await self._setup()
+        try:
+            ws = await self._open_session(client)
+            for turn in range(2):
+                await ws.send_json({"type": "user_message",
+                                    "text": f"turn {turn}"})
+                while True:
+                    msg = await recv_json(ws)
+                    if msg["type"] == "response_complete":
+                        break
+                    assert msg["type"] != "error", msg
+            seen = [len(e.requests_seen) for e in engines]
+            assert sorted(seen) == [0, 2]  # both turns, one replica
+        finally:
+            await client.close()
+            router.shutdown()
+
+    async def test_fleet_endpoint_and_drain(self):
+        router, engines, handles, server, client = await self._setup()
+        try:
+            resp = await client.get("/fleet")
+            assert resp.status == 200
+            body = await resp.json()
+            assert len(body["replicas"]) == 2
+            assert {r["replica_id"] for r in body["replicas"]} \
+                == {"r0", "r1"}
+            assert all(r["state"] == "healthy"
+                       for r in body["replicas"])
+            resp = await client.post("/fleet/drain/r0")
+            assert resp.status == 200
+            assert (await resp.json())["draining"] is True
+            body = await (await client.get("/fleet")).json()
+            drained = {r["replica_id"]: r for r in body["replicas"]}
+            assert drained["r0"]["draining"] is True
+            resp = await client.post("/fleet/drain/nope")
+            assert resp.status == 404
+        finally:
+            await client.close()
+            router.shutdown()
+
+    async def test_health_shows_fleet_and_degrades_on_death(self):
+        router, engines, handles, server, client = await self._setup()
+        try:
+            body = await (await client.get("/health")).json()
+            assert body["fleet"]["replicas"] == 2
+            assert body["fleet"]["available"] == 2
+            engines[0].kill()
+            router.probe_once()
+            resp = await client.get("/health")
+            assert resp.status == 200  # still serving via the survivor
+            body = await resp.json()
+            assert body["fleet"]["available"] == 1
+            assert body["status"] == "degraded"
+        finally:
+            await client.close()
+            router.shutdown()
+
+    async def test_router_metrics_exposed(self):
+        router, engines, handles, server, client = await self._setup()
+        try:
+            router.affinity.set("s1", "r0")
+            engines[0].die_after_tokens = 2
+            events = await collect(router, "q1", "s1")
+            assert events[-1]["type"] == "done"
+            from fasttalk_tpu.utils.metrics import get_metrics
+            text = get_metrics().prometheus()
+            for name in ("router_replicas", "router_failovers_total",
+                         "router_resumes_total",
+                         "router_placements_total"):
+                assert name in text
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "check_prometheus", "scripts/check_prometheus.py")
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            assert not mod.validate(text)
+        finally:
+            await client.close()
+            router.shutdown()
+
+
+class TestRouterConfig:
+    def test_knobs_validated(self):
+        from fasttalk_tpu.utils.config import Config
+        with pytest.raises(ValueError, match="router_affinity_ttl_s"):
+            Config(llm_provider="fake", router_affinity_ttl_s=0)
+        with pytest.raises(ValueError, match="router_dead_probes"):
+            Config(llm_provider="fake", router_dead_probes=0)
+        with pytest.raises(ValueError, match="at least one replica"):
+            Config(llm_provider="fake", router_enabled=True,
+                   fleet_replicas=0)
+        with pytest.raises(ValueError, match="incompatible"):
+            Config(llm_provider="fake", router_enabled=True,
+                   spmd_role="leader")
+
+    def test_build_fleet_from_config(self):
+        from fasttalk_tpu.router import build_fleet
+        from fasttalk_tpu.utils.config import Config
+        cfg = Config(llm_provider="fake", router_enabled=True,
+                     fleet_replicas=2, router_probe_interval_s=0)
+        router = build_fleet(cfg)
+        assert len(router.replicas) == 2
+        assert router.replicas[0].replica_id == "inproc-0"
+
+    def test_remote_backends_parsed(self):
+        from fasttalk_tpu.router import build_fleet
+        from fasttalk_tpu.utils.config import Config
+        cfg = Config(llm_provider="fake", router_enabled=True,
+                     fleet_replicas=1,
+                     router_backends="http://a:8000, http://b:8000")
+        router = build_fleet(cfg)
+        ids = [h.replica_id for h in router.replicas]
+        assert ids == ["inproc-0", "remote-0", "remote-1"]
+        assert router.replicas[1].base_url == "http://a:8000"
