@@ -285,7 +285,9 @@ class TPUEngine(EngineBase):
                  kv_host_budget_mb: float | None = None,
                  kv_park_ttl_s: float | None = None,
                  kv_park_idle_s: float | None = None,
-                 kv_restore_min_tokens: int | None = None):
+                 kv_restore_min_tokens: int | None = None,
+                 kv_quant: str = "none",
+                 kv_quant_granule: str = "token"):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -305,6 +307,50 @@ class TPUEngine(EngineBase):
         # int8-matmul kernels gate independently.
         self.use_pallas_attention = use_pallas_attention and mesh is None
         self.use_pallas_int8 = use_pallas_int8 and mesh is None
+        # Int8 KV-cache tier (ops/kv_quant.py, docs/KVCACHE.md): the
+        # cache stores int8 rows + per-row float32 scales; every KV
+        # touchpoint (decode scatter, the prefill paths, prefix copy,
+        # host park/restore) moves the quantized domain, halving
+        # resident HBM, attention-read bandwidth and offload copy
+        # bytes. The compatibility matrix is EXPLICIT — unsupported
+        # combinations raise here (and at Config validation with the
+        # same reasons) rather than silently degrading:
+        # - mesh: the scale arrays do not shard with the kv axis yet;
+        # - Pallas decode attention: the kernel streams raw cache rows
+        #   (the quantized tier is the XLA dequant path first);
+        # - speculative decoding: verify-block quantize-on-write is
+        #   unvalidated.
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be 'none' or 'int8', "
+                             f"got {kv_quant!r}")
+        self.kv_quant = kv_quant == "int8"
+        if self.kv_quant:
+            from fasttalk_tpu.ops.kv_quant import granule_dim
+
+            if mesh is not None:
+                raise ValueError(
+                    "KV_QUANT=int8 is single-device only: the per-row "
+                    "scale arrays do not shard with the kv axis yet")
+            if self.use_pallas_attention:
+                raise ValueError(
+                    "KV_QUANT=int8 is incompatible with the Pallas "
+                    "decode-attention kernel (it streams raw cache "
+                    "rows; the quantized tier dequantizes in the XLA "
+                    "attention read) — set TPU_USE_PALLAS_ATTENTION="
+                    "false")
+            if spec_decode in ("ngram", "auto"):
+                raise ValueError(
+                    "KV_QUANT=int8 is incompatible with speculative "
+                    "decoding (the verify block's quantize-on-write "
+                    "is unvalidated) — set TPU_SPEC_DECODE=off")
+            self.kv_scale_granule = granule_dim(kv_quant_granule,
+                                                model_cfg.num_kv_heads)
+        else:
+            self.kv_scale_granule = 0
+        # Extra _note_compile attrs for cache-touching programs: the
+        # quantized tier's executables get their own ledger keys, the
+        # bf16 tier's keys stay byte-identical to before.
+        self._kvq_attrs = {"kv_quant": "int8"} if self.kv_quant else {}
         # Single-device decode uses models.llama.forward_decode: the
         # whole cache rides the step scan's CARRY (carries alias inside
         # a program), each step scatter-writes only the new K/V column,
@@ -545,15 +591,27 @@ class TPUEngine(EngineBase):
         self._tracer = get_tracer()
         # Attribution ledger (observability/perf.py): binds the served
         # model's FLOP cost estimate so step records can carry per-call
-        # FLOPs and /perf can report achieved-vs-peak MFU.
+        # FLOPs and /perf can report achieved-vs-peak MFU. The KV
+        # element size feeds the ledger's FLOP/byte and KV-bandwidth
+        # figures honestly — int8 rows + scales, never an assumed bf16.
+        # Bytes one decode step reads per (slot, position) row across
+        # all layers: k+v rows, plus the scale rows when quantized.
+        kv_elt = 1 if self.kv_quant else jnp.dtype(dtype).itemsize
+        self._kv_row_bytes = 2 * model_cfg.num_layers * (
+            model_cfg.num_kv_heads * model_cfg.head_dim * kv_elt
+            + self.kv_scale_granule * 4)
         self._perf = get_perf()
         self._perf.bind_model(model_cfg, num_slots,
-                              jnp.dtype(dtype).name)
+                              jnp.dtype(dtype).name,
+                              kv_quant=kv_quant,
+                              kv_row_bytes=self._kv_row_bytes)
 
     def _make_cache(self) -> KVCache:
         if self.mesh is None:
             return init_cache(self.cfg, self.num_slots, self.max_len,
-                              self.dtype)
+                              self.dtype, quantized=self.kv_quant,
+                              scale_granule=max(1,
+                                                self.kv_scale_granule))
         from jax.sharding import NamedSharding
 
         from fasttalk_tpu.parallel.sharding import cache_pspecs
@@ -888,14 +946,27 @@ class TPUEngine(EngineBase):
             # which nothing has claimed yet (kv_written stays 0).
             b = 16
             while True:
-                k_rows, v_rows = self._get_kv_slice_fn(b)(
+                # Slice returns (k, v) — or (k, v, k_scale, v_scale)
+                # on the quantized tier — in exactly the restore fn's
+                # argument order, so the round trip is layout-agnostic.
+                rows = self._get_kv_slice_fn(b)(
                     self.cache, np.int32(0))
                 self.cache = self._get_kv_restore_fn(b)(
-                    self.cache, k_rows, v_rows, np.int32(0))
+                    self.cache, *rows, np.int32(0))
                 jax.block_until_ready(self.cache.k)
                 if b >= self.max_len:
                     break
                 b = min(b * 2, self.max_len)
+        if self.shared_prefix:
+            # Shared-prefix stamp programs at the common granules (the
+            # quantized tier's variants copy rows + scales): a cold
+            # fleet burst's first admission should not pay this compile
+            # on the TTFT path. Src == dst == slot 0 (unclaimed at
+            # warmup; kv_written stays 0, so nothing trusts the rows).
+            for plen in {g for g in (64, 256) if g <= self.max_len}:
+                self.cache = self._get_prefix_copy_fn(plen)(
+                    self.cache, np.int32(0), np.int32(0))
+            jax.block_until_ready(self.cache.k)
         jax.block_until_ready(self.cache.k)
         # Warm every fetch worker's first device→host copy: on relayed
         # attach paths a thread's FIRST fetch pays one-time client
@@ -1118,6 +1189,7 @@ class TPUEngine(EngineBase):
             "context_window": self.usable_len,
             "decode_slots": self.num_slots,
             "dtype": jnp.dtype(self.dtype).name,
+            "kv_quant": "int8" if self.kv_quant else "none",
             "devices": [str(d) for d in jax.devices()],
             "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
         }
@@ -1128,6 +1200,7 @@ class TPUEngine(EngineBase):
             "waiting": len(self._sched),
             "scheduler": self._sched.stats(),
             "running": len(self._running),
+            "kv_quant": "int8" if self.kv_quant else "none",
             "kv_host": {**self._kv_pool.stats(),
                         "policy": self._kv_policy.stats()},
         }
@@ -1206,7 +1279,8 @@ class TPUEngine(EngineBase):
         fn = self._decode_fns.get((kv_len, steps, with_history))
         if fn is not None:
             return fn
-        self._note_compile("decode", kv_len=kv_len, steps=steps)
+        self._note_compile("decode", kv_len=kv_len, steps=steps,
+                           **self._kvq_attrs)
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
         scatter = self._scatter_decode and not use_pallas
         rows = jnp.arange(self.num_slots)
@@ -1234,7 +1308,7 @@ class TPUEngine(EngineBase):
                                  cur_tokens, positions, active, temps,
                                  topks, topps, reps, press, freqs, rng):
                 def step(carry, _):
-                    ck, cv, hist, cnt, cur, pos, key = carry
+                    ck, cv, ks, vs, hist, cnt, cur, pos, key = carry
                     key, sub = jax.random.split(key)
                     act = jnp.logical_and(active, pos < kv_len)
                     wp = jnp.where(act, pos, max_len)
@@ -1243,7 +1317,8 @@ class TPUEngine(EngineBase):
                     cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
                                                 unique_indices=True)
                     logits, newc = forward_decode(
-                        params, self.cfg, cur, pos, KVCache(ck, cv), act,
+                        params, self.cfg, cur, pos,
+                        KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
                         pallas_int8=self.use_pallas_int8)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
@@ -1251,12 +1326,17 @@ class TPUEngine(EngineBase):
                     nxt = sample_tokens(lg, sub, temps, topks, topps,
                                         method=self.sampling_method)
                     pos = pos + act.astype(pos.dtype)
-                    return (newc.k, newc.v, hist, cnt, nxt, pos, key), nxt
+                    return (newc.k, newc.v, newc.k_scale, newc.v_scale,
+                            hist, cnt, nxt, pos, key), nxt
 
-                (ck, cv, hist, cnt, cur, pos, rng), toks = jax.lax.scan(
-                    step, (cache.k, cache.v, history, counts, cur_tokens,
-                           positions, rng), None, length=steps)
-                return KVCache(ck, cv), hist, cnt, toks, cur, pos, rng
+                (ck, cv, ks, vs, hist, cnt, cur, pos, rng), toks = \
+                    jax.lax.scan(
+                        step, (cache.k, cache.v, cache.k_scale,
+                               cache.v_scale, history, counts,
+                               cur_tokens, positions, rng), None,
+                        length=steps)
+                return KVCache(ck, cv, ks, vs), hist, cnt, toks, cur, \
+                    pos, rng
 
             self._decode_fns[(kv_len, steps, with_history)] = \
                 decode_call_hist
@@ -1268,7 +1348,7 @@ class TPUEngine(EngineBase):
                         reps, press, freqs, rng):
             if scatter:
                 def step(carry, _):
-                    ck, cv, cnt, cur, pos, key = carry
+                    ck, cv, ks, vs, cnt, cur, pos, key = carry
                     key, sub = jax.random.split(key)
                     # A slot that finished mid-pipeline keeps "decoding"
                     # until the host reconciles; clamp it off the
@@ -1281,7 +1361,8 @@ class TPUEngine(EngineBase):
                     cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
                                                 unique_indices=True)
                     logits, newc = forward_decode(
-                        params, self.cfg, cur, pos, KVCache(ck, cv), act,
+                        params, self.cfg, cur, pos,
+                        KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
                         pallas_int8=self.use_pallas_int8)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
@@ -1289,12 +1370,15 @@ class TPUEngine(EngineBase):
                     nxt = sample_tokens(lg, sub, temps, topks, topps,
                                         method=self.sampling_method)
                     pos = pos + act.astype(pos.dtype)
-                    return (newc.k, newc.v, cnt, nxt, pos, key), nxt
+                    return (newc.k, newc.v, newc.k_scale, newc.v_scale,
+                            cnt, nxt, pos, key), nxt
 
-                (ck, cv, cnt, cur, pos, rng), toks = jax.lax.scan(
-                    step, (cache.k, cache.v, counts, cur_tokens,
-                           positions, rng), None, length=steps)
-                return KVCache(ck, cv), cnt, toks, cur, pos, rng
+                (ck, cv, ks, vs, cnt, cur, pos, rng), toks = \
+                    jax.lax.scan(
+                        step, (cache.k, cache.v, cache.k_scale,
+                               cache.v_scale, counts, cur_tokens,
+                               positions, rng), None, length=steps)
+                return KVCache(ck, cv, ks, vs), cnt, toks, cur, pos, rng
 
             ck = jax.lax.slice_in_dim(cache.k, 0, kv_len, axis=2)
             cv = jax.lax.slice_in_dim(cache.v, 0, kv_len, axis=2)
@@ -1516,16 +1600,32 @@ class TPUEngine(EngineBase):
             return fn
         shape = (self.cfg.num_layers, 1, plen, self.cfg.num_kv_heads,
                  self.cfg.head_dim)
+        # Quantized tier: the stamp copies int8 rows + their scale rows
+        # — half the HBM traffic of the bf16 stamp, same ordering
+        # guarantees (donated-cache chain).
+        kvq = self.kv_quant
+        sshape = (self.cfg.num_layers, 1, plen, self.kv_scale_granule)
 
         @partial(jax.jit, donate_argnums=(0,))
         def prefix_copy(cache: KVCache, src, dst):
             rk = jax.lax.dynamic_slice(cache.k, (0, src, 0, 0, 0), shape)
             rv = jax.lax.dynamic_slice(cache.v, (0, src, 0, 0, 0), shape)
+            new_k = jax.lax.dynamic_update_slice(cache.k, rk,
+                                                 (0, dst, 0, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(cache.v, rv,
+                                                 (0, dst, 0, 0, 0))
+            if not kvq:
+                return KVCache(new_k, new_v)
+            rks = jax.lax.dynamic_slice(cache.k_scale, (0, src, 0, 0),
+                                        sshape)
+            rvs = jax.lax.dynamic_slice(cache.v_scale, (0, src, 0, 0),
+                                        sshape)
             return KVCache(
-                jax.lax.dynamic_update_slice(cache.k, rk,
-                                             (0, dst, 0, 0, 0)),
-                jax.lax.dynamic_update_slice(cache.v, rv,
-                                             (0, dst, 0, 0, 0)))
+                new_k, new_v,
+                jax.lax.dynamic_update_slice(cache.k_scale, rks,
+                                             (0, dst, 0, 0)),
+                jax.lax.dynamic_update_slice(cache.v_scale, rvs,
+                                             (0, dst, 0, 0)))
 
         self._prefill_fns[key] = prefix_copy
         return prefix_copy
@@ -1539,8 +1639,10 @@ class TPUEngine(EngineBase):
         key = ("kvslice", bucket)
         fn = self._prefill_fns.get(key)
         if fn is None:
-            self._note_compile("kv_offload", bucket=bucket)
-            fn = make_kv_slice_fn(self.cfg, bucket)
+            self._note_compile("kv_offload", bucket=bucket,
+                               **self._kvq_attrs)
+            fn = make_kv_slice_fn(self.cfg, bucket,
+                                  self.kv_scale_granule)
             self._prefill_fns[key] = fn
         return fn
 
@@ -1550,8 +1652,10 @@ class TPUEngine(EngineBase):
         key = ("kvrestore", bucket)
         fn = self._prefill_fns.get(key)
         if fn is None:
-            self._note_compile("kv_restore", bucket=bucket)
-            fn = make_kv_restore_fn(self.cfg, bucket, KVCache)
+            self._note_compile("kv_restore", bucket=bucket,
+                               **self._kvq_attrs)
+            fn = make_kv_restore_fn(self.cfg, bucket, KVCache,
+                                    self.kv_scale_granule)
             self._prefill_fns[key] = fn
         return fn
 
@@ -1575,10 +1679,16 @@ class TPUEngine(EngineBase):
     def _park_slot(self, slot: Slot, kept: int) -> None:
         bucket = kv_bucket(kept, self.max_len)
         t0 = time.monotonic()
-        k_rows, v_rows = self._get_kv_slice_fn(bucket)(
+        out = self._get_kv_slice_fn(bucket)(
             self.cache, np.int32(slot.index))
+        # Quantized tier: the slice carries int8 rows + scale rows;
+        # the pool entry's nbytes (and therefore the budget, the
+        # kv_host_bytes gauge and the copy-bandwidth EMA) see the
+        # honest quantized footprint.
+        scales = (out[2], out[3]) if self.kv_quant else None
         self._kv_offload.park(slot.session_id, list(slot.tokens[:kept]),
-                              kept, bucket, k_rows, v_rows, t0)
+                              kept, bucket, out[0], out[1], t0,
+                              scales=scales)
 
     def _try_restore(self, req: _Request, slot: Slot,
                      prompt: list[int]) -> int:
@@ -1601,13 +1711,31 @@ class TPUEngine(EngineBase):
         if not self._kv_policy.should_restore(match, entry.nbytes):
             self._kv_pool.note_lookup(False)
             return 0  # entry stays parked for a later, longer match
+        if self.kv_quant and entry.k_scale is None:
+            # A bf16-era entry cannot restore into the quantized cache
+            # (unreachable within one engine lifetime — the pool is
+            # engine-owned — but never corrupt KV over an assumption).
+            self._kv_pool.note_lookup(False)
+            return 0
         t0 = time.monotonic()
         fn = self._get_kv_restore_fn(entry.bucket)
         k_arg, v_arg = entry.k_dev, entry.v_dev
         prestaged = k_arg is not None and v_arg is not None
         if not prestaged:  # prestage didn't land
             k_arg, v_arg = self._arg(entry.k), self._arg(entry.v)
-        self.cache = fn(self.cache, k_arg, v_arg, np.int32(slot.index))
+        if self.kv_quant:
+            # Scales ride with their rows (prestaged before k_dev/v_dev
+            # on the copy thread, so prestaged rows imply staged
+            # scales).
+            ks_arg, vs_arg = entry.k_scale_dev, entry.v_scale_dev
+            if not prestaged or ks_arg is None or vs_arg is None:
+                ks_arg = self._arg(entry.k_scale)
+                vs_arg = self._arg(entry.v_scale)
+            self.cache = fn(self.cache, k_arg, v_arg, ks_arg, vs_arg,
+                            np.int32(slot.index))
+        else:
+            self.cache = fn(self.cache, k_arg, v_arg,
+                            np.int32(slot.index))
         dt = time.monotonic() - t0
         slot.tokens = list(entry.tokens[:match])
         slot.kv_written = match
@@ -1670,7 +1798,10 @@ class TPUEngine(EngineBase):
         fn = self._prefill_fns.get(chunk)
         if fn is not None:
             return fn
-        self._note_compile("prefill", chunk=chunk)
+        self._note_compile("prefill", chunk=chunk, **self._kvq_attrs)
+        kvq = self.kv_quant
+        sslot_shape = (self.cfg.num_layers, 1, self.max_len,
+                       self.kv_scale_granule)
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: KVCache, tokens, start, slot,
@@ -1680,16 +1811,32 @@ class TPUEngine(EngineBase):
                           self.cfg.num_kv_heads, self.cfg.head_dim)
             lk = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0), slot_shape)
             lv = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0), slot_shape)
+            if kvq:
+                lks = jax.lax.dynamic_slice(cache.k_scale,
+                                            (0, slot, 0, 0), sslot_shape)
+                lvs = jax.lax.dynamic_slice(cache.v_scale,
+                                            (0, slot, 0, 0), sslot_shape)
+                small = KVCache(lk, lv, lks, lvs)
+            else:
+                small = KVCache(lk, lv)
             positions = start + jnp.arange(chunk)[None, :]
             logits, updated = forward(
                 params, self.cfg, tokens[None, :], positions,
-                KVCache(lk, lv), start[None], blockwise=True,
+                small, start[None], blockwise=True,
                 pallas_int8=self.use_pallas_int8,
                 logits_indices=last_index[None])
             new_k = jax.lax.dynamic_update_slice(
                 cache.k, updated.k, (0, slot, 0, 0, 0))
             new_v = jax.lax.dynamic_update_slice(
                 cache.v, updated.v, (0, slot, 0, 0, 0))
+            if kvq:
+                return KVCache(
+                    new_k, new_v,
+                    jax.lax.dynamic_update_slice(
+                        cache.k_scale, updated.k_scale, (0, slot, 0, 0)),
+                    jax.lax.dynamic_update_slice(
+                        cache.v_scale, updated.v_scale,
+                        (0, slot, 0, 0))), logits[0, 0]
             return KVCache(new_k, new_v), logits[0, 0]
 
         self._prefill_fns[chunk] = prefill_step
@@ -1783,8 +1930,9 @@ class TPUEngine(EngineBase):
         if fn is not None:
             return fn
         self._note_compile("batched_prefill", chunk=chunk, group=group,
-                           ctx=ctx)
+                           ctx=ctx, **self._kvq_attrs)
         replicate = self._replicate_sharding()
+        kvq = self.kv_quant
 
         @partial(jax.jit, donate_argnums=(1,))
         def batched_prefill(params, cache: KVCache, tokens, rowcfg,
@@ -1798,9 +1946,15 @@ class TPUEngine(EngineBase):
                                    rowcfg[:, 6])
             gk = cache.k[:, slot_idx, :ctx]  # [L, group, ctx, Kv, H]
             gv = cache.v[:, slot_idx, :ctx]
+            if kvq:
+                small = KVCache(gk, gv,
+                                cache.k_scale[:, slot_idx, :ctx],
+                                cache.v_scale[:, slot_idx, :ctx])
+            else:
+                small = KVCache(gk, gv)
             positions = starts[:, None] + jnp.arange(chunk)[None, :]
             logits, upd = forward(
-                params, self.cfg, tokens, positions, KVCache(gk, gv),
+                params, self.cfg, tokens, positions, small,
                 starts, blockwise=True, write_mask=mask,
                 pallas_int8=self.use_pallas_int8,
                 logits_indices=last_idx)
@@ -1808,6 +1962,12 @@ class TPUEngine(EngineBase):
                 upd.k, mode="drop", unique_indices=True)
             new_v = cache.v.at[:, slot_idx, :ctx].set(
                 upd.v, mode="drop", unique_indices=True)
+            new_ks = new_vs = None
+            if kvq:
+                new_ks = cache.k_scale.at[:, slot_idx, :ctx].set(
+                    upd.k_scale, mode="drop", unique_indices=True)
+                new_vs = cache.v_scale.at[:, slot_idx, :ctx].set(
+                    upd.v_scale, mode="drop", unique_indices=True)
             # First-token sampling fused into the same call: one device
             # round-trip per burst instead of two (TTFT-critical).
             rng, sub = jax.random.split(rng)
@@ -1818,7 +1978,8 @@ class TPUEngine(EngineBase):
             if replicate is not None:  # host-fetched on every DCN host
                 firsts = jax.lax.with_sharding_constraint(firsts,
                                                           replicate)
-            return KVCache(new_k, new_v), firsts, new_cur, rng
+            return KVCache(new_k, new_v, new_ks, new_vs), firsts, \
+                new_cur, rng
 
         self._prefill_fns[key] = batched_prefill
         return batched_prefill
@@ -2843,12 +3004,19 @@ class TPUEngine(EngineBase):
             occupancy = round(len(snapshot) / max(1, self.num_slots), 3)
             rows = int(res.shape[0]) * self.num_slots \
                 * (res.shape[2] - 1 if spec else 1)
+            # kv_bytes: what this call's attention streamed from HBM —
+            # every step reads kv_len rows for all S slots, at the
+            # cache's HONEST element size (int8 rows + scales under
+            # KV_QUANT=int8, not an assumed bf16). Feeds the ledger's
+            # KV-bandwidth-utilisation figure next to MFU.
             self._tracer.step(
                 "engine_step", t_disp, t1, steps=int(res.shape[0]),
                 batch=len(snapshot), slots=self.num_slots,
                 occupancy=occupancy, kind="spec" if spec else "plain",
                 tokens=consumed, rows=rows, kv_len=kv_len,
-                flops=self._perf.call_flops(consumed, kv_len))
+                flops=self._perf.call_flops(consumed, kv_len),
+                kv_bytes=int(res.shape[0]) * self.num_slots * kv_len
+                * self._kv_row_bytes)
             for s, req in snapshot:
                 self._tracer.add_span(
                     req.request_id, "decode_step", t_disp, t1,

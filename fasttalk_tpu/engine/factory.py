@@ -87,8 +87,18 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
     else:
         wbytes_dev = ((m.param_count() - norm_params) * dsize // tp
                       + norm_params * dsize)
-    kv = (model_cfg.num_layers * cfg.decode_slots * cfg.max_model_len
-          * model_cfg.num_kv_heads * model_cfg.head_dim * 2 * dsize)
+    if cfg.kv_quant == "int8":
+        # Quantized KV tier (ops/kv_quant.py): int8 rows + per-row
+        # float32 scales — the accounting sees honest quantized bytes,
+        # so the same HBM budget admits ~2x the slots x context.
+        from fasttalk_tpu.ops.kv_quant import granule_dim
+
+        g = granule_dim(cfg.kv_quant_granule, m.num_kv_heads)
+        kv = (m.num_layers * cfg.decode_slots * cfg.max_model_len
+              * 2 * (m.num_kv_heads * m.head_dim * 1 + g * 4))
+    else:
+        kv = (m.num_layers * cfg.decode_slots * cfg.max_model_len
+              * m.num_kv_heads * m.head_dim * 2 * dsize)
     acct = {
         "weight_bytes_per_device": wbytes_dev,
         "kv_cache_bytes_per_device": kv // n_devices,
@@ -243,7 +253,7 @@ def build_engine(cfg: Config) -> EngineBase:
         f"({model_cfg.param_count() / 1e9:.2f}B params, "
         f"weights {'loaded' if loaded else 'random-init'}), "
         f"slots={cfg.decode_slots}, max_len={cfg.max_model_len}, "
-        f"dtype={cfg.dtype}, "
+        f"dtype={cfg.dtype}, kv_quant={cfg.kv_quant}, "
         f"mesh={dict(mesh.shape) if mesh else 'single-device'}")
     engine = TPUEngine(
         model_cfg, params, tokenizer,
@@ -265,5 +275,7 @@ def build_engine(cfg: Config) -> EngineBase:
         kv_host_budget_mb=cfg.kv_host_budget_mb,
         kv_park_ttl_s=cfg.kv_park_ttl_s,
         kv_park_idle_s=cfg.kv_park_idle_s,
-        kv_restore_min_tokens=cfg.kv_restore_min_tokens)
+        kv_restore_min_tokens=cfg.kv_restore_min_tokens,
+        kv_quant=cfg.kv_quant,
+        kv_quant_granule=cfg.kv_quant_granule)
     return engine

@@ -47,7 +47,10 @@ class ParkedKV:
     bucket: int                  # stored row length (>= kept)
     k: Any                       # np.ndarray [L, bucket, Kv, H]
     v: Any                       # np.ndarray [L, bucket, Kv, H]
-    nbytes: int                  # honest host-RAM footprint (bucketed)
+    nbytes: int                  # honest host-RAM footprint (bucketed;
+    #   int8 rows + scale rows under KV_QUANT=int8 — the budget and
+    #   the kv_host_bytes gauge see quantized bytes, so the same
+    #   KV_HOST_BUDGET_MB parks ~2x the sessions)
     parked_at: float = field(default_factory=time.monotonic)
     last_used: float = field(default_factory=time.monotonic)
     # Best-effort device-staged copies (offload.prestage): uploaded on
@@ -55,6 +58,13 @@ class ParkedKV:
     # the restore dispatch pays no host→device transfer.
     k_dev: Any = None
     v_dev: Any = None
+    # Quantized tier (KV_QUANT=int8): per-row float32 scales
+    # [L, bucket, G] riding alongside the int8 rows (None on the bf16
+    # tier), plus their prestaged device copies.
+    k_scale: Any = None
+    v_scale: Any = None
+    k_scale_dev: Any = None
+    v_scale_dev: Any = None
 
 
 class HostKVPool:
@@ -280,6 +290,7 @@ class HostKVPool:
                 "parked_total": self._n_parked,
                 "restored_total": self._n_restored,
                 "evicted_total": self._n_evicted,
+                "rejected_total": self._n_rejected,
                 "restore_lookups": self._lookups,
                 "restore_hits": self._hits,
                 "restore_hit_ratio": (self._hits / self._lookups

@@ -39,32 +39,64 @@ from fasttalk_tpu.utils.metrics import get_metrics
 log = get_logger("kvcache.offload")
 
 
-def make_kv_slice_fn(cfg, bucket: int):
+def make_kv_slice_fn(cfg, bucket: int, scale_granule: int = 0):
     """Jitted read of one slot's leading ``bucket`` KV rows → fresh
     [L, bucket, Kv, H] arrays. NOT donated: the engine's cache
     reference stays live; execution is ordered before any later
     donated call by dispatch order, so the rows read are exactly the
-    pre-eviction values."""
+    pre-eviction values.
+
+    ``scale_granule`` > 0 selects the quantized tier (KV_QUANT=int8):
+    the slice additionally returns the [L, bucket, G] float32 scale
+    rows, so parks move int8+scales — roughly half the D2H bytes."""
     import jax
 
     shape = (cfg.num_layers, 1, bucket, cfg.num_kv_heads, cfg.head_dim)
+    sshape = (cfg.num_layers, 1, bucket, scale_granule)
 
     @jax.jit
     def kv_slice(cache, slot):
         k = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0), shape)
         v = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0), shape)
+        if scale_granule:
+            ks = jax.lax.dynamic_slice(cache.k_scale, (0, slot, 0, 0),
+                                       sshape)
+            vs = jax.lax.dynamic_slice(cache.v_scale, (0, slot, 0, 0),
+                                       sshape)
+            return k[:, 0], v[:, 0], ks[:, 0], vs[:, 0]
         return k[:, 0], v[:, 0]
 
     return kv_slice
 
 
-def make_kv_restore_fn(cfg, bucket: int, cache_cls):
+def make_kv_restore_fn(cfg, bucket: int, cache_cls,
+                       scale_granule: int = 0):
     """Jitted write of stored rows back into a slot's leading region.
     Donates the cache so it chains in place like prefill/prefix-copy.
     Rows beyond the restored entry's trusted ``kept`` length carry
     stale values — harmless, because the caller sets ``kv_written`` to
-    the matched prefix and the delta prefill overwrites from there."""
+    the matched prefix and the delta prefill overwrites from there.
+
+    ``scale_granule`` > 0: the quantized tier restores int8 rows plus
+    their [L, bucket, G] scale rows in one program — half the H2D
+    bytes of a bf16 restore, which is exactly the restore-latency
+    win."""
     import jax
+
+    if scale_granule:
+        @partial(jax.jit, donate_argnums=(0,))
+        def kv_restore_q(cache, k_rows, v_rows, ks_rows, vs_rows, slot):
+            new_k = jax.lax.dynamic_update_slice(
+                cache.k, k_rows[:, None], (0, slot, 0, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(
+                cache.v, v_rows[:, None], (0, slot, 0, 0, 0))
+            new_ks = jax.lax.dynamic_update_slice(
+                cache.k_scale, ks_rows[:, None], (0, slot, 0, 0))
+            new_vs = jax.lax.dynamic_update_slice(
+                cache.v_scale, vs_rows[:, None], (0, slot, 0, 0))
+            return cache_cls(new_k, new_v, new_ks, new_vs)
+
+        return kv_restore_q
 
     @partial(jax.jit, donate_argnums=(0,))
     def kv_restore(cache, k_rows, v_rows, slot):
@@ -154,13 +186,19 @@ class KVOffloader:
             return session_id in self._parking
 
     def park(self, session_id: str, tokens: list[int], kept: int,
-             bucket: int, k_rows: Any, v_rows: Any, t0: float) -> None:
+             bucket: int, k_rows: Any, v_rows: Any, t0: float,
+             scales: tuple[Any, Any] | None = None) -> None:
         """Finish a park off the engine thread: fetch the slice result
         to host numpy (blocks until the device catches up — the whole
         reason this runs here), insert into the pool, feed the measured
         bandwidth to the policy, and record the ``kv_offload`` span.
         A second park for a session whose snapshot is still in flight
-        is dropped (the caller re-checks parked_len on a later tick)."""
+        is dropped (the caller re-checks parked_len on a later tick).
+
+        ``scales``: the quantized tier's (k_scale, v_scale) slice
+        results — fetched with the rows, counted in ``nbytes`` so the
+        pool budget and the copy-bandwidth EMA see honest int8+scales
+        bytes."""
         with self._parking_lock:
             if session_id in self._parking:
                 return
@@ -184,10 +222,17 @@ class KVOffloader:
                 # memory the pool must own outright.
                 k = np.array(k_rows, copy=True)
                 v = np.array(v_rows, copy=True)
+                ks = vs = None
+                if scales is not None:
+                    ks = np.array(scales[0], copy=True)
+                    vs = np.array(scales[1], copy=True)
                 t1 = time.monotonic()
+                nbytes = int(k.nbytes) + int(v.nbytes)
+                if ks is not None:
+                    nbytes += int(ks.nbytes) + int(vs.nbytes)
                 entry = ParkedKV(session_id=session_id, tokens=tokens,
                                  kept=kept, bucket=bucket, k=k, v=v,
-                                 nbytes=int(k.nbytes) + int(v.nbytes))
+                                 k_scale=ks, v_scale=vs, nbytes=nbytes)
                 if self.pool.put(entry):
                     self.policy.note_copy(entry.nbytes,
                                           max(t1 - tf, 1e-6))
@@ -237,6 +282,13 @@ class KVOffloader:
                 return
             k_dev = jax.device_put(entry.k)
             v_dev = jax.device_put(entry.v)
+            if entry.k_scale is not None:
+                # Quantized tier: scales stage with their rows, and
+                # BEFORE k_dev/v_dev — the restore's staged check keys
+                # on those, so it can never observe rows without
+                # scales.
+                entry.k_scale_dev = jax.device_put(entry.k_scale)
+                entry.v_scale_dev = jax.device_put(entry.v_scale)
             # Single assignment each (GIL-atomic); the consumer reads
             # k_dev/v_dev at restore time and either sees both or
             # treats the entry as unstaged.

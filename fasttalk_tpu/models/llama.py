@@ -27,6 +27,7 @@ import numpy as np
 
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.kv_quant import kv_dequantize, kv_quantize
 from fasttalk_tpu.ops.quant import embed_lookup, matmul_tied
 from fasttalk_tpu.ops.quant import matmul as qmm
 from fasttalk_tpu.ops.rope import apply_rope, rope_frequencies
@@ -35,10 +36,20 @@ Params = dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    """Per-layer key/value cache: k, v each [L, B, S, num_kv_heads, head_dim]."""
+    """Per-layer key/value cache: k, v each [L, B, S, num_kv_heads, head_dim].
+
+    Quantized tier (``KV_QUANT=int8``, ops/kv_quant.py): k/v are int8
+    and ``k_scale``/``v_scale`` hold per-row float32 scales
+    [L, B, S, G] (G = 1 per-token or num_kv_heads per-head). ``None``
+    scales mean the full-precision cache; every consumer branches on
+    that at trace time, and None fields are empty pytree nodes, so the
+    two layouts jit/scan/donate identically.
+    """
 
     k: jnp.ndarray
     v: jnp.ndarray
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
 
     @property
     def batch(self) -> int:
@@ -48,12 +59,31 @@ class KVCache(NamedTuple):
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int,
-               dtype: jnp.dtype = jnp.bfloat16, device=None) -> KVCache:
+               dtype: jnp.dtype = jnp.bfloat16, device=None, *,
+               quantized: bool = False,
+               scale_granule: int = 1) -> KVCache:
     """``device`` may be a Sharding — the cache is then created directly
-    in its shards (never materialised on a single chip)."""
+    in its shards (never materialised on a single chip).
+
+    ``quantized`` allocates the int8 tier: int8 rows + float32 scales
+    with granule axis ``scale_granule`` (1 or num_kv_heads). Zero
+    scales on the unwritten tail dequantize to the same zeros the bf16
+    cache initialises to (and are never attended anyway)."""
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    if quantized:
+        sshape = (cfg.num_layers, batch, max_len, scale_granule)
+        return KVCache(k=jnp.zeros(shape, jnp.int8, device=device),
+                       v=jnp.zeros(shape, jnp.int8, device=device),
+                       k_scale=jnp.zeros(sshape, jnp.float32,
+                                         device=device),
+                       v_scale=jnp.zeros(sshape, jnp.float32,
+                                         device=device))
     return KVCache(k=jnp.zeros(shape, dtype, device=device),
                    v=jnp.zeros(shape, dtype, device=device))
 
@@ -102,20 +132,24 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
 def _write_kv(cache_layer: jnp.ndarray, new: jnp.ndarray,
               write_start: jnp.ndarray,
               write_mask: jnp.ndarray | None) -> jnp.ndarray:
-    """Write new [B, T, K, H] into cache [B, S, K, H] at per-row offsets.
+    """Write new [B, T, ...] into cache [B, S, ...] at per-row offsets
+    (trailing dims pass through — [K, H] row blocks and [G] scale rows
+    share this one write path).
 
     ``write_mask`` [B] bool: rows with False keep their existing cache
     contents (used by the batched decode step so idle slots can never
     clobber resident KV of a parked session).
     """
+    zeros = (0,) * (new.ndim - 2)
     if write_mask is None:
         def row(c, n, s):
-            return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+            return jax.lax.dynamic_update_slice(c, n, (s,) + zeros)
         return jax.vmap(row)(cache_layer, new, write_start)
 
     def row(c, n, s, m):
-        cur = jax.lax.dynamic_slice(c, (s, 0, 0), n.shape)
-        return jax.lax.dynamic_update_slice(c, jnp.where(m, n, cur), (s, 0, 0))
+        cur = jax.lax.dynamic_slice(c, (s,) + zeros, n.shape)
+        return jax.lax.dynamic_update_slice(c, jnp.where(m, n, cur),
+                                            (s,) + zeros)
     return jax.vmap(row)(cache_layer, new, write_start, write_mask)
 
 
@@ -180,9 +214,20 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     # attention kernel's (pallas_decode) — disabling one must not
     # silently disable the other.
     pok = pallas_int8 and t == 1
+    # Int8 KV tier: quantize each fresh row at write time, dequantize
+    # on the attention read (fused into the operand load — XLA path;
+    # ops/kv_quant.py). The self-attention override regimes (ring
+    # prefill, training) bypass the cache read and are rejected at
+    # Config validation, as is the Pallas decode kernel (it streams
+    # raw cache rows).
+    kvq = cache.quantized
+    if kvq:
+        assert attn_override is None and not pallas_decode, \
+            "quantized KV cache is XLA scatter/slice paths only"
+        kvg = cache.k_scale.shape[-1]
 
     def layer(x, scanned):
-        lp, ck, cv = scanned
+        lp, ck, cv, ks, vs = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
                    qmm(h, lp["wv"], pok))
@@ -199,27 +244,39 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                 cv = _write_kv(cv, v, write_start, write_mask)
             o = attn_override(q, k, v, positions)
         else:
-            ck = _write_kv(ck, k, write_start, write_mask)
-            cv = _write_kv(cv, v, write_start, write_mask)
+            if kvq:
+                qk, sk = kv_quantize(k, kvg)
+                qv, sv = kv_quantize(v, kvg)
+                ck = _write_kv(ck, qk, write_start, write_mask)
+                cv = _write_kv(cv, qv, write_start, write_mask)
+                ks = _write_kv(ks, sk, write_start, write_mask)
+                vs = _write_kv(vs, sv, write_start, write_mask)
+                ak = kv_dequantize(ck, ks, x.dtype)
+                av = kv_dequantize(cv, vs, x.dtype)
+            else:
+                ck = _write_kv(ck, k, write_start, write_mask)
+                cv = _write_kv(cv, v, write_start, write_mask)
+                ak, av = ck, cv
             if cache_attn_override is not None:
-                o = cache_attn_override(q, ck, cv, positions)
+                o = cache_attn_override(q, ak, av, positions)
             elif pallas_decode and t == 1:
                 from fasttalk_tpu.ops.pallas_attention import decode_attend
 
-                o = decode_attend(q[:, 0], ck, cv,
+                o = decode_attend(q[:, 0], ak, av,
                                   positions[:, 0] + 1)[:, None]
             else:
                 attn_fn = attend_blockwise if blockwise else attend
-                o = attn_fn(q, ck, cv, positions)
+                o = attn_fn(q, ak, av, positions)
         x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
         up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
         x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
-        return x, (ck, cv)
+        return x, (ck, cv, ks, vs)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache.k, cache.v))
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        layer, x, (params["layers"], cache.k, cache.v,
+                   cache.k_scale, cache.v_scale))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     pok_head = pok
     if logits_indices is not None:
@@ -231,7 +288,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                              pok_head).astype(jnp.float32)
     else:
         logits = qmm(x, params["lm_head"], pok_head).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v)
+    return logits, KVCache(k=new_k, v=new_v, k_scale=new_ks,
+                           v_scale=new_vs)
 
 
 def forward_decode_multi(params: Params, cfg: ModelConfig,
@@ -265,9 +323,15 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
     rows = jnp.arange(b)
     # Masked rows scatter out of range -> dropped (mode="drop").
     write_cols = jnp.where(write_mask[:, None], pos_mat, s_total)
+    # Int8 KV tier: the block's fresh rows quantize before the scatter
+    # (per-row max-abs scales, ops/kv_quant.py), and the bounded
+    # attention read dequantizes the sliced region into the matmul —
+    # int8 bytes are what the decode step streams from HBM.
+    kvq = cache.quantized
+    kvg = cache.k_scale.shape[-1] if kvq else 0
 
     def layer(carry, lp):
-        x, ck_all, cv_all, li = carry
+        x, ck_all, cv_all, ks_all, vs_all, li = carry
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         pok = pallas_int8
         q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
@@ -279,6 +343,13 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
         v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, pos_mat, inv_freq)
         k = apply_rope(k, pos_mat, inv_freq)
+        if kvq:
+            k, sk = kv_quantize(k, kvg)
+            v, sv = kv_quantize(v, kvg)
+            ks_all = ks_all.at[li, rows[:, None], write_cols].set(
+                sk, mode="drop", unique_indices=True)
+            vs_all = vs_all.at[li, rows[:, None], write_cols].set(
+                sv, mode="drop", unique_indices=True)
         ck_all = ck_all.at[li, rows[:, None], write_cols].set(
             k, mode="drop", unique_indices=True)
         cv_all = cv_all.at[li, rows[:, None], write_cols].set(
@@ -289,16 +360,24 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
         av = jax.lax.dynamic_slice(
             cv_all, (li, 0, 0, 0, 0),
             (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+        if kvq:
+            aks = jax.lax.dynamic_slice(
+                ks_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
+            avs = jax.lax.dynamic_slice(
+                vs_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
+            ak = kv_dequantize(ak, aks, x.dtype)
+            av = kv_dequantize(av, avs, x.dtype)
         o = attend(q, ak, av, pos_mat)
         x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
         up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
         x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
-        return (x, ck_all, cv_all, li + 1), None
+        return (x, ck_all, cv_all, ks_all, vs_all, li + 1), None
 
-    (x, new_k, new_v, _), _ = jax.lax.scan(
-        layer, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
+    (x, new_k, new_v, new_ks, new_vs, _), _ = jax.lax.scan(
+        layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale,
+                jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     # The T=1 int8 kernels gate themselves on shape inside qmm/
     # matmul_tied (x.shape[1] == 1), so the verify block transparently
@@ -308,7 +387,8 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
                              pallas_int8).astype(jnp.float32)
     else:
         logits = qmm(x, params["lm_head"], pallas_int8).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v)
+    return logits, KVCache(k=new_k, v=new_v, k_scale=new_ks,
+                           v_scale=new_vs)
 
 
 def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,

@@ -69,15 +69,28 @@ PEAK_TFLOPS_BF16 = (
     ("v4", 275.0),
 )
 
+# Peak HBM bandwidth GB/s per chip by device-kind substring (public
+# spec sheets) — the roofline denominator for the KV-bandwidth
+# utilisation figure (PERF_PEAK_HBM_GBPS overrides). Decode is
+# KV-read-bound at scale, so this sits next to MFU: a call can be far
+# off the FLOP roofline while saturating HBM — which is exactly what
+# the int8 KV tier (KV_QUANT, docs/KVCACHE.md) halves.
+PEAK_HBM_GBPS = (
+    ("v6e", 1640.0), ("v6", 1640.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0), ("v5 lite", 819.0), ("v5litepod", 819.0),
+    ("v4", 1228.0),
+)
+
 # Step-ring record names this ledger aggregates (engine/engine.py):
 # decode calls (dispatch → retirement) and prefill calls (dispatch).
 _STEP = "engine_step"
 _PREFILL = "engine_prefill"
 
 
-def detect_peak_tflops() -> tuple[float, str]:
-    """(peak bf16 TFLOP/s per local device set, device kind). 0.0 when
-    the platform has no table entry — MFU then reports null."""
+def _detect_peak(table) -> tuple[float, str]:
+    """(summed per-device peak from ``table``, device kind). 0.0 when
+    the platform has no table entry — the figure then reports null."""
     try:
         import jax
 
@@ -88,10 +101,20 @@ def detect_peak_tflops() -> tuple[float, str]:
         return 0.0, "unknown"
     kind = getattr(devs[0], "device_kind", "") or devs[0].platform
     low = str(kind).lower()
-    for key, peak in PEAK_TFLOPS_BF16:
+    for key, peak in table:
         if key in low:
             return peak * len(devs), str(kind)
     return 0.0, str(kind)
+
+
+def detect_peak_tflops() -> tuple[float, str]:
+    """(peak bf16 TFLOP/s per local device set, device kind)."""
+    return _detect_peak(PEAK_TFLOPS_BF16)
+
+
+def detect_peak_hbm_gbps() -> tuple[float, str]:
+    """(peak HBM GB/s per local device set, device kind)."""
+    return _detect_peak(PEAK_HBM_GBPS)
 
 
 class PerfLedger:
@@ -111,6 +134,8 @@ class PerfLedger:
         self._peak_override = peak_tflops if peak_tflops is not None \
             else env_float("PERF_PEAK_TFLOPS", 0.0)
         self._peak: tuple[float, str] | None = None
+        self._hbm_override = env_float("PERF_PEAK_HBM_GBPS", 0.0)
+        self._hbm_detected: tuple[float, str] | None = None
         self._tracer = tracer
         self._clock = clock
         self._lock = threading.Lock()
@@ -122,6 +147,13 @@ class PerfLedger:
         self._params = 0
         self._flops_base = 0.0
         self._flops_per_ctx = 0.0
+        # KV-cache byte facts from the engine (bind_model): the honest
+        # per-(slot, position)-row read cost across all layers — int8
+        # rows + scales under KV_QUANT=int8, bf16 otherwise. The
+        # FLOP/byte side of the attribution never assumes an element
+        # size again.
+        self._kv_quant = "none"
+        self._kv_row_bytes = 0
         # Compile ledger: key -> {kind, count, serving, first/last ts}.
         self._compiles: dict[str, dict[str, Any]] = {}
         m = get_metrics()
@@ -157,6 +189,15 @@ class PerfLedger:
             "perf_peak_tflops",
             "roofline peak used for perf_mfu (0 = unknown device kind "
             "and PERF_PEAK_TFLOPS unset)")
+        self._m_kv_gbps = m.gauge(
+            "perf_kv_read_gbps",
+            "KV-cache bytes the decode calls' attention streamed per "
+            "wall second (honest element size: int8+scales under "
+            "KV_QUANT=int8)")
+        self._m_kv_bw = m.gauge(
+            "perf_kv_bw_util",
+            "KV attention-read bandwidth vs the device HBM peak "
+            "(0 when the peak is unknown; see PERF_PEAK_HBM_GBPS)")
         self._m_compiles = m.counter(
             "perf_serving_compiles_total",
             "jitted-executable compiles observed while serving traffic")
@@ -171,14 +212,21 @@ class PerfLedger:
         return self._tracer
 
     def bind_model(self, model_cfg: Any, num_slots: int,
-                   dtype: str = "") -> None:
+                   dtype: str = "", kv_quant: str = "none",
+                   kv_row_bytes: int = 0) -> None:
         """Attach the served model's cost estimate (engine __init__).
         FLOPs/token = 2·params (every weight partakes in one multiply-
-        accumulate) + 4·layers·q_dim·kv_len (QKᵀ and A·V per head)."""
+        accumulate) + 4·layers·q_dim·kv_len (QKᵀ and A·V per head).
+        ``kv_row_bytes``: what one attention read of one (slot,
+        position) row costs across all layers, at the cache's actual
+        element size — int8 rows + scales under KV_QUANT=int8, never
+        an assumed bf16."""
         with self._lock:
             self._model_name = getattr(model_cfg, "name", "")
             self._num_slots = num_slots
             self._dtype = dtype
+            self._kv_quant = kv_quant
+            self._kv_row_bytes = int(kv_row_bytes)
             self._params = int(model_cfg.param_count())
             self._flops_base = 2.0 * self._params
             self._flops_per_ctx = 4.0 * model_cfg.num_layers \
@@ -217,6 +265,13 @@ class PerfLedger:
             self._peak = detect_peak_tflops()
         return self._peak
 
+    def _peak_hbm(self) -> tuple[float, str]:
+        if self._hbm_override > 0:
+            return self._hbm_override, "PERF_PEAK_HBM_GBPS"
+        if self._hbm_detected is None:
+            self._hbm_detected = detect_peak_hbm_gbps()
+        return self._hbm_detected
+
     def report(self, now: float | None = None) -> dict[str, Any]:
         """The ``GET /perf`` body. ``now`` is on the step records'
         clock (time.monotonic in production; fake in tests)."""
@@ -240,18 +295,24 @@ class PerfLedger:
             "n_prefill_calls": sum(1 for r in records
                                    if r.name == _PREFILL),
             "model": {"name": self._model_name, "params": self._params,
-                      "slots": self._num_slots, "dtype": self._dtype},
+                      "slots": self._num_slots, "dtype": self._dtype,
+                      "kv_quant": self._kv_quant,
+                      "kv_row_bytes": self._kv_row_bytes},
             "compiles": {
                 "total": sum(e["count"] for e in compiles),
                 "serving": sum(e["serving"] for e in compiles),
                 "by_key": compiles,
             },
         }
+        peak_hbm, hbm_src = self._peak_hbm()
         if not records:
             out["wall"] = None
             out["tokens"] = None
             out["mfu"] = {"peak_tflops": peak or None,
                           "device": device, "mfu": None}
+            out["kv"] = {"bytes_read": 0, "read_gbps": 0.0,
+                         "peak_hbm_gbps": peak_hbm or None,
+                         "hbm_source": hbm_src, "bw_util": None}
             return out
 
         # Wall-time decomposition: union the (clipped) call intervals,
@@ -300,11 +361,11 @@ class PerfLedger:
             "idle_frac": frac(idle),
         }
 
-        # Useful tokens vs computed rows, occupancy, FLOPs.
+        # Useful tokens vs computed rows, occupancy, FLOPs, KV bytes.
         decode_tokens = prefill_tokens = 0
         computed_rows = 0
         occ_weight = occ_sum = 0.0
-        flops = 0.0
+        flops = kv_bytes = 0.0
         for r in records:
             a = r.attrs
             flops += float(a.get("flops", 0.0))
@@ -313,6 +374,7 @@ class PerfLedger:
                 computed_rows += int(a.get("rows",
                                            int(a.get("steps", 0))
                                            * int(a.get("slots", 0))))
+                kv_bytes += float(a.get("kv_bytes", 0.0))
                 dur = max(0.0, r.t1 - r.t0)
                 occ_weight += dur
                 occ_sum += dur * float(a.get("occupancy", 0.0))
@@ -342,6 +404,20 @@ class PerfLedger:
             "device": device,
             "mfu": round(achieved / peak, 6) if peak > 0 else None,
         }
+        # KV attention-read bandwidth next to MFU: decode is
+        # KV-read-bound at scale, and the element size here is the
+        # cache's honest one (int8+scales under KV_QUANT=int8) — the
+        # halved-bytes win is directly visible as read_gbps dropping
+        # (same tok/s) or bw_util headroom appearing.
+        kv_gbps = kv_bytes / window / 1e9 if window > 0 else 0.0
+        out["kv"] = {
+            "bytes_read": kv_bytes,
+            "read_gbps": kv_gbps,
+            "peak_hbm_gbps": peak_hbm or None,
+            "hbm_source": hbm_src,
+            "bw_util": round(kv_gbps / peak_hbm, 6)
+            if peak_hbm > 0 else None,
+        }
         return out
 
     def summary(self, now: float | None = None) -> dict[str, Any]:
@@ -350,6 +426,7 @@ class PerfLedger:
         wall = rep.get("wall") or {}
         toks = rep.get("tokens") or {}
         mfu = rep.get("mfu") or {}
+        kv = rep.get("kv") or {}
         return {
             "device_busy_frac": wall.get("device_busy_frac"),
             "host_gap_frac": wall.get("host_gap_frac"),
@@ -359,6 +436,8 @@ class PerfLedger:
             "useful_tok_s": toks.get("useful_tok_s"),
             "mfu": mfu.get("mfu"),
             "achieved_tflops": mfu.get("achieved_tflops"),
+            "kv_read_gbps": kv.get("read_gbps"),
+            "kv_bw_util": kv.get("bw_util"),
             "serving_compiles": rep["compiles"]["serving"],
         }
 
@@ -370,6 +449,7 @@ class PerfLedger:
         wall = rep.get("wall") or {}
         toks = rep.get("tokens") or {}
         mfu = rep.get("mfu") or {}
+        kv = rep.get("kv") or {}
         self._m_busy.set(wall.get("device_busy_frac") or 0.0)
         self._m_gap.set(wall.get("host_gap_frac") or 0.0)
         self._m_idle.set(wall.get("idle_frac") or 0.0)
@@ -378,6 +458,8 @@ class PerfLedger:
         self._m_tok_s.set(toks.get("useful_tok_s") or 0.0)
         self._m_mfu.set(mfu.get("mfu") or 0.0)
         self._m_peak.set(mfu.get("peak_tflops") or 0.0)
+        self._m_kv_gbps.set(kv.get("read_gbps") or 0.0)
+        self._m_kv_bw.set(kv.get("bw_util") or 0.0)
 
     def clear(self) -> None:
         """Test hook: drop the compile ledger IN PLACE. The model

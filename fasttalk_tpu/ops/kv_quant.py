@@ -1,0 +1,75 @@
+"""Int8 KV-cache quantization: per-row symmetric scales.
+
+The weight tier (ops/quant.py) halves what decode reads of the model;
+this module halves what decode reads of the *cache* — the dominant HBM
+consumer at scale, and the thing every attention read is bound on. The
+scheme is KIVI/vLLM-fp8-style per-token scaling: each freshly computed
+K/V row is quantized at write time with a max-abs scale over its own
+values, and the attention matmuls dequantize on read (a convert + one
+broadcast multiply that XLA fuses into the operand load, exactly like
+the int8 weight path) — so int8 bytes are what crosses HBM and the
+full-precision cache is never materialised.
+
+Scale granularity (``KV_QUANT_GRANULE``):
+
+- ``"token"`` (default): one float32 scale per (layer, slot, position)
+  row — max-abs over the whole [Kv, H] row. Cheapest (4 bytes per
+  2·Kv·H int8 bytes) and the KIVI per-token baseline.
+- ``"head"``: one scale per (layer, slot, position, kv-head) — max-abs
+  over [H] only. Tighter when head magnitudes diverge, at Kv× the
+  scale storage (still tiny next to the rows).
+
+Both store scales as a trailing granule axis ``G`` (1 or num_kv_heads),
+so every consumer broadcasts uniformly: ``q * s[..., None]`` covers
+either shape against a [..., Kv, H] row block.
+
+Storage layout (models/llama.py ``KVCache``): ``k``/``v`` int8
+[L, B, S, Kv, H] plus ``k_scale``/``v_scale`` float32 [L, B, S, G].
+Everything that moves KV — the decode scatter, the three prefill
+paths, the cross-slot shared-prefix copy, and the host offload tier's
+park/restore — moves rows *and* scales together in the quantized
+domain, so HBM, PCIe and host-RAM all hold int8+scales bytes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Below this magnitude a row is effectively zero; the floor keeps the
+# divide finite and quantizes such rows to exact zeros (matching the
+# bf16 cache's zero-initialised, never-attended tail).
+_EPS = 1e-8
+
+GRANULES = ("token", "head")
+
+
+def granule_dim(granule: str, num_kv_heads: int) -> int:
+    """Scale-axis length G for a granule name (see module docstring)."""
+    if granule not in GRANULES:
+        raise ValueError(f"KV_QUANT_GRANULE must be one of {GRANULES}, "
+                         f"got {granule!r}")
+    return num_kv_heads if granule == "head" else 1
+
+
+def kv_quantize(x: jax.Array, g: int) -> tuple[jax.Array, jax.Array]:
+    """Quantize K/V rows ``x`` [..., Kv, H] → (int8 rows, f32 scales
+    [..., G]) with symmetric per-row max-abs scales. ``g`` is the
+    granule axis length: 1 (per token row) or Kv (per head row)."""
+    xf = x.astype(jnp.float32)
+    if g == 1:
+        amax = jnp.max(jnp.abs(xf), axis=(-2, -1))[..., None]
+    else:
+        amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.maximum(amax / 127.0, _EPS)
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def kv_dequantize(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    """int8 rows [..., Kv, H] × scales [..., G] → ``dtype`` rows.
+
+    Written so XLA fuses the convert+multiply into the consuming
+    matmul's operand read — callers pass the result straight into the
+    attention einsum and the int8 bytes are what leaves HBM."""
+    return (q.astype(jnp.float32) * s[..., None]).astype(dtype)

@@ -387,6 +387,26 @@ class Config:
     # copy dispatch (the shared-prefix/delta-prefill paths serve).
     kv_restore_min_tokens: int = field(
         default_factory=lambda: _env_int("KV_RESTORE_MIN_TOKENS", 32))
+    # ---- Quantized KV-cache tier (ops/kv_quant.py, docs/KVCACHE.md
+    # "Quantized tier") ----
+    # "none" | "int8": store the KV cache as int8 rows + per-row
+    # float32 scales — ~2x resident sessions/context per HBM budget,
+    # ~2x effective attention-read bandwidth, and half the bytes
+    # through every park/restore/prefix copy. Explicit compatibility
+    # matrix (validated below, mirrored in the engine): single-device
+    # only (no tp/dp/sp mesh — the scale arrays do not shard with the
+    # kv axis yet), XLA attention only (no TPU_USE_PALLAS_ATTENTION —
+    # the kernel streams raw rows), and no speculative decoding (the
+    # verify block's quantize-on-write is unvalidated; set
+    # TPU_SPEC_DECODE=off).
+    kv_quant: str = field(
+        default_factory=lambda: _env_str("KV_QUANT", "none"))
+    # Scale granularity: "token" (one f32 scale per (layer, slot,
+    # position) row — the KIVI per-token baseline, cheapest) or
+    # "head" (one per kv head per row — tighter when head magnitudes
+    # diverge, at num_kv_heads x the scale storage).
+    kv_quant_granule: str = field(
+        default_factory=lambda: _env_str("KV_QUANT_GRANULE", "token"))
     # ---- SLOs + stall watchdog (observability/slo.py, watchdog.py,
     # docs/OBSERVABILITY.md). The observability singletons read the
     # same env knobs at construction; the fields here give operators
@@ -452,6 +472,11 @@ class Config:
     # 0 = detect from the device kind; unknown kinds report mfu: null.
     perf_peak_tflops: float = field(
         default_factory=lambda: _env_float("PERF_PEAK_TFLOPS", 0.0))
+    # Roofline peak for the KV-bandwidth-utilisation figure (total
+    # HBM GB/s across local devices). 0 = detect from the device kind;
+    # unknown kinds report kv bw_util: null.
+    perf_peak_hbm_gbps: float = field(
+        default_factory=lambda: _env_float("PERF_PEAK_HBM_GBPS", 0.0))
     # ---- Incident flight recorder (observability/flight.py,
     # POST /debug/bundle) ----
     flight_enabled: bool = field(
@@ -606,6 +631,35 @@ class Config:
                         "idle parking)")
         if self.kv_restore_min_tokens < 1:
             errs.append("kv_restore_min_tokens must be >= 1")
+        if self.kv_quant not in ("none", "int8"):
+            errs.append("kv_quant must be 'none' or 'int8'")
+        if self.kv_quant_granule not in ("token", "head"):
+            errs.append("kv_quant_granule must be 'token' or 'head'")
+        if self.kv_quant == "int8":
+            # The quantized tier's compatibility matrix is explicit:
+            # every unsupported combination fails HERE with the reason,
+            # never silently degrades to bf16 (docs/KVCACHE.md).
+            if self.tp_size > 1 or self.dp_size > 1 or self.sp_size > 1:
+                errs.append(
+                    "KV_QUANT=int8 is single-device only (the per-row "
+                    "scale arrays do not shard with the kv axis yet); "
+                    "set TPU_TP_SIZE=TPU_DP_SIZE=TPU_SP_SIZE=1")
+            if self.spmd_role != "off":
+                errs.append("KV_QUANT=int8 is incompatible with "
+                            "multi-host SPMD serving (sharded cache); "
+                            "set TPU_SPMD_ROLE=off")
+            if self.use_pallas_attention:
+                errs.append(
+                    "KV_QUANT=int8 is incompatible with the Pallas "
+                    "decode-attention kernel (it streams raw bf16 "
+                    "cache rows; the quantized tier dequantizes inside "
+                    "the XLA attention read) — set "
+                    "TPU_USE_PALLAS_ATTENTION=false")
+            if self.spec_decode != "off":
+                errs.append(
+                    "KV_QUANT=int8 is incompatible with speculative "
+                    "decoding (the verify block's quantize-on-write "
+                    "is unvalidated) — set TPU_SPEC_DECODE=off")
         if self.kv_host_budget_mb > 0:
             # Warn (don't fail) when the budget exceeds detectable host
             # RAM: the pool would page/OOM long before filling.
@@ -638,6 +692,9 @@ class Config:
             errs.append("perf_idle_gap_ms must be > 0")
         if self.perf_peak_tflops < 0:
             errs.append("perf_peak_tflops must be >= 0 (0 = detect "
+                        "from the device kind)")
+        if self.perf_peak_hbm_gbps < 0:
+            errs.append("perf_peak_hbm_gbps must be >= 0 (0 = detect "
                         "from the device kind)")
         if not self.flight_dir.strip():
             errs.append("flight_dir must be a non-empty path")

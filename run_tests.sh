@@ -22,6 +22,15 @@
 #                             validation, plus a trace_report smoke
 #                             checking the kv_offload/kv_restore phase
 #                             percentiles (docs/KVCACHE.md).
+#   ./run_tests.sh --kvq      quantized-KV group (KV_QUANT=int8):
+#                             quantize/dequant numerics, model parity
+#                             vs the bf16 cache, engine greedy
+#                             equivalence + park→restore under
+#                             quantization, honest int8+scales host
+#                             byte accounting (~2x sessions per
+#                             budget), and the compat-matrix
+#                             validation (docs/KVCACHE.md "Quantized
+#                             tier").
 #   ./run_tests.sh --slo      SLO/watchdog group: burn-rate windows,
 #                             goodput, the fake-clock stall watchdog,
 #                             /slo + /events endpoints, the strict
@@ -90,6 +99,23 @@ EOF
         grep -q "$phase" <<<"$out" \
             || { echo "trace_report kv smoke: missing $phase" >&2; exit 1; }
     done
+    exit 0
+fi
+
+if [[ "${1:-}" == "--kvq" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_kv_quant.py "$@"
+    echo "--- trace_report --perf kv-bandwidth smoke ---"
+    tmp="$(mktemp)"
+    trap 'rm -f "$tmp"' EXIT
+    cat > "$tmp" <<'EOF'
+{"request_id": null, "session_id": "", "span": "engine_step", "ts": 100.0, "dur_ms": 1000.0, "attrs": {"steps": 8, "batch": 2, "slots": 4, "occupancy": 0.5, "tokens": 16, "rows": 32, "kv_len": 512, "flops": 1e9, "kv_bytes": 2e9}}
+{"request_id": null, "session_id": "", "span": "engine_prefill", "ts": 101.1, "dur_ms": 100.0, "attrs": {"bucket": 64, "tokens": 40, "rows": 64}}
+EOF
+    out="$("${PYENV[@]}" python scripts/trace_report.py --perf "$tmp")"
+    echo "$out"
+    grep -q "KV read" <<<"$out" \
+        || { echo "trace_report --perf smoke: missing KV read GB/s" >&2; exit 1; }
     exit 0
 fi
 

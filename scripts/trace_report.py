@@ -189,13 +189,14 @@ def perf_attribution(records: Iterable[dict[str, Any]],
         cursor = max(cursor, b)
     window = end - start
     decode_toks = prefill_toks = computed = 0
-    occ_w = occ_s = flops = 0.0
+    occ_w = occ_s = flops = kv_bytes = 0.0
     for r in rows:
         a = r.get("attrs") or {}
         flops += float(a.get("flops", 0.0))
         if r["span"] == "engine_step":
             decode_toks += int(a.get("tokens", 0))
             computed += int(a.get("rows", 0))
+            kv_bytes += float(a.get("kv_bytes", 0.0))
             dur = float(r.get("dur_ms", 0.0))
             occ_w += dur
             occ_s += dur * float(a.get("occupancy", 0.0))
@@ -218,6 +219,10 @@ def perf_attribution(records: Iterable[dict[str, Any]],
         "occupancy_mean": occ_s / occ_w if occ_w > 0 else None,
         "achieved_tflops": achieved,
         "mfu": achieved / peak_tflops if peak_tflops > 0 else None,
+        # KV attention-read bandwidth (engine rows carry honest
+        # kv_bytes: int8+scales under KV_QUANT=int8, bf16 otherwise).
+        "kv_read_gbps": kv_bytes / window / 1e9 if window > 0
+        and kv_bytes else None,
     }
 
 
@@ -241,7 +246,9 @@ def format_perf(p: dict[str, Any]) -> str:
         f"occupancy {num(p['occupancy_mean'])}",
         f"  flops: {p['achieved_tflops']:.4f} TFLOP/s achieved"
         + ("" if p["mfu"] is None else f"; MFU {p['mfu']:.2%}"
-           " (PERF_PEAK_TFLOPS roofline)"),
+           " (PERF_PEAK_TFLOPS roofline)")
+        + ("" if p.get("kv_read_gbps") is None
+           else f"; KV read {p['kv_read_gbps']:.3f} GB/s"),
     ]
     return "\n".join(lines)
 
