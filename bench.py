@@ -25,6 +25,11 @@ control in subprocess-isolated phases — reports parked-session
 capacity per budget (headline: the ratio, expected ~2x), restore-
 latency p50 both ways, and decode tok/s (must stay within noise).
 
+``BENCH_MODE=structured`` runs the constrained-decoding scenario
+(docs/STRUCTURED.md): per-step mask-apply overhead vs an unconstrained
+control (target <5% tok/s), and jump-forward's forced-token fraction +
+e2e delta on a forced-chain-heavy schema, greedy, engine-seam.
+
 ``BENCH_MODE=overload`` runs the admission-control scenario
 (docs/SCHEDULING.md): an OPEN-LOOP arrival process (one request every
 ``BENCH_ARRIVAL_MS`` ms for ``BENCH_OVERLOAD_S`` s, regardless of
@@ -909,6 +914,123 @@ async def bench_overload(cfg) -> dict:
     return res
 
 
+async def bench_structured(engine) -> dict:
+    """BENCH_MODE=structured (docs/STRUCTURED.md): two questions.
+
+    1. **Mask overhead** — constrained decode steps gather/unpack one
+       packed bitmask row per slot per step inside the jitted sampler;
+       target <5% tok/s cost. Measured with an unforced constraint
+       (``[ab]{N}``: every step a choice, no jump-forward, no early
+       accept) against an unconstrained ``ignore_eos`` control of the
+       same length, single session, greedy.
+    2. **Jump-forward savings** — a schema whose fixed punctuation and
+       long property names compile to single-transition chains; same
+       greedy document with jump-forward off vs on, reporting the
+       forced-token fraction and the e2e delta.
+    """
+    from fasttalk_tpu.engine.engine import GenerationParams
+    from fasttalk_tpu.utils.metrics import get_metrics
+
+    n_tok = int(os.environ.get("BENCH_ST_TOKENS", "96"))
+    greedy = dict(temperature=0.0, top_k=0, top_p=1.0)
+
+    async def one(rid, params, prompt="measure"):
+        t0 = time.monotonic()
+        ttft = None
+        text = ""
+        stats = {}
+        async for ev in engine.generate(rid, f"sess-{rid}",
+                                        [{"role": "user",
+                                          "content": prompt}], params):
+            if ev["type"] == "token":
+                if ttft is None:
+                    ttft = (time.monotonic() - t0) * 1000.0
+                text += ev["text"]
+            elif ev["type"] == "done":
+                stats = ev["stats"]
+            elif ev["type"] == "error":
+                raise RuntimeError(f"generation failed: {ev}")
+        engine.release_session(f"sess-{rid}")
+        return {"wall_s": time.monotonic() - t0, "ttft_ms": ttft or 0.0,
+                "tokens": stats.get("tokens_generated", 0),
+                "text": text}
+
+    log("warmup (compiling plain + constrained decode shapes)...")
+    await one("warm-plain", GenerationParams(max_tokens=8, **greedy))
+    await one("warm-st", GenerationParams(
+        max_tokens=8, **greedy,
+        structured={"kind": "regex", "regex": "[ab]{512}"}))
+
+    log("mask-overhead phase (unconstrained control vs [ab]{N})...")
+    reps = int(os.environ.get("BENCH_ST_REPS", "3"))
+    base_s, mask_s = [], []
+    for i in range(reps):
+        r = await one(f"base{i}", GenerationParams(
+            max_tokens=n_tok, ignore_eos=True, **greedy))
+        base_s.append(r["tokens"] / r["wall_s"])
+        r = await one(f"mask{i}", GenerationParams(
+            max_tokens=n_tok, **greedy,
+            structured={"kind": "regex",
+                        "regex": "[ab]{%d}" % (4 * n_tok)}))
+        mask_s.append(r["tokens"] / r["wall_s"])
+    base_tps = statistics.median(base_s)
+    mask_tps = statistics.median(mask_s)
+    overhead = 1.0 - mask_tps / base_tps
+
+    log("jump-forward phase (forced-chain schema, off vs on)...")
+    # Long single-transition runs: fixed punctuation + numeric
+    # property names are forced for any tokenizer (digits are
+    # single-byte tokens in every BPE's base alphabet).
+    schema = {"type": "object", "properties": {
+        "measurement_0123456789_a": {"enum": ["blue", "red"]},
+        "measurement_0123456789_b": {"type": "boolean"},
+        "measurement_0123456789_c": {"enum": [1, 2, 3]}}}
+    sp = dict(structured={"kind": "json_schema", "schema": schema})
+    jf_min, counter = engine._st_jf_min, get_metrics().counter(
+        "structured_jump_forward_tokens_total")
+    try:
+        engine._st_jf_min = 0
+        await one("jfw0", GenerationParams(max_tokens=256, **greedy,
+                                           **sp))  # compile prefill
+        offs = [await one(f"jf-off{i}", GenerationParams(
+            max_tokens=256, **greedy, **sp)) for i in range(reps)]
+        engine._st_jf_min = int(os.environ.get("BENCH_ST_JF_MIN", "4"))
+        await one("jfw1", GenerationParams(max_tokens=256, **greedy,
+                                           **sp))  # compile jump path
+        before = counter.value
+        ons = [await one(f"jf-on{i}", GenerationParams(
+            max_tokens=256, **greedy, **sp)) for i in range(reps)]
+        jumped = (counter.value - before) / reps
+    finally:
+        engine._st_jf_min = jf_min
+    assert all(o["text"] == offs[0]["text"] for o in offs + ons), \
+        "jump-forward changed the greedy document"
+    off_ms = statistics.median(o["wall_s"] for o in offs) * 1000
+    on_ms = statistics.median(o["wall_s"] for o in ons) * 1000
+    doc_tokens = offs[0]["tokens"]
+    res = {
+        "unconstrained_tok_s": round(base_tps, 2),
+        "constrained_tok_s": round(mask_tps, 2),
+        "mask_overhead_frac": round(overhead, 4),
+        "jump_forward": {
+            "doc_tokens": doc_tokens,
+            "forced_tokens": round(jumped, 1),
+            "forced_fraction": round(jumped / max(1, doc_tokens), 3),
+            "e2e_off_ms": round(off_ms, 1),
+            "e2e_on_ms": round(on_ms, 1),
+            "e2e_speedup": round(off_ms / on_ms, 2) if on_ms else None,
+        },
+        "fsm_compile_ms": get_metrics().histogram(
+            "fsm_compile_ms").summary(),
+    }
+    log(f"  mask overhead {overhead:.1%} ({base_tps:.1f} -> "
+        f"{mask_tps:.1f} tok/s); jump-forward forced "
+        f"{res['jump_forward']['forced_fraction']:.0%} of "
+        f"{doc_tokens} tokens, e2e {off_ms:.0f} -> {on_ms:.0f} ms "
+        f"({res['jump_forward']['e2e_speedup']}x)")
+    return res
+
+
 async def bench_engine(engine) -> dict:
     log("warmup (compiling prefill + decode buckets)...")
     t0 = time.monotonic()
@@ -1149,6 +1271,37 @@ def main() -> None:
             "unit": "tok/s",
             "vs_baseline": round(r["goodput_tok_s"] / BASELINE_TOKS, 2),
             "overload": r,
+        }), flush=True)
+        return
+    if MODE == "structured":
+        from fasttalk_tpu.engine.factory import build_engine
+
+        t0 = time.monotonic()
+        engine = build_engine(cfg)
+        engine.start()
+        log(f"engine up in {time.monotonic() - t0:.1f}s")
+        try:
+            r = asyncio.run(bench_structured(engine))
+        finally:
+            engine.shutdown()
+        jf = r["jump_forward"]
+        print(json.dumps({
+            "metric": (f"structured mask overhead frac, {MODEL}: "
+                       f"constrained {r['constrained_tok_s']} vs "
+                       f"unconstrained {r['unconstrained_tok_s']} "
+                       f"tok/s greedy (target < 0.05); jump-forward "
+                       f"forced {jf['forced_fraction']:.0%} of "
+                       f"{jf['doc_tokens']} tokens, e2e "
+                       f"{jf['e2e_off_ms']:.0f} -> "
+                       f"{jf['e2e_on_ms']:.0f} ms "
+                       f"({jf['e2e_speedup']}x)"),
+            "value": r["mask_overhead_frac"],
+            "unit": "frac",
+            # For this mode the baseline is the unconstrained tok/s on
+            # the same engine: the ratio shows what the mask costs.
+            "vs_baseline": round(r["constrained_tok_s"]
+                                 / r["unconstrained_tok_s"], 3),
+            "structured": r,
         }), flush=True)
         return
     if MODE == "ws":

@@ -81,9 +81,23 @@ class HermesStreamParser:
                 return "".join(pre), calls, "".join(post)
 
     def flush(self) -> str:
-        """End of stream: release held-back text (an unterminated tool
-        call is dropped — it never completed)."""
-        text = "" if self._in_call else self._buf
+        """End of stream: release held-back text. An unterminated tool
+        call body is dropped (it never completed) — and so is a held
+        partial OPENING tag of two or more characters ("<tool_cal" at
+        a max_tokens cutoff): the in-stream path holds such a suffix
+        back waiting for the rest of the tag, and releasing it here
+        leaked raw markup into user-visible text whenever the stream
+        ended mid-tag. A lone trailing "<" is still released —
+        legitimate prose ends with it far more often than a tag
+        starts one character before the end of a stream."""
+        if self._in_call:
+            text = ""
+        else:
+            text = self._buf
+            for k in range(min(len(OPEN_TAG) - 1, len(text)), 1, -1):
+                if text.endswith(OPEN_TAG[:k]):
+                    text = text[:-k]
+                    break
         self._buf = ""
         self._in_call = False
         return text

@@ -82,6 +82,12 @@ from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
                                        sample_tokens)
 from fasttalk_tpu.scheduling.scheduler import RequestScheduler
+from fasttalk_tpu.structured.compiler import (FSMCompiler,
+                                              StructuredError,
+                                              validate_structured_spec)
+from fasttalk_tpu.structured.fsm import FSMTooLarge, TokenFSM
+from fasttalk_tpu.structured.runtime import (ArenaFull, FSMArena,
+                                             pack_mask_row)
 from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
                                        LLMServiceError)
 from fasttalk_tpu.utils.logger import get_logger
@@ -144,6 +150,21 @@ class GenerationParams:
                 raise ValueError(
                     f"deadline_s must be a positive number, "
                     f"got {self.deadline_s!r}")
+        if self.structured is not None:
+            # Shape errors surface here (400 / invalid_config);
+            # compile errors surface at the engine seam the same way.
+            self.structured = validate_structured_spec(self.structured)
+            if self.ignore_eos:
+                raise ValueError(
+                    "structured output is incompatible with "
+                    "ignore_eos=true (the FSM decides where the "
+                    "document ends)")
+            if self.stop:
+                raise ValueError(
+                    "structured output is incompatible with stop "
+                    "sequences: a stop string could truncate the "
+                    "document mid-grammar and break the validity "
+                    "guarantee")
     # Text-completion mode (/v1/completions): the prompt is the joined
     # message content, tokenized verbatim (BOS + bytes, no chat
     # template). Out of band on purpose — an in-band role sentinel
@@ -156,6 +177,13 @@ class GenerationParams:
     # default). Client-settable per session/request.
     priority: str = "interactive"
     deadline_s: float | None = None
+    # Constrained decoding (docs/STRUCTURED.md): a structured spec
+    # ({"kind": "json_object" | "json_schema" | "regex" | "tool_call",
+    # ...}) compiled to a token FSM whose allowed-token mask is applied
+    # inside the jitted sampler every step. None = unconstrained (the
+    # zero-cost default). Validated here so a malformed spec surfaces
+    # as a 400 / invalid_config, never a 500.
+    structured: Any = None
 
 
 def raw_prompt_text(messages: list[dict]) -> str:
@@ -214,6 +242,14 @@ class _Request:
     prefill_tokens: int = 0             # tokens actually prefilled
     #   (after resident/restored/shared reuse) — feeds the restore
     #   policy's measured prefill-throughput EMA (kvcache/policy.py)
+    # Constrained decoding (docs/STRUCTURED.md): the compiled token
+    # FSM, its arena registration, and the HOST-side mirror of the
+    # per-slot FSM state (replayed token-by-token at retirement; the
+    # authoritative copy advances on device inside the decode scan).
+    fsm: TokenFSM | None = None
+    fsm_entry: Any = None               # structured/runtime._Entry
+    fsm_state: int = 0                  # local (per-FSM) state id
+    jump_tokens: int = 0                # tokens emitted by jump-forward
 
 
 class EngineBase:
@@ -287,7 +323,13 @@ class TPUEngine(EngineBase):
                  kv_park_idle_s: float | None = None,
                  kv_restore_min_tokens: int | None = None,
                  kv_quant: str = "none",
-                 kv_quant_granule: str = "token"):
+                 kv_quant_granule: str = "token",
+                 structured: str = "auto",
+                 structured_max_states: int = 8192,
+                 structured_state_budget: int = 16384,
+                 structured_jf_min: int = 4,
+                 structured_cache: int = 64,
+                 structured_json_depth: int = 3):
         self.cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -409,6 +451,52 @@ class TPUEngine(EngineBase):
         # "dp"-sharded and a cross-slot dynamic slice would bounce
         # through collectives.
         self.shared_prefix = shared_prefix and mesh is None
+        # Structured decoding (fasttalk_tpu/structured/,
+        # docs/STRUCTURED.md): per-request grammar/JSON-schema
+        # constraints compiled to token FSMs whose allowed-token mask
+        # is gathered inside the jitted decode scan. The compatibility
+        # matrix is EXPLICIT, following the KV-quant precedent:
+        # - single-device only in v1 (the mesh decode path is the
+        #   non-scatter forward; per-slot FSM state is not threaded
+        #   through it);
+        # - no Pallas decode attention (same non-scatter path);
+        # - speculative decoding pauses per CALL while any constrained
+        #   slot is running (verify-block masking is unvalidated) and
+        #   resumes when the last constrained slot finishes.
+        # "auto" degrades to unavailable on incompatible engines
+        # (constrained REQUESTS are rejected with the reason; plain
+        # serving is untouched); "on" makes the incompatibility a
+        # construction error; "off" disables the subsystem.
+        if structured not in ("auto", "on", "off"):
+            raise ValueError(f"structured must be auto|on|off, "
+                             f"got {structured!r}")
+        reason: str | None = None
+        if mesh is not None:
+            reason = ("structured decoding is single-device only in "
+                      "v1 (no tp/dp/sp mesh — per-slot FSM state is "
+                      "not threaded through the sharded decode path)")
+        elif self.use_pallas_attention:
+            reason = ("structured decoding is incompatible with the "
+                      "Pallas decode-attention kernel (it uses the "
+                      "non-scatter decode path) — set "
+                      "TPU_USE_PALLAS_ATTENTION=false")
+        if structured == "on" and reason is not None:
+            raise ValueError(f"STRUCTURED_MODE=on: {reason}")
+        if structured == "off":
+            reason = "disabled (STRUCTURED_MODE=off)"
+        # None = constrained requests are served; a string = the
+        # rejection reason (serving layers read this pre-breaker).
+        self.structured_reason = reason
+        self._st_jf_min = max(0, structured_jf_min)
+        self._st_cfg = {"max_states": structured_max_states,
+                        "state_budget": structured_state_budget,
+                        "cache_size": structured_cache,
+                        "json_depth": structured_json_depth}
+        self._st_compiler: FSMCompiler | None = None   # lazy (asyncio)
+        self._st_compiler_lock = threading.Lock()
+        self._st_arena: FSMArena | None = None         # lazy (engine)
+        self._st_sample_fn: Any = None
+        self._st_patch_fn: Any = None
 
         if mesh is not None:
             # Tensor-parallel serving: weights and KV sharded over ICI;
@@ -573,6 +661,16 @@ class TPUEngine(EngineBase):
             "tokens emitted per speculative verify block (accepted "
             "drafts + 1); 1 means no draft accepted",
             buckets=tuple(range(1, max(2, self.spec_draft + 2))))
+        # Structured decoding (docs/STRUCTURED.md): volume, the
+        # jump-forward savings (tokens emitted without model steps),
+        # and validity-contract violations (must stay 0).
+        self._m_st_requests = m.counter(
+            "structured_requests_total",
+            "constrained (structured-output) generations accepted")
+        self._m_st_jump = m.counter(
+            "structured_jump_forward_tokens_total",
+            "forced tokens emitted by jump-forward without decode "
+            "steps")
         # Request-phase histograms (ISSUE 1): where a request's latency
         # lives, as aggregates; the span tracer carries the per-request
         # breakdown.
@@ -689,6 +787,20 @@ class TPUEngine(EngineBase):
         # Admission emits the first token only when the fetch lands, so
         # prefill never blocks the engine thread on a device round trip.
         self._pending_firsts: deque[tuple[Future, list]] = deque()
+        # Structured decoding device state (docs/STRUCTURED.md): the
+        # per-slot FSM state vector is chained through constrained
+        # decode calls exactly like positions; 0 = the FREE state every
+        # unconstrained slot sits in. The union tables (masks/cls/next)
+        # upload at admission when the arena grows — never per step.
+        self._st_state_dev = self._put(np.zeros((num_slots,), np.int32))
+        self._st_sel = np.zeros((num_slots,), np.int32)  # host mirror
+        self._st_masks_dev: Any = None
+        self._st_cls_dev: Any = None
+        self._st_nexts_dev: Any = None
+        self._st_dirty: set[int] = set()       # slots needing st patch
+        self._st_jf_pending: set[str] = set()  # request ids to jump
+        if self._st_arena is not None:
+            self._st_arena.dirty = True        # restart: re-upload
 
     # ---------------- public (asyncio side) ----------------
 
@@ -710,6 +822,8 @@ class TPUEngine(EngineBase):
                 self._started = False
             self._fetch_pool.shutdown(wait=False, cancel_futures=True)
             self._kv_offload.shutdown()
+            if self._st_compiler is not None:
+                self._st_compiler.shutdown()
 
     def restart(self) -> bool:
         """Recover from an engine-thread crash: rebuild the device-side
@@ -1006,6 +1120,40 @@ class TPUEngine(EngineBase):
             prompt_tokens=prompt, params=params,
             out_queue=asyncio.Queue(), loop=asyncio.get_running_loop(),
             detok=StreamDetokenizer(self.tokenizer))
+        if params.structured is not None:
+            # Compile (or cache-hit) the token FSM OFF the engine
+            # thread and off this event loop, before submission —
+            # admission never blocks on a cold schema. Compat and
+            # compile failures are client-shape errors: 400 /
+            # invalid_config, never a 500 or a breaker hit.
+            if self.structured_reason is not None:
+                raise LLMServiceError(
+                    f"structured output unavailable: "
+                    f"{self.structured_reason}",
+                    category=ErrorCategory.VALIDATION,
+                    recoverable=False)
+            if self.call_sink is not None:
+                raise LLMServiceError(
+                    "structured output is unsupported in multi-host "
+                    "SPMD serving mode",
+                    category=ErrorCategory.VALIDATION,
+                    recoverable=False)
+            t0c = time.monotonic()
+            try:
+                req.fsm = await self._get_st_compiler().compile_async(
+                    params.structured)
+            except (StructuredError, FSMTooLarge) as e:
+                raise LLMServiceError(
+                    str(e), category=ErrorCategory.VALIDATION,
+                    recoverable=False) from e
+            req.fsm_state = req.fsm.start
+            self._m_st_requests.inc()
+            if self._tracer.enabled:
+                self._tracer.add_span(
+                    request_id, "fsm_compile", t0c, time.monotonic(),
+                    kind=params.structured.get("kind"),
+                    states=req.fsm.n_states,
+                    classes=req.fsm.n_classes)
         self._m_requests.inc()
         # Trace the request's whole lifecycle. The serving layer starts
         # the trace first (it owns the ws_send spans and the finish);
@@ -1195,6 +1343,15 @@ class TPUEngine(EngineBase):
         }
 
     def get_stats(self) -> dict:
+        structured: dict[str, Any] = {
+            "available": self.structured_reason is None,
+        }
+        if self.structured_reason is not None:
+            structured["reason"] = self.structured_reason
+        if self._st_compiler is not None:
+            structured["compiler"] = self._st_compiler.stats()
+        if self._st_arena is not None:
+            structured["arena"] = self._st_arena.stats()
         return {
             "slots": self.slots.stats(),
             "waiting": len(self._sched),
@@ -1203,6 +1360,7 @@ class TPUEngine(EngineBase):
             "kv_quant": "int8" if self.kv_quant else "none",
             "kv_host": {**self._kv_pool.stats(),
                         "policy": self._kv_policy.stats()},
+            "structured": structured,
         }
 
     # ---------------- jitted steps ----------------
@@ -1253,7 +1411,8 @@ class TPUEngine(EngineBase):
         return NamedSharding(self.mesh, PartitionSpec())
 
     def _get_decode_fn(self, kv_len: int, steps: int | None = None,
-                       with_history: bool = False):
+                       with_history: bool = False,
+                       with_fsm: bool = False):
         """K decode steps in one jitted call (K = ``steps``, default
         steps_per_call; the dispatcher also compiles the short
         ``steps_burst`` variant for admission-latency-sensitive moments).
@@ -1276,16 +1435,38 @@ class TPUEngine(EngineBase):
             # bucket specialisation buys nothing); one executable per
             # step count.
             kv_len = self.max_len
-        fn = self._decode_fns.get((kv_len, steps, with_history))
+        fn = self._decode_fns.get((kv_len, steps, with_history,
+                                   with_fsm))
         if fn is not None:
             return fn
         self._note_compile("decode", kv_len=kv_len, steps=steps,
+                           **({"structured": True} if with_fsm else {}),
                            **self._kvq_attrs)
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
         scatter = self._scatter_decode and not use_pallas
         rows = jnp.arange(self.num_slots)
         max_len = self.max_len
         replicate = self._replicate_sharding()
+        if with_fsm:
+            # Constrained variant (docs/STRUCTURED.md): identical step
+            # math plus (1) a per-slot allowed-token mask gathered from
+            # the packed-bitmask union table by FSM state and applied
+            # to the penalised logits BEFORE candidate preselection —
+            # composing with penalties/top-k/top-p exactly like a
+            # penalty — and (2) the state advance, a two-gather chain
+            # next = nexts[state, cls[sel, token]], all device-
+            # resident: no host sync anywhere on the step path.
+            # Unconstrained slots ride along in the FREE state (mask
+            # all-ones, self-loop). Dispatched only while a constrained
+            # slot is running, so plain serving keeps its executables
+            # byte-identical. Single-device scatter path only (the
+            # engine rejects constrained requests otherwise).
+            assert scatter, "structured decode requires the scatter path"
+            fn = self._build_fsm_decode(kv_len, steps, with_history,
+                                        rows, max_len)
+            self._decode_fns[(kv_len, steps, with_history,
+                              with_fsm)] = fn
+            return fn
         cache_override = None
         if sp > 1:
             from fasttalk_tpu.parallel.ring_attention import \
@@ -1338,7 +1519,7 @@ class TPUEngine(EngineBase):
                 return KVCache(ck, cv, ks, vs), hist, cnt, toks, cur, \
                     pos, rng
 
-            self._decode_fns[(kv_len, steps, with_history)] = \
+            self._decode_fns[(kv_len, steps, with_history, False)] = \
                 decode_call_hist
             return decode_call_hist
 
@@ -1417,8 +1598,119 @@ class TPUEngine(EngineBase):
                 toks = jax.lax.with_sharding_constraint(toks, replicate)
             return KVCache(new_k, new_v), cnt, toks, cur, pos, rng
 
-        self._decode_fns[(kv_len, steps, with_history)] = decode_call
+        self._decode_fns[(kv_len, steps, with_history, False)] = \
+            decode_call
         return decode_call
+
+    def _build_fsm_decode(self, kv_len: int, steps: int,
+                          with_history: bool, rows, max_len: int):
+        """The constrained K-step decode programs (see _get_decode_fn).
+        Carry gains the per-slot FSM state; the union tables ride as
+        ordinary (non-donated) arguments, so arena growth re-uploads
+        without recompiling, and the executables key only on the
+        bucketed table shapes.
+
+        DELIBERATE duplication of _get_decode_fn's scatter step bodies
+        (KEEP THEM IN SYNC — any change to count/forward/penalty/
+        sample there must land here too): the unconstrained variants'
+        byte-identical-executable guarantee is an acceptance-tested
+        contract, and sharing closures would put every future fsm-side
+        edit one trace-time branch away from perturbing it."""
+        sv = self.sample_vocab
+        powers = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+
+        def masked(lg, fst, masks):
+            bits = masks[fst]                        # [S, W] gather
+            # Unpack by broadcast-test-reshape (cheaper than a [S, sv]
+            # word gather: no per-element index math, and XLA fuses
+            # the bit test straight into the select).
+            allow = (bits[:, :, None]
+                     & powers[None, None, :]) != 0   # [S, W, 32]
+            allow = allow.reshape(bits.shape[0], -1)[:, :sv]
+            return jnp.where(allow, lg, jnp.float32(-1e30))
+
+        def advance(fst, nxt, act, sel, cls, nexts):
+            ns = nexts[fst, cls[sel, nxt]]
+            return jnp.where(act, ns, fst)
+
+        if with_history:
+            @partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+            def decode_fsm_hist(params, cache: KVCache, history, counts,
+                                fsm_state, cur_tokens, positions,
+                                active, temps, topks, topps, reps,
+                                press, freqs, rng, sel, masks, cls,
+                                nexts):
+                def step(carry, _):
+                    ck, cv, ks, vs, hist, cnt, fst, cur, pos, key = carry
+                    key, sub = jax.random.split(key)
+                    act = jnp.logical_and(active, pos < kv_len)
+                    wp = jnp.where(act, pos, max_len)
+                    hist = hist.at[rows, wp].set(cur, mode="drop",
+                                                 unique_indices=True)
+                    cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                                unique_indices=True)
+                    logits, newc = forward_decode(
+                        params, self.cfg, cur, pos,
+                        KVCache(ck, cv, ks, vs), act,
+                        attn_len=kv_len,
+                        pallas_int8=self.use_pallas_int8)
+                    lg = apply_penalties(logits[:, :sv], cnt, reps,
+                                         press, freqs)
+                    lg = masked(lg, fst, masks)
+                    nxt = sample_tokens(lg, sub, temps, topks, topps,
+                                        method=self.sampling_method)
+                    fst = advance(fst, nxt, act, sel, cls, nexts)
+                    pos = pos + act.astype(pos.dtype)
+                    return (newc.k, newc.v, newc.k_scale, newc.v_scale,
+                            hist, cnt, fst, nxt, pos, key), nxt
+
+                (ck, cv, ks, vs, hist, cnt, fst, cur, pos, rng), toks \
+                    = jax.lax.scan(
+                        step, (cache.k, cache.v, cache.k_scale,
+                               cache.v_scale, history, counts,
+                               fsm_state, cur_tokens, positions, rng),
+                        None, length=steps)
+                return KVCache(ck, cv, ks, vs), hist, cnt, fst, toks, \
+                    cur, pos, rng
+
+            return decode_fsm_hist
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def decode_fsm(params, cache: KVCache, counts, fsm_state,
+                       cur_tokens, positions, active, temps, topks,
+                       topps, reps, press, freqs, rng, sel, masks, cls,
+                       nexts):
+            def step(carry, _):
+                ck, cv, ks, vs, cnt, fst, cur, pos, key = carry
+                key, sub = jax.random.split(key)
+                act = jnp.logical_and(active, pos < kv_len)
+                cnt = cnt.at[rows, cur].add(act.astype(jnp.int32),
+                                            unique_indices=True)
+                logits, newc = forward_decode(
+                    params, self.cfg, cur, pos,
+                    KVCache(ck, cv, ks, vs), act,
+                    attn_len=kv_len,
+                    pallas_int8=self.use_pallas_int8)
+                lg = apply_penalties(logits[:, :sv], cnt, reps,
+                                     press, freqs)
+                lg = masked(lg, fst, masks)
+                nxt = sample_tokens(lg, sub, temps, topks, topps,
+                                    method=self.sampling_method)
+                fst = advance(fst, nxt, act, sel, cls, nexts)
+                pos = pos + act.astype(pos.dtype)
+                return (newc.k, newc.v, newc.k_scale, newc.v_scale,
+                        cnt, fst, nxt, pos, key), nxt
+
+            (ck, cv, ks, vs, cnt, fst, cur, pos, rng), toks = \
+                jax.lax.scan(
+                    step, (cache.k, cache.v, cache.k_scale,
+                           cache.v_scale, counts, fsm_state,
+                           cur_tokens, positions, rng), None,
+                    length=steps)
+            return KVCache(ck, cv, ks, vs), cnt, fst, toks, cur, pos, \
+                rng
+
+        return decode_fsm
 
     def _get_spec_decode_fn(self, kv_len: int, steps: int):
         """K speculative steps in one jitted call (single-device scatter
@@ -1794,6 +2086,258 @@ class TPUEngine(EngineBase):
                 continue  # snapshot current or in flight
             self._park_slot(slot, kept)
 
+    # ---------------- structured decoding ----------------
+    # (fasttalk_tpu/structured/; docs/STRUCTURED.md)
+
+    def _get_st_compiler(self) -> FSMCompiler:
+        """The (schema, tokenizer) FSM compiler+cache. Lazy and lock-
+        guarded: first touched from the asyncio side (generate), and a
+        plain-serving engine never builds the vocab byte table at
+        all — the subsystem stays zero-cost until first use."""
+        if self._st_compiler is None:
+            with self._st_compiler_lock:
+                if self._st_compiler is None:
+                    self._st_compiler = FSMCompiler(
+                        self.tokenizer,
+                        cache_size=self._st_cfg["cache_size"],
+                        max_states=self._st_cfg["max_states"],
+                        json_depth=self._st_cfg["json_depth"],
+                        sample_vocab=self.sample_vocab)
+        return self._st_compiler
+
+    def _st_register(self, req: _Request) -> None:
+        """Pin a constrained request's FSM into the device union arena
+        (engine thread, at admission). Growing the arena re-packs state
+        offsets, so with constrained calls in flight the pipeline is
+        drained first — the host FSM mirrors become authoritative and
+        the refreshed per-slot states cannot rewind the device copy.
+        Raises ArenaFull when running requests pin the whole budget."""
+        if self._st_arena is None:
+            self._st_arena = FSMArena(
+                self.sample_vocab,
+                tuple(sorted(t for t in self.tokenizer.eos_ids
+                             if 0 <= t < self.sample_vocab)),
+                self.num_slots,
+                state_budget=self._st_cfg["state_budget"])
+        arena = self._st_arena
+        before = arena.state_cap
+        req.fsm_entry = arena.register(req.fsm)
+        if arena.dirty:
+            if any(r.fsm is not None for _, r in
+                   [p for call in self._inflight for p in call[3]]):
+                while self._inflight:
+                    self._retire_oldest()
+            if any(r.fsm is not None for _, _, r in
+                   [e for _, ents in self._pending_firsts
+                    for e in ents]):
+                self._drain_firsts(block=True)
+            self._st_upload_tables()
+            # Offsets may have moved: refresh every ACTIVE constrained
+            # slot's device state from the (now-authoritative) host
+            # mirrors.
+            for s, r in self._running.items():
+                if r.fsm is not None and r.fsm_entry is not None:
+                    self._st_sel[s] = r.fsm_entry.sel
+                    self._st_dirty.add(s)
+            if arena.state_cap != before:
+                # New table shapes: the constrained decode executables
+                # key on them (one compile per capacity bucket).
+                self._note_compile("structured_tables",
+                                   states=arena.state_cap,
+                                   classes=arena.class_cap)
+
+    def _st_upload_tables(self) -> None:
+        arena = self._st_arena
+        self._st_masks_dev = self._put(arena.masks)
+        self._st_nexts_dev = self._put(arena.nexts)
+        self._st_cls_dev = self._put(arena.cls)
+        arena.dirty = False
+
+    def _st_release(self, req: _Request) -> None:
+        """Terminal-path cleanup for a constrained request (inside
+        _finish): unpin the FSM (tables stay cached for the next
+        request of the same schema) and park the slot's device state
+        back in FREE so a later unconstrained occupant is untouched."""
+        self._st_jf_pending.discard(req.request_id)
+        if req.fsm_entry is not None and self._st_arena is not None:
+            self._st_arena.release(req.fsm)
+            req.fsm_entry = None
+        slot = req.slot
+        if slot is not None:
+            self._st_sel[slot.index] = 0
+            self._st_dirty.add(slot.index)
+
+    def _st_global_state(self, slot_index: int) -> int:
+        req = self._running.get(slot_index)
+        if req is None or req.fsm is None or req.fsm_entry is None:
+            return 0  # FREE
+        return self._st_arena.global_state(req.fsm_entry,
+                                           req.fsm_state)
+
+    def _get_st_patch_fn(self):
+        """Scatter host-authoritative FSM states onto the chained
+        device vector (finish→FREE resets, arena-repack refreshes)."""
+        if self._st_patch_fn is None:
+            @partial(jax.jit, donate_argnums=(1,))
+            def st_patch(packed, fst):
+                dirty = packed[:, 0] > 0.5
+                return jnp.where(dirty, packed[:, 1].astype(fst.dtype),
+                                 fst)
+
+            self._st_patch_fn = st_patch
+        return self._st_patch_fn
+
+    def _get_st_sample_fn(self):
+        """Masked sample-and-place: complete a constrained prefill (or
+        a jump-forward) by sampling the next token under the packed
+        allowed-row of the request's current FSM state, scattering it
+        into the decode chain's current-token vector AND advancing the
+        slot's device FSM state — one program, no host round trip
+        before the first decode call."""
+        if self._st_sample_fn is None:
+            self._note_compile("st_sample")
+            sv = self.sample_vocab
+            widx = jnp.arange(sv) // 32
+            wsh = (jnp.arange(sv) % 32).astype(jnp.uint32)
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def st_sample(last_logits, cur, fst, rng, cfg_row,
+                          mask_row, cls, nexts):
+                slot = cfg_row[0].astype(jnp.int32)
+                state = cfg_row[4].astype(jnp.int32)
+                sel = cfg_row[5].astype(jnp.int32)
+                rng, sub = jax.random.split(rng)
+                allow = ((mask_row[widx] >> wsh)
+                         & jnp.uint32(1)).astype(bool)
+                lg = jnp.where(allow,
+                               last_logits[:sv].astype(jnp.float32),
+                               jnp.float32(-1e30))
+                tok = sample_tokens(
+                    lg[None], sub, cfg_row[1][None],
+                    cfg_row[2].astype(jnp.int32)[None],
+                    cfg_row[3][None], method=self.sampling_method)
+                ns = nexts[state, cls[sel, tok[0]]]
+                return (tok, cur.at[slot].set(tok[0], mode="drop"),
+                        fst.at[slot].set(ns, mode="drop"), rng)
+
+            self._st_sample_fn = st_sample
+        return self._st_sample_fn
+
+    def _st_sample_place(self, req: _Request, slot: Slot,
+                         last_logits: Any) -> None:
+        """Run the masked sample-place for one constrained slot and
+        queue the token's emission (same deferred-fetch discipline as
+        plain prefill completion)."""
+        entry = req.fsm_entry
+        gstate = self._st_arena.global_state(entry, req.fsm_state)
+        mask_row = pack_mask_row(req.fsm, req.fsm_state,
+                                 self._st_arena.words,
+                                 req.fsm.eos_ids)
+        cfg_row = np.array([slot.index, req.params.temperature,
+                            req.params.top_k, req.params.top_p,
+                            gstate, entry.sel], np.float32)
+        first, self._cur_tokens, self._st_state_dev, self._rng_dev = \
+            self._get_st_sample_fn()(
+                last_logits, self._cur_tokens, self._st_state_dev,
+                self._rng_dev, self._arg(cfg_row),
+                self._arg(mask_row), self._st_cls_dev,
+                self._st_nexts_dev)
+        # The program just wrote this slot's authoritative state
+        # (post-first-token). A pending host-side patch for the slot —
+        # the previous occupant's finish→FREE reset, queued before
+        # this admission — is now obsolete and would REWIND the device
+        # FSM by one token (the host mirror lags until the deferred
+        # first-token fetch drains): drop it.
+        self._st_dirty.discard(slot.index)
+        self._defer_first(first, [(0, slot.index, req)])
+
+    def _st_penalties_neutral(self, req: _Request) -> bool:
+        p = req.params
+        return (p.repeat_penalty == 1.0 and p.presence_penalty == 0.0
+                and p.frequency_penalty == 0.0)
+
+    def _st_note_jump_candidate(self, req: _Request) -> None:
+        """Called per consumed token for constrained requests: when the
+        new state opens a forced single-transition chain long enough to
+        beat one pipeline bubble, queue a jump. Jump-forward needs
+        neutral penalties (forced tokens bypass the on-device count
+        maintenance); with penalties active the decode steps still emit
+        the same forced tokens — only the speed-up is skipped."""
+        if self._st_jf_min <= 0 or not self._st_penalties_neutral(req):
+            return
+        if req.fsm_state < 0 \
+                or int(req.fsm.forced_tok[req.fsm_state]) < 0:
+            return  # DONE/DEAD sentinel, or not a forced state
+        chain, _ = req.fsm.forced_chain(req.fsm_state)
+        if len(chain) >= self._st_jf_min:
+            self._st_jf_pending.add(req.request_id)
+
+    def _st_jump_forward(self) -> None:
+        """SGLang-style compressed-FSM jump: when a constrained slot's
+        FSM state has a single outgoing transition chain, emit the
+        forced tokens directly — one prefill call writes their KV rows
+        (model steps skipped entirely), the text streams immediately,
+        and a masked sample from the chain-end state restarts ordinary
+        decoding. Runs only with the pipeline empty, so the host FSM
+        mirrors are authoritative and no in-flight call can double-emit
+        the chain."""
+        self._drain_firsts(block=True)
+        pending, self._st_jf_pending = self._st_jf_pending, set()
+        for rid in pending:
+            req = self._by_id.get(rid)
+            if req is None or req.finished or req.slot is None:
+                continue
+            slot = req.slot
+            if self._running.get(slot.index) is not req:
+                continue
+            chain, _end = req.fsm.forced_chain(req.fsm_state)
+            room = min(req.params.max_tokens - req.generated,
+                       self.usable_len - len(slot.tokens) - 1,
+                       self.prefill_chunk - 1)
+            n = min(len(chain), room)
+            if n < self._st_jf_min:
+                continue
+            chain = chain[:n]
+            start = int(self._positions[slot.index])
+            # Feed the not-yet-fed newest token plus the whole chain:
+            # the returned last-token logits then predict the token
+            # AFTER the chain — exactly what the masked sample needs.
+            feed = [slot.tokens[-1]] + chain
+            bucket = next((b for b in _PREFILL_BUCKETS
+                           if b >= len(feed)), None)
+            if bucket is None or start + bucket > self.max_len:
+                continue  # no room: plain decode emits the chain
+            t0 = time.monotonic()
+            padded = np.zeros((bucket,), np.int32)
+            padded[:len(feed)] = feed
+            fn = self._get_prefill_fn(bucket)
+            self.cache, last_logits = fn(
+                self.params, self.cache, self._arg(padded),
+                np.int32(start), np.int32(slot.index), np.int32(n))
+            self._positions[slot.index] = start + n + 1
+            slot.kv_written = start + n + 1
+            self._dirty_slots.add(slot.index)
+            for tok in chain:
+                if req.finished \
+                        or self._running.get(slot.index) is not req:
+                    break
+                self._consume_token(req, tok)
+                req.jump_tokens += 1
+                self._m_st_jump.inc()
+            self._flush_emit(req)
+            if self._tracer.enabled:
+                self._tracer.step(
+                    "engine_prefill", t0, time.monotonic(),
+                    bucket=bucket, tokens=len(feed), rows=bucket,
+                    kind="jump_forward",
+                    flops=self._perf.call_flops(len(feed), start + n))
+                self._tracer.add_span(
+                    req.request_id, "jump_forward", t0,
+                    time.monotonic(), tokens=n)
+            if req.finished:
+                continue
+            self._st_sample_place(req, slot, last_logits)
+
     def _get_prefill_fn(self, chunk: int):
         fn = self._prefill_fns.get(chunk)
         if fn is not None:
@@ -2095,8 +2639,19 @@ class TPUEngine(EngineBase):
                     idle_wait = not self._inflight and not (
                         self._running and self._should_dispatch())
                     self._drain_firsts(block=idle_wait)
+                if self._st_jf_pending and not self._inflight:
+                    # Jump-forward fires only on an empty pipeline (the
+                    # host FSM mirrors are then authoritative); while a
+                    # jump is pending, dispatch pauses below so the
+                    # pipeline drains within one retirement. If the
+                    # chain evaporates (state moved on), decoding
+                    # resumes untouched — the mask makes the decode
+                    # steps emit the forced tokens correctly either
+                    # way; jump-forward is purely the fast path.
+                    self._st_jump_forward()
                 if self._running:
-                    if self._should_dispatch():
+                    if self._should_dispatch() \
+                            and not self._st_jf_pending:
                         self._dispatch_decode()
                         if len(self._inflight) >= self.pipeline_depth:
                             self._retire_oldest()
@@ -2140,6 +2695,11 @@ class TPUEngine(EngineBase):
                 if req.finished:
                     continue
                 req.finished = True
+            if req.fsm is not None:
+                # Unpin from the FSM arena (the abort path bypasses
+                # _finish): a leaked ref would pin the schema's states
+                # for the engine's lifetime.
+                self._st_release(req)
             self._record_slo(req, ok=False)
             self._emit(req, {"type": "error", "error": reason,
                              "code": "internal_error"})
@@ -2149,6 +2709,7 @@ class TPUEngine(EngineBase):
         self._running.clear()
         self._inflight.clear()
         self._pending_firsts.clear()
+        self._st_jf_pending.clear()
 
     def _drain_commands(self, block: bool) -> bool:
         """Process queued commands. Returns False on stop."""
@@ -2324,6 +2885,23 @@ class TPUEngine(EngineBase):
                              error=f"prompt ({len(prompt)} tok) exceeds "
                              "context")
                 continue
+            if req.fsm is not None:
+                # Constrained admission: pin the FSM into the device
+                # arena, then take the single-slot prefill path — its
+                # completion samples the first token under the start-
+                # state mask (the batched group's fused sampler is
+                # unmasked). Structured requests are the minority; the
+                # batched path stays untouched for everyone else.
+                try:
+                    self._st_register(req)
+                except ArenaFull as e:
+                    self._finish(req, "error", error=str(e),
+                                 code="structured_capacity")
+                    continue
+                self._prefilling.append(
+                    _PrefillState(req=req, slot=slot, start=reused,
+                                  todo=todo))
+                continue
             bucket = next((b for b in _PREFILL_BUCKETS if b >= len(todo)),
                           None)
             if bucket is not None and len(todo) <= allowed \
@@ -2445,6 +3023,13 @@ class TPUEngine(EngineBase):
                 return  # next chunk on a later iteration
             self._prefilling.pop(0)
             self._m_prefill.observe((time.monotonic() - st.t0) * 1000)
+            if req.fsm is not None:
+                # Masked first-token sample from the FSM start state;
+                # also activates — _st_sample_place defers the fetch
+                # like the plain path below.
+                self._activate(req, slot)
+                self._st_sample_place(req, slot, st.last_logits)
+                return
             cfg_row = np.array([slot.index, req.params.temperature,
                                 req.params.top_k, req.params.top_p],
                                np.float32)
@@ -2695,6 +3280,10 @@ class TPUEngine(EngineBase):
                     prompt_tokens=len(req.prompt_tokens))
                 self._tracer.set_phase(req.request_id, "decode")
         self._running[s] = req
+        if req.fsm_entry is not None:
+            # The slot's row into the arena's per-FSM class table —
+            # shipped with every constrained decode call.
+            self._st_sel[s] = req.fsm_entry.sel
         self._positions[s] = len(slot.tokens)
         self._active_mask[s] = True
         self._temps[s] = req.params.temperature
@@ -2771,6 +3360,17 @@ class TPUEngine(EngineBase):
         old flush-the-pipeline-and-reupload on every slot-set change,
         which serialised admission behind up to pipeline_depth decode
         calls."""
+        if self._st_dirty:
+            # FSM-state resets/refreshes (finish → FREE, arena repack):
+            # a separate tiny program so the shared patch executable —
+            # and therefore the unconstrained serving path — stays
+            # byte-identical to the pre-structured engine.
+            packed = np.zeros((self.num_slots, 2), np.float32)
+            for s in self._st_dirty:
+                packed[s] = (1.0, self._st_global_state(s))
+            self._st_dirty.clear()
+            self._st_state_dev = self._get_st_patch_fn()(
+                self._arg(packed), self._st_state_dev)
         if self.spec_draft and self._dirty_history:
             # Prompt tokens of freshly admitted slots -> device history
             # (one bucketed upload + one program that pads to max_len
@@ -2844,8 +3444,15 @@ class TPUEngine(EngineBase):
         # be at the END of this call.
         base = int(self._positions[active].max()) \
             + sum(adv for _, _, adv, _, _, _ in self._inflight)
+        # Constrained slot running → the per-call compat matrix
+        # (docs/STRUCTURED.md): speculative calls pause (verify-block
+        # masking is unvalidated in v1) and the fsm decode variants
+        # carry the per-slot FSM state + union tables. With NO
+        # constrained slot this block is untouched and the original
+        # executables dispatch — the zero-cost-when-off guarantee.
+        st_on = any(r.fsm is not None for r in self._running.values())
         T = self.spec_draft + 1
-        if self.spec_draft and self._spec_call_wanted():
+        if self.spec_draft and not st_on and self._spec_call_wanted():
             # Size the KV bucket by the EMA-EXPECTED advance (+1 block
             # of headroom), not the K*T worst case: worst-case sizing
             # jumped to the next bucket immediately — a mid-stream
@@ -2897,29 +3504,58 @@ class TPUEngine(EngineBase):
             # Auto mode chose plain for this call (or the spec bucket
             # check fell through): keep the draft history fresh so the
             # next probe drafts from current text, not stale history.
-            fn = self._get_decode_fn(kv_len, steps, with_history=True)
+            fn = self._get_decode_fn(kv_len, steps, with_history=True,
+                                     with_fsm=st_on)
             self._sink("decode", kv_len=kv_len, steps=steps,
                        with_history=True)
-            (self.cache, self._history_dev, self._counts_dev, toks,
-             self._cur_tokens, self._positions_dev, self._rng_dev) = fn(
-                self.params, self.cache, self._history_dev,
-                self._counts_dev, self._cur_tokens, self._positions_dev,
-                self._active_dev, self._temps_dev, self._topks_dev,
-                self._topps_dev, self._reps_dev, self._press_dev,
-                self._freqs_dev, self._rng_dev)
+            if st_on:
+                (self.cache, self._history_dev, self._counts_dev,
+                 self._st_state_dev, toks, self._cur_tokens,
+                 self._positions_dev, self._rng_dev) = fn(
+                    self.params, self.cache, self._history_dev,
+                    self._counts_dev, self._st_state_dev,
+                    self._cur_tokens, self._positions_dev,
+                    self._active_dev, self._temps_dev, self._topks_dev,
+                    self._topps_dev, self._reps_dev, self._press_dev,
+                    self._freqs_dev, self._rng_dev,
+                    self._arg(self._st_sel.copy()),
+                    self._st_masks_dev, self._st_cls_dev,
+                    self._st_nexts_dev)
+            else:
+                (self.cache, self._history_dev, self._counts_dev, toks,
+                 self._cur_tokens, self._positions_dev,
+                 self._rng_dev) = fn(
+                    self.params, self.cache, self._history_dev,
+                    self._counts_dev, self._cur_tokens,
+                    self._positions_dev, self._active_dev,
+                    self._temps_dev, self._topks_dev, self._topps_dev,
+                    self._reps_dev, self._press_dev, self._freqs_dev,
+                    self._rng_dev)
             self._inflight.append(
                 (self._fetch_pool.submit(np.asarray, toks), steps, steps,
                  snapshot, t_disp, kv_len))
             return
-        fn = self._get_decode_fn(kv_len, steps)
+        fn = self._get_decode_fn(kv_len, steps, with_fsm=st_on)
         self._sink("decode", kv_len=kv_len, steps=steps,
                    with_history=False)
-        (self.cache, self._counts_dev, toks, self._cur_tokens,
-         self._positions_dev, self._rng_dev) = fn(
-            self.params, self.cache, self._counts_dev, self._cur_tokens,
-            self._positions_dev, self._active_dev, self._temps_dev,
-            self._topks_dev, self._topps_dev, self._reps_dev,
-            self._press_dev, self._freqs_dev, self._rng_dev)
+        if st_on:
+            (self.cache, self._counts_dev, self._st_state_dev, toks,
+             self._cur_tokens, self._positions_dev, self._rng_dev) = fn(
+                self.params, self.cache, self._counts_dev,
+                self._st_state_dev, self._cur_tokens,
+                self._positions_dev, self._active_dev, self._temps_dev,
+                self._topks_dev, self._topps_dev, self._reps_dev,
+                self._press_dev, self._freqs_dev, self._rng_dev,
+                self._arg(self._st_sel.copy()), self._st_masks_dev,
+                self._st_cls_dev, self._st_nexts_dev)
+        else:
+            (self.cache, self._counts_dev, toks, self._cur_tokens,
+             self._positions_dev, self._rng_dev) = fn(
+                self.params, self.cache, self._counts_dev,
+                self._cur_tokens, self._positions_dev, self._active_dev,
+                self._temps_dev, self._topks_dev, self._topps_dev,
+                self._reps_dev, self._press_dev, self._freqs_dev,
+                self._rng_dev)
         # Start the device→host copy NOW on a worker thread: by
         # retirement time it has been in flight for a whole call's
         # compute, and later calls' fetches overlap it (see the
@@ -3001,6 +3637,8 @@ class TPUEngine(EngineBase):
             # the FLOP estimate both imply.
             t1 = time.monotonic()
             spec = res.ndim == 3
+            constrained = sum(1 for _, r in snapshot
+                              if r.fsm is not None)
             occupancy = round(len(snapshot) / max(1, self.num_slots), 3)
             rows = int(res.shape[0]) * self.num_slots \
                 * (res.shape[2] - 1 if spec else 1)
@@ -3016,7 +3654,13 @@ class TPUEngine(EngineBase):
                 tokens=consumed, rows=rows, kv_len=kv_len,
                 flops=self._perf.call_flops(consumed, kv_len),
                 kv_bytes=int(res.shape[0]) * self.num_slots * kv_len
-                * self._kv_row_bytes)
+                * self._kv_row_bytes,
+                # Mask-apply attribution (docs/STRUCTURED.md): rows
+                # with constrained>0 ran the fsm decode variant — the
+                # per-step mask gather/unpack cost is the step-duration
+                # delta against constrained-free rows of the same
+                # (steps, kv_len) shape in the perf ledger.
+                **({"constrained": constrained} if constrained else {}))
             for s, req in snapshot:
                 self._tracer.add_span(
                     req.request_id, "decode_step", t_disp, t1,
@@ -3037,6 +3681,12 @@ class TPUEngine(EngineBase):
         assert slot is not None and req.detok is not None
         slot.tokens.append(token_id)
         req.generated += 1
+        if req.fsm is not None:
+            # Host mirror of the on-device FSM advance: one dict-free
+            # table lookup per token. The device copy is authoritative
+            # inside the scan; this replay is what _finish, the
+            # terminal-accept check and jump-forward read.
+            req.fsm_state = req.fsm.step(req.fsm_state, token_id)
         now = time.monotonic()
         if req.last_token_at is not None:
             gap_ms = (now - req.last_token_at) * 1000
@@ -3057,10 +3707,21 @@ class TPUEngine(EngineBase):
             self._stream_text(req, delta)
         if req.finished:
             return  # stop string hit inside _stream_text
+        if req.fsm is not None and req.fsm.is_terminal(req.fsm_state):
+            # The FSM reached an accept state with EOS as the only
+            # continuation: the document is complete. Finish with
+            # "stop" NOW — before the budget check below, so a
+            # generation that completes its document on its last
+            # budgeted token reports "stop", not "length" — and
+            # without spending a decode step on the EOS itself.
+            self._finish(req, "stop")
+            return
         if req.generated >= req.params.max_tokens:
             self._finish(req, "length")
         elif len(slot.tokens) >= self.usable_len:
             self._finish(req, "length")
+        elif req.fsm is not None:
+            self._st_note_jump_candidate(req)
 
     def _stream_text(self, req: _Request, delta: str) -> None:
         """Emit text, holding back any suffix that could start a stop seq."""
@@ -3122,6 +3783,8 @@ class TPUEngine(EngineBase):
                     self._slo.record_shed(req.params.priority)
             else:
                 self._record_slo(req, ok=error is None)
+        if req.fsm is not None:
+            self._st_release(req)
         slot = req.slot
         if slot is not None:
             decoding = self._running.get(slot.index) is req
@@ -3185,6 +3848,9 @@ class TPUEngine(EngineBase):
                     attrs["spec_accepted"] = req.spec_accepted
                     attrs["spec_rejected"] = (req.spec_drafted
                                               - req.spec_accepted)
+                if req.fsm is not None:
+                    attrs["structured"] = True
+                    attrs["jump_tokens"] = req.jump_tokens
                 self._tracer.add_span(req.request_id, "decode",
                                       req.decode_started_at, now,
                                       summary=True, **attrs)

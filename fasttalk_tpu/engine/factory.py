@@ -277,5 +277,11 @@ def build_engine(cfg: Config) -> EngineBase:
         kv_park_idle_s=cfg.kv_park_idle_s,
         kv_restore_min_tokens=cfg.kv_restore_min_tokens,
         kv_quant=cfg.kv_quant,
-        kv_quant_granule=cfg.kv_quant_granule)
+        kv_quant_granule=cfg.kv_quant_granule,
+        structured=cfg.structured_mode,
+        structured_max_states=cfg.structured_max_states,
+        structured_state_budget=cfg.structured_state_budget,
+        structured_jf_min=cfg.structured_jf_min,
+        structured_cache=cfg.structured_cache,
+        structured_json_depth=cfg.structured_json_depth)
     return engine

@@ -282,6 +282,28 @@ class VLLMRemoteEngine(_RemoteEngine):
         }
         if params.repeat_penalty != 1.0 and not self._no_repetition_penalty:
             body["repetition_penalty"] = params.repeat_penalty
+        if params.structured is not None:
+            # Structured passthrough (docs/STRUCTURED.md): the JSON
+            # kinds map onto the upstream's own response_format; kinds
+            # the OpenAI wire protocol cannot express fail loudly —
+            # silently serving unconstrained output would break the
+            # validity contract the client asked for.
+            kind = params.structured.get("kind")
+            if kind == "json_object":
+                body["response_format"] = {"type": "json_object"}
+            elif kind == "json_schema":
+                body["response_format"] = {
+                    "type": "json_schema",
+                    "json_schema": {"name": "response", "strict": True,
+                                    "schema":
+                                        params.structured["schema"]}}
+            else:
+                raise LLMServiceError(
+                    f"structured kind {kind!r} cannot pass through an "
+                    "OpenAI-compatible upstream (json_object/"
+                    "json_schema only)",
+                    category=ErrorCategory.VALIDATION,
+                    recoverable=False)
         if not self._no_stream_options:
             # Ask the backend for its own token accounting (an OpenAI /
             # vLLM-supported option): the final chunk then carries
@@ -492,6 +514,22 @@ class OllamaRemoteEngine(_RemoteEngine):
             body["messages"] = messages
         if params.stop:
             body["options"]["stop"] = params.stop
+        if params.structured is not None:
+            # Ollama's structured-outputs surface: format="json" for
+            # the generic contract, format=<schema> for a JSON Schema.
+            # Other kinds cannot be expressed — fail loudly rather
+            # than silently dropping the constraint.
+            kind = params.structured.get("kind")
+            if kind == "json_object":
+                body["format"] = "json"
+            elif kind == "json_schema":
+                body["format"] = params.structured["schema"]
+            else:
+                raise LLMServiceError(
+                    f"structured kind {kind!r} cannot pass through an "
+                    "Ollama upstream (json_object/json_schema only)",
+                    category=ErrorCategory.VALIDATION,
+                    recoverable=False)
         started = time.monotonic()
         ttft = None
         chunks = 0

@@ -414,6 +414,22 @@ class FleetRouter(EngineBase):
                            "code": "replica_failed"}
                     return
                 if delivered > 0:
+                    if params.structured is not None:
+                        # Constrained stream (docs/STRUCTURED.md): a
+                        # resume re-generates the document on the
+                        # survivor, and splicing a NEW document onto
+                        # already-delivered text would hand the client
+                        # invalid output — the one thing structured
+                        # mode promises never happens. Fail the stream
+                        # instead; pre-first-token failover above
+                        # still re-routes silently.
+                        yield {"type": "error",
+                               "error": f"replica {handle.replica_id} "
+                               "died mid-stream of a structured "
+                               "generation (resume would break the "
+                               f"validity contract): {failure}",
+                               "code": "replica_failed"}
+                        return
                     if not self.resume_enabled:
                         yield {"type": "error",
                                "error": f"replica {handle.replica_id} "

@@ -33,8 +33,10 @@ from fasttalk_tpu.agents.hermes import (
 )
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.engine.remote import _RemoteEngine
+from fasttalk_tpu.structured.compiler import validate_structured_spec
 from fasttalk_tpu.utils.errors import (AdmissionRejected, CircuitBreaker,
-                                       CircuitBreakerOpen)
+                                       CircuitBreakerOpen, ErrorCategory,
+                                       LLMServiceError)
 from fasttalk_tpu.utils.logger import get_logger
 
 log = get_logger("serving.openai")
@@ -113,6 +115,68 @@ def _parse_tools(body: dict) -> tuple[list[dict], str | None]:
     elif choice not in (None, "auto"):
         raise _BadRequest(f"unsupported tool_choice {choice!r}")
     return specs, forced
+
+
+def _parse_response_format(body: dict) -> dict | None:
+    """OpenAI ``response_format`` → the engine's structured spec
+    (docs/STRUCTURED.md). Returns None for absent/"text"."""
+    rf = body.get("response_format")
+    if rf is None:
+        return None
+    if not isinstance(rf, dict) or "type" not in rf:
+        raise _BadRequest('response_format must be an object with a '
+                          '"type"')
+    t = rf["type"]
+    if t == "text":
+        return None
+    if t == "json_object":
+        return {"kind": "json_object"}
+    if t == "json_schema":
+        js = rf.get("json_schema")
+        schema = js.get("schema") if isinstance(js, dict) else None
+        if not isinstance(schema, dict):
+            raise _BadRequest(
+                "response_format.json_schema.schema must be a JSON "
+                "Schema object")
+        return {"kind": "json_schema", "schema": schema}
+    raise _BadRequest(f"unsupported response_format type {t!r} "
+                      "(supported: text, json_object, json_schema)")
+
+
+def _check_structured_combos(body: dict, structured: dict | None,
+                             specs: list[dict] | None = None) -> None:
+    """Unsupported-combination guard: every rejection is a clean 400
+    naming the clash, never a 500 from deep inside the engine."""
+    if structured is None:
+        return
+    n = body.get("n", 1)
+    if n not in (None, 1):
+        raise _BadRequest(
+            f"response_format with n={n!r} is not supported "
+            "(constrained decoding serves one choice per request)")
+    if specs:
+        raise _BadRequest(
+            "response_format cannot be combined with tools: the tool-"
+            "call markup would violate the JSON contract (use "
+            "tool_choice to force a schema-constrained tool call "
+            "instead)")
+    if body.get("ignore_eos"):
+        raise _BadRequest(
+            "response_format is incompatible with ignore_eos=true "
+            "(the grammar decides where the document ends)")
+    if body.get("stop"):
+        raise _BadRequest(
+            "response_format is incompatible with stop sequences: a "
+            "stop string can truncate the document mid-grammar and "
+            "break the validity guarantee (the grammar decides where "
+            "the document ends)")
+
+
+def _structured_denied(engine) -> str | None:
+    """The engine's structured-availability reason (None = available).
+    Duck-typed: remote/fake engines without the attribute pass the
+    spec through and decide upstream."""
+    return getattr(_unwrap_agent(engine), "structured_reason", None)
 
 
 def _hermes_messages(messages: list[dict]) -> list[dict]:
@@ -327,6 +391,20 @@ def register_openai_routes(app: web.Application,
                 f"data: {json.dumps({'error': e.to_dict()})}\n\n"
                 .encode())
             await resp.write(b"data: [DONE]\n\n")
+        except LLMServiceError as e:
+            if e.category != ErrorCategory.VALIDATION:
+                if breaker is not None:
+                    breaker.record_failure()
+                raise
+            # Client-shape rejection raised by the engine seam (e.g.
+            # an uncompilable structured schema): headers are already
+            # committed, so it rides an error frame + [DONE] — and the
+            # breaker stays closed, same as the 400 the non-stream
+            # path returns.
+            await resp.write(
+                f"data: {json.dumps({'error': e.to_dict()})}\n\n"
+                .encode())
+            await resp.write(b"data: [DONE]\n\n")
         except Exception:
             if breaker is not None:
                 breaker.record_failure()
@@ -362,6 +440,15 @@ def register_openai_routes(app: web.Application,
                 breaker.record_success()
         except AdmissionRejected:
             raise  # shed, not a backend failure: caller maps to 429
+        except LLMServiceError as e:
+            if e.category == ErrorCategory.VALIDATION:
+                # Client-shape rejection from the engine seam (e.g. an
+                # uncompilable structured schema): caller maps to 400;
+                # the breaker stays closed.
+                raise
+            if breaker is not None:
+                breaker.record_failure()
+            raise
         except Exception:
             if breaker is not None:
                 breaker.record_failure()
@@ -403,6 +490,11 @@ def register_openai_routes(app: web.Application,
         try:
             params = _params(body)
             specs, forced = _parse_tools(body)
+            # Structured output (docs/STRUCTURED.md): response_format
+            # compiles to a token-FSM constraint; unsupported combos
+            # 400 here with the clash named.
+            params.structured = _parse_response_format(body)
+            _check_structured_combos(body, params.structured, specs)
         except (_BadRequest, TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": str(e),
@@ -438,6 +530,48 @@ def register_openai_routes(app: web.Application,
                     status=400)
         if specs:
             messages = _inject_tools_prompt(messages, specs, forced)
+        if forced is not None and params.structured is None \
+                and not isinstance(_unwrap_agent(engine), _RemoteEngine) \
+                and not params.stop \
+                and _structured_denied(engine) is None:
+            # tool_choice forced a call: constrain the whole completion
+            # to hermes tool-call markup whose *arguments* validate
+            # against the tool's parameter schema — the call cannot be
+            # malformed (docs/STRUCTURED.md). In-tree engine only;
+            # remote upstreams bring their own tool enforcement. The
+            # constraint is an internal upgrade the client never asked
+            # for, so when this engine build cannot serve constraints
+            # (mesh/Pallas/STRUCTURED_MODE=off) — or client stop
+            # sequences would clash with the grammar — the request
+            # falls back to the pre-existing prompt-injection +
+            # stream-parser path instead of being rejected.
+            if body.get("ignore_eos"):
+                # Same clash the response_format path 400s on: the
+                # grammar decides where the call ends. Enforced here
+                # because the constraint is attached after _params()
+                # ran GenerationParams' own validation.
+                return web.json_response(
+                    {"error": {"message":
+                               "a forcing tool_choice is incompatible "
+                               "with ignore_eos=true (the tool-call "
+                               "grammar decides where the completion "
+                               "ends)",
+                               "type": "invalid_request_error"}},
+                    status=400)
+            params.structured = validate_structured_spec({
+                "kind": "tool_call",
+                "tools": [{"name": s["name"],
+                           "parameters": s["parameters"]}
+                          for s in specs
+                          if forced == "" or s["name"] == forced]})
+        if params.structured is not None:
+            reason = _structured_denied(engine)
+            if reason is not None:
+                return web.json_response(
+                    {"error": {"message": "structured output "
+                               f"unavailable: {reason}",
+                               "type": "invalid_request_error"}},
+                    status=400)
         parser = HermesStreamParser() if specs else None
         denied = _breaker_503()
         if denied is not None:
@@ -510,6 +644,13 @@ def register_openai_routes(app: web.Application,
                 on_token)
         except AdmissionRejected as e:
             return _reject_429(e)
+        except LLMServiceError as e:
+            if e.category != ErrorCategory.VALIDATION:
+                raise
+            return web.json_response(
+                {"error": {"message": e.message,
+                           "type": "invalid_request_error"}},
+                status=400)
         if err is not None:
             return err
         if parser is not None:
@@ -557,7 +698,9 @@ def register_openai_routes(app: web.Application,
                            "type": "invalid_request_error"}}, status=400)
         try:
             params = _params(body)
-        except (TypeError, ValueError) as e:
+            params.structured = _parse_response_format(body)
+            _check_structured_combos(body, params.structured)
+        except (_BadRequest, TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": str(e),
                            "type": "invalid_request_error"}}, status=400)
@@ -575,6 +718,14 @@ def register_openai_routes(app: web.Application,
         # The raw path never goes through an agent's tool loop.
         engine = _unwrap_agent(get_backend())
         messages = [{"role": "user", "content": prompt}]
+        if params.structured is not None:
+            reason = _structured_denied(engine)
+            if reason is not None:
+                return web.json_response(
+                    {"error": {"message": "structured output "
+                               f"unavailable: {reason}",
+                               "type": "invalid_request_error"}},
+                    status=400)
         denied = _breaker_503()
         if denied is not None:
             return denied
@@ -617,6 +768,13 @@ def register_openai_routes(app: web.Application,
                 on_token)
         except AdmissionRejected as e:
             return _reject_429(e)
+        except LLMServiceError as e:
+            if e.category != ErrorCategory.VALIDATION:
+                raise
+            return web.json_response(
+                {"error": {"message": e.message,
+                           "type": "invalid_request_error"}},
+                status=400)
         if err is not None:
             return err
         return web.json_response({

@@ -46,6 +46,7 @@ from fasttalk_tpu.utils.errors import (
     AdmissionRejected,
     CircuitBreaker,
     CircuitBreakerOpen,
+    ErrorCategory,
     ErrorHandler,
     LLMServiceError,
 )
@@ -419,7 +420,7 @@ class WebSocketLLMServer:
     _GEN_KEYS = ("temperature", "top_p", "top_k", "max_tokens", "stop",
                  "tts_chunking", "repeat_penalty", "presence_penalty",
                  "frequency_penalty", "ignore_eos", "priority",
-                 "deadline_s")
+                 "deadline_s", "structured")
 
     @classmethod
     def _gen_overrides(cls, cfg: dict) -> dict:
@@ -502,6 +503,11 @@ class WebSocketLLMServer:
                                   self.config.sched_default_priority)),
             deadline_s=(float(over["deadline_s"])
                         if over.get("deadline_s") is not None else None),
+            # Constrained decoding (docs/STRUCTURED.md): the session's
+            # "structured" config key ({"kind": "json_object" |
+            # "json_schema" | "regex" | "tool_call", ...}); shape
+            # errors surface as invalid_config via GenerationParams.
+            structured=over.get("structured"),
         )
 
     async def _generate(self, session_id: str, user_text: str,
@@ -542,6 +548,18 @@ class WebSocketLLMServer:
                 await self._send_error(session_id, ws, "invalid_config",
                                        str(e))
                 return
+            if params.structured is not None:
+                # Structured-support probe BEFORE the breaker, mirror
+                # of the /v1 route's 400: an engine that cannot serve
+                # constraints (mesh, Pallas attention, disabled) is a
+                # client-visible config clash, not a backend failure.
+                reason = getattr(self.engine, "structured_reason", None)
+                if reason is not None:
+                    self.connection_manager.record_error(session_id)
+                    await self._send_error(
+                        session_id, ws, "invalid_config",
+                        f"structured output unavailable: {reason}")
+                    return
             self.breaker.check()
             messages = self.conversation_manager.get_messages_for_generation(
                 session_id)
@@ -676,7 +694,13 @@ class WebSocketLLMServer:
             await self._send(session_id, ws,
                              {"type": "error", "error": e.to_dict()})
         except LLMServiceError as e:
-            self.breaker.record_failure()
+            # Client-shape rejections raised at the engine seam
+            # (category VALIDATION — e.g. an uncompilable structured
+            # schema, a too-long prompt) must not open the SHARED
+            # breaker: one misbehaving client would 503 every
+            # session. Mirrors the /v1 routes' exemption.
+            if e.category != ErrorCategory.VALIDATION:
+                self.breaker.record_failure()
             self.error_handler.handle_error(e, {"session_id": session_id})
             self.connection_manager.record_error(session_id)
             await self._send(session_id, ws,

@@ -407,6 +407,43 @@ class Config:
     # diverge, at num_kv_heads x the scale storage).
     kv_quant_granule: str = field(
         default_factory=lambda: _env_str("KV_QUANT_GRANULE", "token"))
+    # ---- Structured decoding (fasttalk_tpu/structured/,
+    # docs/STRUCTURED.md) ----
+    # "auto" (default): constrained requests are served whenever the
+    # engine build supports them and rejected with a named reason
+    # otherwise; "on": an unsupported build is a CONFIG ERROR at
+    # startup (the KV-quant precedent — explicit compat matrix, no
+    # silent degrade): single-device only (no tp/dp/sp mesh, no
+    # multi-host SPMD) and no Pallas decode attention; "off": the
+    # subsystem is disabled and every structured request 400s.
+    # Speculative decoding needs no exclusion — it pauses per decode
+    # call while a constrained slot is running and resumes after.
+    structured_mode: str = field(
+        default_factory=lambda: _env_str("STRUCTURED_MODE", "auto"))
+    # Per-FSM compile bound: a schema whose token FSM exceeds this
+    # many states is rejected with a 400 naming the count.
+    structured_max_states: int = field(
+        default_factory=lambda: _env_int("STRUCTURED_MAX_STATES", 8192))
+    # Device union-arena budget: total FSM states resident across all
+    # concurrently served schemas (tables bucket to powers of two
+    # below this).
+    structured_state_budget: int = field(
+        default_factory=lambda: _env_int("STRUCTURED_STATE_BUDGET",
+                                         16384))
+    # Jump-forward engages when the FSM's forced single-transition
+    # chain is at least this many tokens (0 disables jump-forward;
+    # decode steps then emit forced tokens one model step each).
+    structured_jf_min: int = field(
+        default_factory=lambda: _env_int("STRUCTURED_JF_MIN", 4))
+    # Compiled-FSM LRU entries per engine (keyed on the canonical
+    # schema text; one entry per distinct schema/tokenizer pair).
+    structured_cache: int = field(
+        default_factory=lambda: _env_int("STRUCTURED_CACHE", 64))
+    # response_format={"type":"json_object"} nesting depth: "any JSON"
+    # is not regular, so the generic grammar unrolls to this many
+    # container levels (scalars only at the innermost).
+    structured_json_depth: int = field(
+        default_factory=lambda: _env_int("STRUCTURED_JSON_DEPTH", 3))
     # ---- SLOs + stall watchdog (observability/slo.py, watchdog.py,
     # docs/OBSERVABILITY.md). The observability singletons read the
     # same env knobs at construction; the fields here give operators
@@ -660,6 +697,48 @@ class Config:
                     "KV_QUANT=int8 is incompatible with speculative "
                     "decoding (the verify block's quantize-on-write "
                     "is unvalidated) — set TPU_SPEC_DECODE=off")
+        if self.structured_mode not in ("auto", "on", "off"):
+            errs.append(f"structured_mode must be auto|on|off, "
+                        f"got {self.structured_mode!r}")
+        if self.structured_max_states < 16:
+            errs.append(f"structured_max_states must be >= 16, "
+                        f"got {self.structured_max_states}")
+        if self.structured_state_budget < self.structured_max_states:
+            errs.append(
+                f"structured_state_budget "
+                f"({self.structured_state_budget}) must be >= "
+                f"structured_max_states ({self.structured_max_states}) "
+                "or the largest admissible FSM could never be pinned")
+        if self.structured_jf_min < 0:
+            errs.append(f"structured_jf_min must be >= 0 (0 disables "
+                        f"jump-forward), got {self.structured_jf_min}")
+        if self.structured_cache < 1:
+            errs.append(f"structured_cache must be >= 1, "
+                        f"got {self.structured_cache}")
+        if not 1 <= self.structured_json_depth <= 8:
+            errs.append(f"structured_json_depth must be in 1..8, "
+                        f"got {self.structured_json_depth}")
+        if self.structured_mode == "on":
+            # Explicit opt-in makes the compat matrix a startup error
+            # with the reason, mirroring KV_QUANT=int8 (docs/
+            # STRUCTURED.md): never silently degrade.
+            if self.tp_size > 1 or self.dp_size > 1 or self.sp_size > 1:
+                errs.append(
+                    "STRUCTURED_MODE=on is single-device only in v1 "
+                    "(per-slot FSM state is not threaded through the "
+                    "sharded decode path); set "
+                    "TPU_TP_SIZE=TPU_DP_SIZE=TPU_SP_SIZE=1 or "
+                    "STRUCTURED_MODE=auto")
+            if self.spmd_role != "off":
+                errs.append("STRUCTURED_MODE=on is incompatible with "
+                            "multi-host SPMD serving; set "
+                            "TPU_SPMD_ROLE=off")
+            if self.use_pallas_attention:
+                errs.append(
+                    "STRUCTURED_MODE=on is incompatible with the "
+                    "Pallas decode-attention kernel (non-scatter "
+                    "decode path) — set TPU_USE_PALLAS_ATTENTION="
+                    "false")
         if self.kv_host_budget_mb > 0:
             # Warn (don't fail) when the budget exceeds detectable host
             # RAM: the pool would page/OOM long before filling.

@@ -46,6 +46,21 @@
 #                             `resumed` integration, /fleet endpoints,
 #                             and the remote-client pre-first-token
 #                             retry discipline (docs/ROUTER.md).
+#   ./run_tests.sh --structured  structured-decoding group: the
+#                             schema→regex→DFA→token-FSM compiler
+#                             (tokenizer-boundary cases incl.
+#                             multi-byte UTF-8 and ByteLevel-BPE
+#                             tokens spanning FSM edges), the device
+#                             union arena, engine-level constrained
+#                             generation (greedy determinism,
+#                             adversarial schema battery on the
+#                             trained tinychat checkpoint,
+#                             jump-forward equivalence, cancel races,
+#                             zero-cost-when-off), the /v1
+#                             response_format + tool_choice and WS
+#                             `structured` surfaces, and the hermes
+#                             split-tag streaming parser
+#                             (docs/STRUCTURED.md).
 #   ./run_tests.sh --perf     perf-attribution/flight-recorder group:
 #                             the step ledger (wall-time decomposition,
 #                             padding waste, MFU, compile ledger),
@@ -169,6 +184,30 @@ except client.Backoff as b:
 client._maybe_backoff({"error": {"code": "model_error",
                                  "message": "boom"}})
 print("client backoff classifier OK")
+EOF
+    exit 0
+fi
+
+if [[ "${1:-}" == "--structured" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_structured.py "$@"
+    echo "--- FSM compiler smoke (schema -> regex -> DFA -> token FSM"
+    echo "    over the byte tokenizer; docs/STRUCTURED.md) ---"
+    "${PYENV[@]}" python - <<'EOF'
+import json
+from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+from fasttalk_tpu.structured import FSMCompiler
+
+comp = FSMCompiler(ByteTokenizer())
+fsm = comp.compile({"kind": "json_schema", "schema": {
+    "type": "object", "properties": {
+        "city": {"type": "string", "maxLength": 12},
+        "units": {"enum": ["C", "F"]}}}})
+chain, _ = fsm.forced_chain(fsm.start)
+assert bytes(chain).startswith(b'{"city":"'), bytes(chain)
+print(f"token FSM: {fsm.n_states} states, {fsm.n_classes} classes, "
+      f"forced prefix {bytes(chain)!r}")
+comp.shutdown()
 EOF
     exit 0
 fi
