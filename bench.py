@@ -30,6 +30,15 @@ latency p50 both ways, and decode tok/s (must stay within noise).
 control (target <5% tok/s), and jump-forward's forced-token fraction +
 e2e delta on a forced-chain-heavy schema, greedy, engine-seam.
 
+``BENCH_MODE=chaos`` runs the recovery-path scenario
+(docs/RESILIENCE.md): (1) a failpoints-off control — off vs
+armed-but-inert (p=0 rule on the decode dispatch seam) must agree
+within 1% tok/s, proving FAULT_POINTS-unset costs nothing; (2)
+engine-restart MTTR p50 over injected crash_thread drills
+(crash-detected -> supervised restart -> first post-restart token);
+(3) router failover resume-latency p50 (kill a replica mid-decode
+under a FakeEngine fleet — the routing layer's recovery deadline).
+
 ``BENCH_MODE=overload`` runs the admission-control scenario
 (docs/SCHEDULING.md): an OPEN-LOOP arrival process (one request every
 ``BENCH_ARRIVAL_MS`` ms for ``BENCH_OVERLOAD_S`` s, regardless of
@@ -1031,6 +1040,286 @@ async def bench_structured(engine) -> dict:
     return res
 
 
+# ---------------- chaos mode (docs/RESILIENCE.md) ----------------
+
+async def _chaos_failover_drill(streams: int = 8,
+                                delay_s: float = 0.004) -> dict:
+    """Router failover recovery timing: N streams over two replicas,
+    kill one mid-decode, measure kill->`resumed` latency per affected
+    stream. FakeEngine-based on purpose — this measures the ROUTING
+    layer's recovery deadline, not model throughput (the real-engine
+    fleet is BENCH_MODE=fleet), so it runs in milliseconds and is
+    device-independent."""
+    from fasttalk_tpu.engine.engine import GenerationParams
+    from fasttalk_tpu.engine.fake import FakeEngine
+    from fasttalk_tpu.router import FleetRouter, ReplicaHandle
+    from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+
+    class Mortal(FakeEngine):
+        def __init__(self):
+            super().__init__(reply="alpha beta gamma delta epsilon "
+                             "zeta eta theta ", n_repeats=12,
+                             delay_s=delay_s)
+            self.dead = False
+
+        def kill(self):
+            self.dead = True
+            self._started = False
+
+        def check_connection(self):
+            return not self.dead and self._started
+
+        async def generate(self, rid, sid, messages, params):
+            if self.dead:
+                raise LLMServiceError(
+                    "replica down", category=ErrorCategory.CONNECTION)
+            async for ev in super().generate(rid, sid, messages,
+                                             params):
+                if self.dead:
+                    raise LLMServiceError(
+                        "replica died mid-stream",
+                        category=ErrorCategory.CONNECTION)
+                yield ev
+
+    engines = [Mortal(), Mortal()]
+    for e in engines:
+        e.start()
+    handles = [ReplicaHandle(f"r{i}", e, dead_probes=1)
+               for i, e in enumerate(engines)]
+    router = FleetRouter(handles, probe_interval_s=0,
+                         failover_retries=2)
+    router.start()
+    kill_at: dict = {"t": None}
+    resume_ms: list[float] = []
+    errors = 0
+
+    async def stream(i: int) -> None:
+        nonlocal errors
+        try:
+            async for ev in router.generate(
+                    f"chaos-req-{i}", f"chaos-sess-{i}",
+                    [{"role": "user", "content": "hi"}],
+                    GenerationParams(max_tokens=64, temperature=0.0,
+                                     top_k=1)):
+                if ev["type"] == "resumed" \
+                        and kill_at["t"] is not None:
+                    resume_ms.append(
+                        (time.monotonic() - kill_at["t"]) * 1000)
+                elif ev["type"] == "error":
+                    errors += 1
+        except Exception:
+            errors += 1
+
+    tasks = [asyncio.create_task(stream(i)) for i in range(streams)]
+    await asyncio.sleep(delay_s * 8)  # streams underway on both
+    kill_at["t"] = time.monotonic()
+    engines[0].kill()
+    await asyncio.gather(*tasks)
+    affected = len({r["session_id"] for r in engines[0].requests_seen})
+    router.shutdown()
+    return {
+        "streams": streams,
+        "affected": affected,
+        "resumed": len(resume_ms),
+        "errors": errors,
+        "resume_p50_ms": round(statistics.median(resume_ms), 2)
+        if resume_ms else None,
+    }
+
+
+async def bench_chaos(engine) -> dict:
+    """The failpoints-off CONTROL: does the fault-injection subsystem
+    cost anything when FAULT_POINTS is unset? Interleaved phases —
+    failpoints OFF vs ARMED-but-inert (a p=0 rule on the decode
+    dispatch seam, so the registry lookup runs on every dispatch and
+    never fires) — must agree within 1% tok/s. The MTTR and failover
+    halves of BENCH_MODE=chaos live in _chaos_mttr_drill /
+    _chaos_failover_drill (orchestrated by bench_chaos_main)."""
+    from fasttalk_tpu.resilience import failpoints as fp
+
+    log("warmup (compiling prefill + decode buckets)...")
+    t0 = time.monotonic()
+    await run_session(engine, 999, max_tokens=8)
+    engine.release_session("bench-sess-999")
+    await asyncio.gather(
+        *(run_session(engine, 900 + i, max_tokens=8)
+          for i in range(NUM_SESSIONS)))
+    for i in range(NUM_SESSIONS):
+        engine.release_session(f"bench-sess-{900 + i}")
+    log(f"warmup done in {time.monotonic() - t0:.1f}s")
+    reset_slo_after_warmup()
+
+    async def tps_phase() -> float:
+        # Several waves per phase: single-wave phases (~1 s on CPU
+        # tiny) sit below the shared-box noise burst scale and swung
+        # 2.5x between back-to-back identical runs; a phase must be
+        # long enough to average over the bursts it cannot avoid.
+        waves = int(os.environ.get("BENCH_CHAOS_WAVES", "3"))
+        t0 = time.monotonic()
+        tokens = 0
+        for _ in range(waves):
+            results = await asyncio.gather(
+                *(run_session(engine, i, MAX_TOKENS)
+                  for i in range(NUM_SESSIONS)))
+            tokens += sum(r["tokens"] for r in results)
+        wall = time.monotonic() - t0
+        for i in range(NUM_SESSIONS):
+            engine.release_session(f"bench-sess-{i}")
+        return tokens / wall
+
+    # (1) Control. Two noise sources dominate short CPU phases: the
+    # client warms in over several runs (throughput climbs ~2x before
+    # settling), and a shared box swings ±10-30% run to run. So:
+    # warm until two consecutive phases agree within 5%, then measure
+    # PAIRS — off and armed back to back, order alternating per pair
+    # — and take the median of the pairwise armed/off ratios. Within
+    # a pair (seconds apart) drift is small; the alternating order
+    # cancels its direction; the median rejects outlier pairs. This
+    # resolves a sub-1% effect where arm-wise medians or maxima of
+    # the same phases swing several percent.
+    log("control phases: failpoints off vs armed-inert (p=0)...")
+    prev = await tps_phase()
+    for _ in range(8):  # warm until stable
+        cur = await tps_phase()
+        if abs(cur - prev) / prev < 0.05:
+            break
+        prev = cur
+
+    async def armed_phase() -> float:
+        fp.activate("engine.decode.dispatch=error;p=0.0")
+        try:
+            return await tps_phase()
+        finally:
+            fp.clear()
+
+    off_tps: list[float] = []
+    armed_tps: list[float] = []
+    ratios: list[float] = []
+    for k in range(6):
+        if k % 2 == 0:
+            o = await tps_phase()
+            a = await armed_phase()
+        else:
+            a = await armed_phase()
+            o = await tps_phase()
+        off_tps.append(o)
+        armed_tps.append(a)
+        ratios.append(a / o)
+    tps_off = statistics.median(off_tps)
+    tps_armed = statistics.median(armed_tps)
+    delta = statistics.median(ratios) - 1.0
+    log(f"  off {tps_off:.1f} tok/s vs armed-inert {tps_armed:.1f} "
+        f"tok/s: delta {delta:+.2%} (target |delta| < 1%)")
+
+    return {
+        "control": {
+            "off_tps": round(tps_off, 2),
+            "armed_tps": round(tps_armed, 2),
+            "delta_frac": round(delta, 4),
+            "off_runs": [round(x, 2) for x in off_tps],
+            "armed_runs": [round(x, 2) for x in armed_tps],
+        },
+    }
+
+
+async def _chaos_mttr_drill(engine) -> dict:
+    """One crash->restart MTTR drill (subprocess body): crash the
+    engine thread under an injected crash_thread mid-decode,
+    supervised-restart it, and time crash-detected -> restart-complete
+    -> first post-restart token."""
+    from fasttalk_tpu.resilience import failpoints as fp
+
+    await run_session(engine, 999, max_tokens=8)  # compile warm
+    engine.release_session("bench-sess-999")
+    loop = asyncio.get_running_loop()
+    victim = asyncio.create_task(run_session(engine, 700, 400))
+    while not engine._running:
+        await asyncio.sleep(0.005)
+    fp.activate("engine.loop.tick=crash_thread;count=1")
+    while engine.check_connection():
+        await asyncio.sleep(0.005)
+    fp.clear()
+    t_dead = time.monotonic()
+    ok = await loop.run_in_executor(None, engine.restart)
+    assert ok, "supervised engine restart failed mid-bench"
+    restart_ms = (time.monotonic() - t_dead) * 1000
+    post = await run_session(engine, 800, max_tokens=8)
+    try:
+        await victim  # terminal internal_error from the crash
+    except RuntimeError:
+        pass
+    return {"restart_ms": round(restart_ms, 1),
+            "mttr_ms": round(restart_ms + post["ttft_ms"], 1)}
+
+
+def _chaos_run_subprocess(phase: str) -> dict:
+    """One chaos phase in its own interpreter (BENCH_CHAOS_PHASE=
+    control|mttr). Subprocess isolation for the same reason as the
+    multiturn/fleet phases: a worked engine's in-process teardown —
+    and doubly a crash->restart cycle's abandoned dispatches — trips
+    the pre-existing XLA-CPU client heap fragility that accelerator
+    runtimes don't share. The parent therefore never builds an engine
+    at all. One drill per process is also the honest MTTR shape:
+    production restarts happen in a fresh process history, not after
+    N prior crash cycles."""
+    import subprocess
+
+    env = dict(os.environ, BENCH_CHAOS_PHASE=phase)
+    last_err = ""
+    for _attempt in range(2):  # native-runtime flakes get one retry
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            # A wedged child is the hang-class flake; it gets the
+            # same retry a crashed one does.
+            last_err = "child timed out after 900s"
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        last_err = proc.stderr[-2000:]
+    raise RuntimeError(
+        f"chaos {phase} subprocess produced no JSON; stderr tail:\n"
+        f"{last_err}")
+
+
+def bench_chaos_main() -> dict:
+    """BENCH_MODE=chaos orchestration: (1) the failpoints-off control,
+    (2) three engine-restart MTTR drills — each subprocess-isolated —
+    and (3) the router failover drill (FakeEngine fleet, in-proc)."""
+    log("control phase (subprocess): failpoints off vs armed-inert...")
+    control = _chaos_run_subprocess("control")
+    log(f"  off {control['off_tps']} tok/s vs armed-inert "
+        f"{control['armed_tps']} tok/s: delta "
+        f"{control['delta_frac']:+.2%} (target |delta| < 1%)")
+
+    log("engine-restart MTTR drills (subprocess-isolated)...")
+    drills = []
+    for k in range(3):
+        d = _chaos_run_subprocess("mttr")
+        drills.append(d)
+        log(f"  drill {k + 1}: restart {d['restart_ms']:.0f} ms, "
+            f"MTTR-to-first-token {d['mttr_ms']:.0f} ms")
+
+    log("router failover drill (2 fake replicas, kill mid-decode)...")
+    failover = asyncio.run(_chaos_failover_drill())
+    log(f"  resumed {failover['resumed']}/{failover['affected']} "
+        f"streams, {failover['errors']} errors, resume p50 "
+        f"{failover['resume_p50_ms']} ms")
+
+    return {
+        "control": control,
+        "restart_p50_ms": round(statistics.median(
+            [d["restart_ms"] for d in drills]), 1),
+        "mttr_p50_ms": round(statistics.median(
+            [d["mttr_ms"] for d in drills]), 1),
+        "mttr_runs_ms": [d["mttr_ms"] for d in drills],
+        "failover": failover,
+    }
+
+
 async def bench_engine(engine) -> dict:
     log("warmup (compiling prefill + decode buckets)...")
     t0 = time.monotonic()
@@ -1302,6 +1591,47 @@ def main() -> None:
             "vs_baseline": round(r["constrained_tok_s"]
                                  / r["unconstrained_tok_s"], 3),
             "structured": r,
+        }), flush=True)
+        return
+    if MODE == "chaos":
+        phase = os.environ.get("BENCH_CHAOS_PHASE", "")
+        if phase in ("control", "mttr"):
+            # Child process: one phase, then hard-exit (a worked
+            # engine's in-process XLA-CPU teardown — let alone a
+            # crash->restart cycle's abandoned dispatches — is the
+            # documented heap-corruption trap the multiturn/fleet
+            # benches also isolate away).
+            from fasttalk_tpu.engine.factory import build_engine
+
+            engine = build_engine(cfg)
+            engine.start()
+            if phase == "control":
+                d = asyncio.run(bench_chaos(engine))["control"]
+            else:
+                d = asyncio.run(_chaos_mttr_drill(engine))
+            print(json.dumps(d), flush=True)
+            sys.stdout.flush()
+            os._exit(0)
+        r = bench_chaos_main()
+        fo = r["failover"]
+        ctl = r["control"]
+        print(json.dumps({
+            "metric": (f"chaos engine-restart MTTR-to-first-token p50 "
+                       f"ms, {MODEL} (restart p50 "
+                       f"{r['restart_p50_ms']} ms over 3 injected "
+                       f"crash_thread drills); failpoints-off control "
+                       f"delta {ctl['delta_frac']:+.2%} "
+                       f"(off {ctl['off_tps']} vs armed-inert "
+                       f"{ctl['armed_tps']} tok/s, target < 1%); "
+                       f"router failover resumed {fo['resumed']}/"
+                       f"{fo['affected']} streams, {fo['errors']} "
+                       f"errors, resume p50 {fo['resume_p50_ms']} ms"),
+            "value": r["mttr_p50_ms"],
+            "unit": "ms",
+            # For this mode the baseline is the failpoints-off phase:
+            # ~1.0 IS the result (armed-inert costs nothing).
+            "vs_baseline": round(ctl["armed_tps"] / ctl["off_tps"], 3),
+            "chaos": r,
         }), flush=True)
         return
     if MODE == "ws":

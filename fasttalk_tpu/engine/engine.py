@@ -77,6 +77,7 @@ from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
                                        init_cache)
 from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.observability.perf import get_perf
+from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.ops.sampling import (apply_penalties, penalize_values,
@@ -577,6 +578,12 @@ class TPUEngine(EngineBase):
         self._fetch_pool = ThreadPoolExecutor(
             max_workers=max(4, self.pipeline_depth + 2),
             thread_name_prefix="tpu-fetch")
+        # Outstanding device→host fetch futures (self._fetch). Tracked
+        # independently of _inflight/_pending_firsts because
+        # _abort_all clears those deques on a crash — restart() must
+        # still be able to QUIESCE the copies before it drops the
+        # cache refs (see the restart note).
+        self._fetch_pending: set[Future] = set()
         self._reset_decode_state()
 
         # Multi-host SPMD serving (parallel/spmd_serving.py): when set,
@@ -813,17 +820,45 @@ class TPUEngine(EngineBase):
                                         daemon=True)
         self._thread.start()
 
-    def shutdown(self) -> None:
+    def shutdown(self, timeout_s: float = 30.0) -> None:
         with self._lifecycle_lock:
             self._closed = True
             if self._started:
                 self._commands.put(("stop", None))
-                self._stopped.wait(timeout=30)
+                if not self._stopped.wait(timeout=timeout_s):
+                    # The engine thread is stuck (a wedged device call,
+                    # a hung collective): we are about to leak it —
+                    # say WHERE it is stuck instead of leaking
+                    # silently. sys._current_frames gives the exact
+                    # frame the thread is blocked in.
+                    self._log_stuck_thread(timeout_s)
                 self._started = False
             self._fetch_pool.shutdown(wait=False, cancel_futures=True)
             self._kv_offload.shutdown()
             if self._st_compiler is not None:
                 self._st_compiler.shutdown()
+
+    def _log_stuck_thread(self, timeout_s: float) -> None:
+        """Shutdown timed out: capture the stuck engine thread's stack
+        (sys._current_frames) into the log and a critical event, so
+        the leaked thread is a diagnosed incident instead of a silent
+        one. faulthandler-style, but scoped to the one thread and
+        delivered through the event log the flight recorder bundles."""
+        import sys
+        import traceback
+
+        thread = self._thread
+        stack = ""
+        if thread is not None and thread.ident is not None:
+            frame = sys._current_frames().get(thread.ident)
+            if frame is not None:
+                stack = "".join(traceback.format_stack(frame))
+        log.critical(
+            f"engine thread failed to stop within {timeout_s:.0f}s; "
+            f"leaking it. Stuck at:\n{stack or '<thread already gone>'}")
+        self._events.emit("engine_shutdown_stuck", severity="critical",
+                          timeout_s=timeout_s,
+                          stack=stack[-2000:] if stack else "")
 
     def restart(self) -> bool:
         """Recover from an engine-thread crash: rebuild the device-side
@@ -880,6 +915,41 @@ class TPUEngine(EngineBase):
                 self._by_id.pop(rid, None)
             self.slots = SlotManager(self.num_slots, self.max_len,
                                      on_evict=self._park_on_evict)
+            # Quiesce the fetch workers FIRST: the crashed thread's
+            # in-flight device calls may still be executing on the
+            # async dispatch stream with their host copies mid-flight
+            # on the fetch pool (_abort_all cleared the deques, not
+            # the workers). Dropping the only cache/decode-state refs
+            # while the runtime still reads those buffers corrupts
+            # the heap (observed: malloc corruption in back-to-back
+            # crash→restart chaos drills on the XLA-CPU client).
+            # A landed fetch implies its producing call retired on
+            # the in-order dispatch stream.
+            from concurrent.futures import TimeoutError as _FutTimeout
+
+            for fut in list(self._fetch_pending):
+                try:
+                    fut.result(timeout=10)
+                except _FutTimeout:
+                    # The copy is STILL RUNNING: dropping the only
+                    # cache/decode-state refs now is exactly the
+                    # use-after-free this quiesce prevents. Refuse
+                    # this attempt — the supervisor retries (with
+                    # backoff), and a permanently wedged copy exhausts
+                    # the restart budget into the designed /health-
+                    # dead state instead of corrupting the heap.
+                    log.error("engine restart aborted: a device->host "
+                              "copy is still in flight after 10s")
+                    return False
+                except Exception:
+                    pass  # the copy FAILING is fine; gone is gone
+            try:
+                # Sync the in-order dispatch stream on the cache chain
+                # itself: the last dispatched call's donated-cache
+                # output must exist before we drop its only reference.
+                jax.block_until_ready(self.cache.k)
+            except Exception:
+                pass  # a poisoned cache buffer is being replaced anyway
             # Release the old KV cache (and the in-flight refs pinning
             # decode-state arrays) BEFORE allocating the fresh one: on
             # host-side crashes the donated buffer was never consumed,
@@ -1382,6 +1452,15 @@ class TPUEngine(EngineBase):
         if self._started:
             self._events.emit("recompile", severity="warning",
                               what=kind, **attrs)
+
+    def _fetch(self, arr) -> Future:
+        """Submit a device→host copy on the fetch pool, tracked so
+        restart() can wait for every outstanding copy to land before
+        rebuilding device state."""
+        fut = self._fetch_pool.submit(np.asarray, arr)
+        self._fetch_pending.add(fut)
+        fut.add_done_callback(self._fetch_pending.discard)
+        return fut
 
     def _put(self, arr):
         """Host array (or PRNG key) → device, replicated over the mesh
@@ -2010,24 +2089,51 @@ class TPUEngine(EngineBase):
             self._kv_pool.note_lookup(False)
             return 0
         t0 = time.monotonic()
-        fn = self._get_kv_restore_fn(entry.bucket)
-        k_arg, v_arg = entry.k_dev, entry.v_dev
-        prestaged = k_arg is not None and v_arg is not None
-        if not prestaged:  # prestage didn't land
-            k_arg, v_arg = self._arg(entry.k), self._arg(entry.v)
-        if self.kv_quant:
-            # Scales ride with their rows (prestaged before k_dev/v_dev
-            # on the copy thread, so prestaged rows imply staged
-            # scales).
-            ks_arg, vs_arg = entry.k_scale_dev, entry.v_scale_dev
-            if not prestaged or ks_arg is None or vs_arg is None:
-                ks_arg = self._arg(entry.k_scale)
-                vs_arg = self._arg(entry.v_scale)
-            self.cache = fn(self.cache, k_arg, v_arg, ks_arg, vs_arg,
-                            np.int32(slot.index))
-        else:
-            self.cache = fn(self.cache, k_arg, v_arg,
-                            np.int32(slot.index))
+        try:
+            if _fp.enabled:
+                _fp.fire("kv.restore.dispatch",
+                         request_id=req.request_id,
+                         session_id=req.session_id)
+            fn = self._get_kv_restore_fn(entry.bucket)
+            k_arg, v_arg = entry.k_dev, entry.v_dev
+            prestaged = k_arg is not None and v_arg is not None
+            if not prestaged:  # prestage didn't land
+                k_arg, v_arg = self._arg(entry.k), self._arg(entry.v)
+            if self.kv_quant:
+                # Scales ride with their rows (prestaged before
+                # k_dev/v_dev on the copy thread, so prestaged rows
+                # imply staged scales).
+                ks_arg, vs_arg = entry.k_scale_dev, entry.v_scale_dev
+                if not prestaged or ks_arg is None or vs_arg is None:
+                    ks_arg = self._arg(entry.k_scale)
+                    vs_arg = self._arg(entry.v_scale)
+                self.cache = fn(self.cache, k_arg, v_arg, ks_arg,
+                                vs_arg, np.int32(slot.index))
+            else:
+                self.cache = fn(self.cache, k_arg, v_arg,
+                                np.int32(slot.index))
+        except Exception as e:
+            # A failed restore dispatch must degrade to a full
+            # prefill, never crash the engine thread mid-admission —
+            # UNLESS the restore program already CONSUMED the donated
+            # cache: serving on would use-after-free the dead buffer
+            # at the next dispatch, a delayed and misattributed
+            # crash. Re-raise into the engine crash path instead
+            # (_abort_all + supervised restart rebuild the cache).
+            if self.cache is None or getattr(
+                    self.cache.k, "is_deleted", lambda: False)():
+                log.critical(f"kv restore for {req.session_id} "
+                             "consumed the donated cache before "
+                             f"failing ({e}); escalating to restart")
+                raise
+            # The entry is purged — after a failed H2D its host copy
+            # is suspect, and the byte accounting must stay exact
+            # (purge removes exactly entry.nbytes).
+            log.error(f"kv restore failed for {req.session_id}: {e}; "
+                      "falling back to full prefill")
+            self._kv_pool.purge(req.session_id)
+            self._kv_pool.note_lookup(False)
+            return 0
         dt = time.monotonic() - t0
         slot.tokens = list(entry.tokens[:match])
         slot.kv_written = match
@@ -2597,6 +2703,11 @@ class TPUEngine(EngineBase):
                 # every 50 ms when idle (command-queue timeout), so a
                 # stale stamp means a blocked device call, not idleness.
                 self._hb_mono = time.monotonic()
+                if _fp.enabled:
+                    # Chaos seam (docs/RESILIENCE.md): crash_thread or
+                    # hang the engine thread itself — the supervisor-
+                    # restart and watchdog drills inject here.
+                    _fp.fire("engine.loop.tick")
                 idle = not self._running and not self._inflight \
                     and not self._prefilling and not self._pending_firsts
                 if not self._drain_commands(block=idle):
@@ -2668,7 +2779,12 @@ class TPUEngine(EngineBase):
                 self._m_queue.set(len(self._sched)
                                   + len(self._prefilling))
                 self._kv_tick()
-        except Exception as e:  # engine thread must not die silently
+        except (_fp.FaultCrash, Exception) as e:
+            # The engine thread must not die silently. FaultCrash is a
+            # BaseException (so it escapes every scoped handler like a
+            # real interpreter-level fault would), but a crash HERE
+            # must still terminal-event the in-flight requests and set
+            # _stopped — the supervisor-restart path depends on it.
             log.critical(f"engine thread crashed: {e}", exc_info=True)
             if self.call_sink is not None:
                 # A published descriptor may precede the crash: tell
@@ -2945,6 +3061,13 @@ class TPUEngine(EngineBase):
         st = self._prefilling[0]
         req, slot = st.req, st.slot
         try:
+            if _fp.enabled:
+                # Chaos seam: `error` is scoped to this request by the
+                # handler below (the engine survives); `crash_thread`
+                # escapes it (BaseException) and kills the thread.
+                _fp.fire("engine.prefill.dispatch",
+                         request_id=req.request_id,
+                         session_id=req.session_id)
             ring_bucket = self._ring_prefill_eligible(st.start,
                                                       len(st.todo))
             t0p = time.monotonic()
@@ -3169,6 +3292,12 @@ class TPUEngine(EngineBase):
                        ) -> None:
         """One batched prefill device call + one batched first-token
         sample for a same-bucket group of requests."""
+        if _fp.enabled:
+            # Same seam name as the chunked path: _prefill_batched's
+            # handler scopes an `error` to this group's requests.
+            _fp.fire("engine.prefill.dispatch",
+                     request_id=";".join(r.request_id
+                                         for r, _, _, _ in sub))
         g = len(sub)
         # Only two group shapes ever compile per bucket: 1 and num_slots.
         # A mid-size burst pads to the full batch (the padded rows are
@@ -3302,7 +3431,7 @@ class TPUEngine(EngineBase):
         for _, _, req in entries:
             req.first_pending = True
         self._pending_firsts.append(
-            (self._fetch_pool.submit(np.asarray, firsts_dev), entries))
+            (self._fetch(firsts_dev), entries))
 
     def _drain_firsts(self, block: bool) -> None:
         """Emit first tokens whose fetch has landed (all of them when
@@ -3427,6 +3556,14 @@ class TPUEngine(EngineBase):
 
     def _dispatch_decode(self) -> None:
         """Launch one K-step decode call; does not wait for results."""
+        if _fp.enabled:
+            # Chaos seam: an `error` here is a dispatch-path failure —
+            # it propagates to _run's crash handler (terminal events
+            # for every request, supervisor restart), exactly like a
+            # real XLA dispatch fault. Host-side only: the jitted
+            # decode program itself is byte-identical with or without
+            # fault injection.
+            _fp.fire("engine.decode.dispatch")
         self._patch_slot_state()
         t_disp = time.monotonic()
         active = list(self._running)
@@ -3494,7 +3631,7 @@ class TPUEngine(EngineBase):
                 promise = steps * min(float(T),
                                       max(1.0, self._spec_ema))
                 self._inflight.append(
-                    (self._fetch_pool.submit(np.asarray, toks), promise,
+                    (self._fetch(toks), promise,
                      exp_adv, snapshot, t_disp, kv_len))
                 return
         max_pos = base + steps
@@ -3532,7 +3669,7 @@ class TPUEngine(EngineBase):
                     self._reps_dev, self._press_dev, self._freqs_dev,
                     self._rng_dev)
             self._inflight.append(
-                (self._fetch_pool.submit(np.asarray, toks), steps, steps,
+                (self._fetch(toks), steps, steps,
                  snapshot, t_disp, kv_len))
             return
         fn = self._get_decode_fn(kv_len, steps, with_fsm=st_on)
@@ -3561,12 +3698,17 @@ class TPUEngine(EngineBase):
         # compute, and later calls' fetches overlap it (see the
         # _fetch_pool note in __init__).
         self._inflight.append(
-            (self._fetch_pool.submit(np.asarray, toks), steps, steps,
+            (self._fetch(toks), steps, steps,
              snapshot, t_disp, kv_len))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
         fut, _, _, snapshot, t_disp, kv_len = self._inflight.popleft()
+        if _fp.enabled:
+            # Chaos seam: `hang` here is the wedged-device-call
+            # scenario — the heartbeat goes stale and the watchdog
+            # must detect it and force_fail the stalled requests.
+            _fp.fire("engine.retire.fetch")
         gen_before = {id(req): req.generated for _, req in snapshot} \
             if self._tracer.enabled else {}
         if any(req.first_pending for _, req in snapshot):

@@ -21,6 +21,7 @@ import aiohttp
 from fasttalk_tpu.engine.engine import (EngineBase, GenerationParams,
                                         raw_prompt_text)
 from fasttalk_tpu.observability.trace import get_tracer
+from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
                                        LLMServiceError)
 from fasttalk_tpu.utils.logger import get_logger
@@ -332,6 +333,14 @@ class VLLMRemoteEngine(_RemoteEngine):
         try:
             while True:  # pre-first-token connect/5xx retry loop
                 try:
+                    if _fp.enabled:
+                        # Chaos seam: raised as the transport error
+                        # type so the pre-first-token retry (and the
+                        # router's replica-fault classifier) treat it
+                        # exactly like a real connect failure.
+                        await _fp.fire_async("remote.connect",
+                                 exc=aiohttp.ClientConnectionError,
+                                 request_id=request_id)
                     for _attempt in range(3):
                         async with client.post(
                                 url, json=body,
@@ -373,6 +382,13 @@ class VLLMRemoteEngine(_RemoteEngine):
                                     details={"status": resp.status})
                                 raise err
                             async for raw in resp.content:
+                                if _fp.enabled:
+                                    # Mid-stream failure: chunks > 0
+                                    # makes the retry non-idempotent,
+                                    # so this must surface terminally.
+                                    await _fp.fire_async("remote.stream",
+                                             exc=aiohttp.ClientError,
+                                             request_id=request_id)
                                 if request_id in self._cancelled:
                                     self._cancelled.discard(request_id)
                                     yield {"type": "cancelled",
@@ -541,6 +557,10 @@ class OllamaRemoteEngine(_RemoteEngine):
         try:
             while True:  # pre-first-token connect/5xx retry loop
                 try:
+                    if _fp.enabled:
+                        await _fp.fire_async("remote.connect",
+                                 exc=aiohttp.ClientConnectionError,
+                                 request_id=request_id)
                     async with client.post(url, json=body) as resp:
                         if resp.status != 200:
                             text = await resp.text()
@@ -550,6 +570,10 @@ class OllamaRemoteEngine(_RemoteEngine):
                                 category=ErrorCategory.CONNECTION,
                                 details={"status": resp.status})
                         async for raw in resp.content:
+                            if _fp.enabled:
+                                await _fp.fire_async("remote.stream",
+                                         exc=aiohttp.ClientError,
+                                         request_id=request_id)
                             if request_id in self._cancelled:
                                 self._cancelled.discard(request_id)
                                 yield {"type": "cancelled",

@@ -33,6 +33,7 @@ from typing import Any
 
 from fasttalk_tpu.kvcache.hostpool import HostKVPool, ParkedKV
 from fasttalk_tpu.kvcache.policy import RestorePolicy
+from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.utils.logger import get_logger
 from fasttalk_tpu.utils.metrics import get_metrics
 
@@ -164,6 +165,9 @@ class KVOffloader:
             try:
                 job()
             except Exception as e:  # the copy thread must never die
+                # (FaultCrash is a BaseException and deliberately
+                # escapes: the chaos suite kills this thread with it
+                # and asserts the next submit() resurrects one.)
                 log.error(f"kv offload job failed: {e}", exc_info=True)
 
     def submit(self, job) -> None:
@@ -208,6 +212,11 @@ class KVOffloader:
             import numpy as np
 
             try:
+                if _fp.enabled:
+                    # Chaos seam: a failed/hung D2H fetch must lose
+                    # only this snapshot (pool accounting untouched:
+                    # the entry is never inserted), never the engine.
+                    _fp.fire("kv.park.copy", session_id=session_id)
                 # Bandwidth sample starts at the FETCH, not the
                 # dispatch: t0 includes the slice program's queue wait
                 # (and its first-use compile), which is not a cost a
@@ -274,6 +283,11 @@ class KVOffloader:
         def job() -> None:
             import jax
 
+            if _fp.enabled:
+                # Chaos seam: prestage is best-effort by contract — a
+                # failure here must cost nothing (the restore falls
+                # back to passing host numpy at dispatch).
+                _fp.fire("kv.prestage.copy", session_id=session_id)
             entry = self.pool.get(session_id)
             if entry is None or entry.k_dev is not None:
                 return

@@ -33,6 +33,7 @@ from fasttalk_tpu.observability.perf import get_perf
 from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.observability.watchdog import get_watchdog
+from fasttalk_tpu.resilience import failpoints
 from fasttalk_tpu.utils.metrics import get_metrics
 
 _profiler_state = {"active": False, "log_dir": None, "started_at": None}
@@ -59,12 +60,24 @@ def _device_memory() -> list[dict]:
 
 
 def build_monitoring_app(ready_check=None, sched_info=None,
+                         supervisor_info=None, fault_http=False,
                          ) -> web.Application:
     """``sched_info``: optional zero-arg callable returning the
     engine's scheduler view ({"stats": ..., "queued": [...]}, see
     engine.scheduler_debug) — surfaces the admission-control overload
     state on /health and queued position/deadline on /debug/requests
-    (docs/SCHEDULING.md)."""
+    (docs/SCHEDULING.md).
+
+    ``supervisor_info``: optional zero-arg callable returning the
+    launcher's restart-budget state; an "exhausted" supervisor marks
+    /health dead (the engine will not be resurrected again —
+    docs/RESILIENCE.md).
+
+    ``fault_http``: enables POST /debug/fault (runtime fault-injection
+    control, resilience/failpoints.py). OFF by default — the
+    monitoring port is unauthenticated, so the mutation endpoint must
+    be an explicit opt-in (FAULT_HTTP=true) and never enabled in
+    production. GET /debug/fault (read-only view) is always served."""
     app = web.Application()
 
     def _sched_view() -> dict | None:
@@ -125,6 +138,29 @@ def build_monitoring_app(ready_check=None, sched_info=None,
                     body["status"] = "degraded"
                 if state != "ok":
                     warnings.append(f"SLO burn {state} for {cls}")
+        # Supervisor restart budget (docs/RESILIENCE.md): exhausted
+        # means the engine is down AND will not be resurrected — the
+        # strongest possible health signal.
+        if supervisor_info is not None:
+            try:
+                sup = supervisor_info()
+            except Exception:
+                sup = None
+            if sup is not None:
+                body["supervisor"] = sup
+                if sup.get("state") == "exhausted":
+                    body["status"] = "dead"
+                    warnings.append(
+                        "Supervisor restart budget exhausted; engine "
+                        "will not be restarted (restart the process)")
+        # Fault injection active is always worth a warning: an
+        # incident responder must see at a glance whether the incident
+        # is an injected drill.
+        if failpoints.enabled:
+            body["fault_injection"] = {
+                "active_points": failpoints.active_points()}
+            warnings.append("Fault injection ACTIVE "
+                            "(see GET /debug/fault)")
         if warnings:
             body["warnings"] = warnings
         return web.json_response(body)
@@ -353,6 +389,43 @@ def build_monitoring_app(ready_check=None, sched_info=None,
         return web.json_response({**flight.stats(),
                                   "status": "writing", "dir": path})
 
+    # ---- fault injection (resilience/failpoints.py, ISSUE 10) ----
+
+    async def fault_get(request: web.Request) -> web.Response:
+        """Read-only view: active rules with hit/fire counts + the
+        full failpoint catalog."""
+        return web.json_response(failpoints.describe())
+
+    async def fault_post(request: web.Request) -> web.Response:
+        """Arm a fault-injection spec at runtime (replaces the active
+        set), or clear it: {"spec": "..."} | {"clear": true}. Gated by
+        FAULT_HTTP — the monitoring port is unauthenticated and this
+        endpoint injects faults on purpose."""
+        if not fault_http:
+            return web.json_response(
+                {"error": "fault-injection HTTP control is disabled "
+                 "(set FAULT_HTTP=true; never in production)"},
+                status=403)
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": "body must be JSON: {\"spec\": \"...\"} or "
+                 "{\"clear\": true}"}, status=400)
+        if body.get("clear"):
+            failpoints.clear()
+            return web.json_response(failpoints.describe())
+        spec = body.get("spec")
+        if not isinstance(spec, str) or not spec.strip():
+            return web.json_response(
+                {"error": "missing \"spec\" (failpoint spec string) "
+                 "or \"clear\": true"}, status=400)
+        try:
+            failpoints.activate(spec)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(failpoints.describe())
+
     # ---- SLO engine + structured event log (ISSUE 3) ----
 
     async def slo(request: web.Request) -> web.Response:
@@ -385,6 +458,8 @@ def build_monitoring_app(ready_check=None, sched_info=None,
     app.router.add_get("/slo", slo)
     app.router.add_get("/perf", perf)
     app.router.add_post("/debug/bundle", debug_bundle)
+    app.router.add_get("/debug/fault", fault_get)
+    app.router.add_post("/debug/fault", fault_post)
     app.router.add_get("/events", events)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/metrics.json", metrics_json)

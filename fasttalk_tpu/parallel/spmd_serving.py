@@ -21,6 +21,25 @@ serving loop — admission, batched prefill, continuous-batching decode,
 EOS retirement — across two real OS processes with stream parity
 against a single-process run.
 
+Cluster liveness (VERDICT item 7, docs/RESILIENCE.md):
+- The leader's broadcaster sends a small ``hb`` frame every
+  ``SPMD_HB_INTERVAL_S`` (default 2 s) even when no device calls are
+  being published, so a dead follower socket is discovered by a failed
+  send within a couple of intervals instead of "whenever the next
+  collective times out".
+- A follower applies ``SPMD_HB_TIMEOUT_S`` (default 8 s) as a recv
+  deadline: a leader that stops publishing (crashed, hung, partitioned)
+  surfaces as a ConnectionError within the deadline, not a forever-
+  blocked recv.
+- A dead follower is **fatal for the cluster**: its shards stop
+  advancing, so per-host state can no longer stay identical. The
+  broadcaster sends an ``abort`` frame to the survivors, marks itself
+  dead, and every later publish raises — the engine thread crashes
+  through its ordinary terminal-event path and the launcher shuts the
+  gateway down for a cluster restart (the previous behaviour silently
+  dropped the follower and served a corrupted cluster until a
+  collective eventually hung).
+
 Scope and limits (stated, not hidden):
 - The wire format is pickle over a loopback/trusted-network TCP socket
   (cluster-internal, like the reference's NCCL/MPI planes); do not
@@ -42,6 +61,11 @@ from typing import Any
 
 import numpy as np
 
+from fasttalk_tpu.resilience import failpoints as _fp
+# Env fallbacks are for standalone/test construction only — the
+# launcher passes the VALIDATED Config values (spmd_hb_interval_s /
+# spmd_hb_timeout_s) explicitly, which is the production path.
+from fasttalk_tpu.utils.config import _env_float
 from fasttalk_tpu.utils.logger import get_logger
 
 log = get_logger("parallel.spmd_serving")
@@ -54,21 +78,42 @@ def _send(conn: socket.socket, obj: Any) -> None:
     conn.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv(conn: socket.socket) -> Any:
-    head = b""
-    while len(head) < _LEN.size:
-        chunk = conn.recv(_LEN.size - len(head))
-        if not chunk:
-            raise ConnectionError("spmd_serving: peer closed")
-        head += chunk
-    (n,) = _LEN.unpack(head)
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = conn.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("spmd_serving: peer closed mid-frame")
-        buf += chunk
-    return pickle.loads(bytes(buf))
+def _recv(conn: socket.socket, deadline_s: float | None = None) -> Any:
+    """Read one frame. ``deadline_s`` bounds how long we wait for the
+    FIRST byte (and each subsequent chunk): with leader heartbeats on
+    the wire, a silent peer past the deadline is a dead peer — surface
+    a ConnectionError now instead of blocking until some collective
+    times out."""
+    if _fp.enabled:
+        _fp.fire("spmd.recv", exc=ConnectionError)
+    # Unconditional: deadline_s=None must mean a BLOCKING recv even on
+    # a socket still carrying a connect-time timeout
+    # (socket.create_connection(timeout=...) lingers otherwise).
+    conn.settimeout(deadline_s)
+    try:
+        head = b""
+        while len(head) < _LEN.size:
+            chunk = conn.recv(_LEN.size - len(head))
+            if not chunk:
+                raise ConnectionError("spmd_serving: peer closed")
+            head += chunk
+        (n,) = _LEN.unpack(head)
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = conn.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                raise ConnectionError(
+                    "spmd_serving: peer closed mid-frame")
+            buf += chunk
+        return pickle.loads(bytes(buf))
+    except (socket.timeout, TimeoutError) as e:
+        # deadline_s can only be None here via an exotic caller-set
+        # socket timeout; format defensively so the diagnostic is
+        # never masked by a TypeError in its own handler.
+        within = f"{deadline_s:.1f}s" if deadline_s else "the deadline"
+        raise ConnectionError(
+            f"spmd_serving: no frame from peer within {within} "
+            "(heartbeat deadline) — peer presumed dead") from e
 
 
 class CallBroadcaster:
@@ -79,13 +124,21 @@ class CallBroadcaster:
     only ENQUEUES — a dedicated sender thread serializes and writes,
     so a stalled follower's TCP window never back-pressures the
     dispatch path, and frame order (including abort-before-dispatch)
-    is preserved by the single queue. A follower whose socket errors
-    is dropped (with a loud log) without starving the others.
+    is preserved by the single queue. A heartbeat thread keeps frames
+    on the wire while the engine is idle, so follower death is
+    detected by a failed send within ~2 heartbeat intervals. A
+    follower whose socket errors is **fatal for the cluster**
+    (module-scope liveness note): the survivors get an abort frame,
+    ``dead_reason`` is set, and every later publish raises.
     ``close()`` may be called from any thread; it flushes the queue,
     sends the stop frame, and joins the sender."""
 
     def __init__(self, host: str, port: int, n_followers: int,
-                 accept_timeout_s: float = 300.0):
+                 accept_timeout_s: float = 300.0,
+                 hb_interval_s: float | None = None):
+        self.hb_interval_s = (hb_interval_s if hb_interval_s is not None
+                              else _env_float("SPMD_HB_INTERVAL_S", 2.0))
+        self.dead_reason: str | None = None
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(accept_timeout_s)
         self._closed = False
@@ -103,41 +156,109 @@ class CallBroadcaster:
                     "the follower process up and pointed at "
                     f"{host}:{port}?") from None
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Send timeout: a HUNG follower (SIGSTOP, wedged device
+            # call) fills its TCP window without ever closing the
+            # socket — sendall would block _pump forever and the
+            # liveness hole would be back for the hang class. A
+            # stalled send past this bound raises socket.timeout
+            # (an OSError), which _pump turns into _fatal.
+            conn.settimeout(max(30.0, 5.0 * self.hb_interval_s))
             self._conns.append(conn)
             log.info(f"spmd follower connected from {addr}")
         self._q: queue.Queue = queue.Queue()
+        # First frame on the wire: the leader's heartbeat contract.
+        # The INTERVAL is a leader-side setting — followers must not
+        # guess it from their own env (a leader with the beacon off
+        # and a follower holding the default deadline would declare a
+        # healthy idle cluster dead).
+        self._q.put(("hello", {"hb_interval_s": self.hb_interval_s}))
         self._sender = threading.Thread(target=self._pump,
                                         name="spmd-sender", daemon=True)
         self._sender.start()
+        self._hb = threading.Thread(target=self._heartbeat,
+                                    name="spmd-hb", daemon=True)
+        self._hb.start()
+
+    @property
+    def port(self) -> int:
+        return self._srv.getsockname()[1]
+
+    def _heartbeat(self) -> None:
+        """Leader liveness beacon: one tiny frame per interval,
+        regardless of engine activity. Followers skip it; its real job
+        is keeping the TCP stream active so a dead follower trips a
+        send error promptly (and giving followers a frame to apply
+        their recv deadline against)."""
+        if self.hb_interval_s <= 0:
+            return
+        while not self._closed and self.dead_reason is None:
+            time.sleep(self.hb_interval_s)
+            if self._closed or self.dead_reason is not None:
+                return
+            self._q.put(("hb", {}))
 
     def _pump(self) -> None:
         while True:
             item = self._q.get()
             if item is None:
                 return
+            if self.dead_reason is not None:
+                continue  # drain post-fatal enqueues silently
             payload = pickle.dumps(item,
                                    protocol=pickle.HIGHEST_PROTOCOL)
             frame = _LEN.pack(len(payload)) + payload
             for conn in list(self._conns):
                 try:
+                    if _fp.enabled:
+                        _fp.fire("spmd.send", exc=OSError)
                     conn.sendall(frame)
                 except OSError as e:
-                    # A dead follower must not starve the rest of the
-                    # cluster of frames; it is dropped loudly. Its
-                    # device shards stop advancing — collectives
-                    # involving it will eventually error, which is the
-                    # honest outcome for a lost cluster member.
-                    log.error(f"spmd follower send failed ({e}); "
-                              "dropping that follower")
-                    self._conns.remove(conn)
-                    try:
-                        conn.close()
-                    except OSError:
-                        pass
+                    # A lost follower's shards stop advancing, so
+                    # per-host device state can no longer be identical:
+                    # the CLUSTER is dead, not just that socket
+                    # (replaying further calls against the survivors
+                    # would serve a corrupted cluster until a
+                    # collective eventually hung — the exact liveness
+                    # hole this closes, VERDICT item 7).
+                    self._fatal(f"follower send failed: {e}")
+                    break
+
+    def _fatal(self, reason: str) -> None:
+        """Mark the cluster dead: abort the surviving followers, close
+        every socket, and make later publishes raise (the engine
+        thread then crashes through its terminal-event path and the
+        launcher shuts the gateway down for a cluster restart)."""
+        self.dead_reason = reason
+        log.critical(f"spmd cluster dead: {reason}; aborting followers "
+                     "and refusing further publishes")
+        try:
+            from fasttalk_tpu.observability.events import get_events
+
+            get_events().emit("spmd_cluster_dead", severity="critical",
+                              reason=reason)
+        except Exception:
+            pass
+        abort = pickle.dumps(("abort", {"reason": reason}),
+                             protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _LEN.pack(len(abort)) + abort
+        for conn in self._conns:
+            try:
+                conn.sendall(frame)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()
 
     def __call__(self, kind: str, payload: dict) -> None:
         if self._closed:
             raise RuntimeError("spmd_serving: publish after close()")
+        if self.dead_reason is not None:
+            raise RuntimeError(
+                f"spmd_serving: cluster is dead ({self.dead_reason}); "
+                "restart the cluster")
         self._q.put((kind, payload))
 
     def close(self) -> None:
@@ -156,17 +277,26 @@ class CallBroadcaster:
 
 
 def follower_loop(engine, host: str, port: int,
-                  connect_timeout_s: float = 300.0) -> int:
+                  connect_timeout_s: float = 300.0,
+                  hb_timeout_s: float | None = None) -> int:
     """Follower side: connect to the leader and replay its device-call
     stream against this process's engine (same construction, same
     seed, never ``start()``ed — the leader's engine thread is the only
     decision-maker in the cluster). Returns the number of calls
     replayed. Blocks until the leader sends "stop".
 
+    ``hb_timeout_s`` (default ``SPMD_HB_TIMEOUT_S``, 8 s) is the recv
+    deadline: the leader heartbeats every SPMD_HB_INTERVAL_S, so a
+    silent leader past the deadline is dead — the follower raises a
+    ConnectionError and exits for a cluster restart instead of
+    blocking in recv until a collective times out.
+
     The connect retries: leader and follower build their engines
     concurrently (the builds rendezvous on collectives), and the
     leader binds its broadcast socket only after ITS build returns —
     a follower that gets there first must wait, not die."""
+    if hb_timeout_s is None:
+        hb_timeout_s = _env_float("SPMD_HB_TIMEOUT_S", 8.0)
     deadline = time.monotonic() + connect_timeout_s
     while True:
         try:
@@ -182,8 +312,32 @@ def follower_loop(engine, host: str, port: int,
     e = engine
     last_logits = None  # register: chunked-prefill → sample_place
     n = 0
+    first = True
     while True:
-        kind, p = _recv(conn)
+        # The FIRST frame gets no heartbeat deadline: the leader's
+        # broadcaster (and therefore its beacon) only starts after ALL
+        # followers have connected, and a sibling may lawfully take up
+        # to the leader's accept timeout to arrive — only once frames
+        # are flowing does silence mean death.
+        kind, p = _recv(conn,
+                        deadline_s=None if first
+                        else (hb_timeout_s or None))
+        first = False
+        if kind == "hello":
+            # The leader's heartbeat contract (authoritative — each
+            # side's env may lawfully differ): beacon OFF means no
+            # heartbeats will ever satisfy a deadline, so disable
+            # ours; beacon slower than our deadline would declare a
+            # healthy idle leader dead, so clamp the deadline to
+            # comfortably exceed the advertised interval.
+            interval = float(p.get("hb_interval_s", 0.0) or 0.0)
+            if interval <= 0:
+                hb_timeout_s = 0.0
+            elif hb_timeout_s:
+                hb_timeout_s = max(hb_timeout_s, 2.5 * interval)
+            continue
+        if kind == "hb":
+            continue  # leader liveness beacon, not a call
         if kind == "stop":
             conn.close()
             log.info(f"spmd follower replayed {n} calls")

@@ -1,0 +1,12 @@
+"""Fault injection and recovery verification (docs/RESILIENCE.md).
+
+``failpoints`` is the named-failpoint registry every resilience seam in
+the stack fires through; ``tests/test_chaos.py`` is the suite that
+drives injected faults through the full stack and asserts the global
+recovery invariants.
+"""
+
+from fasttalk_tpu.resilience.failpoints import (CATALOG, FaultCrash,
+                                                FaultInjected)
+
+__all__ = ["CATALOG", "FaultCrash", "FaultInjected"]

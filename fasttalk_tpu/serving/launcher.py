@@ -49,8 +49,67 @@ def run_spmd_follower(config: Config) -> int:
     host, port = config.spmd_addr.rsplit(":", 1)
     log.info(f"SPMD follower: replaying leader calls from "
              f"{host}:{port}")
-    follower_loop(engine, host, int(port))
+    follower_loop(engine, host, int(port),
+                  hb_timeout_s=config.spmd_hb_timeout_s)
     return 0
+
+
+class RestartBudget:
+    """Supervisor restart-storm guard (docs/RESILIENCE.md): a bounded
+    number of restarts per rolling window, with exponential backoff
+    between attempts. A persistently poisoned device state used to
+    crash-loop restart attempts at full CPU forever; now the budget
+    exhausts, ``/health`` goes dead, and the supervisor stops
+    resurrecting — the orchestrator (or an operator) owns recovery
+    from there. Clock injectable for tests."""
+
+    def __init__(self, max_restarts: int = 5, window_s: float = 300.0,
+                 backoff_s: float = 2.0, backoff_cap_s: float = 60.0,
+                 clock=None):
+        import time as _time
+
+        self.max_restarts = max(1, max_restarts)
+        self.window_s = window_s
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self._clock = clock if clock is not None else _time.monotonic
+        self._attempts: list[float] = []  # timestamps inside the window
+        self.exhausted = False
+
+    def _prune(self) -> None:
+        cutoff = self._clock() - self.window_s
+        self._attempts = [t for t in self._attempts if t >= cutoff]
+
+    def allow(self) -> bool:
+        """May another restart be attempted now? Exhaustion LATCHES:
+        a supervisor that gave up stays given-up until the process is
+        replaced — flapping back to life on window expiry would be the
+        same crash loop at a slower cadence."""
+        if self.exhausted:
+            return False
+        self._prune()
+        if len(self._attempts) >= self.max_restarts:
+            self.exhausted = True
+            return False
+        return True
+
+    def note_attempt(self) -> float:
+        """Record one attempt; returns the backoff delay to wait
+        before the NEXT attempt (exponential in the in-window attempt
+        count, capped)."""
+        self._prune()
+        self._attempts.append(self._clock())
+        n = len(self._attempts)
+        return min(self.backoff_cap_s, self.backoff_s * (2 ** (n - 1)))
+
+    def state(self) -> dict:
+        self._prune()
+        return {
+            "state": "exhausted" if self.exhausted else "ok",
+            "restarts_in_window": len(self._attempts),
+            "max_restarts": self.max_restarts,
+            "window_s": self.window_s,
+        }
 
 
 class ServerLauncher:
@@ -70,7 +129,8 @@ class ServerLauncher:
             engine = build_engine(config)
             host, port = config.spmd_addr.rsplit(":", 1)
             self._spmd_sink = CallBroadcaster(
-                host, int(port), config.spmd_followers)
+                host, int(port), config.spmd_followers,
+                hb_interval_s=config.spmd_hb_interval_s)
             engine.call_sink = self._spmd_sink
         if engine is None and config.router_enabled:
             # Router-backed mode (docs/ROUTER.md): the "engine" is a
@@ -83,21 +143,61 @@ class ServerLauncher:
         self.agent = build_agent(config, self.engine)
         self.server = WebSocketLLMServer(config, self.engine, self.agent)
         self._stop = asyncio.Event()
+        # Restart-storm guard: bounded budget + exponential backoff;
+        # exhaustion marks /health dead and stops resurrecting.
+        self.restart_budget = RestartBudget(
+            max_restarts=config.supervisor_max_restarts,
+            window_s=config.supervisor_window_s,
+            backoff_s=config.supervisor_backoff_s)
+        from fasttalk_tpu.observability.events import get_events
         from fasttalk_tpu.utils.metrics import get_metrics
 
+        self._events = get_events()
         self._m_restarts = get_metrics().counter(
             "engine_restarts_total",
             "supervised engine restarts after a crash")
+
+    def supervisor_info(self) -> dict:
+        """Supervisor state for the monitoring port's /health: while
+        "exhausted", the health surface reports dead (the supervisor
+        will not resurrect the engine again)."""
+        return self.restart_budget.state()
+
+    def _ready(self) -> bool:
+        """/health/ready: the engine must be up AND the supervisor
+        must not have given up on it."""
+        return (not self.restart_budget.exhausted
+                and self.engine.check_connection())
 
     async def _watchdog(self, interval: float = 5.0) -> None:
         """Supervised in-process recovery: if the engine thread dies,
         rebuild its device state and restart it (the reference's only
         recovery at this layer was docker `restart: unless-stopped`).
         In-flight requests already received terminal error events from
-        the crash; new requests are served after the restart."""
+        the crash; new requests are served after the restart.
+
+        Restart-storm guard (docs/RESILIENCE.md): attempts are
+        metered by ``self.restart_budget`` — exponential backoff
+        between attempts, at most SUPERVISOR_MAX_RESTARTS per
+        SUPERVISOR_WINDOW_S. On exhaustion the supervisor stops
+        resurrecting (a persistently poisoned device state must not
+        crash-loop at full CPU) and /health reports dead."""
         while not self._stop.is_set():
             await asyncio.sleep(interval)
-            if self._stop.is_set() or self.engine.check_connection():
+            if self._stop.is_set():
+                return
+            if self._spmd_sink is not None \
+                    and self._spmd_sink.dead_reason is not None:
+                # Cluster liveness: a dead follower killed the cluster
+                # (spmd_serving._fatal). Even if the engine thread is
+                # still idling, the gateway must come down for a
+                # cluster restart.
+                log.critical("SPMD cluster dead "
+                             f"({self._spmd_sink.dead_reason}); "
+                             "shutting the gateway down")
+                self._stop.set()
+                return
+            if self.engine.check_connection():
                 continue
             if self._spmd_sink is not None:
                 # In-place restart is leader-local state surgery and is
@@ -113,7 +213,13 @@ class ServerLauncher:
             restart = getattr(self.engine, "restart", None)
             if restart is None or not self.config.engine_auto_restart:
                 continue
-            log.error("engine thread is down; attempting restart")
+            if not self.restart_budget.allow():
+                if self.restart_budget.exhausted:
+                    self._note_exhausted()
+                continue
+            backoff = self.restart_budget.note_attempt()
+            log.error("engine thread is down; attempting restart "
+                      f"(next attempt no sooner than {backoff:.1f}s)")
             try:
                 ok = await asyncio.get_running_loop().run_in_executor(
                     None, restart)
@@ -124,6 +230,28 @@ class ServerLauncher:
                 self._m_restarts.inc()
             (log.info if ok else log.error)(
                 f"engine restart {'succeeded' if ok else 'failed'}")
+            # Exponential backoff before the NEXT attempt regardless of
+            # this one's outcome: the storm the guard exists for is
+            # succeed-then-recrash (a poisoned device state restarts
+            # cleanly and dies on the next dispatch) — spacing only the
+            # failed attempts would burn the whole budget in a few
+            # watchdog ticks.
+            await asyncio.sleep(backoff)
+
+    _exhausted_logged = False
+
+    def _note_exhausted(self) -> None:
+        if self._exhausted_logged:
+            return
+        self._exhausted_logged = True
+        st = self.restart_budget.state()
+        log.critical(
+            f"supervisor restart budget exhausted "
+            f"({st['max_restarts']} restarts in {st['window_s']:.0f}s); "
+            "the engine stays down and /health reports dead — "
+            "restart the process to recover")
+        self._events.emit("supervisor_exhausted", severity="critical",
+                          **st)
 
     def verify_backend(self) -> None:
         """Pre-flight: refuse to serve if the engine isn't healthy
@@ -153,8 +281,10 @@ class ServerLauncher:
                  f"{self.config.port}/ws/llm")
 
         mon_app = build_monitoring_app(
-            ready_check=self.engine.check_connection,
-            sched_info=getattr(self.engine, "scheduler_debug", None))
+            ready_check=self._ready,
+            sched_info=getattr(self.engine, "scheduler_debug", None),
+            supervisor_info=self.supervisor_info,
+            fault_http=self.config.fault_http_enabled)
         mon_runner = web.AppRunner(mon_app)
         await mon_runner.setup()
         await web.TCPSite(mon_runner, self.config.monitoring_host,

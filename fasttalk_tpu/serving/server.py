@@ -38,6 +38,7 @@ from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import bind_request, get_tracer
 from fasttalk_tpu.observability.watchdog import get_watchdog
+from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.serving.connection import ConnectionManager, ConnectionState
 from fasttalk_tpu.serving.conversation import ConversationManager
 from fasttalk_tpu.serving.text_processor import extract_speakable_chunk
@@ -366,6 +367,19 @@ class WebSocketLLMServer:
         from a slow client shows up exactly here)."""
         if ws.closed:
             return
+        if _fp.enabled:
+            # Chaos seam: `error` simulates a peer reset mid-send (the
+            # stream teardown must cancel the generation and free the
+            # slot); `corrupt` delivers a non-JSON text frame — what a
+            # half-written proxy buffer looks like to the client.
+            # fire_async: delay/hang here must stall THIS stream, not
+            # the whole event loop.
+            if await _fp.fire_async(
+                    "serving.ws.send", exc=ConnectionResetError,
+                    session_id=session_id,
+                    request_id=request_id or "") == "corrupt":
+                await ws.send_str("\x00corrupt-frame\x00")
+                return
         if request_id is not None:
             t0 = time.monotonic()
             await ws.send_json(payload)

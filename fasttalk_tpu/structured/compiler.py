@@ -32,6 +32,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any
 
+from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.structured.fsm import (TokenFSM, lift_dfa,
                                          token_byte_table)
 from fasttalk_tpu.structured.regex_dfa import RegexError, compile_regex
@@ -148,6 +149,12 @@ class FSMCompiler:
                 self._m_hit.inc()
                 return hit
         self._m_miss.inc()
+        if _fp.enabled:
+            # Chaos seam (docs/RESILIENCE.md): a compile-worker fault
+            # is a client-shape error (StructuredError -> 400 /
+            # invalid_config at the engine seam), never a 500 and
+            # never a breaker hit.
+            _fp.fire("structured.compile", exc=StructuredError)
         t0 = time.monotonic()
         pattern = spec_to_regex(spec, self.json_depth)
         try:

@@ -183,6 +183,32 @@ class Config:
     # analogue of the reference's docker `restart: unless-stopped`).
     engine_auto_restart: bool = field(
         default_factory=lambda: _env_bool("ENGINE_AUTO_RESTART", True))
+    # Restart-storm guard (serving/launcher.py RestartBudget, docs/
+    # RESILIENCE.md): at most max restarts per rolling window, with
+    # exponential backoff from backoff_s (capped at 60 s) between
+    # attempts. On exhaustion the supervisor stops resurrecting and
+    # /health reports dead — a persistently poisoned device state
+    # must not crash-loop at full CPU.
+    supervisor_max_restarts: int = field(
+        default_factory=lambda: _env_int("SUPERVISOR_MAX_RESTARTS", 5))
+    supervisor_window_s: float = field(
+        default_factory=lambda: _env_float("SUPERVISOR_WINDOW_S",
+                                           300.0))
+    supervisor_backoff_s: float = field(
+        default_factory=lambda: _env_float("SUPERVISOR_BACKOFF_S", 2.0))
+    # ---- Fault injection (fasttalk_tpu/resilience/failpoints.py,
+    # docs/RESILIENCE.md). FAULT_POINTS is a validated spec of named
+    # failpoints to arm, e.g.
+    # "engine.decode.dispatch=error;count=1,kv.park.copy=delay_ms:250"
+    # — unset (the default) compiles the whole subsystem down to one
+    # module-flag check per seam (measured <1% tok/s,
+    # BENCH_MODE=chaos). FAULT_HTTP gates the runtime
+    # POST /debug/fault endpoint on the monitoring port: OFF by
+    # default — never enable it in production. ----
+    fault_points: str = field(
+        default_factory=lambda: _env_str("FAULT_POINTS", ""))
+    fault_http_enabled: bool = field(
+        default_factory=lambda: _env_bool("FAULT_HTTP", False))
     max_history_length: int = field(default_factory=lambda: _env_int("MAX_HISTORY_LENGTH", 50))
     log_path: str = field(default_factory=lambda: _env_str("LOG_PATH", "./logs"))
 
@@ -213,6 +239,16 @@ class Config:
                                          "127.0.0.1:8890"))
     spmd_followers: int = field(
         default_factory=lambda: _env_int("TPU_SPMD_FOLLOWERS", 1))
+    # SPMD cluster liveness (parallel/spmd_serving.py, docs/
+    # RESILIENCE.md): the leader heartbeats followers every interval
+    # (0 disables the beacon), and a follower treats a leader silent
+    # past the timeout as dead (ConnectionError + exit for a cluster
+    # restart) instead of blocking in recv until a collective times
+    # out.
+    spmd_hb_interval_s: float = field(
+        default_factory=lambda: _env_float("SPMD_HB_INTERVAL_S", 2.0))
+    spmd_hb_timeout_s: float = field(
+        default_factory=lambda: _env_float("SPMD_HB_TIMEOUT_S", 8.0))
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
     # The length-pruning Pallas decode-attention kernel. Off by default:
     # profiled on v5e-1 its per-grid-cell cost (8 statically unrolled
@@ -602,6 +638,42 @@ class Config:
                 errs.append("spmd_addr must be host:port")
             if self.spmd_followers <= 0:
                 errs.append("spmd_followers must be >= 1")
+        if self.spmd_hb_interval_s < 0:
+            errs.append("spmd_hb_interval_s must be >= 0 (0 disables "
+                        "the leader heartbeat beacon)")
+        if self.spmd_hb_timeout_s < 0:
+            errs.append("spmd_hb_timeout_s must be >= 0 (0 disables "
+                        "the follower recv deadline)")
+        if self.spmd_hb_interval_s > 0 and self.spmd_hb_timeout_s > 0 \
+                and self.spmd_hb_timeout_s <= self.spmd_hb_interval_s:
+            errs.append(
+                "spmd_hb_timeout_s must exceed spmd_hb_interval_s "
+                "(a deadline shorter than the beacon period declares "
+                "a healthy leader dead)")
+        if self.spmd_hb_interval_s == 0 and self.spmd_hb_timeout_s > 0:
+            errs.append(
+                "SPMD_HB_INTERVAL_S=0 (heartbeats off) requires "
+                "SPMD_HB_TIMEOUT_S=0: a follower recv deadline with "
+                "no heartbeats on the wire declares a healthy idle "
+                "leader dead")
+        if self.supervisor_max_restarts < 1:
+            errs.append("supervisor_max_restarts must be >= 1")
+        if self.supervisor_window_s <= 0:
+            errs.append("supervisor_window_s must be > 0")
+        if self.supervisor_backoff_s <= 0:
+            errs.append("supervisor_backoff_s must be > 0")
+        if self.fault_points.strip():
+            # Validate the fault-injection spec at startup so a chaos
+            # drill with a typo'd point/action is a NAMED config
+            # error, never a silently disabled drill
+            # (resilience/failpoints.py parse_spec).
+            try:
+                from fasttalk_tpu.resilience.failpoints import \
+                    parse_spec
+
+                parse_spec(self.fault_points)
+            except ValueError as e:
+                errs.append(str(e))
         if self.decode_steps_per_call <= 0:
             errs.append("decode_steps_per_call must be >= 1")
         if self.spec_decode not in ("off", "ngram", "auto"):

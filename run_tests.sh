@@ -61,6 +61,18 @@
 #                             `structured` surfaces, and the hermes
 #                             split-tag streaming parser
 #                             (docs/STRUCTURED.md).
+#   ./run_tests.sh --chaos    fault-injection/chaos group: the
+#                             failpoint registry (spec grammar, p/
+#                             count/after/match, zero-overhead-off),
+#                             injected crash/hang/error/corrupt drills
+#                             through engine, KV offload, remote, WS
+#                             serving, SPMD and the structured
+#                             compiler asserting the exactly-once-
+#                             terminal + no-hang invariants, the
+#                             supervisor restart-storm guard, the
+#                             SPMD follower-kill liveness test, and
+#                             the scripts/check_failpoints.py
+#                             coverage lint (docs/RESILIENCE.md).
 #   ./run_tests.sh --perf     perf-attribution/flight-recorder group:
 #                             the step ledger (wall-time decomposition,
 #                             padding waste, MFU, compile ledger),
@@ -209,6 +221,15 @@ print(f"token FSM: {fsm.n_states} states, {fsm.n_classes} classes, "
       f"forced prefix {bytes(chain)!r}")
 comp.shutdown()
 EOF
+    exit 0
+fi
+
+if [[ "${1:-}" == "--chaos" ]]; then
+    shift
+    echo "--- check_failpoints lint (catalog <-> call sites <-> chaos"
+    echo "    tests; docs/RESILIENCE.md) ---"
+    "${PYENV[@]}" python scripts/check_failpoints.py
+    "${PYENV[@]}" python -m pytest tests/test_chaos.py "$@"
     exit 0
 fi
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Failpoint coverage lint (docs/RESILIENCE.md, run_tests.sh --chaos).
+
+Statically cross-checks three surfaces — no imports, pure AST/text, so
+it runs in milliseconds anywhere:
+
+1. The CATALOG in fasttalk_tpu/resilience/failpoints.py is the single
+   source of truth for failpoint names.
+2. Every catalog name is FIRED by at least one call site under
+   fasttalk_tpu/ (a registered-but-never-fired point is dead weight),
+   and every fire("...") literal uses a catalog name (a typo'd name
+   would assert at runtime — catch it here first).
+3. Every catalog name is INJECTED by at least one chaos test in
+   tests/test_chaos.py (a failpoint no chaos test exercises is an
+   unproven recovery path — the exact gap this PR closes), and no
+   test references a nonexistent point.
+
+Exit 0 = clean; exit 1 = problems, each printed on its own line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FAILPOINTS = REPO / "fasttalk_tpu" / "resilience" / "failpoints.py"
+CHAOS_TEST = REPO / "tests" / "test_chaos.py"
+
+
+def catalog_names() -> set[str]:
+    """CATALOG keys, read from the AST (no import: the lint must not
+    depend on the package's import-time env behaviour)."""
+    tree = ast.parse(FAILPOINTS.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign) or isinstance(node, ast.Assign):
+            targets = ([node.target] if isinstance(node, ast.AnnAssign)
+                       else node.targets)
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if "CATALOG" in names and isinstance(node.value, ast.Dict):
+                return {k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)}
+    raise SystemExit(f"{FAILPOINTS}: CATALOG dict literal not found")
+
+
+def fire_call_sites() -> dict[str, list[str]]:
+    """point name -> files under fasttalk_tpu/ that fire()/
+    fire_async() it with a string literal first argument."""
+    sites: dict[str, list[str]] = {}
+    for path in sorted((REPO / "fasttalk_tpu").rglob("*.py")):
+        if path == FAILPOINTS:
+            continue  # the registry's own docstring examples
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as e:  # pragma: no cover
+            print(f"PROBLEM: {path}: unparseable ({e})")
+            sys.exit(1)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            is_fire = (isinstance(func, ast.Attribute)
+                       and func.attr in ("fire", "fire_async")) or (
+                isinstance(func, ast.Name)
+                and func.id in ("fire", "fire_async"))
+            if not is_fire or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                sites.setdefault(arg.value, []).append(
+                    str(path.relative_to(REPO)))
+    return sites
+
+
+def chaos_test_refs(names: set[str]) -> tuple[set[str], set[str]]:
+    """(catalog names referenced in test_chaos.py, point-shaped
+    strings referenced that are NOT in the catalog). Points appear in
+    spec strings ("point=action") and fire() calls, so a plain string
+    scan over dotted names is the robust form."""
+    text = CHAOS_TEST.read_text()
+    referenced = {n for n in names if n in text}
+    # Any dotted token that appears on the left of '=<action>' in a
+    # spec literal must be a real point.
+    unknown = set()
+    for m in re.finditer(
+            r"[\"'\s,]([a-z_]+(?:\.[a-z_]+)+)=(?:error|hang|corrupt|"
+            r"crash_thread|delay_ms)", text):
+        if m.group(1) not in names:
+            unknown.add(m.group(1))
+    return referenced, unknown
+
+
+def main() -> int:
+    names = catalog_names()
+    problems: list[str] = []
+
+    sites = fire_call_sites()
+    for name in sorted(names):
+        if name not in sites:
+            problems.append(
+                f"catalog point {name!r} is never fired by any call "
+                "site under fasttalk_tpu/")
+    for name in sorted(set(sites) - names):
+        problems.append(
+            f"fire({name!r}) in {', '.join(sites[name])} is not in "
+            "the failpoints CATALOG")
+
+    if not CHAOS_TEST.exists():
+        problems.append(f"{CHAOS_TEST} does not exist")
+    else:
+        referenced, unknown = chaos_test_refs(names)
+        for name in sorted(names - referenced):
+            problems.append(
+                f"catalog point {name!r} is not injected by any test "
+                "in tests/test_chaos.py (unproven recovery path)")
+        for name in sorted(unknown):
+            problems.append(
+                f"tests/test_chaos.py injects nonexistent point "
+                f"{name!r}")
+
+    if problems:
+        for p in problems:
+            print(f"PROBLEM: {p}")
+        return 1
+    print(f"check_failpoints: {len(names)} catalog points, all fired "
+          f"in-tree and all injected by tests/test_chaos.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

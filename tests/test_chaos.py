@@ -1,0 +1,1060 @@
+"""Chaos suite (ISSUE 10, docs/RESILIENCE.md): drive the full stack
+through injected faults and assert the GLOBAL recovery invariants —
+
+- every request terminates with exactly one terminal event,
+- no caller awaits forever,
+- supervisor / breaker / watchdog / failover engage within their
+  deadlines,
+- KV byte accounting stays exact across crash-park-restore,
+- metrics stay Prometheus-valid mid-incident.
+
+Every failpoint registered in resilience/failpoints.py CATALOG must be
+injected by at least one test here — scripts/check_failpoints.py
+statically enforces it (run_tests.sh --chaos).
+"""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+from fasttalk_tpu.models import get_model_config, init_params
+from fasttalk_tpu.observability.events import get_events
+from fasttalk_tpu.resilience import failpoints as fp
+from fasttalk_tpu.utils.metrics import get_metrics
+
+TINY = get_model_config("test-tiny")
+GREEDY = dict(temperature=0.0, top_k=0, top_p=1.0)
+
+MSG_A = [{"role": "user", "content":
+          "a reasonably long first-turn message for chaos session A"}]
+FILLER_B = [{"role": "user", "content": "filler session B text"}]
+FILLER_C = [{"role": "user", "content": "filler session C text"}]
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    """A leaked rule would inject faults into the NEXT test — clear on
+    both sides unconditionally."""
+    fp.clear()
+    yield
+    fp.clear()
+
+
+def _make_engine(**kw):
+    import jax
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    defaults = dict(num_slots=2, max_len=256, prefill_chunk=64,
+                    kv_host_budget_mb=64.0, kv_park_ttl_s=600.0,
+                    kv_park_idle_s=0.0, kv_restore_min_tokens=8)
+    defaults.update(kw)
+    eng = TPUEngine(TINY, params, ByteTokenizer(), **defaults)
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = _make_engine()
+    yield e
+    fp.clear()
+    e.shutdown()
+
+
+def _revived(e) -> bool:
+    """Crash tests kill the module engine's thread; every test begins
+    from a known-running engine."""
+    return e.check_connection() or e.restart()
+
+
+def _collect(eng, rid, sid, msgs, max_tokens=8, **params):
+    async def run():
+        out = []
+        async for ev in eng.generate(
+                rid, sid, msgs,
+                GenerationParams(max_tokens=max_tokens, **GREEDY,
+                                 **params)):
+            out.append(ev)
+        return out
+    return asyncio.run(run())
+
+
+def _spawn_collect(eng, rid, sid, msgs, **kw):
+    box = {}
+
+    def run():
+        try:
+            box["events"] = _collect(eng, rid, sid, msgs, **kw)
+        except Exception as e:  # surfaced by the joining test
+            box["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def _text(events):
+    return "".join(e.get("text", "") for e in events
+                   if e["type"] == "token")
+
+
+def _terminals(events):
+    return [e for e in events
+            if e["type"] in ("done", "error", "cancelled")]
+
+
+def _assert_one_terminal(events, type_=None, code=None):
+    terms = _terminals(events)
+    assert len(terms) == 1, f"expected exactly one terminal: {events}"
+    if type_ is not None:
+        assert terms[0]["type"] == type_, terms[0]
+    if code is not None:
+        assert terms[0].get("code") == code, terms[0]
+    # The terminal must be the LAST event the caller saw (nothing
+    # streams after a terminal).
+    assert events[-1] is terms[0]
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------
+# Failpoint machinery
+# ---------------------------------------------------------------------
+
+class TestFailpointMachinery:
+    def test_spec_validation_names_every_problem(self):
+        # (Non-dotted bogus name on purpose: scripts/check_failpoints
+        # treats dotted point-shaped literals here as injections.)
+        with pytest.raises(ValueError, match="unknown failpoint"):
+            fp.parse_spec("bogus=error")
+        with pytest.raises(ValueError, match="unknown action"):
+            fp.parse_spec("engine.loop.tick=explode")
+        with pytest.raises(ValueError, match="delay_ms"):
+            fp.parse_spec("engine.loop.tick=delay_ms:-5")
+        with pytest.raises(ValueError, match="bad value"):
+            fp.parse_spec("engine.loop.tick=error;p=nope")
+        with pytest.raises(ValueError, match="unknown parameter"):
+            fp.parse_spec("engine.loop.tick=error;frobnicate=1")
+        # Multiple problems are ALL named (config shows the full list).
+        with pytest.raises(ValueError) as ei:
+            fp.parse_spec("bogus=error,engine.loop.tick=explode")
+        assert "unknown failpoint" in str(ei.value)
+        assert "unknown action" in str(ei.value)
+
+    def test_bare_delay_ms_rejected_not_inert(self):
+        # "delay_ms" without ":N" must be a NAMED error, not a 0 ms
+        # no-op — a silently inert drill is the exact failure mode the
+        # validated spec exists to prevent.
+        with pytest.raises(ValueError, match="requires an argument"):
+            fp.parse_spec("engine.loop.tick=delay_ms")
+
+    async def test_fire_async_yields_instead_of_blocking_loop(self):
+        # delay/hang at async seams must stall only that coroutine:
+        # another task on the same loop keeps making progress.
+        fp.activate("serving.ws.send=delay_ms:200;count=1")
+        ticks = {"n": 0}
+
+        async def ticker():
+            while True:
+                ticks["n"] += 1
+                await asyncio.sleep(0.01)
+
+        t = asyncio.ensure_future(ticker())
+        try:
+            await fp.fire_async("serving.ws.send")
+        finally:
+            t.cancel()
+        assert ticks["n"] >= 5, \
+            "event loop was blocked through the injected delay"
+        # Error/corrupt semantics match the sync fire().
+        fp.activate("serving.ws.send=corrupt;count=1,"
+                    "remote.connect=error;count=1")
+        assert await fp.fire_async("serving.ws.send") == "corrupt"
+        with pytest.raises(TimeoutError):
+            await fp.fire_async("remote.connect", exc=TimeoutError)
+
+    def test_spmd_hb_interval_zero_requires_timeout_zero(self,
+                                                         monkeypatch):
+        from fasttalk_tpu.utils.config import Config
+
+        monkeypatch.setenv("SPMD_HB_INTERVAL_S", "0")
+        with pytest.raises(ValueError, match="SPMD_HB_TIMEOUT_S=0"):
+            Config()
+        monkeypatch.setenv("SPMD_HB_TIMEOUT_S", "0")
+        Config()  # heartbeats and deadline both off: valid
+
+    def test_count_after_and_match_semantics(self):
+        fp.activate("serving.ws.send=error;count=2;after=1;match=S7")
+        # Hit 1 (matching) is skipped by after=1.
+        assert fp.fire("serving.ws.send", session_id="S7") is None
+        # Non-matching hits never count or fire.
+        assert fp.fire("serving.ws.send", session_id="S9") is None
+        for _ in range(2):  # hits 2..3 fire (count=2)
+            with pytest.raises(fp.FaultInjected):
+                fp.fire("serving.ws.send", session_id="S7")
+        assert fp.fire("serving.ws.send", session_id="S7") is None
+        rule = fp.describe()["rules"][0]
+        assert rule["fired"] == 2
+
+    def test_probability_zero_rule_is_armed_but_inert(self):
+        # The BENCH_MODE=chaos control: registry armed, nothing fires.
+        fp.activate("engine.decode.dispatch=error;p=0.0")
+        assert fp.enabled
+        for _ in range(50):
+            assert fp.fire("engine.decode.dispatch") is None
+        assert fp.describe()["rules"][0]["fired"] == 0
+
+    def test_disabled_flag_is_the_off_contract(self):
+        assert not fp.enabled
+        assert fp.describe()["rules"] == []
+        # Call sites guard on the flag, so fire() is never reached
+        # with injection off; even if called, it is a no-op.
+        assert fp.fire("engine.loop.tick") is None
+
+    def test_exc_class_override(self):
+        fp.activate("remote.connect=error;count=1")
+        with pytest.raises(TimeoutError):
+            fp.fire("remote.connect", exc=TimeoutError)
+
+    def test_fire_counts_reach_metrics_and_events(self):
+        fp.activate("kv.park.copy=corrupt;count=1")
+        assert fp.fire("kv.park.copy", session_id="s") == "corrupt"
+        assert get_metrics().counter("fault_injected_total").value >= 1
+        kinds = [e["kind"] for e in get_events().recent(50)]
+        assert "fault_injection" in kinds
+
+    def test_config_validates_fault_points_env(self, monkeypatch):
+        from fasttalk_tpu.utils.config import Config
+
+        monkeypatch.setenv("FAULT_POINTS", "nope=error")
+        with pytest.raises(ValueError, match="unknown failpoint"):
+            Config()
+        monkeypatch.setenv("FAULT_POINTS",
+                           "engine.loop.tick=delay_ms:1;p=0.5")
+        assert Config().fault_points  # valid spec accepted
+
+
+# ---------------------------------------------------------------------
+# Engine chaos: crash / scoped error / slowness / hang
+# ---------------------------------------------------------------------
+
+class TestEngineChaos:
+    def test_decode_dispatch_error_exactly_one_terminal_then_restart(
+            self, eng):
+        assert _revived(eng)
+        fp.activate("engine.decode.dispatch=error;count=1")
+        events = _collect(eng, "cd1", "CD1", MSG_A, max_tokens=8)
+        # The dispatch fault crashes the engine thread; _abort_all must
+        # deliver exactly one internal_error — never zero (caller would
+        # await forever), never two.
+        _assert_one_terminal(events, "error", code="internal_error")
+        fp.clear()
+        assert _wait(lambda: not eng.check_connection(), 5.0)
+        assert eng.restart()
+        events = _collect(eng, "cd2", "CD2", MSG_A, max_tokens=4)
+        _assert_one_terminal(events, "done")
+
+    def test_prefill_dispatch_error_scoped_to_request(self, eng):
+        assert _revived(eng)
+        fp.activate("engine.prefill.dispatch=error;count=1")
+        events = _collect(eng, "pf1", "PF1", MSG_A, max_tokens=4)
+        _assert_one_terminal(events, "error")
+        # Scoped: the engine thread survived a per-request prefill
+        # fault — no crash, no restart needed.
+        assert eng.check_connection()
+        events = _collect(eng, "pf2", "PF2", MSG_A, max_tokens=4)
+        _assert_one_terminal(events, "done")
+
+    def test_decode_dispatch_delay_still_completes(self, eng):
+        assert _revived(eng)
+        fp.activate("engine.decode.dispatch=delay_ms:40;count=3")
+        events = _collect(eng, "dl1", "DL1", MSG_A, max_tokens=6)
+        _assert_one_terminal(events, "done")
+        assert fp.describe()["rules"][0]["fired"] >= 1
+
+    def test_retire_fetch_hang_watchdog_terminates_within_deadline(
+            self, eng):
+        from fasttalk_tpu.observability.watchdog import Watchdog
+
+        assert _revived(eng)
+        wd = Watchdog(token_stall_s=0.3, step_stall_s=0.3,
+                      cancel_stall_s=0.3, interval_s=0.05)
+        wd.bind_engine(eng)
+        fp.activate("engine.retire.fetch=hang")
+        t, box = _spawn_collect(eng, "hg1", "HG1", MSG_A,
+                                max_tokens=32)
+        try:
+            # The hang wedges the engine thread at the fetch: the
+            # heartbeat goes stale and the request stops progressing.
+            assert _wait(lambda: (eng.heartbeat_age() or 0) > 0.4, 10.0)
+            # Watchdog deadline: within ~cancel_stall_s + a few check
+            # intervals the stalled request must be terminated from
+            # OUTSIDE the hung thread (force_fail), unblocking the
+            # caller while the engine thread is still wedged.
+            t0 = time.monotonic()
+
+            def tick():
+                status = wd.check()
+                assert status["step_stalled"] or not t.is_alive()
+                return not t.is_alive()
+
+            assert _wait(tick, 5.0), \
+                "watchdog never unblocked the stalled caller"
+            assert time.monotonic() - t0 < 5.0
+            assert get_metrics().counter(
+                "watchdog_cancelled_total").value >= 1
+        finally:
+            fp.clear()  # release the hang
+        t.join(timeout=15)
+        assert not t.is_alive(), "caller awaited forever"
+        _assert_one_terminal(box["events"], "error", code="stalled")
+        # The released engine thread finishes the wedged call cleanly.
+        assert _wait(eng.check_connection, 5.0)
+        events = _collect(eng, "hg2", "HG2", MSG_A, max_tokens=4)
+        _assert_one_terminal(events, "done")
+
+    def test_shutdown_timeout_logs_stuck_stack(self):
+        e = _make_engine(num_slots=1, kv_host_budget_mb=0.0)
+        try:
+            fp.activate("engine.loop.tick=hang")
+            assert _wait(lambda: (e.heartbeat_age() or 0) > 0.2, 10.0)
+            e.shutdown(timeout_s=0.3)  # times out against the hang
+            evs = get_events().recent(50, kind="engine_shutdown_stuck")
+            assert evs and evs[0]["severity"] == "critical"
+            # The captured stack names the seam the thread is stuck in.
+            assert "fire" in evs[0]["attrs"].get("stack", "")
+        finally:
+            fp.clear()  # release so the thread can exit
+            e.shutdown(timeout_s=5)
+
+
+# ---------------------------------------------------------------------
+# Supervisor restart path, end to end (ISSUE 10 satellite)
+# ---------------------------------------------------------------------
+
+class TestSupervisorRestartE2E:
+    def test_crash_park_restart_queue_survival(self, eng):
+        assert _revived(eng)
+        restarts_before = len(get_events().recent(
+            100, kind="engine_restart"))
+
+        # 1. Session A decodes, then is evicted by two fillers on the
+        #    2-slot engine -> its KV parks to the host pool.
+        r1 = _text(_collect(eng, "sv1", "SVA", MSG_A))
+        _collect(eng, "svb", "SVB", FILLER_B)
+        _collect(eng, "svc", "SVC", FILLER_C)
+        assert _wait(lambda: eng._kv_pool.parked_len("SVA") > 0), \
+            "eviction never parked session SVA"
+        bytes_parked = eng._kv_pool.stats()["bytes"]
+        assert bytes_parked > 0
+
+        # 2. A long generation is mid-decode when the engine thread is
+        #    killed (crash_thread at the loop seam).
+        t, box = _spawn_collect(eng, "svg", "SVG", FILLER_B,
+                                max_tokens=400)
+        assert _wait(lambda: len(eng._running) > 0, 10.0)
+        fp.activate("engine.loop.tick=crash_thread;count=1")
+        assert _wait(lambda: not eng.check_connection(), 10.0)
+        t.join(timeout=15)
+        assert not t.is_alive(), "in-flight caller awaited forever"
+        # Exactly one terminal internal_error for the in-flight stream.
+        _assert_one_terminal(box["events"], "error",
+                             code="internal_error")
+
+        # 3. A request submitted in the crash race window (teardown
+        #    raced the connection check) survives on the command queue
+        #    and must be served after restart.
+        fp.clear()
+        eng.check_connection = lambda: True  # simulate the race window
+        try:
+            tq, boxq = _spawn_collect(eng, "svq", "SVQ", FILLER_C,
+                                      max_tokens=4)
+            assert _wait(lambda: "svq" in eng._by_id, 10.0)
+        finally:
+            del eng.__dict__["check_connection"]
+
+        # 4. Supervised restart: device state rebuilt, SAME command
+        #    queue, parked host KV intentionally survives.
+        assert eng.restart()
+        restart_evs = get_events().recent(100, kind="engine_restart")
+        assert len(restart_evs) > restarts_before
+        assert restart_evs[0]["attrs"]["parked_sessions"] >= 1
+        tq.join(timeout=30)
+        assert not tq.is_alive(), "queued-during-outage caller hung"
+        _assert_one_terminal(boxq["events"], "done")
+
+        # 5. Session A's follow-up restores from the surviving parked
+        #    KV instead of re-prefilling; byte accounting stays exact
+        #    (the consumed entry leaves the pool empty again).
+        restored_before = eng.get_stats()["kv_host"]["restored_total"]
+        msg2 = MSG_A + [{"role": "assistant", "content": r1},
+                        {"role": "user", "content": "follow-up turn"}]
+        events = _collect(eng, "sv2", "SVA", msg2)
+        _assert_one_terminal(events, "done")
+        st = eng.get_stats()["kv_host"]
+        assert st["restored_total"] > restored_before
+        # Exact byte accounting across crash-park-restore: the
+        # restore CONSUMED the entry, so SVA holds no parked bytes
+        # and the pool's session count matches its entry map.
+        assert eng._kv_pool.parked_len("SVA") == 0
+        assert st["sessions"] == len(eng._kv_pool)
+
+
+class TestLauncherSupervisor:
+    class _CrashyEngine:
+        """Engine stub for the launcher watchdog: dead until restart()
+        succeeds; restart outcomes are scripted."""
+
+        def __init__(self, outcomes):
+            self.outcomes = list(outcomes)
+            self.alive = True
+            self.restarts = 0
+
+        def check_connection(self):
+            return self.alive
+
+        def restart(self):
+            self.restarts += 1
+            ok = self.outcomes.pop(0) if self.outcomes else False
+            self.alive = ok
+            return ok
+
+    def _launcher(self, engine, **cfg_over):
+        import os
+
+        from fasttalk_tpu.serving.launcher import ServerLauncher
+        from fasttalk_tpu.utils.config import Config
+
+        old = os.environ.get("ENABLE_PYDANTIC_AI")
+        os.environ["ENABLE_PYDANTIC_AI"] = "false"
+        try:
+            cfg = Config()
+        finally:
+            if old is None:
+                os.environ.pop("ENABLE_PYDANTIC_AI", None)
+            else:
+                os.environ["ENABLE_PYDANTIC_AI"] = old
+        for k, v in cfg_over.items():
+            setattr(cfg, k, v)
+        return ServerLauncher(cfg, engine=engine)
+
+    async def test_restart_increments_counter(self):
+        engine = self._CrashyEngine([True])
+        launcher = self._launcher(engine, supervisor_backoff_s=0.01)
+        task = asyncio.create_task(launcher._watchdog(interval=0.02))
+        engine.alive = False
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if engine.alive:
+                break
+        task.cancel()
+        assert engine.restarts == 1
+        assert launcher._m_restarts.value == 1
+        assert launcher.supervisor_info()["state"] == "ok"
+        assert launcher._ready()
+
+    async def test_restart_storm_exhausts_budget_and_marks_dead(self):
+        engine = self._CrashyEngine([])  # every restart fails
+        launcher = self._launcher(engine,
+                                  supervisor_max_restarts=2,
+                                  supervisor_window_s=300.0,
+                                  supervisor_backoff_s=0.01)
+        task = asyncio.create_task(launcher._watchdog(interval=0.02))
+        engine.alive = False
+        for _ in range(300):
+            await asyncio.sleep(0.02)
+            if launcher.restart_budget.exhausted:
+                break
+        # Grace ticks: a storm-guarded supervisor must NOT keep
+        # attempting after exhaustion.
+        await asyncio.sleep(0.2)
+        task.cancel()
+        assert launcher.restart_budget.exhausted
+        assert engine.restarts == 2  # the budget, not one per tick
+        assert launcher.supervisor_info()["state"] == "exhausted"
+        assert not launcher._ready()
+        kinds = [e["kind"] for e in get_events().recent(50)]
+        assert "supervisor_exhausted" in kinds
+
+    async def test_health_endpoint_reports_supervisor_dead(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+        app = build_monitoring_app(
+            ready_check=lambda: False,
+            supervisor_info=lambda: {"state": "exhausted",
+                                     "restarts_in_window": 5})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = await (await client.get("/health")).json()
+            assert body["status"] == "dead"
+            assert body["supervisor"]["state"] == "exhausted"
+            assert any("restart budget exhausted" in w
+                       for w in body["warnings"])
+            assert (await client.get("/health/ready")).status == 503
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------
+# KV offload tier chaos: byte accounting stays exact
+# ---------------------------------------------------------------------
+
+class TestKVChaos:
+    def test_park_copy_error_loses_snapshot_not_accounting(self):
+        e = _make_engine()
+        try:
+            fp.activate("kv.park.copy=error")
+            _collect(e, "k1", "KA", MSG_A)
+            _collect(e, "k2", "KB", FILLER_B)
+            _collect(e, "k3", "KC", FILLER_C)  # evicts KA -> park fails
+            assert _wait(lambda: fp.describe()["rules"][0]["fired"] > 0)
+            time.sleep(0.2)  # let the copy thread finish failing
+            st = e.get_stats()["kv_host"]
+            # The failed snapshot was never inserted: zero entries,
+            # zero bytes — exact, not approximately-rolled-back.
+            assert st["sessions"] == 0 and st["bytes"] == 0
+            assert e.check_connection()
+            fp.clear()
+            # KA re-prefills from scratch and still completes.
+            events = _collect(e, "k4", "KA", MSG_A)
+            _assert_one_terminal(events, "done")
+        finally:
+            fp.clear()
+            e.shutdown()
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_park_copy_crash_kills_then_resurrects_copy_thread(self):
+        # The injected FaultCrash escaping the copy thread IS the test
+        # — silence pytest's unhandled-thread-exception warning.
+        e = _make_engine()
+        try:
+            fp.activate("kv.park.copy=crash_thread;count=1")
+            _collect(e, "c1", "CA", MSG_A)
+            _collect(e, "c2", "CB", FILLER_B)
+            _collect(e, "c3", "CC", FILLER_C)  # evict CA: thread dies
+            assert _wait(lambda: fp.describe()["rules"][0]["fired"] > 0)
+            assert _wait(
+                lambda: not e._kv_offload._thread.is_alive(), 5.0)
+            fp.clear()
+            # The next park submission resurrects the copy thread
+            # (submit -> _ensure_thread) and lands normally.
+            _collect(e, "c4", "CA", MSG_A)
+            _collect(e, "c5", "CD", FILLER_C)  # evicts CB or CA
+            assert _wait(lambda: len(e._kv_pool) > 0, 10.0)
+        finally:
+            fp.clear()
+            e.shutdown()
+
+    def test_restore_dispatch_error_falls_back_to_prefill(self):
+        e = _make_engine()
+        try:
+            r1 = _text(_collect(e, "r1", "RA", MSG_A))
+            _collect(e, "r2", "RB", FILLER_B)
+            _collect(e, "r3", "RC", FILLER_C)  # evicts RA -> parks
+            assert _wait(lambda: e._kv_pool.parked_len("RA") > 0)
+            fp.activate("kv.restore.dispatch=error;count=1")
+            msg2 = MSG_A + [{"role": "assistant", "content": r1},
+                            {"role": "user", "content": "again"}]
+            events = _collect(e, "r4", "RA", msg2)
+            # Recovery contract: restore fails -> full prefill, one
+            # clean `done`, engine thread alive.
+            _assert_one_terminal(events, "done")
+            assert e.check_connection()
+            st = e.get_stats()["kv_host"]
+            assert st["restored_total"] == 0
+            # The suspect entry was purged with exact accounting.
+            assert e._kv_pool.parked_len("RA") == 0
+            assert st["sessions"] == len(e._kv_pool)
+        finally:
+            fp.clear()
+            e.shutdown()
+
+    def test_prestage_error_restore_still_works(self):
+        e = _make_engine()
+        try:
+            fp.activate("kv.prestage.copy=error")
+            r1 = _text(_collect(e, "p1", "PA", MSG_A))
+            _collect(e, "p2", "PB", FILLER_B)
+            _collect(e, "p3", "PC", FILLER_C)  # evicts PA -> parks
+            assert _wait(lambda: e._kv_pool.parked_len("PA") > 0)
+            msg2 = MSG_A + [{"role": "assistant", "content": r1},
+                            {"role": "user", "content": "back again"}]
+            events = _collect(e, "p4", "PA", msg2)
+            _assert_one_terminal(events, "done")
+            # Prestage is best-effort: its failure must not stop the
+            # restore (which falls back to host numpy at dispatch).
+            assert e.get_stats()["kv_host"]["restored_total"] >= 1
+        finally:
+            fp.clear()
+            e.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Remote backend chaos
+# ---------------------------------------------------------------------
+
+class TestRemoteChaos:
+    async def _vllm_server(self):
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        calls = {"n": 0}
+        app = web.Application()
+
+        async def chat(request: web.Request) -> web.StreamResponse:
+            calls["n"] += 1
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for word in ("alpha", "beta", "gamma", "delta"):
+                chunk = {"choices": [{"delta": {"content": word},
+                                      "finish_reason": None}]}
+                await resp.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        return server, calls
+
+    async def test_connect_error_retried_then_succeeds(self):
+        from fasttalk_tpu.engine.remote import VLLMRemoteEngine
+
+        server, calls = await self._vllm_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1",
+                connect_retries=2)
+            eng.start()
+            fp.activate("remote.connect=error;count=1")
+            events = []
+            async for ev in eng.generate(
+                    "rc1", "s1", [{"role": "user", "content": "x"}],
+                    GenerationParams()):
+                events.append(ev)
+            # The injected connect failure was retried exactly like a
+            # real one; the upstream then served.
+            assert events[-1]["type"] == "done"
+            assert calls["n"] == 1  # injected failure never reached it
+            assert get_metrics().counter(
+                "remote_connect_retries_total").value >= 1
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_connect_error_exhausts_with_retry_after(self):
+        from fasttalk_tpu.engine.remote import VLLMRemoteEngine
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        server, _calls = await self._vllm_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1",
+                connect_retries=1)
+            eng.start()
+            fp.activate("remote.connect=error")  # every attempt
+            with pytest.raises(LLMServiceError) as ei:
+                async for _ in eng.generate(
+                        "rc2", "s1",
+                        [{"role": "user", "content": "x"}],
+                        GenerationParams()):
+                    pass
+            # No caller awaits forever: bounded retries then a
+            # terminal connection error carrying retry_after.
+            assert ei.value.retry_after is not None
+            eng.shutdown()
+        finally:
+            await server.close()
+
+    async def test_stream_error_mid_stream_surfaces_unretried(self):
+        from fasttalk_tpu.engine.remote import VLLMRemoteEngine
+        from fasttalk_tpu.utils.errors import LLMServiceError
+
+        server, calls = await self._vllm_server()
+        try:
+            eng = VLLMRemoteEngine(
+                f"http://127.0.0.1:{server.port}/v1", "m1",
+                connect_retries=3)
+            eng.start()
+            retries_before = get_metrics().counter(
+                "remote_connect_retries_total").value
+            fp.activate("remote.stream=error;after=2")
+            events = []
+            with pytest.raises(LLMServiceError):
+                async for ev in eng.generate(
+                        "rs1", "s1",
+                        [{"role": "user", "content": "x"}],
+                        GenerationParams()):
+                    events.append(ev)
+            # Tokens streamed before the fault; mid-stream failures
+            # are NOT idempotent and must surface without retry.
+            assert any(e["type"] == "token" for e in events)
+            assert calls["n"] == 1
+            assert get_metrics().counter(
+                "remote_connect_retries_total").value == retries_before
+            eng.shutdown()
+        finally:
+            await server.close()
+
+
+# ---------------------------------------------------------------------
+# WebSocket serving chaos
+# ---------------------------------------------------------------------
+
+class TestWSChaos:
+    async def _setup(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from fasttalk_tpu.engine.fake import FakeEngine
+        from fasttalk_tpu.serving.server import WebSocketLLMServer
+        from fasttalk_tpu.utils.config import Config
+        import os
+
+        old = {k: os.environ.get(k) for k in
+               ("LLM_PROVIDER", "ENABLE_PYDANTIC_AI")}
+        os.environ["LLM_PROVIDER"] = "fake"
+        os.environ["ENABLE_PYDANTIC_AI"] = "false"
+        try:
+            config = Config()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        engine = FakeEngine(delay_s=0.001)
+        engine.start()
+        server = WebSocketLLMServer(config, engine)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        return engine, server, client
+
+    async def test_ws_send_error_does_not_kill_the_server(self):
+        engine, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            started = json.loads((await ws.receive()).data)
+            assert started["type"] == "session_started"
+            # First send (session_started) passed; fail the next one.
+            fp.activate("serving.ws.send=error;count=1")
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            # The injected peer-reset breaks this generation's sends;
+            # the session and server survive. Drain whatever arrives
+            # until the error frame or response_complete.
+            saw_terminal = False
+            for _ in range(200):
+                msg = await asyncio.wait_for(ws.receive(), timeout=10)
+                if msg.data is None:
+                    break
+                try:
+                    obj = json.loads(msg.data)
+                except (TypeError, ValueError):
+                    continue
+                if obj["type"] in ("error", "response_complete"):
+                    saw_terminal = True
+                    break
+            assert saw_terminal, "client saw neither error nor " \
+                "completion after an injected send fault"
+            fp.clear()
+            # The SAME server still serves a fresh session end to end.
+            ws2 = await client.ws_connect("/ws/llm")
+            assert json.loads((await ws2.receive()).data)[
+                "type"] == "session_started"
+            await ws2.send_json({"type": "user_message", "text": "yo"})
+            done = False
+            for _ in range(200):
+                obj = json.loads((await asyncio.wait_for(
+                    ws2.receive(), timeout=10)).data)
+                if obj["type"] == "response_complete":
+                    done = True
+                    break
+            assert done
+            await ws2.close()
+            await ws.close()
+        finally:
+            fp.clear()
+            await client.close()
+
+    async def test_ws_send_corrupt_delivers_garbage_then_recovers(
+            self):
+        engine, server, client = await self._setup()
+        try:
+            ws = await client.ws_connect("/ws/llm")
+            await ws.receive()  # session_started
+            fp.activate("serving.ws.send=corrupt;count=1")
+            await ws.send_json({"type": "user_message", "text": "hi"})
+            saw_garbage = saw_complete = False
+            for _ in range(300):
+                msg = await asyncio.wait_for(ws.receive(), timeout=10)
+                if msg.data is None:
+                    break
+                try:
+                    obj = json.loads(msg.data)
+                except (TypeError, ValueError):
+                    saw_garbage = True  # the corrupted frame
+                    continue
+                if obj["type"] == "response_complete":
+                    saw_complete = True
+                    break
+            # One corrupted frame, then the stream keeps flowing to a
+            # clean completion — corruption is lossy, not fatal.
+            assert saw_garbage and saw_complete
+            await ws.close()
+        finally:
+            fp.clear()
+            await client.close()
+
+
+# ---------------------------------------------------------------------
+# SPMD cluster liveness (VERDICT item 7 satellite)
+# ---------------------------------------------------------------------
+
+class TestSpmdChaos:
+    def _leader_with_follower(self, hb_interval_s=0.05):
+        """CallBroadcaster + a raw-socket 'follower' we control."""
+        from fasttalk_tpu.parallel.spmd_serving import CallBroadcaster
+
+        port = _free_port()
+        follower_box = {}
+
+        def connect():
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    follower_box["sock"] = socket.create_connection(
+                        ("127.0.0.1", port), timeout=1)
+                    return
+                except OSError:
+                    time.sleep(0.02)
+
+        t = threading.Thread(target=connect, daemon=True)
+        t.start()
+        sink = CallBroadcaster("127.0.0.1", port, n_followers=1,
+                               hb_interval_s=hb_interval_s)
+        t.join(timeout=10)
+        assert "sock" in follower_box
+        return sink, follower_box["sock"]
+
+    def test_follower_death_is_fatal_within_deadline(self):
+        # THE liveness test: kill a follower mid-stream; the leader
+        # must error within ~2 heartbeat intervals + TCP turnaround,
+        # not hang until some collective times out.
+        sink, follower = self._leader_with_follower(hb_interval_s=0.05)
+        try:
+            sink("decode", {"kv_len": 512, "steps": 8,
+                            "with_history": False})
+            follower.close()  # follower dies mid-decode
+            t0 = time.monotonic()
+            assert _wait(lambda: sink.dead_reason is not None, 5.0), \
+                "leader never detected the dead follower"
+            assert time.monotonic() - t0 < 5.0
+            with pytest.raises(RuntimeError, match="cluster is dead"):
+                sink("decode", {"kv_len": 512, "steps": 8,
+                                "with_history": False})
+            kinds = [e["kind"] for e in get_events().recent(50)]
+            assert "spmd_cluster_dead" in kinds
+        finally:
+            sink.close()
+
+    def test_send_failpoint_aborts_surviving_followers(self):
+        from fasttalk_tpu.parallel.spmd_serving import _recv
+
+        sink, follower = self._leader_with_follower(hb_interval_s=0.0)
+        try:
+            # Drain the hello frame FIRST — it proves the pump is past
+            # it, so the armed failpoint deterministically hits our
+            # publish, not the handshake.
+            kind, hello = _recv(follower, deadline_s=5.0)
+            assert kind == "hello" and hello["hb_interval_s"] == 0.0
+            fp.activate("spmd.send=error;count=1")
+            sink("patch", {"packed": None})
+            assert _wait(lambda: sink.dead_reason is not None, 5.0)
+            # The survivor got a clean abort frame, not silence.
+            kind, payload = _recv(follower, deadline_s=5.0)
+            assert kind == "abort"
+            assert "fault injected" in payload["reason"]
+        finally:
+            fp.clear()
+            sink.close()
+            follower.close()
+
+    def test_heartbeats_flow_while_engine_idle(self):
+        from fasttalk_tpu.parallel.spmd_serving import _recv
+
+        sink, follower = self._leader_with_follower(hb_interval_s=0.05)
+        try:
+            # The hello handshake leads (carrying the leader's beacon
+            # contract, so followers never guess it from local env)...
+            kind, hello = _recv(follower, deadline_s=5.0)
+            assert kind == "hello"
+            assert hello["hb_interval_s"] == pytest.approx(0.05)
+            # ...then heartbeats flow with no engine activity at all.
+            kind, _ = _recv(follower, deadline_s=5.0)
+            assert kind == "hb"
+        finally:
+            sink.close()
+            follower.close()
+
+    def test_follower_recv_deadline_detects_silent_leader(self):
+        from fasttalk_tpu.parallel.spmd_serving import _recv
+
+        a, b = socket.socketpair()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(ConnectionError,
+                               match="heartbeat deadline"):
+                _recv(a, deadline_s=0.3)
+            # Within the deadline (+ margin), not a blocked-forever
+            # recv.
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_failpoint_injects_peer_failure(self):
+        from fasttalk_tpu.parallel.spmd_serving import _recv
+
+        a, b = socket.socketpair()
+        try:
+            fp.activate("spmd.recv=error;count=1")
+            with pytest.raises(ConnectionError):
+                _recv(a, deadline_s=1.0)
+        finally:
+            fp.clear()
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------
+# Structured-compile worker chaos
+# ---------------------------------------------------------------------
+
+class TestStructuredChaos:
+    def test_compile_fault_is_client_shape_error(self, eng):
+        from fasttalk_tpu.utils.errors import ErrorCategory, \
+            LLMServiceError
+
+        assert _revived(eng)
+        fp.activate("structured.compile=error;count=1")
+        with pytest.raises(LLMServiceError) as ei:
+            _collect(eng, "st1", "ST1", MSG_A, max_tokens=4,
+                     structured={"kind": "regex", "regex": "ab+a"})
+        # A compile-worker fault is a VALIDATION error (400 /
+        # invalid_config at the serving edge) — never a 500, never a
+        # breaker hit, and the engine thread is untouched.
+        assert ei.value.category == ErrorCategory.VALIDATION
+        assert eng.check_connection()
+        fp.clear()
+        # The identical spec compiles fine once the fault is gone.
+        events = _collect(eng, "st2", "ST2", MSG_A, max_tokens=6,
+                          structured={"kind": "regex", "regex": "ab+a"})
+        _assert_one_terminal(events)
+
+
+# ---------------------------------------------------------------------
+# Cross-cutting invariants
+# ---------------------------------------------------------------------
+
+class TestMidIncidentInvariants:
+    def test_metrics_prometheus_valid_mid_incident(self, eng):
+        import importlib.util
+        import pathlib
+
+        assert _revived(eng)
+        # Produce a real incident: injected park failures + an
+        # injected scoped prefill error, with fires recorded.
+        fp.activate("kv.park.copy=error,engine.prefill.dispatch="
+                    "error;count=1")
+        events = _collect(eng, "mi1", "MI1", MSG_A, max_tokens=4)
+        _assert_one_terminal(events, "error")
+        fp.clear()
+        spec = importlib.util.spec_from_file_location(
+            "check_prometheus",
+            pathlib.Path(__file__).parent.parent / "scripts"
+            / "check_prometheus.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        text = get_metrics().prometheus()
+        assert "fault_injected_total" in text
+        problems = mod.validate(text)
+        assert not problems, problems
+
+
+class TestFaultHttpEndpoint:
+    async def _client(self, fault_http):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+        client = TestClient(TestServer(
+            build_monitoring_app(fault_http=fault_http)))
+        await client.start_server()
+        return client
+
+    async def test_post_disabled_by_default(self):
+        client = await self._client(fault_http=False)
+        try:
+            resp = await client.post("/debug/fault", json={
+                "spec": "engine.loop.tick=error"})
+            assert resp.status == 403
+            assert not fp.enabled  # nothing armed
+            # The read-only view is always served.
+            body = await (await client.get("/debug/fault")).json()
+            assert "engine.loop.tick" in body["catalog"]
+        finally:
+            await client.close()
+
+    async def test_arm_inspect_clear_roundtrip(self):
+        client = await self._client(fault_http=True)
+        try:
+            resp = await client.post("/debug/fault", json={
+                "spec": "kv.park.copy=delay_ms:5;count=3"})
+            assert resp.status == 200
+            assert fp.enabled
+            body = await (await client.get("/debug/fault")).json()
+            assert body["rules"][0]["point"] == "kv.park.copy"
+            # /health must flag the active drill for responders.
+            health = await (await client.get("/health")).json()
+            assert health["fault_injection"]["active_points"] == [
+                "kv.park.copy"]
+            assert any("Fault injection" in w
+                       for w in health["warnings"])
+            # Bad specs 400 with the reasons, leaving rules untouched.
+            resp = await client.post("/debug/fault", json={
+                "spec": "nope=error"})
+            assert resp.status == 400
+            assert "unknown failpoint" in (await resp.json())["error"]
+            assert fp.enabled
+            resp = await client.post("/debug/fault",
+                                     json={"clear": True})
+            assert resp.status == 200
+            assert not fp.enabled
+        finally:
+            await client.close()
