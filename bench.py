@@ -25,6 +25,15 @@ control in subprocess-isolated phases — reports parked-session
 capacity per budget (headline: the ratio, expected ~2x), restore-
 latency p50 both ways, and decode tok/s (must stay within noise).
 
+``BENCH_MODE=paged`` runs the paged-KV capacity scenario
+(docs/KVCACHE.md "Paged tier"): a mixed-context fleet on a FIXED
+KV-row budget, dense layout (admission priced at slots x max_len) vs
+paged block tables (priced at blocks in use) in subprocess-isolated
+phases — reports peak concurrent sessions per layout (headline: the
+ratio), the same-slot-count short-context decode tok/s pair (the
+gather/scatter overhead bound, target within 10%), and aliased-prefix
+HBM savings.
+
 ``BENCH_MODE=structured`` runs the constrained-decoding scenario
 (docs/STRUCTURED.md): per-step mask-apply overhead vs an unconstrained
 control (target <5% tok/s), and jump-forward's forced-token fraction +
@@ -609,6 +618,208 @@ def bench_longctx() -> dict:
             "parked_capacity_ratio": cap_ratio,
             "restore_p50_speedup": restore_speedup,
             "decode_tok_s_ratio": tok_ratio}
+
+
+# ---------------- paged mode (block-table KV cache) ----------------
+
+async def _pg_session(engine, rid: str, sid: str, messages: list[dict],
+                      max_tokens: int) -> dict:
+    """One admission-wave turn that RETURNS a shed instead of raising:
+    block-pool exhaustion rejections (code kv_blocks_exhausted, with
+    retry_after) are a measured outcome of this scenario, not a bench
+    failure."""
+    from fasttalk_tpu.engine.engine import GenerationParams
+
+    tokens = 0
+    params = GenerationParams(temperature=0.7, top_k=40, top_p=0.9,
+                              max_tokens=max_tokens)
+    async for event in engine.generate(rid, sid, messages, params):
+        if event["type"] == "token":
+            tokens += 1
+        elif event["type"] == "done":
+            tokens = event["stats"]["tokens_generated"]
+        elif event["type"] == "error":
+            return {"tokens": tokens, "shed": True,
+                    "code": event.get("code")}
+    return {"tokens": tokens, "shed": False, "code": None}
+
+
+async def _pg_admission_phase(cfg, sessions: int, contexts: list[int],
+                              max_tokens: int) -> dict:
+    """The fixed-HBM-budget admission scenario, one layout per child
+    process: a MIXED-context fleet (the 512–32k production mix scaled
+    to the bench max_len) submits concurrently and the phase reports
+    how many sessions the layout held resident AT ONCE (peak
+    concurrent decodes — the dense layout is hard-capped at
+    rows_budget / max_len slots however short the prompts are), plus
+    sheds, wall time, and — on the paged phase — the block pool's
+    aliased-prefix savings from a shared-system-prompt wave."""
+    from fasttalk_tpu.engine.factory import build_engine
+
+    engine = build_engine(cfg)
+    engine.warmup(cfg.warmup)
+    engine.start()
+    try:
+        prompts = [_lc_long_prompt(engine, i, ctx)
+                   for i, ctx in enumerate(contexts)]
+        peak = {"running": 0}
+        stop = asyncio.Event()
+
+        async def sampler():
+            while not stop.is_set():
+                st = engine.get_stats()
+                peak["running"] = max(peak["running"], st["running"])
+                await asyncio.sleep(0.02)
+
+        samp = asyncio.ensure_future(sampler())
+        t0 = time.monotonic()
+        results = await asyncio.gather(*(
+            _pg_session(engine, f"pg-{i}", f"pg-sess-{i}",
+                        [{"role": "user", "content": prompts[i]}],
+                        max_tokens)
+            for i in range(len(contexts))))
+        wall = time.monotonic() - t0
+        stop.set()
+        await samp
+        shed = sum(1 for r in results if r["shed"])
+        out = {
+            "kv_layout": cfg.kv_layout,
+            "slots": cfg.decode_slots,
+            "sessions": len(contexts),
+            "completed": len(contexts) - shed,
+            "shed": shed,
+            "peak_concurrent": peak["running"],
+            "wall_s": round(wall, 2),
+            "tokens": sum(r["tokens"] for r in results),
+        }
+        if cfg.kv_layout == "paged":
+            # Aliased-prefix savings: fresh sessions sharing one long
+            # system prompt must stamp by refcount aliasing (zero KV
+            # row copies beyond the COW tail block).
+            sys_prompt = _lc_long_prompt(engine, 999, 256)
+            for j in range(3):
+                r = await _pg_session(
+                    engine, f"pga-{j}", f"pga-sess-{j}",
+                    [{"role": "system", "content": sys_prompt},
+                     {"role": "user", "content": f"hello #{j}"}],
+                    max_tokens)
+                assert not r["shed"], r
+            bl = engine.get_stats()["kv_blocks"]
+            bs = bl["block_size"]
+            out["blocks"] = {k: bl[k] for k in
+                            ("total", "in_use", "aliased",
+                             "alias_events", "cow_copies",
+                             "fragmentation")}
+            # Rows the aliased blocks would otherwise hold as copies.
+            out["alias_saved_rows"] = bl["aliased"] * bs
+    finally:
+        engine.shutdown()
+    return out
+
+
+async def _pg_tput_phase(cfg, max_tokens: int) -> dict:
+    """Short-context decode throughput at IDENTICAL slot count and a
+    dense-equivalent pool: isolates the paged gather/scatter overhead
+    (acceptance bar: within 10% of the dense control)."""
+    from fasttalk_tpu.engine.factory import build_engine
+
+    engine = build_engine(cfg)
+    engine.warmup(cfg.warmup)
+    engine.start()
+    try:
+        # Warmup wave compiles the shapes the measurement hits.
+        await asyncio.gather(*(
+            run_session_msgs(
+                engine, f"pgw-{i}", f"pgw-sess-{i}",
+                [{"role": "user", "content": f"[w{i}] hi"}], 8)
+            for i in range(cfg.decode_slots)))
+        t0 = time.monotonic()
+        results = await asyncio.gather(*(
+            run_session_msgs(
+                engine, f"pgt-{i}", f"pgt-sess-{i}",
+                [{"role": "user", "content": f"[d{i}] {PROMPT}"}],
+                max_tokens)
+            for i in range(cfg.decode_slots)))
+        wall = time.monotonic() - t0
+    finally:
+        engine.shutdown()
+    return {"kv_layout": cfg.kv_layout,
+            "tok_s": round(sum(r["tokens"] for r in results) / wall, 2)}
+
+
+def _pg_run_phase_subprocess(phase: str, layout: str) -> dict:
+    """One paged phase per child process (same isolation rationale as
+    multiturn/longctx: two warmed engines in one process trip the
+    XLA-CPU teardown crash, and fresh processes keep the layouts'
+    compile caches and heap symmetric)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["BENCH_PG_PHASE"] = phase
+    env["BENCH_PG_LAYOUT"] = layout
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"paged phase ({phase}/{layout}) exited "
+                           f"{proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _pg_mixed_contexts(sessions: int, max_len: int) -> list[int]:
+    """The production 512–32k context mix scaled into the bench
+    max_len: geometric spread from max_len/32 up to max_len/2."""
+    lo, hi = max(32, max_len // 32), max_len // 2
+    step = (hi / lo) ** (1.0 / max(1, sessions - 1))
+    return [min(hi, int(lo * step ** i)) for i in range(sessions)]
+
+
+def bench_paged() -> dict:
+    """The paged-KV capacity scenario (docs/KVCACHE.md "Paged tier"):
+    a FIXED KV-row budget serves a mixed-context fleet under both
+    layouts — dense affords only budget/max_len slots (admission
+    priced at worst-case context), paged holds sessions by blocks in
+    use — plus a same-slot-count short-context throughput pair bounding
+    the gather/scatter overhead, and the aliased-prefix HBM savings."""
+    sessions = int(os.environ.get("BENCH_PG_SESSIONS", "8"))
+    max_len = int(os.environ.get("BENCH_PG_MAX_LEN", "2048"))
+    rows = int(os.environ.get("BENCH_PG_KV_ROWS", "6144"))
+    bs = int(os.environ.get("KV_BLOCK_SIZE", "16"))
+    contexts = _pg_mixed_contexts(sessions, max_len)
+    dense_slots = max(1, rows // max_len)
+    log(f"paged: {sessions} sessions, contexts {contexts} on a fixed "
+        f"{rows}-row KV budget (dense affords {dense_slots} x "
+        f"{max_len} slots; paged {rows // bs} x {bs}-token blocks)...")
+    log("--- phase 1/4: admission, dense control ---")
+    d_adm = _pg_run_phase_subprocess("admission", "dense")
+    log(f"  dense: peak {d_adm['peak_concurrent']} concurrent, "
+        f"{d_adm['completed']}/{d_adm['sessions']} done in "
+        f"{d_adm['wall_s']} s")
+    log("--- phase 2/4: admission, paged ---")
+    p_adm = _pg_run_phase_subprocess("admission", "paged")
+    log(f"  paged: peak {p_adm['peak_concurrent']} concurrent, "
+        f"{p_adm['completed']}/{p_adm['sessions']} done in "
+        f"{p_adm['wall_s']} s, aliased {p_adm['blocks']['aliased']} "
+        f"blocks ({p_adm['alias_saved_rows']} rows saved)")
+    log("--- phase 3/4: throughput, dense control ---")
+    d_tp = _pg_run_phase_subprocess("tput", "dense")
+    log("--- phase 4/4: throughput, paged ---")
+    p_tp = _pg_run_phase_subprocess("tput", "paged")
+    log(f"  decode tok/s dense {d_tp['tok_s']} vs paged "
+        f"{p_tp['tok_s']}")
+    ratio = (round(p_adm["peak_concurrent"]
+                   / d_adm["peak_concurrent"], 2)
+             if d_adm["peak_concurrent"] else None)
+    tok_ratio = (round(p_tp["tok_s"] / d_tp["tok_s"], 3)
+                 if d_tp["tok_s"] else None)
+    return {"sessions": sessions, "contexts": contexts,
+            "kv_rows_budget": rows, "max_len": max_len,
+            "block_size": bs, "dense_slots": dense_slots,
+            "admission": {"dense": d_adm, "paged": p_adm},
+            "concurrent_ratio": ratio,
+            "alias_saved_rows": p_adm["alias_saved_rows"],
+            "throughput": {"dense_tok_s": d_tp["tok_s"],
+                           "paged_tok_s": p_tp["tok_s"],
+                           "ratio": tok_ratio}}
 
 
 # ---------------- fleet mode (router scale-out) ----------------
@@ -1492,6 +1703,69 @@ def main() -> None:
             # ~double the sessions per byte.
             "vs_baseline": r["parked_capacity_ratio"],
             "longctx": r,
+        }), flush=True)
+        return
+    if MODE == "paged":
+        sessions = int(os.environ.get("BENCH_PG_SESSIONS", "8"))
+        max_len = int(os.environ.get("BENCH_PG_MAX_LEN", "2048"))
+        rows = int(os.environ.get("BENCH_PG_KV_ROWS", "6144"))
+        bs = int(os.environ.get("KV_BLOCK_SIZE", "16"))
+        max_tokens = int(os.environ.get("BENCH_PG_MAX_TOKENS", "16"))
+        if os.environ.get("BENCH_PG_PHASE"):
+            # Child process: one (phase, layout) pair. Weight quant
+            # and spec decode off in every phase — orthogonal knobs
+            # would only blur the layout comparison; the host pool is
+            # off so admission capacity is purely the device layout's.
+            phase = os.environ["BENCH_PG_PHASE"]
+            layout = os.environ["BENCH_PG_LAYOUT"]
+            common = dict(llm_provider="tpu", model_name=MODEL,
+                          prefill_chunk=512, dtype="bfloat16",
+                          port=PORT, monitoring_port=PORT + 1,
+                          enable_agent=False, spec_decode="off",
+                          quantize="none", kv_host_budget_mb=0.0,
+                          kv_layout=layout, kv_block_size=bs)
+            if phase == "admission":
+                slots = (sessions if layout == "paged"
+                         else max(1, rows // max_len))
+                cfg = Config(decode_slots=slots, max_model_len=max_len,
+                             default_context_window=max_len,
+                             kv_pool_blocks=(rows // bs
+                                             if layout == "paged"
+                                             else 0),
+                             **common)
+                out = asyncio.run(_pg_admission_phase(
+                    cfg, sessions, _pg_mixed_contexts(sessions,
+                                                      max_len),
+                    max_tokens))
+            else:
+                # Throughput pair: identical slot count, paged pool
+                # at the dense-equivalent size — the overhead control.
+                tslots = int(os.environ.get("BENCH_PG_TPUT_SLOTS",
+                                            "4"))
+                cfg = Config(decode_slots=tslots, max_model_len=512,
+                             default_context_window=512, **common)
+                out = asyncio.run(_pg_tput_phase(cfg, 64))
+            print(json.dumps(out), flush=True)
+            return
+        r = bench_paged()
+        print(json.dumps({
+            "metric": (f"paged-KV peak concurrent sessions on a fixed "
+                       f"{r['kv_rows_budget']}-row KV budget, {MODEL}: "
+                       f"mixed contexts {r['contexts']}, dense "
+                       f"{r['admission']['dense']['peak_concurrent']} "
+                       f"(hard cap {r['dense_slots']} slots) vs paged "
+                       f"{r['admission']['paged']['peak_concurrent']} "
+                       f"({r['concurrent_ratio']}x); short-context "
+                       f"decode tok/s ratio "
+                       f"{r['throughput']['ratio']}; aliased-prefix "
+                       f"savings {r['alias_saved_rows']} rows"),
+            "value": r["admission"]["paged"]["peak_concurrent"],
+            "unit": "sessions",
+            # For this mode the baseline is the dense layout on the
+            # SAME budget: > 1 means block-granular admission is
+            # holding more of the mixed fleet resident.
+            "vs_baseline": r["concurrent_ratio"],
+            "paged": r,
         }), flush=True)
         return
     if MODE == "fleet":

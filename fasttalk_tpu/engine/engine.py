@@ -70,11 +70,15 @@ from fasttalk_tpu.engine.slots import Slot, SlotManager, _lcp
 from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
 from fasttalk_tpu.kvcache import (HostKVPool, KVOffloader, RestorePolicy,
                                   kv_env_defaults)
+from fasttalk_tpu.kvcache.blocks import BlockAllocator, blocks_for
 from fasttalk_tpu.kvcache.offload import (kv_bucket, make_kv_restore_fn,
-                                          make_kv_slice_fn)
+                                          make_kv_slice_fn,
+                                          make_paged_kv_restore_fn,
+                                          make_paged_kv_slice_fn,
+                                          pad_rows)
 from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
-                                       init_cache)
+                                       init_cache, init_paged_cache)
 from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.observability.perf import get_perf
 from fasttalk_tpu.resilience import failpoints as _fp
@@ -89,7 +93,8 @@ from fasttalk_tpu.structured.compiler import (FSMCompiler,
 from fasttalk_tpu.structured.fsm import FSMTooLarge, TokenFSM
 from fasttalk_tpu.structured.runtime import (ArenaFull, FSMArena,
                                              pack_mask_row)
-from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
+from fasttalk_tpu.utils.errors import (ENGINE_SHED_CODES,
+                                       AdmissionRejected, ErrorCategory,
                                        LLMServiceError)
 from fasttalk_tpu.utils.logger import get_logger
 from fasttalk_tpu.utils.metrics import get_metrics
@@ -325,6 +330,11 @@ class TPUEngine(EngineBase):
                  kv_restore_min_tokens: int | None = None,
                  kv_quant: str = "none",
                  kv_quant_granule: str = "token",
+                 kv_layout: str = "dense",
+                 kv_block_size: int = 16,
+                 kv_pool_blocks: int = 0,
+                 kv_reserve_policy: str = "fixed",
+                 kv_reserve_tokens: int = 128,
                  structured: str = "auto",
                  structured_max_states: int = 8192,
                  structured_state_budget: int = 16384,
@@ -394,6 +404,52 @@ class TPUEngine(EngineBase):
         # quantized tier's executables get their own ledger keys, the
         # bf16 tier's keys stay byte-identical to before.
         self._kvq_attrs = {"kv_quant": "int8"} if self.kv_quant else {}
+        # Paged KV tier (KV_LAYOUT=paged — kvcache/blocks.py,
+        # docs/KVCACHE.md "Paged tier"): the cache becomes one flat
+        # block pool [L, blocks*block_size, Kv, H] and per-slot block
+        # tables map logical positions to pool rows, so HBM admission
+        # capacity is priced at blocks actually in use instead of
+        # every slot's worst-case context. Composes with the int8
+        # tier (scales live in pool layout), the host park/offload
+        # tier (block-granular entries), speculative + structured
+        # decoding (both ride the scatter decode path), and the
+        # Pallas decode kernel (block-walking variant). Single-device
+        # only, same precedent as shared_prefix/KV_QUANT: the pool
+        # and tables are host-orchestrated per chip.
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(f"kv_layout must be 'dense' or 'paged', "
+                             f"got {kv_layout!r}")
+        self.paged = kv_layout == "paged"
+        self.kv_block_size = int(kv_block_size)
+        self._kv_blocks: BlockAllocator | None = None
+        if self.paged:
+            bs = self.kv_block_size
+            if bs < 8 or bs > _KV_BUCKETS[0] or bs & (bs - 1):
+                raise ValueError(
+                    f"KV_BLOCK_SIZE must be a power of two in "
+                    f"[8, {_KV_BUCKETS[0]}], got {bs}")
+            if mesh is not None:
+                raise ValueError(
+                    "KV_LAYOUT=paged is single-device only: the block "
+                    "pool and per-slot tables are host-orchestrated "
+                    "per chip (no tp/dp/sp mesh yet)")
+            if kv_reserve_policy not in ("none", "fixed", "max_tokens"):
+                raise ValueError(
+                    f"kv_reserve_policy must be none|fixed|max_tokens, "
+                    f"got {kv_reserve_policy!r}")
+            self.kv_reserve_policy = kv_reserve_policy
+            self.kv_reserve_tokens = max(0, int(kv_reserve_tokens))
+            # 0 = dense-equivalent pool (same HBM as the dense layout;
+            # the factory passes a budget-derived count in production).
+            self.kv_pool_blocks = int(kv_pool_blocks) \
+                or num_slots * self.max_len // bs
+            self._kv_blocks = BlockAllocator(self.kv_pool_blocks, bs,
+                                             num_slots)
+        # Worst-case decode-position advances of in-flight calls
+        # (paged only): the dispatcher must pre-allocate blocks for
+        # where the DEVICE can be, which leads the host mirrors by
+        # these.
+        self._paged_leads: deque[int] = deque()
         # Single-device decode uses models.llama.forward_decode: the
         # whole cache rides the step scan's CARRY (carries alias inside
         # a program), each step scatter-writes only the new K/V column,
@@ -553,7 +609,8 @@ class TPUEngine(EngineBase):
             else kv_park_idle_s
         self._kv_last_tick = 0.0
         self.slots = SlotManager(num_slots, self.max_len,
-                                 on_evict=self._park_on_evict)
+                                 on_evict=self._park_on_evict,
+                                 on_unpin=self._on_slot_unpin)
         self.steps_per_call = max(1, steps_per_call)
         # Burst-mode call length: while admissions or prefills are
         # pending, dispatch SHORT calls so a new arrival's prefill waits
@@ -712,6 +769,11 @@ class TPUEngine(EngineBase):
                               kv_row_bytes=self._kv_row_bytes)
 
     def _make_cache(self) -> KVCache:
+        if self.paged:
+            return init_paged_cache(
+                self.cfg, self.kv_pool_blocks, self.kv_block_size,
+                self.dtype, quantized=self.kv_quant,
+                scale_granule=max(1, self.kv_scale_granule))
         if self.mesh is None:
             return init_cache(self.cfg, self.num_slots, self.max_len,
                               self.dtype, quantized=self.kv_quant,
@@ -914,7 +976,16 @@ class TPUEngine(EngineBase):
                         if r.finished]:
                 self._by_id.pop(rid, None)
             self.slots = SlotManager(self.num_slots, self.max_len,
-                                     on_evict=self._park_on_evict)
+                                     on_evict=self._park_on_evict,
+                                     on_unpin=self._on_slot_unpin)
+            if self.paged:
+                # The crash may have struck mid-allocation; the pool is
+                # rebuilt with the cache (all sessions re-prefill, so
+                # no table survives either).
+                self._kv_blocks = BlockAllocator(
+                    self.kv_pool_blocks, self.kv_block_size,
+                    self.num_slots)
+            self._paged_leads.clear()
             # Quiesce the fetch workers FIRST: the crashed thread's
             # in-flight device calls may still be executing on the
             # async dispatch stream with their host copies mid-flight
@@ -1024,7 +1095,8 @@ class TPUEngine(EngineBase):
                         self._positions_dev, inactive, self._temps_dev,
                         self._topks_dev, self._topps_dev,
                         self._reps_dev, self._press_dev,
-                        self._freqs_dev, self._rng_dev)
+                        self._freqs_dev, self._rng_dev,
+                        *self._paged_decode_args(b))
                 else:
                     fn = self._get_decode_fn(b, steps)
                     self.cache, self._counts_dev, toks, _, _, _ = fn(
@@ -1032,7 +1104,8 @@ class TPUEngine(EngineBase):
                         self._cur_tokens, self._positions_dev, inactive,
                         self._temps_dev, self._topks_dev,
                         self._topps_dev, self._reps_dev,
-                        self._press_dev, self._freqs_dev, self._rng_dev)
+                        self._press_dev, self._freqs_dev, self._rng_dev,
+                        *self._paged_decode_args(b))
                 jax.block_until_ready(toks)
                 if self.spec_draft:
                     # All-inactive spec warmup: every write masks out.
@@ -1049,7 +1122,8 @@ class TPUEngine(EngineBase):
                         self._positions_dev, inactive,
                         self._temps_dev, self._topks_dev,
                         self._topps_dev, self._reps_dev, self._press_dev,
-                        self._freqs_dev, self._rng_dev)
+                        self._freqs_dev, self._rng_dev,
+                        *self._paged_decode_args(b))
                     jax.block_until_ready(toks)
         if self.spec_draft:
             # The admission-path history upload (slot indices out of
@@ -1087,7 +1161,6 @@ class TPUEngine(EngineBase):
             # session (starts=0): the smallest KV bucket covering b.
             ctx = next((k for k in kv_buckets if k >= b), self.max_len)
             for gp in sorted({1, self.num_slots}):
-                fn = self._get_batched_prefill_fn(b, gp, ctx)
                 # All rows masked + out-of-range scatter: no cache (or
                 # cur-token) writes. Args are built exactly as the
                 # serving path builds them (numpy via _arg) so the
@@ -1096,11 +1169,26 @@ class TPUEngine(EngineBase):
                 rowcfg[:, 0] = np.arange(self.num_slots,
                                          self.num_slots + gp)
                 rowcfg[:, 4:] = (1.0, 40, 0.9)
-                (self.cache, firsts, self._cur_tokens,
-                 self._rng_dev) = fn(
-                    self.params, self.cache,
-                    self._arg(np.zeros((gp, b), np.int32)),
-                    self._arg(rowcfg), self._cur_tokens, self._rng_dev)
+                if self.paged:
+                    fn = self._get_paged_batched_prefill_fn(b, gp, ctx)
+                    widx = np.stack([self._paged_oob_indices(j, b)
+                                     for j in range(gp)])
+                    (self.cache, firsts, self._cur_tokens,
+                     self._rng_dev) = fn(
+                        self.params, self.cache,
+                        self._arg(np.zeros((gp, b), np.int32)),
+                        self._arg(rowcfg),
+                        self._arg(np.zeros((gp, ctx), np.int32)),
+                        self._arg(widx), self._cur_tokens,
+                        self._rng_dev)
+                else:
+                    fn = self._get_batched_prefill_fn(b, gp, ctx)
+                    (self.cache, firsts, self._cur_tokens,
+                     self._rng_dev) = fn(
+                        self.params, self.cache,
+                        self._arg(np.zeros((gp, b), np.int32)),
+                        self._arg(rowcfg), self._cur_tokens,
+                        self._rng_dev)
                 jax.block_until_ready(firsts)
             if level == "full" or b == long_bucket:
                 # Single-slot long-prompt path: writes land in slot 0's
@@ -1109,11 +1197,23 @@ class TPUEngine(EngineBase):
                 # runs the same jitted sample-and-place program the
                 # serving path uses (slot index out of range: the
                 # current-token scatter drops).
-                fn = self._get_prefill_fn(b)
-                self.cache, last = fn(self.params, self.cache,
-                                      self._arg(np.zeros((b,), np.int32)),
-                                      np.int32(0), np.int32(0),
-                                      np.int32(b - 1))
+                if self.paged:
+                    wctx = next((k for k in kv_buckets if k >= b),
+                                self.max_len)
+                    fn = self._get_paged_prefill_fn(b, wctx)
+                    self.cache, last = fn(
+                        self.params, self.cache,
+                        self._arg(np.zeros((b,), np.int32)),
+                        np.int32(0),
+                        self._arg(np.zeros((wctx,), np.int32)),
+                        self._arg(self._paged_oob_indices(0, b)),
+                        np.int32(b - 1))
+                else:
+                    fn = self._get_prefill_fn(b)
+                    self.cache, last = fn(
+                        self.params, self.cache,
+                        self._arg(np.zeros((b,), np.int32)),
+                        np.int32(0), np.int32(0), np.int32(b - 1))
                 cfg_row = np.array([self.num_slots, 1.0, 40, 0.9],
                                    np.float32)
                 first, self._cur_tokens, self._rng_dev = \
@@ -1128,15 +1228,25 @@ class TPUEngine(EngineBase):
             # slice/update programs — cheap next to the model graphs
             # above). The warmup restore writes zero rows into slot 0,
             # which nothing has claimed yet (kv_written stays 0).
-            b = 16
+            b = max(16, self.kv_block_size) if self.paged else 16
             while True:
                 # Slice returns (k, v) — or (k, v, k_scale, v_scale)
                 # on the quantized tier — in exactly the restore fn's
                 # argument order, so the round trip is layout-agnostic.
-                rows = self._get_kv_slice_fn(b)(
-                    self.cache, np.int32(0))
-                self.cache = self._get_kv_restore_fn(b)(
-                    self.cache, *rows, np.int32(0))
+                if self.paged:
+                    # Gather pool row 0, scatter to dropped OOR rows:
+                    # the paged copy programs compile with no writes.
+                    rows = self._get_paged_kv_slice_fn(b)(
+                        self.cache,
+                        self._arg(np.zeros((b,), np.int32)))
+                    self.cache = self._get_paged_kv_restore_fn(b)(
+                        self.cache, *rows,
+                        self._arg(self._paged_oob_indices(0, b)))
+                else:
+                    rows = self._get_kv_slice_fn(b)(
+                        self.cache, np.int32(0))
+                    self.cache = self._get_kv_restore_fn(b)(
+                        self.cache, *rows, np.int32(0))
                 jax.block_until_ready(self.cache.k)
                 if b >= self.max_len:
                     break
@@ -1147,9 +1257,16 @@ class TPUEngine(EngineBase):
             # fleet burst's first admission should not pay this compile
             # on the TTFT path. Src == dst == slot 0 (unclaimed at
             # warmup; kv_written stays 0, so nothing trusts the rows).
-            for plen in {g for g in (64, 256) if g <= self.max_len}:
-                self.cache = self._get_prefix_copy_fn(plen)(
+            # Paged tier: sharing is block ALIASING (host bookkeeping,
+            # nothing to compile) — only the single COW block-copy
+            # program warms, src == dst == block 0.
+            if self.paged:
+                self.cache = self._get_block_copy_fn()(
                     self.cache, np.int32(0), np.int32(0))
+            else:
+                for plen in {g for g in (64, 256) if g <= self.max_len}:
+                    self.cache = self._get_prefix_copy_fn(plen)(
+                        self.cache, np.int32(0), np.int32(0))
             jax.block_until_ready(self.cache.k)
         jax.block_until_ready(self.cache.k)
         # Warm every fetch worker's first device→host copy: on relayed
@@ -1249,7 +1366,8 @@ class TPUEngine(EngineBase):
                                priority=params.priority,
                                deadline_s=params.deadline_s, payload=req,
                                wait_discount_s=self._kv_wait_discount(
-                                   session_id, prompt))
+                                   session_id, prompt)
+                               - self._paged_wait_penalty(len(prompt)))
         except AdmissionRejected:
             self._by_id.pop(request_id, None)
             req.finished = True
@@ -1408,6 +1526,7 @@ class TPUEngine(EngineBase):
             "decode_slots": self.num_slots,
             "dtype": jnp.dtype(self.dtype).name,
             "kv_quant": "int8" if self.kv_quant else "none",
+            "kv_layout": "paged" if self.paged else "dense",
             "devices": [str(d) for d in jax.devices()],
             "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
         }
@@ -1422,16 +1541,22 @@ class TPUEngine(EngineBase):
             structured["compiler"] = self._st_compiler.stats()
         if self._st_arena is not None:
             structured["arena"] = self._st_arena.stats()
-        return {
+        out = {
             "slots": self.slots.stats(),
             "waiting": len(self._sched),
             "scheduler": self._sched.stats(),
             "running": len(self._running),
             "kv_quant": "int8" if self.kv_quant else "none",
+            "kv_layout": "paged" if self.paged else "dense",
             "kv_host": {**self._kv_pool.stats(),
                         "policy": self._kv_policy.stats()},
             "structured": structured,
         }
+        if self.paged:
+            used = sum(min(s.kv_written, len(s.tokens))
+                       for s in self.slots.slots)
+            out["kv_blocks"] = self._kv_blocks.stats(used_tokens=used)
+        return out
 
     # ---------------- jitted steps ----------------
 
@@ -1520,9 +1645,16 @@ class TPUEngine(EngineBase):
             return fn
         self._note_compile("decode", kv_len=kv_len, steps=steps,
                            **({"structured": True} if with_fsm else {}),
-                           **self._kvq_attrs)
+                           **self._kvq_attrs,
+                           **({"kv_layout": "paged"} if self.paged
+                              else {}))
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
-        scatter = self._scatter_decode and not use_pallas
+        # Paged tier: the Pallas kernel's block-walking variant rides
+        # the scatter path (forward_decode routes it), so paged never
+        # leaves the scatter decode family.
+        scatter = self._scatter_decode and (self.paged or not use_pallas)
+        pallas_paged = self.paged and self.use_pallas_attention
+        bsz = self.kv_block_size
         rows = jnp.arange(self.num_slots)
         max_len = self.max_len
         replicate = self._replicate_sharding()
@@ -1566,7 +1698,8 @@ class TPUEngine(EngineBase):
             @partial(jax.jit, donate_argnums=(1, 2, 3))
             def decode_call_hist(params, cache: KVCache, history, counts,
                                  cur_tokens, positions, active, temps,
-                                 topks, topps, reps, press, freqs, rng):
+                                 topks, topps, reps, press, freqs, rng,
+                                 bt=None):
                 def step(carry, _):
                     ck, cv, ks, vs, hist, cnt, cur, pos, key = carry
                     key, sub = jax.random.split(key)
@@ -1580,7 +1713,9 @@ class TPUEngine(EngineBase):
                         params, self.cfg, cur, pos,
                         KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
-                        pallas_int8=self.use_pallas_int8)
+                        pallas_int8=self.use_pallas_int8,
+                        block_table=bt, block_size=bsz,
+                        pallas_paged=pallas_paged)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
                                          cnt, reps, press, freqs)
                     nxt = sample_tokens(lg, sub, temps, topks, topps,
@@ -1605,7 +1740,7 @@ class TPUEngine(EngineBase):
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_call(params, cache: KVCache, counts, cur_tokens,
                         positions, active, temps, topks, topps,
-                        reps, press, freqs, rng):
+                        reps, press, freqs, rng, bt=None):
             if scatter:
                 def step(carry, _):
                     ck, cv, ks, vs, cnt, cur, pos, key = carry
@@ -1624,7 +1759,9 @@ class TPUEngine(EngineBase):
                         params, self.cfg, cur, pos,
                         KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
-                        pallas_int8=self.use_pallas_int8)
+                        pallas_int8=self.use_pallas_int8,
+                        block_table=bt, block_size=bsz,
+                        pallas_paged=pallas_paged)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
                                          cnt, reps, press, freqs)
                     nxt = sample_tokens(lg, sub, temps, topks, topps,
@@ -1696,6 +1833,8 @@ class TPUEngine(EngineBase):
         contract, and sharing closures would put every future fsm-side
         edit one trace-time branch away from perturbing it."""
         sv = self.sample_vocab
+        bsz = self.kv_block_size
+        pallas_paged = self.paged and self.use_pallas_attention
         powers = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
 
         def masked(lg, fst, masks):
@@ -1718,7 +1857,7 @@ class TPUEngine(EngineBase):
                                 fsm_state, cur_tokens, positions,
                                 active, temps, topks, topps, reps,
                                 press, freqs, rng, sel, masks, cls,
-                                nexts):
+                                nexts, bt=None):
                 def step(carry, _):
                     ck, cv, ks, vs, hist, cnt, fst, cur, pos, key = carry
                     key, sub = jax.random.split(key)
@@ -1732,7 +1871,9 @@ class TPUEngine(EngineBase):
                         params, self.cfg, cur, pos,
                         KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
-                        pallas_int8=self.use_pallas_int8)
+                        pallas_int8=self.use_pallas_int8,
+                        block_table=bt, block_size=bsz,
+                        pallas_paged=pallas_paged)
                     lg = apply_penalties(logits[:, :sv], cnt, reps,
                                          press, freqs)
                     lg = masked(lg, fst, masks)
@@ -1758,7 +1899,7 @@ class TPUEngine(EngineBase):
         def decode_fsm(params, cache: KVCache, counts, fsm_state,
                        cur_tokens, positions, active, temps, topks,
                        topps, reps, press, freqs, rng, sel, masks, cls,
-                       nexts):
+                       nexts, bt=None):
             def step(carry, _):
                 ck, cv, ks, vs, cnt, fst, cur, pos, key = carry
                 key, sub = jax.random.split(key)
@@ -1769,7 +1910,9 @@ class TPUEngine(EngineBase):
                     params, self.cfg, cur, pos,
                     KVCache(ck, cv, ks, vs), act,
                     attn_len=kv_len,
-                    pallas_int8=self.use_pallas_int8)
+                    pallas_int8=self.use_pallas_int8,
+                    block_table=bt, block_size=bsz,
+                    pallas_paged=pallas_paged)
                 lg = apply_penalties(logits[:, :sv], cnt, reps,
                                      press, freqs)
                 lg = masked(lg, fst, masks)
@@ -1829,10 +1972,12 @@ class TPUEngine(EngineBase):
         max_len = self.max_len
         sv = self.sample_vocab
 
+        bsz = self.kv_block_size
+
         @partial(jax.jit, donate_argnums=(1, 2, 3))
         def spec_call(params, cache: KVCache, history, counts, cur_tokens,
                       positions, active, temps, topks, topps,
-                      reps, press, freqs, rng):
+                      reps, press, freqs, rng, bt=None):
             rows = jnp.arange(S)
 
             def step(carry, _):
@@ -1861,7 +2006,8 @@ class TPUEngine(EngineBase):
                 logits, newc = forward_decode_multi(
                     params, self.cfg, tokens_in, pos, KVCache(ck, cv),
                     act, attn_len=kv_len,
-                    pallas_int8=self.use_pallas_int8)
+                    pallas_int8=self.use_pallas_int8,
+                    block_table=bt, block_size=bsz)
                 key, sub = jax.random.split(key)
                 # EXACT per-position penalty counts, without vocab-wide
                 # per-position intermediates: block position j is
@@ -2030,6 +2176,28 @@ class TPUEngine(EngineBase):
             self._prefill_fns[key] = fn
         return fn
 
+    def _get_paged_kv_slice_fn(self, bucket: int):
+        key = ("pkvslice", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            self._note_compile("kv_offload", bucket=bucket,
+                               kv_layout="paged", **self._kvq_attrs)
+            fn = make_paged_kv_slice_fn(self.cfg, bucket,
+                                        self.kv_scale_granule)
+            self._prefill_fns[key] = fn
+        return fn
+
+    def _get_paged_kv_restore_fn(self, bucket: int):
+        key = ("pkvrestore", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            self._note_compile("kv_restore", bucket=bucket,
+                               kv_layout="paged", **self._kvq_attrs)
+            fn = make_paged_kv_restore_fn(self.cfg, bucket, KVCache,
+                                          self.kv_scale_granule)
+            self._prefill_fns[key] = fn
+        return fn
+
     def _park_on_evict(self, victim: Slot) -> None:
         """SlotManager eviction hook (engine thread, inside acquire):
         snapshot the victim's kept KV rows to the host pool before the
@@ -2050,8 +2218,22 @@ class TPUEngine(EngineBase):
     def _park_slot(self, slot: Slot, kept: int) -> None:
         bucket = kv_bucket(kept, self.max_len)
         t0 = time.monotonic()
-        out = self._get_kv_slice_fn(bucket)(
-            self.cache, np.int32(slot.index))
+        trim = None
+        if self.paged:
+            # Paged tier: gather the slot's BLOCK LIST (flat pool rows
+            # via its table) rather than a dense slot slice, and trim
+            # the host entry to exact per-block bytes — the pool
+            # budget accounts blocks, not power-of-two padding.
+            bucket = max(bucket, self.kv_block_size)
+            trim = (blocks_for(kept, self.kv_block_size)
+                    * self.kv_block_size)
+            out = self._get_paged_kv_slice_fn(bucket)(
+                self.cache,
+                self._arg(self._paged_read_indices(slot.index,
+                                                   bucket)))
+        else:
+            out = self._get_kv_slice_fn(bucket)(
+                self.cache, np.int32(slot.index))
         # Quantized tier: the slice carries int8 rows + scale rows;
         # the pool entry's nbytes (and therefore the budget, the
         # kv_host_bytes gauge and the copy-bandwidth EMA) see the
@@ -2059,7 +2241,7 @@ class TPUEngine(EngineBase):
         scales = (out[2], out[3]) if self.kv_quant else None
         self._kv_offload.park(slot.session_id, list(slot.tokens[:kept]),
                               kept, bucket, out[0], out[1], t0,
-                              scales=scales)
+                              scales=scales, trim_rows=trim)
 
     def _try_restore(self, req: _Request, slot: Slot,
                      prompt: list[int]) -> int:
@@ -2088,30 +2270,54 @@ class TPUEngine(EngineBase):
             # engine-owned — but never corrupt KV over an assumption).
             self._kv_pool.note_lookup(False)
             return 0
+        if self.paged and not self._kv_blocks.ensure(slot.index, match):
+            # No blocks for the restored prefix: leave the entry
+            # parked; the full-prefill fallback faces the admission
+            # check next.
+            self._kv_pool.note_lookup(False)
+            return 0
         t0 = time.monotonic()
         try:
             if _fp.enabled:
                 _fp.fire("kv.restore.dispatch",
                          request_id=req.request_id,
                          session_id=req.session_id)
-            fn = self._get_kv_restore_fn(entry.bucket)
+            paged = self.paged
+            if paged:
+                fn = self._get_paged_kv_restore_fn(entry.bucket)
+                # Scatter target: the freshly allocated block list
+                # (positions past it carry distinct OOR indices and
+                # drop — a restore allocates exactly
+                # ceil(match/block_size) blocks).
+                tgt = (self._arg(self._paged_write_indices(
+                    slot.index, 0, entry.bucket)),)
+            else:
+                fn = self._get_kv_restore_fn(entry.bucket)
+                tgt = (np.int32(slot.index),)
             k_arg, v_arg = entry.k_dev, entry.v_dev
             prestaged = k_arg is not None and v_arg is not None
             if not prestaged:  # prestage didn't land
-                k_arg, v_arg = self._arg(entry.k), self._arg(entry.v)
+                if paged:  # stored rows are block-trimmed: pad back
+                    k_arg = self._arg(pad_rows(entry.k, entry.bucket))
+                    v_arg = self._arg(pad_rows(entry.v, entry.bucket))
+                else:
+                    k_arg, v_arg = self._arg(entry.k), self._arg(entry.v)
             if self.kv_quant:
                 # Scales ride with their rows (prestaged before
                 # k_dev/v_dev on the copy thread, so prestaged rows
                 # imply staged scales).
                 ks_arg, vs_arg = entry.k_scale_dev, entry.v_scale_dev
                 if not prestaged or ks_arg is None or vs_arg is None:
-                    ks_arg = self._arg(entry.k_scale)
-                    vs_arg = self._arg(entry.v_scale)
+                    ks_arg = self._arg(pad_rows(entry.k_scale,
+                                                entry.bucket)
+                                       if paged else entry.k_scale)
+                    vs_arg = self._arg(pad_rows(entry.v_scale,
+                                                entry.bucket)
+                                       if paged else entry.v_scale)
                 self.cache = fn(self.cache, k_arg, v_arg, ks_arg,
-                                vs_arg, np.int32(slot.index))
+                                vs_arg, *tgt)
             else:
-                self.cache = fn(self.cache, k_arg, v_arg,
-                                np.int32(slot.index))
+                self.cache = fn(self.cache, k_arg, v_arg, *tgt)
         except Exception as e:
             # A failed restore dispatch must degrade to a full
             # prefill, never crash the engine thread mid-admission —
@@ -2131,6 +2337,12 @@ class TPUEngine(EngineBase):
             # (purge removes exactly entry.nbytes).
             log.error(f"kv restore failed for {req.session_id}: {e}; "
                       "falling back to full prefill")
+            if self.paged:
+                # Release the blocks ensure() allocated for the failed
+                # scatter: the slot's table must be EMPTY again, or
+                # the shared-prefix alias stamp (which requires a
+                # fresh table) corrupts refcounts on this admission.
+                self._kv_blocks.truncate(slot.index, slot.kv_written)
             self._kv_pool.purge(req.session_id)
             self._kv_pool.note_lookup(False)
             return 0
@@ -2191,6 +2403,298 @@ class TPUEngine(EngineBase):
                     or self._kv_offload.parking(slot.session_id):
                 continue  # snapshot current or in flight
             self._park_slot(slot, kept)
+
+    # ---------------- paged KV tier ----------------
+    # (KV_LAYOUT=paged — kvcache/blocks.py; docs/KVCACHE.md "Paged
+    # tier". All methods engine-thread only unless noted.)
+
+    def _on_slot_unpin(self, slot: Slot) -> None:
+        """SlotManager unpin hook: a session leaving its slot (evict
+        or release) drops its whole block table — aliased blocks
+        survive through their other referents' refcounts."""
+        if self.paged:
+            self._kv_blocks.release(slot.index)
+
+    def _paged_table_np(self, nb: int) -> np.ndarray:
+        """[S, nb] block-table argument for a decode call at KV bucket
+        nb * block_size. Unallocated entries stay 0 — their rows sit
+        beyond every slot's position mask."""
+        tbl = np.zeros((self.num_slots, nb), np.int32)
+        for s in range(self.num_slots):
+            t = self._kv_blocks.table(s)
+            n = min(len(t), nb)
+            if n:
+                tbl[s, :n] = t[:n]
+        return tbl
+
+    def _paged_read_indices(self, slot_index: int,
+                            rows: int) -> np.ndarray:
+        """Flat pool-row indices of one slot's logical positions
+        0..rows (park slice / prefill gather region). Positions past
+        the slot's table read pool row 0 — always masked or trimmed by
+        the consumer."""
+        bs = self.kv_block_size
+        t = self._kv_blocks.table(slot_index)
+        nb = -(-rows // bs)
+        blocks = np.zeros((nb,), np.int64)
+        n = min(len(t), nb)
+        if n:
+            blocks[:n] = t[:n]
+        idx = (blocks[:, None] * bs
+               + np.arange(bs)[None, :]).reshape(-1)[:rows]
+        return idx.astype(np.int32)
+
+    def _paged_write_indices(self, slot_index: int, start: int,
+                             count: int) -> np.ndarray:
+        """Flat pool-row indices for writing positions
+        start..start+count (prefill chunk scatter). Every position must
+        already have an allocated block (``ensure`` ran); positions
+        past max_len get DISTINCT out-of-range indices and drop."""
+        bs = self.kv_block_size
+        t = self._kv_blocks.table(slot_index)
+        pool_rows = self.kv_pool_blocks * bs
+        out = np.empty((count,), np.int64)
+        for i in range(count):
+            pos = start + i
+            blk = pos // bs
+            if blk < len(t):
+                out[i] = t[blk] * bs + pos % bs
+            else:
+                out[i] = pool_rows + slot_index * self.max_len + pos
+        return out.astype(np.int32)
+
+    def _paged_oob_indices(self, row: int, count: int) -> np.ndarray:
+        """DISTINCT out-of-range flat indices for a padding row's
+        scatter (mode="drop" + unique_indices needs them distinct even
+        though they never land)."""
+        base = (self.kv_pool_blocks * self.kv_block_size
+                + (self.num_slots + row) * self.max_len)
+        return (base + np.arange(count)).astype(np.int32)
+
+    def _get_block_copy_fn(self):
+        """Copy one block's rows (all layers, + scale rows on the
+        quantized tier) between flat-pool offsets — the copy-on-write
+        primitive behind partial-tail aliasing and divergence COW. One
+        executable total, vs the dense tier's per-length prefix-copy
+        family."""
+        key = ("pblockcopy",)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        self._note_compile("kv_block_copy",
+                           block_size=self.kv_block_size,
+                           **self._kvq_attrs)
+        bs = self.kv_block_size
+        shape = (self.cfg.num_layers, bs, self.cfg.num_kv_heads,
+                 self.cfg.head_dim)
+        sshape = (self.cfg.num_layers, bs, self.kv_scale_granule)
+        kvq = self.kv_quant
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def block_copy(cache: KVCache, src_row, dst_row):
+            rk = jax.lax.dynamic_slice(cache.k, (0, src_row, 0, 0),
+                                       shape)
+            rv = jax.lax.dynamic_slice(cache.v, (0, src_row, 0, 0),
+                                       shape)
+            new_k = jax.lax.dynamic_update_slice(cache.k, rk,
+                                                 (0, dst_row, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(cache.v, rv,
+                                                 (0, dst_row, 0, 0))
+            if not kvq:
+                return KVCache(new_k, new_v)
+            rks = jax.lax.dynamic_slice(cache.k_scale,
+                                        (0, src_row, 0), sshape)
+            rvs = jax.lax.dynamic_slice(cache.v_scale,
+                                        (0, src_row, 0), sshape)
+            return KVCache(
+                new_k, new_v,
+                jax.lax.dynamic_update_slice(cache.k_scale, rks,
+                                             (0, dst_row, 0)),
+                jax.lax.dynamic_update_slice(cache.v_scale, rvs,
+                                             (0, dst_row, 0)))
+
+        self._prefill_fns[key] = block_copy
+        return block_copy
+
+    def _paged_copy_block(self, src_blk: int, dst_blk: int) -> None:
+        bs = self.kv_block_size
+        self.cache = self._get_block_copy_fn()(
+            self.cache, np.int32(src_blk * bs), np.int32(dst_blk * bs))
+
+    def _paged_sync_resident(self, slot: Slot) -> None:
+        """Reconcile the slot's block table with its (possibly just
+        truncated) trusted history: drop blocks past kv_written, and
+        copy-on-write the tail block when it is shared and the next
+        write would land inside it — an aliased prefix must never be
+        written through."""
+        alloc = self._kv_blocks
+        kvw = slot.kv_written
+        alloc.truncate(slot.index, kvw)
+        tail = kvw % self.kv_block_size
+        if tail and alloc.tail_shared(slot.index):
+            pair = alloc.cow_tail(slot.index)
+            if pair is None:
+                # Pool empty: fall back to the block boundary — the
+                # dropped tail rows re-prefill (never corrupt a shared
+                # block over an allocation failure).
+                aligned = kvw - tail
+                slot.tokens = slot.tokens[:aligned]
+                slot.kv_written = aligned
+                alloc.truncate(slot.index, aligned)
+                return
+            self._paged_copy_block(*pair)
+
+    def _paged_alias(self, src: Slot | None, slot: Slot,
+                     share: int) -> int:
+        """The paged shared-prefix stamp: alias the source's full
+        blocks into this fresh slot's table (refcount bump, ZERO row
+        copies) and copy-on-write the partially shared tail block.
+        Returns the prompt tokens now resident."""
+        if src is None or share < 16:
+            return 0
+        bs = self.kv_block_size
+        alloc = self._kv_blocks
+        full, tail = divmod(share, bs)
+        n = alloc.alias(src.index, slot.index, full) if full else 0
+        reused = n * bs
+        if n == full and tail:
+            blk = alloc.append_block(slot.index)
+            if blk is not None:
+                src_blk = alloc.table(src.index)[full]
+                self._paged_copy_block(src_blk, blk)
+                alloc.cow_copies += 1
+                reused += tail
+        return reused
+
+    def _paged_reserve_tokens(self, req: _Request) -> int:
+        """Decode-growth reserve the admission check must see free
+        (KV_RESERVE_POLICY): 'fixed' covers the next
+        KV_RESERVE_TOKENS of growth, 'max_tokens' the request's whole
+        budget, 'none' admits on prefill fit alone (maximum packing,
+        relies on mid-decode shedding)."""
+        if self.kv_reserve_policy == "none":
+            return 0
+        if self.kv_reserve_policy == "max_tokens":
+            return req.params.max_tokens
+        return min(self.kv_reserve_tokens, req.params.max_tokens)
+
+    def _paged_admissible(self, slot: Slot, req: _Request,
+                          reused: int, todo: int) -> bool:
+        """A request is admissible iff its prefill blocks fit now and
+        the reserve policy's decode-growth horizon is also free
+        (ROADMAP item 1's admission-by-blocks-in-use). Rejections shed
+        with retry_after — the same taxonomy as a queue shed, so
+        clients back off instead of erroring."""
+        bs = self.kv_block_size
+        # The prefill pads its LAST chunk to a bucket: admission must
+        # cover that padded write horizon — reused + the full chunks +
+        # the final chunk's bucket — not todo plus a whole extra
+        # bucket (which would demand up to 2x the blocks prefill ever
+        # ensures and shed requests that fit).
+        last = (todo % self.prefill_chunk
+                or min(max(1, todo), self.prefill_chunk))
+        pad = next((b for b in _PREFILL_BUCKETS if b >= last),
+                   _PREFILL_BUCKETS[-1])
+        need_tokens = min(self.max_len,
+                          reused + max(0, todo - last) + pad
+                          + self._paged_reserve_tokens(req))
+        need = blocks_for(need_tokens, bs) \
+            - self._kv_blocks.slot_blocks(slot.index)
+        if need <= self._kv_blocks.available():
+            return True
+        self._paged_exhausted_finish(
+            req, f"KV block pool exhausted: prompt needs {need} more "
+                 f"{bs}-token blocks ({self._kv_blocks.available()} "
+                 f"free of {self.kv_pool_blocks})")
+        return False
+
+    def _paged_retry_after(self) -> float:
+        """Back-off hint for a block-exhaustion shed: roughly one
+        service time must elapse for a running generation to finish
+        and free its blocks."""
+        ema = self._sched.stats().get("service_time_ema_s") or 0.0
+        return round(max(0.5, float(ema)), 2)
+
+    def _paged_exhausted_finish(self, req: _Request,
+                                error: str) -> None:
+        self._events.emit("kv_pressure", severity="warning",
+                          coalesce_s=10.0, coalesce_key="blocks",
+                          reason="block_pool_exhausted",
+                          free=self._kv_blocks.available(),
+                          total=self.kv_pool_blocks)
+        self._finish(req, "error", error=error,
+                     code="kv_blocks_exhausted",
+                     retry_after=self._paged_retry_after())
+
+    def _paged_wait_penalty(self, prompt_len: int) -> float:
+        """Block-pressure term for the scheduler's estimated-wait shed
+        (asyncio side, racy-read tolerable — it's an estimate): when
+        the pool cannot currently hold this prompt, at least one
+        running generation must finish first, so the wait estimate
+        grows by ~one service time."""
+        if not self.paged:
+            return 0.0
+        need = blocks_for(prompt_len, self.kv_block_size)
+        if need <= self._kv_blocks.available():
+            return 0.0
+        return self._paged_retry_after()
+
+    def _paged_prepare_decode(self, worst_adv: int) -> bool:
+        """Pre-allocate every running slot's blocks out to its worst-
+        case write horizon for the next decode call (device positions
+        lead the host mirrors by the in-flight calls' advances). On
+        pool exhaustion, sheds the youngest running request (frees its
+        blocks via session release) and retries — the rehearsed
+        degradation, never a crash. Returns False when nothing is left
+        to run. MUST run before _patch_slot_state so a shed's
+        deactivation reaches the very next call."""
+        lead = sum(self._paged_leads) + worst_adv
+        while self._running:
+            victim: _Request | None = None
+            for s, req in list(self._running.items()):
+                horizon = min(self.max_len,
+                              int(self._positions[s]) + lead)
+                if not self._kv_blocks.ensure(s, horizon):
+                    victim = max(self._running.values(),
+                                 key=lambda r: r.admitted_at or 0.0)
+                    break
+            if victim is None:
+                return True
+            log.warning(
+                f"KV block pool exhausted mid-decode; shedding "
+                f"{victim.request_id}")
+            slot = victim.slot
+            self._paged_exhausted_finish(
+                victim, "KV block pool exhausted mid-decode: request "
+                        "shed to free blocks")
+            if slot is not None and slot.session_id is not None:
+                # The shed must actually free blocks: drop the
+                # session's residency (its next turn re-prefills).
+                self.slots.release_session(slot.session_id)
+                self._kv_pool.purge(slot.session_id)
+        return False
+
+    def _kv_read_rows(self, snapshot, kv_len: int) -> int:
+        """KV rows one decode step actually streamed, for the perf
+        ledger's bandwidth figure. Dense: the fixed shapes read the
+        whole bucket for every slot. Paged: only blocks backing live
+        rows are read (the block walk prunes per slot), so the ledger
+        counts blocks-read — this is what stops /perf bw_util
+        over-reporting a mixed-length batch as S x bucket traffic."""
+        if not self.paged:
+            return self.num_slots * kv_len
+        bs = self.kv_block_size
+        return sum(
+            min(kv_len, blocks_for(int(self._positions[s]), bs) * bs)
+            for s, _ in snapshot)
+
+    def _paged_decode_args(self, kv_len: int):
+        """The block-table extra argument for a paged decode dispatch
+        (empty tuple on the dense tier, so call sites stay shared)."""
+        if not self.paged:
+            return ()
+        nb = kv_len // self.kv_block_size
+        return (self._arg(self._paged_table_np(nb)),)
 
     # ---------------- structured decoding ----------------
     # (fasttalk_tpu/structured/; docs/STRUCTURED.md)
@@ -2413,13 +2917,14 @@ class TPUEngine(EngineBase):
                            if b >= len(feed)), None)
             if bucket is None or start + bucket > self.max_len:
                 continue  # no room: plain decode emits the chain
+            if self.paged and not self._kv_blocks.ensure(
+                    slot.index, start + bucket):
+                continue  # no blocks: plain decode emits the chain
             t0 = time.monotonic()
             padded = np.zeros((bucket,), np.int32)
             padded[:len(feed)] = feed
-            fn = self._get_prefill_fn(bucket)
-            self.cache, last_logits = fn(
-                self.params, self.cache, self._arg(padded),
-                np.int32(start), np.int32(slot.index), np.int32(n))
+            last_logits = self._run_chunk_prefill(
+                slot, padded, start, n, bucket)
             self._positions[slot.index] = start + n + 1
             slot.kv_written = start + n + 1
             self._dirty_slots.add(slot.index)
@@ -2491,6 +2996,91 @@ class TPUEngine(EngineBase):
 
         self._prefill_fns[chunk] = prefill_step
         return prefill_step
+
+    def _get_paged_prefill_fn(self, chunk: int, ctx: int):
+        """Paged single-slot prompt chunk: gather the slot's logical
+        0..ctx rows out of the flat pool (read_idx, host-built from
+        the block table), run the UNCHANGED dense ``forward`` over the
+        contiguous scratch region, then scatter only the chunk's
+        written rows back through write_idx — gather-run-scatter is
+        the same structure the dense batched path already uses for
+        slot rows, so the model code needs no paged prefill variant.
+        ``ctx`` is a KV bucket covering start+chunk."""
+        key = ("pprefill", chunk, ctx)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        self._note_compile("prefill", chunk=chunk, ctx=ctx,
+                           kv_layout="paged", **self._kvq_attrs)
+        kvq = self.kv_quant
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def paged_prefill_step(params, cache: KVCache, tokens, start,
+                               read_idx, write_idx, last_index):
+            gk = cache.k[:, read_idx][:, None]  # [L, 1, ctx, Kv, H]
+            gv = cache.v[:, read_idx][:, None]
+            if kvq:
+                small = KVCache(gk, gv,
+                                cache.k_scale[:, read_idx][:, None],
+                                cache.v_scale[:, read_idx][:, None])
+            else:
+                small = KVCache(gk, gv)
+            positions = start + jnp.arange(chunk)[None, :]
+            logits, upd = forward(
+                params, self.cfg, tokens[None, :], positions,
+                small, start[None], blockwise=True,
+                pallas_int8=self.use_pallas_int8,
+                logits_indices=last_index[None])
+
+            def written(arr):  # [L, 1, ctx, ...] -> the chunk's rows
+                sizes = (arr.shape[0], 1, chunk) + arr.shape[3:]
+                zeros = (0,) * (arr.ndim - 3)
+                return jax.lax.dynamic_slice(
+                    arr, (0, 0, start) + zeros, sizes)[:, 0]
+
+            new_k = cache.k.at[:, write_idx].set(
+                written(upd.k), mode="drop", unique_indices=True)
+            new_v = cache.v.at[:, write_idx].set(
+                written(upd.v), mode="drop", unique_indices=True)
+            if kvq:
+                return KVCache(
+                    new_k, new_v,
+                    cache.k_scale.at[:, write_idx].set(
+                        written(upd.k_scale), mode="drop",
+                        unique_indices=True),
+                    cache.v_scale.at[:, write_idx].set(
+                        written(upd.v_scale), mode="drop",
+                        unique_indices=True)), logits[0, 0]
+            return KVCache(new_k, new_v), logits[0, 0]
+
+        self._prefill_fns[key] = paged_prefill_step
+        return paged_prefill_step
+
+    def _run_chunk_prefill(self, slot: Slot, padded: np.ndarray,
+                           start: int, last_index: int, bucket: int):
+        """Dispatch one single-slot prefill chunk on the layout's
+        program (dense slot slice or paged gather/scatter) and return
+        the last-token logits. Paged callers must have ensured blocks
+        for start+bucket."""
+        if self.paged:
+            ctx = next((b for b in _KV_BUCKETS
+                        if b >= start + bucket and b <= self.max_len),
+                       self.max_len)
+            fn = self._get_paged_prefill_fn(bucket, ctx)
+            self.cache, last = fn(
+                self.params, self.cache, self._arg(padded),
+                np.int32(start),
+                self._arg(self._paged_read_indices(slot.index, ctx)),
+                self._arg(self._paged_write_indices(slot.index, start,
+                                                    bucket)),
+                np.int32(last_index))
+            return last
+        fn = self._get_prefill_fn(bucket)
+        self.cache, last = fn(self.params, self.cache,
+                              self._arg(padded), np.int32(start),
+                              np.int32(slot.index),
+                              np.int32(last_index))
+        return last
 
     def _ring_prefill_eligible(self, start: int, n_tokens: int) -> int:
         """If this fresh prompt should prefill through ring attention,
@@ -2633,6 +3223,79 @@ class TPUEngine(EngineBase):
 
         self._prefill_fns[key] = batched_prefill
         return batched_prefill
+
+    def _get_paged_batched_prefill_fn(self, chunk: int, group: int,
+                                      ctx: int):
+        """Paged variant of ``_get_batched_prefill_fn``: the group's
+        KV regions gather through per-row flat pool indices (read_idx
+        [group, ctx]) instead of slot ids, and each row's written
+        chunk scatters back through write_idx [group, chunk] (padding
+        rows carry distinct out-of-range indices and drop). The
+        forward body, rowcfg packing and fused first-token sampling
+        are identical to the dense program."""
+        key = ("pbatch", chunk, group, ctx)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        self._note_compile("batched_prefill", chunk=chunk, group=group,
+                           ctx=ctx, kv_layout="paged",
+                           **self._kvq_attrs)
+        kvq = self.kv_quant
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def paged_batched_prefill(params, cache: KVCache, tokens,
+                                  rowcfg, read_idx, write_idx, cur,
+                                  rng):
+            slot_idx = rowcfg[:, 0].astype(jnp.int32)
+            starts = rowcfg[:, 1].astype(jnp.int32)
+            last_idx = rowcfg[:, 2].astype(jnp.int32)
+            mask = rowcfg[:, 3] > 0.5
+            temps, topks, topps = (rowcfg[:, 4],
+                                   rowcfg[:, 5].astype(jnp.int32),
+                                   rowcfg[:, 6])
+            gk = cache.k[:, read_idx]  # [L, group, ctx, Kv, H]
+            gv = cache.v[:, read_idx]
+            if kvq:
+                small = KVCache(gk, gv,
+                                cache.k_scale[:, read_idx],
+                                cache.v_scale[:, read_idx])
+            else:
+                small = KVCache(gk, gv)
+            positions = starts[:, None] + jnp.arange(chunk)[None, :]
+            logits, upd = forward(
+                params, self.cfg, tokens, positions, small,
+                starts, blockwise=True, write_mask=mask,
+                pallas_int8=self.use_pallas_int8,
+                logits_indices=last_idx)
+            sel = positions  # [group, chunk] region rows each row wrote
+
+            def written(arr):  # [L, group, ctx, ...] -> chunk rows
+                idx = sel.reshape((1,) + sel.shape
+                                  + (1,) * (arr.ndim - 3))
+                return jnp.take_along_axis(arr, idx, axis=2)
+
+            new_k = cache.k.at[:, write_idx].set(
+                written(upd.k), mode="drop", unique_indices=True)
+            new_v = cache.v.at[:, write_idx].set(
+                written(upd.v), mode="drop", unique_indices=True)
+            new_ks = new_vs = None
+            if kvq:
+                new_ks = cache.k_scale.at[:, write_idx].set(
+                    written(upd.k_scale), mode="drop",
+                    unique_indices=True)
+                new_vs = cache.v_scale.at[:, write_idx].set(
+                    written(upd.v_scale), mode="drop",
+                    unique_indices=True)
+            rng, sub = jax.random.split(rng)
+            firsts = sample_tokens(logits[:, 0, :self.sample_vocab], sub,
+                                   temps, topks, topps,
+                                   method=self.sampling_method)
+            new_cur = cur.at[slot_idx].set(firsts, mode="drop")
+            return KVCache(new_k, new_v, new_ks, new_vs), firsts, \
+                new_cur, rng
+
+        self._prefill_fns[key] = paged_batched_prefill
+        return paged_batched_prefill
 
     def _get_patch_fn(self):
         """One jitted program applying all dirty-slot mirror changes:
@@ -2825,6 +3488,7 @@ class TPUEngine(EngineBase):
         self._running.clear()
         self._inflight.clear()
         self._pending_firsts.clear()
+        self._paged_leads.clear()
         self._st_jf_pending.clear()
 
     def _drain_commands(self, block: bool) -> bool:
@@ -2966,6 +3630,12 @@ class TPUEngine(EngineBase):
                 self._tracer.set_phase(req.request_id, "prefill")
             prompt = req.prompt_tokens
             reused = self.slots.reuse_prefix(slot, prompt)
+            if self.paged:
+                # Reconcile the block table with the (possibly just
+                # truncated) history: free divergent blocks, and COW a
+                # shared tail block before any write can land in it.
+                self._paged_sync_resident(slot)
+                reused = min(reused, slot.kv_written)
             if reused:
                 self._m_prefix.inc(reused)
             elif (restored := self._try_restore(req, slot, prompt)):
@@ -2983,6 +3653,19 @@ class TPUEngine(EngineBase):
                 # are stable: its own writes only ever target positions
                 # >= its kept length.
                 src, share = self.slots.best_shared_prefix(slot, prompt)
+                if self.paged:
+                    # Paged tier: block ALIASING, not row copies — the
+                    # full shared blocks refcount-bump into this slot's
+                    # table, only a partial tail block device-copies
+                    # (COW). No pow2 granule needed: there is no
+                    # per-length executable family to bound.
+                    aliased = self._paged_alias(src, slot, share)
+                    if aliased:
+                        slot.tokens = list(prompt[:aliased])
+                        slot.kv_written = aliased
+                        reused = aliased
+                        self._m_shared.inc(aliased)
+                    src = None  # the dense stamp below must not run
                 share = self._share_granule(share)
                 if src is not None and share >= 16:
                     self._sink("prefix_copy", share=share,
@@ -3001,6 +3684,9 @@ class TPUEngine(EngineBase):
                              error=f"prompt ({len(prompt)} tok) exceeds "
                              "context")
                 continue
+            if self.paged and not self._paged_admissible(
+                    slot, req, reused, len(todo)):
+                continue  # shed with retry_after (blocks don't fit)
             if req.fsm is not None:
                 # Constrained admission: pin the FSM into the device
                 # arena, then take the single-slot prefill path — its
@@ -3107,19 +3793,26 @@ class TPUEngine(EngineBase):
                                  error="KV cache exhausted during "
                                        "prefill")
                     return
+                if self.paged and not self._kv_blocks.ensure(
+                        slot.index, st.start + bucket):
+                    # The rehearsed mid-prefill exhaustion: shed THIS
+                    # request with retry_after and exact accounting
+                    # (ensure is all-or-nothing), never crash the
+                    # engine (kv.block_alloc chaos drill).
+                    self._prefilling.pop(0)
+                    self._paged_exhausted_finish(
+                        req, "KV block pool exhausted during prefill")
+                    return
                 chunk = st.todo[:take]
                 padded = np.zeros((bucket,), np.int32)
                 padded[:take] = chunk
-                fn = self._get_prefill_fn(bucket)
                 self._sink("prefill", bucket=bucket, tokens=padded,
                            start=st.start, slot=slot.index,
                            last=take - 1)
                 # numpy scalars, not jnp ones: each eager jnp scalar is
                 # its own device round trip on relayed backends.
-                self.cache, st.last_logits = fn(
-                    self.params, self.cache, self._arg(padded),
-                    np.int32(st.start), np.int32(slot.index),
-                    np.int32(take - 1))
+                st.last_logits = self._run_chunk_prefill(
+                    slot, padded, st.start, take - 1, bucket)
                 slot.tokens.extend(chunk)
                 st.start += take
                 slot.kv_written = st.start
@@ -3298,6 +3991,23 @@ class TPUEngine(EngineBase):
             _fp.fire("engine.prefill.dispatch",
                      request_id=";".join(r.request_id
                                          for r, _, _, _ in sub))
+        if self.paged:
+            # Blocks for every row's padded write horizon, before any
+            # array is built: a row the pool cannot hold sheds HERE
+            # with retry_after (exact accounting — ensure is
+            # all-or-nothing) and the rest of the group proceeds.
+            kept = []
+            for item in sub:
+                if self._kv_blocks.ensure(item[1].index,
+                                          item[2] + bucket):
+                    kept.append(item)
+                else:
+                    self._paged_exhausted_finish(
+                        item[0], "KV block pool exhausted during "
+                                 "batched prefill")
+            sub = kept
+            if not sub:
+                return
         g = len(sub)
         # Only two group shapes ever compile per bucket: 1 and num_slots.
         # A mid-size burst pads to the full batch (the padded rows are
@@ -3320,7 +4030,6 @@ class TPUEngine(EngineBase):
         need = int(rowcfg[:, 1].max()) + bucket
         ctx = next((b for b in _KV_BUCKETS
                     if b >= need and b <= self.max_len), self.max_len)
-        fn = self._get_batched_prefill_fn(bucket, gp, ctx)
         self._sink("batched_prefill", bucket=bucket, gp=gp, ctx=ctx,
                    tokens=tokens, rowcfg=rowcfg)
         # First tokens stay on device: the program scatters them into
@@ -3329,9 +4038,30 @@ class TPUEngine(EngineBase):
         # without waiting for the round trip; text is emitted when the
         # fetch lands.
         t0p = time.monotonic()
-        self.cache, firsts_dev, self._cur_tokens, self._rng_dev = fn(
-            self.params, self.cache, self._arg(tokens), self._arg(rowcfg),
-            self._cur_tokens, self._rng_dev)
+        if self.paged:
+            read_idx = np.zeros((gp, ctx), np.int32)
+            write_idx = np.zeros((gp, bucket), np.int32)
+            for j in range(gp):
+                if j < len(sub):
+                    slot_j, start_j = sub[j][1], sub[j][2]
+                    read_idx[j] = self._paged_read_indices(
+                        slot_j.index, ctx)
+                    write_idx[j] = self._paged_write_indices(
+                        slot_j.index, start_j, bucket)
+                else:
+                    write_idx[j] = self._paged_oob_indices(j, bucket)
+            fn = self._get_paged_batched_prefill_fn(bucket, gp, ctx)
+            (self.cache, firsts_dev, self._cur_tokens,
+             self._rng_dev) = fn(
+                self.params, self.cache, self._arg(tokens),
+                self._arg(rowcfg), self._arg(read_idx),
+                self._arg(write_idx), self._cur_tokens, self._rng_dev)
+        else:
+            fn = self._get_batched_prefill_fn(bucket, gp, ctx)
+            (self.cache, firsts_dev, self._cur_tokens,
+             self._rng_dev) = fn(
+                self.params, self.cache, self._arg(tokens),
+                self._arg(rowcfg), self._cur_tokens, self._rng_dev)
         # Attribution row: the call computed gp × bucket token rows
         # (padding rows + per-row bucket padding included); useful =
         # the real prompt tokens. Interval covers dispatch only — the
@@ -3564,6 +4294,10 @@ class TPUEngine(EngineBase):
             # decode program itself is byte-identical with or without
             # fault injection.
             _fp.fire("engine.decode.dispatch")
+        worst_adv = self.steps_per_call * (self.spec_draft + 1
+                                           if self.spec_draft else 1)
+        if self.paged and not self._paged_prepare_decode(worst_adv):
+            return  # every running request was shed for blocks
         self._patch_slot_state()
         t_disp = time.monotonic()
         active = list(self._running)
@@ -3622,7 +4356,9 @@ class TPUEngine(EngineBase):
                     self._positions_dev, self._active_dev,
                     self._temps_dev, self._topks_dev, self._topps_dev,
                     self._reps_dev, self._press_dev, self._freqs_dev,
-                    self._rng_dev)
+                    self._rng_dev, *self._paged_decode_args(kv_len))
+                if self.paged:
+                    self._paged_leads.append(worst_adv)
                 # Promise the EMA-expected tokens, not the minimum:
                 # spec calls deliver K..K*T, and promising K made the
                 # dispatcher queue up to T× too many calls — a
@@ -3657,7 +4393,8 @@ class TPUEngine(EngineBase):
                     self._freqs_dev, self._rng_dev,
                     self._arg(self._st_sel.copy()),
                     self._st_masks_dev, self._st_cls_dev,
-                    self._st_nexts_dev)
+                    self._st_nexts_dev,
+                    *self._paged_decode_args(kv_len))
             else:
                 (self.cache, self._history_dev, self._counts_dev, toks,
                  self._cur_tokens, self._positions_dev,
@@ -3667,7 +4404,9 @@ class TPUEngine(EngineBase):
                     self._positions_dev, self._active_dev,
                     self._temps_dev, self._topks_dev, self._topps_dev,
                     self._reps_dev, self._press_dev, self._freqs_dev,
-                    self._rng_dev)
+                    self._rng_dev, *self._paged_decode_args(kv_len))
+            if self.paged:
+                self._paged_leads.append(worst_adv)
             self._inflight.append(
                 (self._fetch(toks), steps, steps,
                  snapshot, t_disp, kv_len))
@@ -3684,7 +4423,8 @@ class TPUEngine(EngineBase):
                 self._topks_dev, self._topps_dev, self._reps_dev,
                 self._press_dev, self._freqs_dev, self._rng_dev,
                 self._arg(self._st_sel.copy()), self._st_masks_dev,
-                self._st_cls_dev, self._st_nexts_dev)
+                self._st_cls_dev, self._st_nexts_dev,
+                *self._paged_decode_args(kv_len))
         else:
             (self.cache, self._counts_dev, toks, self._cur_tokens,
              self._positions_dev, self._rng_dev) = fn(
@@ -3692,7 +4432,9 @@ class TPUEngine(EngineBase):
                 self._cur_tokens, self._positions_dev, self._active_dev,
                 self._temps_dev, self._topks_dev, self._topps_dev,
                 self._reps_dev, self._press_dev, self._freqs_dev,
-                self._rng_dev)
+                self._rng_dev, *self._paged_decode_args(kv_len))
+        if self.paged:
+            self._paged_leads.append(worst_adv)
         # Start the device→host copy NOW on a worker thread: by
         # retirement time it has been in flight for a whole call's
         # compute, and later calls' fetches overlap it (see the
@@ -3704,6 +4446,8 @@ class TPUEngine(EngineBase):
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
         fut, _, _, snapshot, t_disp, kv_len = self._inflight.popleft()
+        if self.paged and self._paged_leads:
+            self._paged_leads.popleft()
         if _fp.enabled:
             # Chaos seam: `hang` here is the wedged-device-call
             # scenario — the heartbeat goes stale and the watchdog
@@ -3795,8 +4539,8 @@ class TPUEngine(EngineBase):
                 occupancy=occupancy, kind="spec" if spec else "plain",
                 tokens=consumed, rows=rows, kv_len=kv_len,
                 flops=self._perf.call_flops(consumed, kv_len),
-                kv_bytes=int(res.shape[0]) * self.num_slots * kv_len
-                * self._kv_row_bytes,
+                kv_bytes=int(res.shape[0]) * self._kv_read_rows(
+                    snapshot, kv_len) * self._kv_row_bytes,
                 # Mask-apply attribution (docs/STRUCTURED.md): rows
                 # with constrained>0 ran the fsm decode variant — the
                 # per-step mask gather/unpack cost is the step-duration
@@ -3912,12 +4656,13 @@ class TPUEngine(EngineBase):
             # Cancels are the client's choice, not an SLO sample;
             # watchdog-failed requests were already recorded as errors
             # by force_fail (idempotent either way). Queue-deadline
-            # expiry is load SHEDDING, same as a submit-time shed: the
-            # request never touched the TPU, and counting it as an SLO
-            # error would page the error-rate objective for exactly
-            # the mechanism that protects the admitted requests'
-            # latency (docs/OBSERVABILITY.md).
-            if code == "deadline_expired" and error is not None:
+            # expiry and KV block-pool exhaustion are load SHEDDING
+            # (utils/errors.ENGINE_SHED_CODES, the same taxonomy the
+            # serving layers map to 429/retry_after): counting them as
+            # SLO errors would page the error-rate objective for
+            # exactly the mechanisms that protect the admitted
+            # requests' latency (docs/OBSERVABILITY.md).
+            if code in ENGINE_SHED_CODES and error is not None:
                 with self._term_lock:
                     already = req.slo_recorded
                     req.slo_recorded = True
@@ -3951,6 +4696,13 @@ class TPUEngine(EngineBase):
             # copy may have speculatively advanced past the kept length).
             self._positions[slot.index] = slot.length
             self._dirty_slots.add(slot.index)
+            if self.paged and slot.session_id is not None:
+                # Reclaim decode-growth slack past the trusted rows.
+                # Safe against the still-draining pipeline: its
+                # garbage writes land in the freed blocks strictly
+                # before any reallocation's writes (in-order dispatch
+                # stream, old table captured at dispatch).
+                self._kv_blocks.truncate(slot.index, slot.kv_written)
             sid = slot.session_id
             if sid is not None and sid in self._release_after:
                 self._release_after.discard(sid)

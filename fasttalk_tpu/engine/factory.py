@@ -94,29 +94,88 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
         from fasttalk_tpu.ops.kv_quant import granule_dim
 
         g = granule_dim(cfg.kv_quant_granule, m.num_kv_heads)
-        kv = (m.num_layers * cfg.decode_slots * cfg.max_model_len
-              * 2 * (m.num_kv_heads * m.head_dim * 1 + g * 4))
+        kv_row_bytes = 2 * (m.num_kv_heads * m.head_dim * 1 + g * 4)
     else:
-        kv = (m.num_layers * cfg.decode_slots * cfg.max_model_len
-              * m.num_kv_heads * m.head_dim * 2 * dsize)
+        kv_row_bytes = m.num_kv_heads * m.head_dim * 2 * dsize
+    kv_row_bytes *= m.num_layers  # one logical token row, all layers
+    dense_rows = cfg.decode_slots * cfg.max_model_len
+    paged = cfg.kv_layout == "paged"
+    pool_blocks = 0
+    if paged:
+        # Paged tier (kvcache/blocks.py): HBM is accounted by POOL
+        # BLOCKS, not slots x max_len. KV_POOL_BLOCKS=0 asks for the
+        # dense-equivalent pool, then SHRINKS to what the budget
+        # actually holds — this fit-to-budget step is exactly where
+        # the paged layout admits mixed-context fleets the dense
+        # layout rejects outright.
+        pool_blocks = cfg.kv_pool_blocks \
+            or dense_rows // cfg.kv_block_size
+        kv = pool_blocks * cfg.kv_block_size * kv_row_bytes
+    else:
+        kv = dense_rows * kv_row_bytes
     acct = {
         "weight_bytes_per_device": wbytes_dev,
         "kv_cache_bytes_per_device": kv // n_devices,
         "hbm_limit_bytes": limit,
         "hbm_utilization": cfg.hbm_util,
+        "kv_pool_blocks": pool_blocks,
     }
     if limit:
         budget = limit * cfg.hbm_util
-        need = acct["weight_bytes_per_device"] + acct["kv_cache_bytes_per_device"]
+        kv_budget = budget - acct["weight_bytes_per_device"]
+        block_bytes = cfg.kv_block_size * kv_row_bytes
+        fit_blocks = max(0, int(kv_budget // block_bytes))
+        if paged and not cfg.kv_pool_blocks:
+            # Auto pool: fit to the budget, floored at one full
+            # max_len context (below that nothing long can ever run
+            # and the layout cannot help).
+            floor = -(-cfg.max_model_len // cfg.kv_block_size)
+            if fit_blocks < floor:
+                raise ValueError(
+                    f"KV_LAYOUT=paged: the HBM budget holds only "
+                    f"{fit_blocks} KV blocks of {cfg.kv_block_size} "
+                    f"tokens after {wbytes_dev / 2**30:.2f} GiB of "
+                    f"weights, below the {floor} blocks one "
+                    f"TPU_MAX_MODEL_LEN={cfg.max_model_len} context "
+                    "needs. Lower TPU_MAX_MODEL_LEN, enable "
+                    "KV_QUANT=int8, or raise TPU_HBM_UTILIZATION.")
+            pool_blocks = min(pool_blocks, fit_blocks)
+            acct["kv_pool_blocks"] = pool_blocks
+            acct["kv_cache_bytes_per_device"] = \
+                pool_blocks * block_bytes // n_devices
+        need = (acct["weight_bytes_per_device"]
+                + acct["kv_cache_bytes_per_device"])
         if need > budget:
+            # The blocks-available math, and the remedy that actually
+            # changes the admission model — not just smaller numbers
+            # for the same dense layout.
+            if paged:
+                remedy = (
+                    f"Lower KV_POOL_BLOCKS ({pool_blocks}; 0 = "
+                    "fit-to-budget), KV_BLOCK_SIZE "
+                    f"({cfg.kv_block_size}), or TPU_MAX_MODEL_LEN "
+                    f"({cfg.max_model_len}); enable KV_QUANT=int8; or "
+                    "raise TPU_HBM_UTILIZATION.")
+            else:
+                dense_blocks = dense_rows // cfg.kv_block_size
+                remedy = (
+                    f"The dense layout preallocates every slot at "
+                    f"worst-case context: TPU_DECODE_SLOTS="
+                    f"{cfg.decode_slots} x TPU_MAX_MODEL_LEN="
+                    f"{cfg.max_model_len} = {dense_rows} KV rows "
+                    f"({dense_blocks} blocks of {cfg.kv_block_size} "
+                    f"tokens), but the budget holds only {fit_blocks} "
+                    "blocks after weights. Set KV_LAYOUT=paged to "
+                    "admit by blocks actually in use (KV_BLOCK_SIZE="
+                    f"{cfg.kv_block_size}), or lower TPU_DECODE_SLOTS "
+                    "/ TPU_MAX_MODEL_LEN, enable TPU_QUANTIZE=int8 / "
+                    "KV_QUANT=int8, or raise TPU_TP_SIZE to shard "
+                    "over more chips.")
             raise ValueError(
-                f"Model + KV cache need {need / 2**30:.2f} GiB/device but the "
-                f"HBM budget is {budget / 2**30:.2f} GiB "
+                f"Model + KV cache need {need / 2**30:.2f} GiB/device "
+                f"but the HBM budget is {budget / 2**30:.2f} GiB "
                 f"({limit / 2**30:.2f} GiB x TPU_HBM_UTILIZATION="
-                f"{cfg.hbm_util}). Lower TPU_DECODE_SLOTS "
-                f"({cfg.decode_slots}) or TPU_MAX_MODEL_LEN "
-                f"({cfg.max_model_len}), enable TPU_QUANTIZE=int8, or raise "
-                "TPU_TP_SIZE to shard over more chips.")
+                f"{cfg.hbm_util}). {remedy}")
     return acct
 
 
@@ -254,7 +313,10 @@ def build_engine(cfg: Config) -> EngineBase:
         f"weights {'loaded' if loaded else 'random-init'}), "
         f"slots={cfg.decode_slots}, max_len={cfg.max_model_len}, "
         f"dtype={cfg.dtype}, kv_quant={cfg.kv_quant}, "
-        f"mesh={dict(mesh.shape) if mesh else 'single-device'}")
+        f"kv_layout={cfg.kv_layout}"
+        + (f" ({acct['kv_pool_blocks']} x {cfg.kv_block_size}-token "
+           f"blocks)" if cfg.kv_layout == "paged" else "")
+        + f", mesh={dict(mesh.shape) if mesh else 'single-device'}")
     engine = TPUEngine(
         model_cfg, params, tokenizer,
         num_slots=cfg.decode_slots, max_len=cfg.max_model_len,
@@ -278,6 +340,11 @@ def build_engine(cfg: Config) -> EngineBase:
         kv_restore_min_tokens=cfg.kv_restore_min_tokens,
         kv_quant=cfg.kv_quant,
         kv_quant_granule=cfg.kv_quant_granule,
+        kv_layout=cfg.kv_layout,
+        kv_block_size=cfg.kv_block_size,
+        kv_pool_blocks=acct["kv_pool_blocks"],
+        kv_reserve_policy=cfg.kv_reserve_policy,
+        kv_reserve_tokens=cfg.kv_reserve_tokens,
         structured=cfg.structured_mode,
         structured_max_states=cfg.structured_max_states,
         structured_state_budget=cfg.structured_state_budget,

@@ -58,7 +58,8 @@ class Slot:
 
 
 class SlotManager:
-    def __init__(self, num_slots: int, max_len: int, on_evict=None):
+    def __init__(self, num_slots: int, max_len: int, on_evict=None,
+                 on_unpin=None):
         self.slots = [Slot(i) for i in range(num_slots)]
         self.max_len = max_len
         self._by_session: dict[str, Slot] = {}
@@ -68,6 +69,11 @@ class SlotManager:
         # — an explicit release_session means the session is done and
         # its KV is not worth keeping anywhere.
         self.on_evict = on_evict
+        # Called with the Slot on EVERY unpin (eviction and explicit
+        # release alike), before its fields clear — the paged KV tier
+        # frees the slot's block table here (kvcache/blocks.py), so
+        # device blocks can never outlive the session that owned them.
+        self.on_unpin = on_unpin
 
     def lookup(self, session_id: str) -> Slot | None:
         return self._by_session.get(session_id)
@@ -102,6 +108,8 @@ class SlotManager:
         return slot
 
     def _unpin(self, slot: Slot) -> None:
+        if self.on_unpin is not None:
+            self.on_unpin(slot)
         if slot.session_id is not None:
             self._by_session.pop(slot.session_id, None)
         slot.session_id = None

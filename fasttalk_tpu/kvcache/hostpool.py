@@ -58,6 +58,12 @@ class ParkedKV:
     # the restore dispatch pays no host→device transfer.
     k_dev: Any = None
     v_dev: Any = None
+    # Device bytes the staged copies actually hold. On the paged tier
+    # the host entry is TRIMMED to exact block rows but the staged
+    # arrays pad back to ``bucket`` — the prestage HBM cap must count
+    # the padded footprint, not the trimmed one (0 = not staged;
+    # dense entries stage exactly nbytes).
+    staged_nbytes: int = 0
     # Quantized tier (KV_QUANT=int8): per-row float32 scales
     # [L, bucket, G] riding alongside the int8 rows (None on the bf16
     # tier), plus their prestaged device copies.
@@ -228,11 +234,14 @@ class HostKVPool:
             self._dead_set.discard(session_id)
 
     def staged_bytes(self) -> int:
-        """Host-pool bytes currently ALSO staged on the device
-        (prestage uploads awaiting their restore) — bounds how much
-        HBM prestaging may hold (kvcache/offload.py)."""
+        """Device bytes currently held by prestage uploads awaiting
+        their restore — bounds how much HBM prestaging may hold
+        (kvcache/offload.py). Counts the staged (bucket-padded)
+        footprint, which exceeds the trimmed host nbytes on the paged
+        tier."""
         with self._lock:
-            return sum(e.nbytes for e in self._entries.values()
+            return sum(e.staged_nbytes or e.nbytes
+                       for e in self._entries.values()
                        if e.k_dev is not None)
 
     def sweep(self, now: float | None = None) -> int:

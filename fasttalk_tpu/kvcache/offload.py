@@ -120,6 +120,84 @@ def kv_bucket(n: int, max_len: int) -> int:
     return min(b, max_len)
 
 
+def make_paged_kv_slice_fn(cfg, bucket: int, scale_granule: int = 0):
+    """Paged-tier park read (KV_LAYOUT=paged): gather one slot's
+    leading ``bucket`` logical rows out of the flat block pool by
+    explicit pool-row indices (``read_idx`` [bucket] int32, built
+    host-side from the slot's block table). NOT donated, same ordering
+    contract as ``make_kv_slice_fn``. Rows whose logical position has
+    no allocated block carry index 0 — they are beyond the kept length
+    and the park job trims them before the entry is built, so the pool
+    accounts exact per-block bytes, never dense slices."""
+    import jax
+
+    del cfg  # shapes ride the cache arrays; kept for API symmetry
+
+    @jax.jit
+    def kv_slice(cache, read_idx):
+        k = cache.k[:, read_idx]
+        v = cache.v[:, read_idx]
+        if scale_granule:
+            return (k, v, cache.k_scale[:, read_idx],
+                    cache.v_scale[:, read_idx])
+        return k, v
+
+    return kv_slice
+
+
+def make_paged_kv_restore_fn(cfg, bucket: int, cache_cls,
+                             scale_granule: int = 0):
+    """Paged-tier restore write: scatter stored rows back into freshly
+    allocated pool blocks through ``write_idx`` [bucket] int32 flat
+    pool rows (donated cache — chains like every other cache op).
+    Entries beyond the allocated blocks carry DISTINCT out-of-range
+    indices and drop, so a restore allocates exactly
+    ceil(match / block_size) blocks however the stored bucket was
+    padded."""
+    import jax
+
+    del cfg
+
+    if scale_granule:
+        @partial(jax.jit, donate_argnums=(0,))
+        def kv_restore_q(cache, k_rows, v_rows, ks_rows, vs_rows,
+                         write_idx):
+            return cache_cls(
+                cache.k.at[:, write_idx].set(
+                    k_rows, mode="drop", unique_indices=True),
+                cache.v.at[:, write_idx].set(
+                    v_rows, mode="drop", unique_indices=True),
+                cache.k_scale.at[:, write_idx].set(
+                    ks_rows, mode="drop", unique_indices=True),
+                cache.v_scale.at[:, write_idx].set(
+                    vs_rows, mode="drop", unique_indices=True))
+
+        return kv_restore_q
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def kv_restore(cache, k_rows, v_rows, write_idx):
+        return cache_cls(
+            cache.k.at[:, write_idx].set(
+                k_rows, mode="drop", unique_indices=True),
+            cache.v.at[:, write_idx].set(
+                v_rows, mode="drop", unique_indices=True))
+
+    return kv_restore
+
+
+def pad_rows(arr, rows: int):
+    """Zero-pad a host [L, R, ...] row array to [L, rows, ...] (the
+    paged tier trims parked entries to exact block bytes; restore and
+    prestage pad back to the executable's power-of-two bucket)."""
+    import numpy as np
+
+    if arr.shape[1] == rows:
+        return arr
+    out = np.zeros((arr.shape[0], rows) + arr.shape[2:], arr.dtype)
+    out[:, :arr.shape[1]] = arr
+    return out
+
+
 class KVOffloader:
     """Dedicated copy thread: D2H park fetches and H2D prestaging."""
 
@@ -191,7 +269,8 @@ class KVOffloader:
 
     def park(self, session_id: str, tokens: list[int], kept: int,
              bucket: int, k_rows: Any, v_rows: Any, t0: float,
-             scales: tuple[Any, Any] | None = None) -> None:
+             scales: tuple[Any, Any] | None = None,
+             trim_rows: int | None = None) -> None:
         """Finish a park off the engine thread: fetch the slice result
         to host numpy (blocks until the device catches up — the whole
         reason this runs here), insert into the pool, feed the measured
@@ -202,7 +281,13 @@ class KVOffloader:
         ``scales``: the quantized tier's (k_scale, v_scale) slice
         results — fetched with the rows, counted in ``nbytes`` so the
         pool budget and the copy-bandwidth EMA see honest int8+scales
-        bytes."""
+        bytes.
+
+        ``trim_rows``: paged tier — keep only the leading
+        ceil(kept / block_size) * block_size rows of the (power-of-two
+        padded) slice before building the entry, so the pool's byte
+        accounting is exact per BLOCK; ``bucket`` then records the
+        padded restore shape, not the stored rows."""
         with self._parking_lock:
             if session_id in self._parking:
                 return
@@ -224,17 +309,26 @@ class KVOffloader:
                 # policy refuse restores that were actually 10-50x
                 # cheaper than the prefill.
                 tf = time.monotonic()
-                # copy=True: on the CPU backend np.asarray of a jax
-                # array can be a zero-copy VIEW of the XLA buffer;
-                # parking that view would pin (and potentially alias
-                # back through a later device_put) device-runtime
-                # memory the pool must own outright.
-                k = np.array(k_rows, copy=True)
-                v = np.array(v_rows, copy=True)
+
+                def grab(arr):
+                    # copy=True: on the CPU backend np.asarray of a
+                    # jax array can be a zero-copy VIEW of the XLA
+                    # buffer; parking that view would pin (and
+                    # potentially alias back through a later
+                    # device_put) device-runtime memory the pool must
+                    # own outright. The paged trim composes: the
+                    # compact copy IS the owned allocation.
+                    host = np.asarray(arr)
+                    if trim_rows is not None:
+                        host = host[:, :trim_rows]
+                    return np.array(host, copy=True)
+
+                k = grab(k_rows)
+                v = grab(v_rows)
                 ks = vs = None
                 if scales is not None:
-                    ks = np.array(scales[0], copy=True)
-                    vs = np.array(scales[1], copy=True)
+                    ks = grab(scales[0])
+                    vs = grab(scales[1])
                 t1 = time.monotonic()
                 nbytes = int(k.nbytes) + int(v.nbytes)
                 if ks is not None:
@@ -292,20 +386,31 @@ class KVOffloader:
             if entry is None or entry.k_dev is not None:
                 return
             cap = self.pool.budget_bytes * self._PRESTAGE_FRACTION
-            if self.pool.staged_bytes() + entry.nbytes > cap:
+            # The DEVICE footprint is the padded bucket, not the
+            # (possibly block-trimmed) host nbytes — cap on what the
+            # HBM will actually hold.
+            stored = max(1, int(entry.k.shape[1]))
+            staged_nbytes = entry.nbytes // stored * entry.bucket
+            if self.pool.staged_bytes() + staged_nbytes > cap:
                 return
-            k_dev = jax.device_put(entry.k)
-            v_dev = jax.device_put(entry.v)
+            # Paged entries store exact block bytes; the restore
+            # executable wants the power-of-two bucket — pad here (a
+            # dense entry is already bucket rows: pad is a no-op).
+            k_dev = jax.device_put(pad_rows(entry.k, entry.bucket))
+            v_dev = jax.device_put(pad_rows(entry.v, entry.bucket))
             if entry.k_scale is not None:
                 # Quantized tier: scales stage with their rows, and
                 # BEFORE k_dev/v_dev — the restore's staged check keys
                 # on those, so it can never observe rows without
                 # scales.
-                entry.k_scale_dev = jax.device_put(entry.k_scale)
-                entry.v_scale_dev = jax.device_put(entry.v_scale)
+                entry.k_scale_dev = jax.device_put(
+                    pad_rows(entry.k_scale, entry.bucket))
+                entry.v_scale_dev = jax.device_put(
+                    pad_rows(entry.v_scale, entry.bucket))
             # Single assignment each (GIL-atomic); the consumer reads
             # k_dev/v_dev at restore time and either sees both or
             # treats the entry as unstaged.
+            entry.staged_nbytes = staged_nbytes
             entry.k_dev = k_dev
             entry.v_dev = v_dev
 

@@ -26,7 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from fasttalk_tpu.models.configs import ModelConfig
-from fasttalk_tpu.ops.attention import attend, attend_blockwise
+from fasttalk_tpu.ops.attention import (attend, attend_blockwise,
+                                        gather_paged_rows,
+                                        paged_gather_indices)
 from fasttalk_tpu.ops.kv_quant import kv_dequantize, kv_quantize
 from fasttalk_tpu.ops.quant import embed_lookup, matmul_tied
 from fasttalk_tpu.ops.quant import matmul as qmm
@@ -86,6 +88,33 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int,
                                          device=device))
     return KVCache(k=jnp.zeros(shape, dtype, device=device),
                    v=jnp.zeros(shape, dtype, device=device))
+
+
+def init_paged_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
+                     dtype: jnp.dtype = jnp.bfloat16, *,
+                     quantized: bool = False,
+                     scale_granule: int = 1) -> KVCache:
+    """The paged tier's device pool (KV_LAYOUT=paged, docs/KVCACHE.md):
+    one FLAT row pool per layer, ``[L, num_blocks * block_size, Kv,
+    H]``, with no slot axis — slots map logical positions onto pool
+    rows through host-managed block tables (kvcache/blocks.py), so a
+    chip's admission capacity is priced at blocks actually in use, not
+    every slot's worst-case context. Distinguishable from the dense
+    layout by rank (4-D pool vs 5-D ``[L, B, S, Kv, H]``); the same
+    NamedTuple rides every donated call chain unchanged. The quantized
+    tier stores int8 rows + per-row float32 scales ``[L, P, G]`` —
+    scales live in pool layout too (the "per-block-row" arrays), so
+    aliasing/park/restore move rows and scales together."""
+    p = num_blocks * block_size
+    shape = (cfg.num_layers, p, cfg.num_kv_heads, cfg.head_dim)
+    if quantized:
+        sshape = (cfg.num_layers, p, scale_granule)
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+    return KVCache(k=jnp.zeros(shape, dtype),
+                   v=jnp.zeros(shape, dtype))
 
 
 def init_params(cfg: ModelConfig, rng: jax.Array,
@@ -296,6 +325,9 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
                          tokens: jnp.ndarray, positions: jnp.ndarray,
                          cache: KVCache, write_mask: jnp.ndarray, *,
                          attn_len: int, pallas_int8: bool = False,
+                         block_table: jnp.ndarray | None = None,
+                         block_size: int = 0,
+                         pallas_paged: bool = False,
                          ) -> tuple[jnp.ndarray, KVCache]:
     """Scatter-write decode over a short block: tokens [B, T] ->
     logits [B, T, V], cache updated IN PLACE.
@@ -312,17 +344,47 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
     block occupies positions..positions+T-1). write_mask [B]: rows with
     False neither write the cache nor advance (their scatter is clamped
     out of range and dropped).
+
+    ``block_table`` [B, attn_len // block_size] selects the PAGED tier
+    (KV_LAYOUT=paged): the cache is then the flat block pool
+    ``[L, P, Kv, H]`` (init_paged_cache) and every logical position
+    routes through the table — writes scatter to
+    ``table[b, pos // bs] * bs + pos % bs`` and the attention read
+    gathers the slot's blocks into position order
+    (ops/attention.paged_gather_indices, the XLA gather fallback).
+    ``pallas_paged`` replaces that gather+attend with the block-walking
+    Pallas kernel (T=1, full-precision rows only).
     """
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
                                             cfg.rope_scaling))
     x = embed_lookup(params["embed"], tokens,
                      params["final_norm"].dtype)  # [B, T, D]
     b, t = tokens.shape
-    s_total = cache.max_len
+    paged = block_table is not None
     pos_mat = positions[:, None] + jnp.arange(t)[None, :]  # [B, T]
     rows = jnp.arange(b)
-    # Masked rows scatter out of range -> dropped (mode="drop").
-    write_cols = jnp.where(write_mask[:, None], pos_mat, s_total)
+    if paged:
+        assert block_table.shape[1] * block_size == attn_len, \
+            "block table must cover exactly the attn_len bucket"
+        pool_rows = cache.k.shape[1]
+        # Logical position -> flat pool row, via the table. Masked rows
+        # scatter out of range — DISTINCT per (row, column), because
+        # unique_indices below promises no duplicates even among
+        # dropped entries.
+        blk = pos_mat // block_size
+        flat = (jnp.take_along_axis(block_table, blk, axis=1)
+                * block_size + pos_mat % block_size)
+        oob = (pool_rows + rows[:, None] * t
+               + jnp.arange(t)[None, :])
+        write_cols = jnp.where(write_mask[:, None], flat, oob)
+        # The attention-read gather indices are table-only (constant
+        # over the layer scan): rows land in logical position order,
+        # so the absolute-position mask in attend() is unchanged.
+        gather_idx = paged_gather_indices(block_table, block_size)
+    else:
+        s_total = cache.max_len
+        # Masked rows scatter out of range -> dropped (mode="drop").
+        write_cols = jnp.where(write_mask[:, None], pos_mat, s_total)
     # Int8 KV tier: the block's fresh rows quantize before the scatter
     # (per-row max-abs scales, ops/kv_quant.py), and the bounded
     # attention read dequantizes the sliced region into the matmul —
@@ -346,28 +408,69 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
         if kvq:
             k, sk = kv_quantize(k, kvg)
             v, sv = kv_quantize(v, kvg)
-            ks_all = ks_all.at[li, rows[:, None], write_cols].set(
-                sk, mode="drop", unique_indices=True)
-            vs_all = vs_all.at[li, rows[:, None], write_cols].set(
-                sv, mode="drop", unique_indices=True)
-        ck_all = ck_all.at[li, rows[:, None], write_cols].set(
-            k, mode="drop", unique_indices=True)
-        cv_all = cv_all.at[li, rows[:, None], write_cols].set(
-            v, mode="drop", unique_indices=True)
-        ak = jax.lax.dynamic_slice(
-            ck_all, (li, 0, 0, 0, 0),
-            (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
-        av = jax.lax.dynamic_slice(
-            cv_all, (li, 0, 0, 0, 0),
-            (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
-        if kvq:
-            aks = jax.lax.dynamic_slice(
-                ks_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
-            avs = jax.lax.dynamic_slice(
-                vs_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
-            ak = kv_dequantize(ak, aks, x.dtype)
-            av = kv_dequantize(av, avs, x.dtype)
-        o = attend(q, ak, av, pos_mat)
+            if paged:
+                ks_all = ks_all.at[li, write_cols].set(
+                    sk, mode="drop", unique_indices=True)
+                vs_all = vs_all.at[li, write_cols].set(
+                    sv, mode="drop", unique_indices=True)
+            else:
+                ks_all = ks_all.at[li, rows[:, None], write_cols].set(
+                    sk, mode="drop", unique_indices=True)
+                vs_all = vs_all.at[li, rows[:, None], write_cols].set(
+                    sv, mode="drop", unique_indices=True)
+        if paged:
+            # Flat-pool scatter: [B, T] rows land at their table-mapped
+            # pool rows; the read below gathers them back into logical
+            # position order.
+            ck_all = ck_all.at[li, write_cols].set(
+                k, mode="drop", unique_indices=True)
+            cv_all = cv_all.at[li, write_cols].set(
+                v, mode="drop", unique_indices=True)
+            lk = jax.lax.dynamic_slice(
+                ck_all, (li, 0, 0, 0),
+                (1, pool_rows, cfg.num_kv_heads, cfg.head_dim))[0]
+            lv = jax.lax.dynamic_slice(
+                cv_all, (li, 0, 0, 0),
+                (1, pool_rows, cfg.num_kv_heads, cfg.head_dim))[0]
+            if pallas_paged:
+                from fasttalk_tpu.ops.pallas_attention import \
+                    decode_attend_paged
+
+                o = decode_attend_paged(
+                    q[:, 0], lk, lv, pos_mat[:, 0] + 1, block_table,
+                    block_size=block_size)[:, None]
+            else:
+                ak = gather_paged_rows(lk, gather_idx)
+                av = gather_paged_rows(lv, gather_idx)
+                if kvq:
+                    aks = gather_paged_rows(jax.lax.dynamic_slice(
+                        ks_all, (li, 0, 0), (1, pool_rows, kvg))[0],
+                        gather_idx)
+                    avs = gather_paged_rows(jax.lax.dynamic_slice(
+                        vs_all, (li, 0, 0), (1, pool_rows, kvg))[0],
+                        gather_idx)
+                    ak = kv_dequantize(ak, aks, x.dtype)
+                    av = kv_dequantize(av, avs, x.dtype)
+                o = attend(q, ak, av, pos_mat)
+        else:
+            ck_all = ck_all.at[li, rows[:, None], write_cols].set(
+                k, mode="drop", unique_indices=True)
+            cv_all = cv_all.at[li, rows[:, None], write_cols].set(
+                v, mode="drop", unique_indices=True)
+            ak = jax.lax.dynamic_slice(
+                ck_all, (li, 0, 0, 0, 0),
+                (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+            av = jax.lax.dynamic_slice(
+                cv_all, (li, 0, 0, 0, 0),
+                (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+            if kvq:
+                aks = jax.lax.dynamic_slice(
+                    ks_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
+                avs = jax.lax.dynamic_slice(
+                    vs_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
+                ak = kv_dequantize(ak, aks, x.dtype)
+                av = kv_dequantize(av, avs, x.dtype)
+            o = attend(q, ak, av, pos_mat)
         x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
@@ -395,19 +498,24 @@ def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
                    positions: jnp.ndarray, cache: KVCache,
                    write_mask: jnp.ndarray, *, attn_len: int,
                    pallas_int8: bool = False,
+                   block_table: jnp.ndarray | None = None,
+                   block_size: int = 0, pallas_paged: bool = False,
                    ) -> tuple[jnp.ndarray, KVCache]:
     """One decode step [B] -> logits [B, V], cache updated IN PLACE.
 
     The throughput-critical specialisation of ``forward`` for T=1 — see
-    ``forward_decode_multi`` for the mechanics. (``forward``'s layer
-    scan threads the cache as scan xs/ys, and XLA materialises the
-    stacked ys every call — a full read+write of the attention region
-    per step, ~1.1 GB/step at a 512 bucket for the 1B model; the
-    scatter form traced at 3.96 vs 4.99 ms/step on v5e-1.)
+    ``forward_decode_multi`` for the mechanics (including the paged-
+    tier ``block_table`` routing). (``forward``'s layer scan threads
+    the cache as scan xs/ys, and XLA materialises the stacked ys every
+    call — a full read+write of the attention region per step, ~1.1
+    GB/step at a 512 bucket for the 1B model; the scatter form traced
+    at 3.96 vs 4.99 ms/step on v5e-1.)
     """
     logits, new_cache = forward_decode_multi(
         params, cfg, cur[:, None], positions, cache, write_mask,
-        attn_len=attn_len, pallas_int8=pallas_int8)
+        attn_len=attn_len, pallas_int8=pallas_int8,
+        block_table=block_table, block_size=block_size,
+        pallas_paged=pallas_paged)
     return logits[:, 0], new_cache
 
 

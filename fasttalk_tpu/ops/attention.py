@@ -27,6 +27,36 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def paged_gather_indices(block_table: jnp.ndarray,
+                         block_size: int) -> jnp.ndarray:
+    """Flat pool-row indices for a paged attention read.
+
+    ``block_table`` [B, nb] maps each slot's logical block ``i`` to a
+    pool block id; the result [B, nb * block_size] names the pool row
+    holding every logical position ``0..nb*block_size`` per slot, in
+    position order — so a gather through it yields a contiguous-looking
+    [B, S, ...] key/value region the position-masked ``attend`` paths
+    consume unchanged. This is the XLA *gather fallback* of the paged
+    KV tier (KV_LAYOUT=paged, docs/KVCACHE.md): it runs everywhere the
+    dense tier does; the block-walking Pallas kernel
+    (ops/pallas_attention.decode_attend_paged) is the TPU fast path.
+    Unallocated table entries may be any in-range id (conventionally
+    0): their rows sit beyond every query's position mask.
+    """
+    b, nb = block_table.shape
+    idx = (block_table[:, :, None] * block_size
+           + jnp.arange(block_size, dtype=block_table.dtype)[None, None, :])
+    return idx.reshape(b, nb * block_size)
+
+
+def gather_paged_rows(pool_rows: jnp.ndarray,
+                      flat_idx: jnp.ndarray) -> jnp.ndarray:
+    """Gather one layer's paged KV rows: pool [P, ...] × indices
+    [B, S] → [B, S, ...]. Plain fancy indexing so XLA lowers it to one
+    gather feeding the attention contraction."""
+    return pool_rows[flat_idx]
+
+
 def _split_gqa(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
     """[B, T, Nq, D] -> [B, T, Nkv, G, D]."""
     b, t, nq, d = q.shape

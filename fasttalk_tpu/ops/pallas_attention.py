@@ -141,3 +141,83 @@ def decode_attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         interpret=interpret,
     )(lengths, qg, k, v)
     return out.reshape(b, nq, d)
+
+
+def _paged_decode_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *,
+                         block_size: int, scale: float):
+    """Identical softmax recurrence to ``_decode_kernel`` — the paged
+    variant differs only in WHERE each grid step's K/V block comes
+    from (the block-table index map below), so the per-slot length
+    pruning carries over unchanged: grid step j of slot b masks by the
+    slot's true length and pruned steps elide their DMA."""
+    _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, block_size=block_size,
+                   scale=scale)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def decode_attend_paged(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        lengths: jnp.ndarray, tables: jnp.ndarray, *,
+                        block_size: int,
+                        interpret: bool | None = None) -> jnp.ndarray:
+    """GQA decode attention over a PAGED block pool: the per-slot
+    length pruning of ``decode_attend`` extended to walk block lists
+    (KV_LAYOUT=paged, docs/KVCACHE.md "Paged tier").
+
+    q [B, Nq, D]; k, v are the flat device pool
+    [P = num_blocks * block_size, Nkv, D]; lengths [B] = valid keys per
+    slot; tables [B, nb] = pool block id holding each slot's logical
+    block (nb * block_size is the call's KV bucket). Both scalar
+    operands prefetch, so the index map routes each grid step's DMA to
+    ``tables[b, j]`` — logically contiguous attention over physically
+    scattered blocks, no gather materialisation. Steps past a slot's
+    live length revisit its last live block and elide the DMA, exactly
+    like the dense kernel.
+    """
+    b, nq, d = q.shape
+    p, nkv = k.shape[0], k.shape[1]
+    g = nq // nkv
+    if p % block_size:
+        raise ValueError(f"pool rows {p} not divisible by {block_size}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb = tables.shape[1]
+    kb = k.reshape(p // block_size, block_size, nkv, d)
+    vb = v.reshape(p // block_size, block_size, nkv, d)
+    qg = q.reshape(b, nkv, g, d)
+    lengths = lengths.astype(jnp.int32)
+    tables = tables.astype(jnp.int32)
+
+    def q_index(b_, j, lens, tabs):  # noqa: ARG001
+        return (b_, 0, 0, 0)
+
+    def kv_index(b_, j, lens, tabs):
+        # Walk the slot's block list; pruned steps revisit the last
+        # live block (same index as the previous step → no DMA).
+        num_live = pl.cdiv(lens[b_], block_size)
+        return (tabs[b_, jnp.minimum(j, num_live - 1)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, nkv, g, d), q_index),
+            pl.BlockSpec((1, block_size, nkv, d), kv_index),
+            pl.BlockSpec((1, block_size, nkv, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, g, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, g, 1), jnp.float32),   # running max
+            pltpu.VMEM((nkv, g, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((nkv, g, d), jnp.float32),   # running numerator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, block_size=block_size,
+                          scale=d ** -0.5),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+        interpret=interpret,
+    )(lengths, tables, qg, kb, vb)
+    return out.reshape(b, nq, d)

@@ -106,6 +106,9 @@ CATALOG: dict[str, str] = {
         "before a prefill device call (chunked and batched paths)",
     "engine.retire.fetch":
         "the blocking wait on a retired decode call's token fetch",
+    "kv.block_alloc":
+        "paged-KV device block-pool allocation (KV_LAYOUT=paged): "
+        "exhaust the pool mid-prefill/decode",
     "kv.park.copy":
         "device->host fetch of a parked session's KV rows (copy "
         "thread)",

@@ -34,7 +34,8 @@ from fasttalk_tpu.agents.hermes import (
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.engine.remote import _RemoteEngine
 from fasttalk_tpu.structured.compiler import validate_structured_spec
-from fasttalk_tpu.utils.errors import (AdmissionRejected, CircuitBreaker,
+from fasttalk_tpu.utils.errors import (ENGINE_SHED_CODES,
+                                       AdmissionRejected, CircuitBreaker,
                                        CircuitBreakerOpen, ErrorCategory,
                                        LLMServiceError)
 from fasttalk_tpu.utils.logger import get_logger
@@ -361,13 +362,14 @@ def register_openai_routes(app: web.Application,
                 elif event["type"] == "error":
                     failed = True
                     err_payload = event.get("error")
-                    if event.get("code") == "deadline_expired":
-                        # Queue-deadline expiry = load shedding: the
-                        # frame keeps retry_after and the breaker is
-                        # untouched (a shed is not a backend fault).
+                    if event.get("code") in ENGINE_SHED_CODES:
+                        # Queue-deadline expiry / block-pool
+                        # exhaustion = load shedding: the frame keeps
+                        # retry_after and the breaker is untouched (a
+                        # shed is not a backend fault).
                         shed = True
                         err_payload = AdmissionRejected \
-                            .from_expiry_event(event).to_dict()
+                            .from_shed_event(event).to_dict()
                     await resp.write(
                         f"data: {json.dumps({'error': err_payload})}\n\n"
                         .encode())
@@ -428,9 +430,9 @@ def register_openai_routes(app: web.Application,
                     finish_reason = _oai_finish(
                         event.get("finish_reason", "stop"))
                 elif event["type"] == "error":
-                    if event.get("code") == "deadline_expired":
+                    if event.get("code") in ENGINE_SHED_CODES:
                         # Shed, not a failure: caller maps to 429.
-                        raise AdmissionRejected.from_expiry_event(event)
+                        raise AdmissionRejected.from_shed_event(event)
                     if breaker is not None:
                         breaker.record_failure()
                     return stats, finish_reason, web.json_response(

@@ -44,6 +44,7 @@ from fasttalk_tpu.serving.conversation import ConversationManager
 from fasttalk_tpu.serving.text_processor import extract_speakable_chunk
 from fasttalk_tpu.utils.config import Config
 from fasttalk_tpu.utils.errors import (
+    ENGINE_SHED_CODES,
     AdmissionRejected,
     CircuitBreaker,
     CircuitBreakerOpen,
@@ -623,11 +624,12 @@ class WebSocketLLMServer:
                         "attempt": event.get("attempt")},
                         request_id=request_id)
                 elif etype == "error":
-                    if event.get("code") == "deadline_expired":
-                        # Queue-deadline expiry is load shedding, not a
-                        # backend fault: surface it like a shed (frame
-                        # keeps retry_after; breaker untouched).
-                        raise AdmissionRejected.from_expiry_event(event)
+                    if event.get("code") in ENGINE_SHED_CODES:
+                        # Queue-deadline expiry / KV block-pool
+                        # exhaustion is load shedding, not a backend
+                        # fault: surface it like a shed (frame keeps
+                        # retry_after; breaker untouched).
+                        raise AdmissionRejected.from_shed_event(event)
                     if event.get("code") == "stalled":
                         # Watchdog-terminated (observability/watchdog
                         # .py force_fail): a genuine backend fault —

@@ -443,6 +443,41 @@ class Config:
     # diverge, at num_kv_heads x the scale storage).
     kv_quant_granule: str = field(
         default_factory=lambda: _env_str("KV_QUANT_GRANULE", "token"))
+    # ---- Paged KV-cache tier (kvcache/blocks.py, docs/KVCACHE.md
+    # "Paged tier") ----
+    # "dense" (default) | "paged": the dense layout preallocates
+    # [layers, slots, max_len, ...] — every slot priced at worst-case
+    # context; the paged layout holds one flat block pool with
+    # per-slot block tables, so HBM admission capacity is priced at
+    # blocks actually in use and shared prefixes alias (refcount
+    # bump) instead of copying rows. Single-device only (the pool and
+    # tables are host-orchestrated per chip); composes with KV_QUANT,
+    # the host park/offload tier, speculative + structured decoding,
+    # and the Pallas decode kernel (block-walking variant).
+    kv_layout: str = field(
+        default_factory=lambda: _env_str("KV_LAYOUT", "dense"))
+    # Tokens per block: power of two in [8, 512]. Small blocks waste
+    # less tail capacity per sequence but grow the table/gather work;
+    # 16 matches vLLM's default granularity.
+    kv_block_size: int = field(
+        default_factory=lambda: _env_int("KV_BLOCK_SIZE", 16))
+    # Device pool size in blocks. 0 (default) sizes the pool to the
+    # dense-equivalent HBM footprint (slots x max_len / block_size);
+    # the factory lowers that to what the HBM budget actually holds,
+    # which is where the paged layout admits fleets the dense layout
+    # rejects.
+    kv_pool_blocks: int = field(
+        default_factory=lambda: _env_int("KV_POOL_BLOCKS", 0))
+    # Decode-growth reserve the admission check must see free beyond
+    # the prompt's blocks: "fixed" covers the next KV_RESERVE_TOKENS
+    # of growth (default), "max_tokens" the request's whole token
+    # budget (no mid-decode sheds, fewest admissions), "none" admits
+    # on prefill fit alone (maximum packing, relies on the rehearsed
+    # mid-decode shed when the pool runs dry).
+    kv_reserve_policy: str = field(
+        default_factory=lambda: _env_str("KV_RESERVE_POLICY", "fixed"))
+    kv_reserve_tokens: int = field(
+        default_factory=lambda: _env_int("KV_RESERVE_TOKENS", 128))
     # ---- Structured decoding (fasttalk_tpu/structured/,
     # docs/STRUCTURED.md) ----
     # "auto" (default): constrained requests are served whenever the
@@ -769,6 +804,38 @@ class Config:
                     "KV_QUANT=int8 is incompatible with speculative "
                     "decoding (the verify block's quantize-on-write "
                     "is unvalidated) — set TPU_SPEC_DECODE=off")
+        if self.kv_layout not in ("dense", "paged"):
+            errs.append(f"kv_layout must be 'dense' or 'paged', "
+                        f"got {self.kv_layout!r}")
+        if (self.kv_block_size < 8 or self.kv_block_size > 512
+                or self.kv_block_size & (self.kv_block_size - 1)):
+            errs.append(f"kv_block_size must be a power of two in "
+                        f"[8, 512], got {self.kv_block_size}")
+        if self.kv_pool_blocks < 0:
+            errs.append("kv_pool_blocks must be >= 0 (0 sizes the pool "
+                        "to the dense-equivalent footprint)")
+        if self.kv_reserve_policy not in ("none", "fixed", "max_tokens"):
+            errs.append(f"kv_reserve_policy must be none|fixed|"
+                        f"max_tokens, got {self.kv_reserve_policy!r}")
+        if self.kv_reserve_tokens < 0:
+            errs.append("kv_reserve_tokens must be >= 0")
+        if self.kv_layout == "paged":
+            # Paged compat matrix (docs/KVCACHE.md): named startup
+            # errors, never a silent fall-back to dense.
+            if self.tp_size > 1 or self.dp_size > 1 or self.sp_size > 1:
+                errs.append(
+                    "KV_LAYOUT=paged is single-device only (the block "
+                    "pool and per-slot tables are host-orchestrated "
+                    "per chip); set TPU_TP_SIZE=TPU_DP_SIZE="
+                    "TPU_SP_SIZE=1")
+            if self.spmd_role != "off":
+                errs.append("KV_LAYOUT=paged is incompatible with "
+                            "multi-host SPMD serving; set "
+                            "TPU_SPMD_ROLE=off")
+            if self.kv_block_size > self.max_model_len:
+                errs.append(
+                    f"kv_block_size ({self.kv_block_size}) must not "
+                    f"exceed max_model_len ({self.max_model_len})")
         if self.structured_mode not in ("auto", "on", "off"):
             errs.append(f"structured_mode must be auto|on|off, "
                         f"got {self.structured_mode!r}")

@@ -91,14 +91,22 @@ class AdmissionRejected(LLMServiceError):
         self.reason = reason
 
     @classmethod
-    def from_expiry_event(cls, event: dict) -> "AdmissionRejected":
-        """Rebuild from an engine terminal error event carrying
-        ``code == "deadline_expired"`` (the queue-expiry contract,
-        engine._expire_queued) — one definition of the message fallback
-        and retry_after coercion for every serving surface."""
-        return cls(str(event.get("error") or "queue deadline expired"),
+    def from_shed_event(cls, event: dict) -> "AdmissionRejected":
+        """Rebuild from an engine terminal error event whose ``code``
+        is in ``ENGINE_SHED_CODES`` (queue-deadline expiry, paged-KV
+        block-pool exhaustion) — one definition of the message
+        fallback and retry_after coercion for every serving surface;
+        the event's code rides through as ``details.reason``."""
+        return cls(str(event.get("error") or "request shed"),
                    retry_after=float(event.get("retry_after") or 1.0),
-                   reason="deadline_expired")
+                   reason=str(event.get("code") or "shed"))
+
+
+# Engine terminal-error codes that are LOAD SHEDDING, not backend
+# faults: every serving surface maps them to the rate-limit taxonomy
+# (WS frame / SSE payload with retry_after, HTTP 429) and leaves the
+# circuit breaker untouched — a shed is the engine protecting itself.
+ENGINE_SHED_CODES = ("deadline_expired", "kv_blocks_exhausted")
 
 
 class CircuitState(str, Enum):

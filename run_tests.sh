@@ -31,6 +31,17 @@
 #                             budget), and the compat-matrix
 #                             validation (docs/KVCACHE.md "Quantized
 #                             tier").
+#   ./run_tests.sh --paged    paged-KV group (KV_LAYOUT=paged):
+#                             block-allocator discipline (refcount
+#                             aliasing, copy-on-write, leak
+#                             invariant), paged-vs-dense greedy token
+#                             parity (bf16 + int8, incl. the trained
+#                             tinychat checkpoint), out-of-blocks
+#                             admission sheds with retry_after,
+#                             park→restore→release zero-leak, the
+#                             kv.block_alloc chaos drill, and the
+#                             failpoint lint (docs/KVCACHE.md "Paged
+#                             tier").
 #   ./run_tests.sh --slo      SLO/watchdog group: burn-rate windows,
 #                             goodput, the fake-clock stall watchdog,
 #                             /slo + /events endpoints, the strict
@@ -143,6 +154,21 @@ EOF
     echo "$out"
     grep -q "KV read" <<<"$out" \
         || { echo "trace_report --perf smoke: missing KV read GB/s" >&2; exit 1; }
+    exit 0
+fi
+
+if [[ "${1:-}" == "--paged" ]]; then
+    shift
+    # Paged block-table KV tier (KV_LAYOUT=paged, docs/KVCACHE.md
+    # "Paged tier"): allocator/config units + the slow engine suites
+    # (paged-vs-dense token parity incl. int8 and the trained
+    # checkpoint, aliasing, admission sheds, park/restore zero-leak)
+    # + the block-pool chaos drill, with the failpoint lint first so
+    # the catalog/test cross-check cannot drift.
+    "${PYENV[@]}" python scripts/check_failpoints.py
+    "${PYENV[@]}" python -m pytest tests/test_paged_kv.py \
+        "tests/test_chaos.py::TestKVChaos::test_block_alloc_exhaustion_sheds_with_exact_accounting" \
+        "$@"
     exit 0
 fi
 

@@ -613,6 +613,37 @@ class TestKVChaos:
             fp.clear()
             e.shutdown()
 
+    def test_block_alloc_exhaustion_sheds_with_exact_accounting(self):
+        """Paged KV tier (kvcache/blocks.py): a mid-prefill block-pool
+        exhaustion sheds THAT request with retry_after and exact
+        refcount/byte accounting — the kv.block_alloc failpoint fires
+        BEFORE any allocator state changes, so the injected failure
+        must leave the pool exactly as it found it. Engine survives."""
+        e = _make_engine(kv_layout="paged", kv_block_size=16)
+        try:
+            alloc = e._kv_blocks
+            fp.activate("kv.block_alloc=error;count=1")
+            events = _collect(e, "ba1", "BA", MSG_A)
+            _assert_one_terminal(events, "error",
+                                 code="kv_blocks_exhausted")
+            assert events[-1]["retry_after"] > 0
+            assert fp.describe()["rules"][0]["fired"] == 1
+            # Exact accounting: the shed request's slot released its
+            # (zero) blocks; refcounts equal table multiplicity.
+            assert _wait(lambda: alloc.in_use() == 0), alloc.stats()
+            alloc.check_leaks()
+            assert e.check_connection()
+            fp.clear()
+            # The rehearsed incident over: the same session admits and
+            # completes, blocks allocate normally.
+            done = _collect(e, "ba2", "BA", MSG_A)
+            _assert_one_terminal(done, "done")
+            assert alloc.in_use() > 0
+            alloc.check_leaks()
+        finally:
+            fp.clear()
+            e.shutdown()
+
 
 # ---------------------------------------------------------------------
 # Remote backend chaos

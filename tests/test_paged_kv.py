@@ -1,0 +1,597 @@
+"""Paged KV-cache tier (KV_LAYOUT=paged — kvcache/blocks.py,
+docs/KVCACHE.md "Paged tier"): block-allocator discipline
+(alloc/free/refcount-alias/copy-on-write, leak invariant), paged-vs-
+dense greedy token parity (bf16 and KV_QUANT=int8), zero-row-copy
+shared-prefix aliasing, out-of-blocks admission rejection with
+retry_after, zero-leak park→restore→release cycles, and the
+Config/factory validation (blocks-available math in the HBM failure
+message). Engine-level suites are marked slow — run via
+``run_tests.sh --paged``."""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fasttalk_tpu.engine.engine import GenerationParams, TPUEngine
+from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+from fasttalk_tpu.kvcache.blocks import (BlockAllocator, BlockExhausted,
+                                         blocks_for)
+from fasttalk_tpu.models import get_model_config, init_params
+
+TINY = get_model_config("test-tiny")
+GREEDY = dict(temperature=0.0, top_k=0, top_p=1.0)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CKPT = os.path.join(REPO, "fasttalk_tpu", "assets", "tinychat")
+HAVE_TINYCHAT = os.path.isfile(os.path.join(CKPT, "model.safetensors"))
+
+
+# ---------------------------------------------------------------------
+# Block allocator units (pure host bookkeeping — fast, tier-1)
+# ---------------------------------------------------------------------
+
+class TestBlocksFor:
+    def test_ceil_division(self):
+        assert blocks_for(0, 16) == 0
+        assert blocks_for(1, 16) == 1
+        assert blocks_for(16, 16) == 1
+        assert blocks_for(17, 16) == 2
+        assert blocks_for(-5, 16) == 0
+
+
+class TestBlockAllocator:
+    def test_pow2_validation(self):
+        with pytest.raises(ValueError, match="power of two"):
+            BlockAllocator(8, 12, 2)
+        with pytest.raises(ValueError, match="num_blocks"):
+            BlockAllocator(0, 16, 2)
+
+    def test_ensure_grow_and_idempotent(self):
+        a = BlockAllocator(8, 16, 2)
+        assert a.ensure(0, 40)  # 3 blocks
+        assert a.slot_blocks(0) == 3
+        assert a.in_use() == 3
+        assert a.ensure(0, 40)  # no growth needed
+        assert a.slot_blocks(0) == 3
+        assert a.ensure(0, 49)  # one more
+        assert a.slot_blocks(0) == 4
+        a.check_leaks()
+
+    def test_exhaustion_is_all_or_nothing(self):
+        a = BlockAllocator(4, 16, 2)
+        assert a.ensure(0, 3 * 16)
+        # Needs 2 more but only 1 free: state must be untouched.
+        assert not a.ensure(1, 2 * 16)
+        assert a.slot_blocks(1) == 0
+        assert a.available() == 1
+        a.check_leaks()
+        with pytest.raises(BlockExhausted):
+            a._take(2)
+        assert a.available() == 1
+
+    def test_release_returns_blocks(self):
+        a = BlockAllocator(8, 16, 2)
+        a.ensure(0, 64)
+        a.ensure(1, 32)
+        a.release(0)
+        assert a.slot_blocks(0) == 0
+        assert a.available() == 8 - 2
+        a.check_leaks()
+
+    def test_truncate_partial(self):
+        a = BlockAllocator(8, 16, 1)
+        a.ensure(0, 80)  # 5 blocks
+        assert a.truncate(0, 33) == 2  # keep ceil(33/16) = 3
+        assert a.slot_blocks(0) == 3
+        assert a.truncate(0, 48) == 0  # exactly covered: no-op
+        a.check_leaks()
+
+    def test_alias_refcounts_and_shared_release(self):
+        a = BlockAllocator(8, 16, 3)
+        a.ensure(0, 64)  # 4 blocks
+        n = a.alias(0, 1, 3)
+        assert n == 3
+        assert a.table(1) == a.table(0)[:3]
+        assert a.in_use() == 4  # aliasing allocates NOTHING
+        assert a.alias_events == 1
+        a.check_leaks()
+        # Source releases: shared blocks survive through slot 1.
+        a.release(0)
+        assert a.slot_blocks(1) == 3
+        assert a.in_use() == 3
+        a.check_leaks()
+        a.release(1)
+        assert a.in_use() == 0
+        assert a.available() == 8
+        a.check_leaks()
+
+    def test_alias_capped_by_source_table(self):
+        a = BlockAllocator(8, 16, 2)
+        a.ensure(0, 32)  # 2 blocks
+        assert a.alias(0, 1, 5) == 2
+
+    def test_tail_shared_and_cow(self):
+        a = BlockAllocator(8, 16, 2)
+        a.ensure(0, 48)  # blocks for 3
+        a.alias(0, 1, 3)
+        assert a.tail_shared(1)
+        old = a.table(1)[-1]
+        pair = a.cow_tail(1)
+        assert pair is not None and pair[0] == old
+        assert a.table(1)[-1] == pair[1] != old
+        assert not a.tail_shared(1)
+        assert not a.tail_shared(0)  # slot 0 exclusive again
+        assert a.cow_copies == 1
+        a.check_leaks()
+
+    def test_cow_pool_empty_returns_none(self):
+        a = BlockAllocator(3, 16, 2)
+        a.ensure(0, 48)
+        a.alias(0, 1, 3)  # pool now empty
+        assert a.cow_tail(1) is None
+        a.check_leaks()
+
+    def test_double_free_asserts(self):
+        a = BlockAllocator(4, 16, 1)
+        a.ensure(0, 16)
+        blk = a.table(0)[0]
+        a.release(0)
+        with pytest.raises(AssertionError, match="double free"):
+            a._drop(blk)
+
+    def test_stats_and_fragmentation(self):
+        a = BlockAllocator(8, 16, 2)
+        a.ensure(0, 20)  # 2 blocks = 32 rows capacity
+        st = a.stats(used_tokens=20)
+        assert st["total"] == 8 and st["in_use"] == 2
+        assert st["block_size"] == 16
+        assert st["fragmentation"] == pytest.approx(12 / 32, abs=1e-3)
+        assert st["tables"] == [2, 0]
+
+    def test_shed_event_maps_to_rate_limit_taxonomy(self):
+        """A kv_blocks_exhausted terminal event must reach clients as
+        load shedding (rate-limit code + retry_after, breaker
+        untouched), exactly like a queue-deadline expiry — the serving
+        layers classify through ENGINE_SHED_CODES."""
+        from fasttalk_tpu.utils.errors import (ENGINE_SHED_CODES,
+                                               AdmissionRejected)
+
+        assert "kv_blocks_exhausted" in ENGINE_SHED_CODES
+        d = AdmissionRejected.from_shed_event(
+            {"code": "kv_blocks_exhausted",
+             "error": "KV block pool exhausted",
+             "retry_after": 2.5}).to_dict()
+        assert d["code"] == "rate_limit_error"
+        assert d["retry_after"] == 2.5
+        assert d["details"]["reason"] == "kv_blocks_exhausted"
+
+    def test_gauges_prometheus_valid(self):
+        """Block-pool gauges render as a valid exposition (the
+        check_prometheus strict validator, same bar as every other
+        metric family)."""
+        import importlib.util
+
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        spec = importlib.util.spec_from_file_location(
+            "check_prometheus",
+            os.path.join(REPO, "scripts", "check_prometheus.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        a = BlockAllocator(8, 16, 2)
+        a.ensure(0, 20)
+        a.stats(used_tokens=20)  # refresh the fragmentation gauge
+        text = get_metrics().prometheus()
+        for name in ("kv_blocks_total", "kv_blocks_in_use",
+                     "kv_blocks_aliased", "kv_block_fragmentation"):
+            assert name in text
+        assert mod.validate(text) == []
+
+
+# ---------------------------------------------------------------------
+# Config / factory validation (fast, tier-1)
+# ---------------------------------------------------------------------
+
+class TestPagedConfig:
+    def _cfg(self, **kw):
+        from fasttalk_tpu.utils.config import Config
+
+        base = dict(llm_provider="fake", enable_agent=False)
+        base.update(kw)
+        return Config(**base)
+
+    def test_valid_paged_config(self):
+        cfg = self._cfg(kv_layout="paged", kv_block_size=32,
+                        kv_reserve_policy="max_tokens")
+        assert cfg.kv_layout == "paged"
+        assert cfg.to_dict()["kv_block_size"] == 32
+
+    def test_bad_layout_and_block_size_named(self):
+        with pytest.raises(ValueError, match="kv_layout"):
+            self._cfg(kv_layout="banana")
+        for bad in (12, 4, 1024):
+            with pytest.raises(ValueError, match="kv_block_size"):
+                self._cfg(kv_block_size=bad)
+        with pytest.raises(ValueError, match="kv_reserve_policy"):
+            self._cfg(kv_reserve_policy="hopeful")
+        with pytest.raises(ValueError, match="kv_reserve_tokens"):
+            self._cfg(kv_reserve_tokens=-1)
+        with pytest.raises(ValueError, match="kv_pool_blocks"):
+            self._cfg(kv_pool_blocks=-1)
+
+    def test_mesh_rejected(self):
+        with pytest.raises(ValueError, match="single-device"):
+            self._cfg(kv_layout="paged", tp_size=2)
+        with pytest.raises(ValueError, match="SPMD"):
+            self._cfg(kv_layout="paged", spmd_role="leader")
+
+    def test_block_size_vs_max_len(self):
+        with pytest.raises(ValueError, match="max_model_len"):
+            self._cfg(kv_layout="paged", kv_block_size=512,
+                      max_model_len=256)
+
+    def test_engine_seam_mirrors_rejections(self):
+        import jax
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="dense.*paged|paged"):
+            TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                      max_len=256, kv_layout="diagonal")
+        with pytest.raises(ValueError, match="KV_BLOCK_SIZE"):
+            TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                      max_len=256, kv_layout="paged", kv_block_size=24)
+
+    def test_hbm_failure_message_names_paged_remedy(self):
+        """Satellite: the dense HBM-budget failure prints the blocks-
+        available math and names KV_LAYOUT=paged as the remedy."""
+        from unittest import mock
+
+        from fasttalk_tpu.engine.factory import check_hbm_budget
+
+        cfg = self._cfg(decode_slots=8, max_model_len=32768)
+        dev = mock.Mock()
+        dev.memory_stats.return_value = {"bytes_limit": 4 << 30}
+        import jax.numpy as jnp
+
+        with mock.patch("jax.local_devices", return_value=[dev]):
+            with pytest.raises(ValueError) as ei:
+                check_hbm_budget(get_model_config("llama3.2:1b"),
+                                 cfg, jnp.bfloat16, 1)
+        msg = str(ei.value)
+        assert "KV_LAYOUT=paged" in msg
+        assert "blocks" in msg
+        assert "KV_BLOCK_SIZE" in msg
+
+    def test_paged_pool_fits_to_budget(self):
+        """KV_POOL_BLOCKS=0 shrinks the pool to the budget instead of
+        failing — the fit-to-budget step that admits what dense
+        rejects."""
+        from unittest import mock
+
+        from fasttalk_tpu.engine.factory import check_hbm_budget
+
+        cfg = self._cfg(decode_slots=8, max_model_len=32768,
+                        kv_layout="paged")
+        dev = mock.Mock()
+        dev.memory_stats.return_value = {"bytes_limit": 4 << 30}
+        import jax.numpy as jnp
+
+        with mock.patch("jax.local_devices", return_value=[dev]):
+            acct = check_hbm_budget(get_model_config("llama3.2:1b"),
+                                    cfg, jnp.bfloat16, 1)
+        dense_equiv = 8 * 32768 // cfg.kv_block_size
+        assert 0 < acct["kv_pool_blocks"] < dense_equiv
+        assert acct["kv_pool_blocks"] >= blocks_for(32768,
+                                                    cfg.kv_block_size)
+
+
+# ---------------------------------------------------------------------
+# Engine-level suites (slow — run_tests.sh --paged)
+# ---------------------------------------------------------------------
+
+def _make_engine(**kw):
+    import jax
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    defaults = dict(num_slots=4, max_len=256, prefill_chunk=64,
+                    kv_host_budget_mb=0.0, kv_park_idle_s=0.0,
+                    kv_restore_min_tokens=8)
+    defaults.update(kw)
+    eng = TPUEngine(TINY, params, ByteTokenizer(), **defaults)
+    eng.start()
+    return eng
+
+
+def _collect(eng, rid, sid, msgs, max_tokens=8, **params):
+    async def run():
+        out = []
+        async for ev in eng.generate(
+                rid, sid, msgs,
+                GenerationParams(max_tokens=max_tokens, **GREEDY,
+                                 **params)):
+            out.append(ev)
+        return out
+    return asyncio.run(run())
+
+
+def _text(events):
+    return "".join(e.get("text", "") for e in events
+                   if e["type"] == "token")
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+SYS = ("You are a helpful, careful assistant. Answer briefly and "
+       "precisely, in plain text, without preamble. " * 2)
+MSG1 = [{"role": "user", "content":
+         "this is a reasonably long first turn message for session A"}]
+FILLER = [{"role": "user", "content": "filler session occupying a slot"}]
+
+
+@pytest.mark.slow
+class TestPagedParity:
+    """Paged engine vs the dense control on the same weights/seed:
+    greedy decode must match token for token across fresh admissions,
+    multi-turn prefix reuse, and shared-prefix aliasing — bf16 and
+    KV_QUANT=int8."""
+
+    def _transcript(self, eng):
+        texts = []
+        # Fresh sessions at varied lengths (different block counts).
+        for i in range(3):
+            evs = _collect(eng, f"r{i}", f"s{i}",
+                           [{"role": "user",
+                             "content": "hello world " * (i + 1)}],
+                           max_tokens=12)
+            assert evs[-1]["type"] == "done", evs[-1]
+            texts.append(_text(evs))
+        # Multi-turn (prefix reuse + decode-growth truncate).
+        evs = _collect(eng, "rmt", "s0",
+                       [{"role": "user", "content": "hello world "},
+                        {"role": "assistant", "content": texts[0]},
+                        {"role": "user", "content": "more"}],
+                       max_tokens=10)
+        assert evs[-1]["type"] == "done", evs[-1]
+        texts.append(_text(evs))
+        # Shared system prefix across two new sessions (alias path on
+        # paged, prefix-copy on dense).
+        for sid in ("pa", "pb"):
+            evs = _collect(eng, f"rp-{sid}", sid,
+                           [{"role": "system", "content": SYS},
+                            {"role": "user", "content": f"hi {sid}"}],
+                           max_tokens=10)
+            assert evs[-1]["type"] == "done", evs[-1]
+            texts.append(_text(evs))
+        return texts
+
+    def test_bf16_token_parity(self):
+        dense = _make_engine()
+        try:
+            want = self._transcript(dense)
+        finally:
+            dense.shutdown()
+        paged = _make_engine(kv_layout="paged", kv_block_size=16)
+        try:
+            got = self._transcript(paged)
+            assert got == want
+            st = paged.get_stats()
+            assert st["kv_layout"] == "paged"
+            alloc = paged._kv_blocks
+            # The shared-prefix sessions aliased (zero row copies for
+            # the full blocks; at most one COW block-copy per aliased
+            # admission).
+            assert alloc.alias_events >= 1
+            assert alloc.stats()["aliased"] >= 1
+            # The dense prefix-copy program must never have compiled:
+            # aliasing IS the paged stamp (zero KV row copies beyond
+            # the single COW tail block).
+            assert not any(isinstance(k, tuple) and k and k[0] == "pcopy"
+                           for k in paged._prefill_fns)
+            alloc.check_leaks()
+        finally:
+            paged.shutdown()
+
+    def test_int8_token_parity(self):
+        dense = _make_engine(kv_quant="int8")
+        try:
+            want = self._transcript(dense)
+        finally:
+            dense.shutdown()
+        paged = _make_engine(kv_layout="paged", kv_block_size=16,
+                             kv_quant="int8")
+        try:
+            got = self._transcript(paged)
+            assert got == want
+            assert paged.cache.k.dtype == np.int8
+            # Per-block-row scales: pool layout [L, P, G].
+            assert paged.cache.k_scale.shape[1] == \
+                paged.kv_pool_blocks * paged.kv_block_size
+            paged._kv_blocks.check_leaks()
+        finally:
+            paged.shutdown()
+
+
+@pytest.mark.slow
+class TestPagedAdmission:
+    def test_out_of_blocks_rejects_with_retry_after(self):
+        # Pool holds 4 blocks of 16 = 64 rows; a ~5-block prompt with
+        # reserve can never fit.
+        eng = _make_engine(num_slots=2, kv_layout="paged",
+                           kv_block_size=16, kv_pool_blocks=4,
+                           kv_reserve_policy="none")
+        try:
+            evs = _collect(eng, "big", "B",
+                           [{"role": "user", "content": "x" * 150}],
+                           max_tokens=8)
+            err = evs[-1]
+            assert err["type"] == "error", err
+            assert err["code"] == "kv_blocks_exhausted"
+            assert err["retry_after"] > 0
+            alloc = eng._kv_blocks
+            alloc.check_leaks()
+            # The shed freed everything it took (slot released).
+            assert _wait(lambda: alloc.in_use() == 0)
+            # The engine survives and serves a prompt that fits.
+            ok = _collect(eng, "ok", "C",
+                          [{"role": "user", "content": "hi"}],
+                          max_tokens=4)
+            assert ok[-1]["type"] == "done"
+        finally:
+            eng.shutdown()
+
+    def test_reserve_policy_max_tokens_blocks_admission(self):
+        # Prompt fits, but max_tokens growth cannot: 'max_tokens'
+        # reserve rejects up front instead of shedding mid-decode.
+        eng = _make_engine(num_slots=2, kv_layout="paged",
+                           kv_block_size=16, kv_pool_blocks=6,
+                           kv_reserve_policy="max_tokens")
+        try:
+            evs = _collect(eng, "r", "R",
+                           [{"role": "user", "content": "hello"}],
+                           max_tokens=200)
+            err = evs[-1]
+            assert err["type"] == "error"
+            assert err["code"] == "kv_blocks_exhausted"
+        finally:
+            eng.shutdown()
+
+
+@pytest.mark.slow
+class TestPagedParkRestore:
+    def test_park_restore_release_zero_leak(self):
+        """Block-granular park/restore with exact byte accounting, and
+        a zero-leak pool after the full cycle."""
+        ctl = _make_engine(kv_layout="paged", kv_block_size=16)
+        eng = _make_engine(num_slots=2, kv_layout="paged",
+                           kv_block_size=16, kv_host_budget_mb=64.0)
+        try:
+            r1c = _text(_collect(ctl, "c1", "A", MSG1))
+            msg2 = MSG1 + [{"role": "assistant", "content": r1c},
+                           {"role": "user", "content": "and more"}]
+            r2c = _text(_collect(ctl, "c2", "A", msg2))
+
+            r1 = _text(_collect(eng, "r1", "A", MSG1))
+            assert r1 == r1c
+            _collect(eng, "rb", "B", FILLER)
+            _collect(eng, "rc", "C", FILLER)  # A evicted -> parked
+            assert _wait(lambda: eng._kv_pool.parked_len("A") > 0), \
+                "eviction never parked session A"
+            # Exact per-BLOCK byte accounting: entry bytes == the
+            # trimmed block rows, never the power-of-two bucket.
+            entry = eng._kv_pool.get("A")
+            rows = blocks_for(entry.kept, 16) * 16
+            row_bytes = (TINY.num_layers * TINY.num_kv_heads
+                         * TINY.head_dim * 2)  # bf16 k or v row
+            assert entry.k.shape[1] == rows
+            assert entry.nbytes == 2 * rows * row_bytes
+            assert eng.slots.lookup("A") is None
+            events = _collect(eng, "r2", "A", msg2)
+            assert events[-1]["type"] == "done"
+            assert eng.get_stats()["kv_host"]["restored_total"] >= 1
+            assert _text(events) == r2c
+            # Full cycle: release everything -> zero blocks leaked.
+            for sid in ("A", "B", "C"):
+                eng.release_session(sid)
+            alloc = eng._kv_blocks
+            assert _wait(lambda: alloc.in_use() == 0), \
+                alloc.stats()
+            alloc.check_leaks()
+        finally:
+            ctl.shutdown()
+            eng.shutdown()
+
+
+@pytest.mark.slow
+class TestPagedRestoreFailure:
+    def test_failed_restore_releases_blocks_before_alias(self):
+        """A failed restore dispatch must free the blocks ensure()
+        allocated BEFORE the admission falls through to the
+        shared-prefix stamp — the alias target must be an empty table
+        (refcount corruption / engine-thread assertion otherwise)."""
+        from fasttalk_tpu.resilience import failpoints as fp
+
+        eng = _make_engine(num_slots=2, kv_layout="paged",
+                           kv_block_size=16, kv_host_budget_mb=64.0)
+        try:
+            r1 = _text(_collect(eng, "r1", "A", MSG1))
+            # B shares A's whole first turn: after the failed restore,
+            # the same admission finds B's resident prefix and takes
+            # the ALIAS path.
+            _collect(eng, "rb", "B", MSG1)
+            _collect(eng, "rc", "C", FILLER)  # evicts A -> parks
+            assert _wait(lambda: eng._kv_pool.parked_len("A") > 0)
+            fp.activate("kv.restore.dispatch=error;count=1")
+            msg2 = MSG1 + [{"role": "assistant", "content": r1},
+                           {"role": "user", "content": "again"}]
+            events = _collect(eng, "r2", "A", msg2)
+            assert events[-1]["type"] == "done", events[-1]
+            assert eng.check_connection()
+            eng._kv_blocks.check_leaks()
+        finally:
+            fp.clear()
+            eng.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not HAVE_TINYCHAT,
+                    reason="tinychat checkpoint not built")
+class TestTrainedPagedAcceptance:
+    """The ISSUE acceptance bar on REAL trained weights, built through
+    the factory (config plumbing included): paged greedy decode matches
+    the dense control token for token — bf16 and int8."""
+
+    def _engine(self, kv_layout, kv_quant="none"):
+        from fasttalk_tpu.engine.factory import build_engine
+        from fasttalk_tpu.utils.config import Config
+
+        cfg = Config(llm_provider="tpu", model_name="tinychat",
+                     model_path=os.path.dirname(CKPT), port=18781,
+                     monitoring_port=18782, enable_agent=False,
+                     max_model_len=1024, default_context_window=1024,
+                     spec_decode="off", kv_layout=kv_layout,
+                     kv_quant=kv_quant)
+        eng = build_engine(cfg)
+        eng.start()
+        return eng
+
+    def _chat(self, eng, rid, messages, max_tokens=32):
+        evs = _collect(eng, rid, f"s-{rid}", messages,
+                       max_tokens=max_tokens)
+        assert evs[-1]["type"] == "done", evs[-1]
+        return _text(evs), evs[-1]["finish_reason"]
+
+    PROMPTS = {
+        "sky": [{"role": "user", "content": "what color is the sky?"}],
+        "name": [{"role": "user", "content": "my name is Ada."},
+                 {"role": "assistant",
+                  "content": "Nice to meet you, Ada!"},
+                 {"role": "user", "content": "what is my name?"}],
+    }
+
+    @pytest.mark.parametrize("kv_quant", ["none", "int8"])
+    def test_greedy_token_for_token_match(self, kv_quant):
+        ctl = self._engine("dense", kv_quant)
+        try:
+            want = {rid: self._chat(ctl, f"c-{rid}", msgs)
+                    for rid, msgs in self.PROMPTS.items()}
+        finally:
+            ctl.shutdown()
+        paged = self._engine("paged", kv_quant)
+        try:
+            assert paged.get_model_info()["kv_layout"] == "paged"
+            for rid, msgs in self.PROMPTS.items():
+                got = self._chat(paged, f"p-{rid}", msgs)
+                assert got == want[rid], (rid, got, want[rid])
+            paged._kv_blocks.check_leaks()
+        finally:
+            paged.shutdown()

@@ -65,6 +65,52 @@ def test_rejects_unaligned_bucket():
         decode_attend(q, k, v, jnp.array([5], jnp.int32), interpret=True)
 
 
+def test_paged_block_walk_matches_xla_attend():
+    """The paged variant (KV_LAYOUT=paged): logically contiguous
+    attention over physically scattered pool blocks must match the XLA
+    reference on the gathered rows — including lengths straddling
+    block edges (the walk's pruning arithmetic) and table orders that
+    shuffle the pool."""
+    from fasttalk_tpu.ops.pallas_attention import decode_attend_paged
+
+    b, nq, nkv, d, bs, nb = 4, 8, 2, 32, 16, 8
+    pool_blocks = 40
+    rng = np.random.default_rng(0)
+    # Distinct, shuffled pool blocks per slot: the physical layout has
+    # nothing to do with logical position order.
+    perm = rng.permutation(pool_blocks)[:b * nb]
+    tables = jnp.asarray(perm.reshape(b, nb).astype(np.int32))
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(kq, (b, nq, d), jnp.float32)
+    pool_k = jax.random.normal(kk, (pool_blocks * bs, nkv, d),
+                               jnp.float32)
+    pool_v = jax.random.normal(kv, (pool_blocks * bs, nkv, d),
+                               jnp.float32)
+    lengths = jnp.array([1, 16, 17, 128], jnp.int32)
+    out = decode_attend_paged(q, pool_k, pool_v, lengths, tables,
+                              block_size=bs, interpret=True)
+    # Reference: gather each slot's rows into logical order, run the
+    # dense XLA path.
+    flat = (np.asarray(tables)[:, :, None] * bs
+            + np.arange(bs)[None, None, :]).reshape(b, nb * bs)
+    k_ref = jnp.asarray(np.asarray(pool_k)[flat])
+    v_ref = jnp.asarray(np.asarray(pool_v)[flat])
+    ref = attend(q[:, None], k_ref, v_ref, (lengths - 1)[:, None])[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_rejects_unaligned_pool():
+    from fasttalk_tpu.ops.pallas_attention import decode_attend_paged
+
+    q = jnp.zeros((1, 4, 32))
+    k = v = jnp.zeros((100, 2, 32))
+    with pytest.raises(ValueError, match="not divisible"):
+        decode_attend_paged(q, k, v, jnp.array([5], jnp.int32),
+                            jnp.zeros((1, 4), jnp.int32),
+                            block_size=16, interpret=True)
+
+
 def test_engine_pallas_unaligned_fallback_bucket():
     """Off-granule max_len (600): the engine rounds the cache up to the
     512-granule (1024) so every kv bucket — including the fallback
