@@ -17,6 +17,11 @@ the real WS server vs a single replica with the same per-replica slot
 count — aggregate tok/s measures what scaling out buys — then kills the
 most-loaded replica mid-stream and reports failover-resume latency
 (every affected stream must see a ``resumed`` frame, never an error).
+It then runs the session-fabric pair: (1) drain-migrate vs
+drain-release follow-up TTFT on long parked sessions (cross-replica KV
+migration must beat re-prefill), and (2) a rolling restart of N
+replicas under live streams (drain → kill → restart each in turn) with
+zero client-visible error frames — only ``resumed`` events.
 
 ``BENCH_MODE=longctx`` runs the quantized-KV capacity scenario
 (docs/KVCACHE.md "Quantized tier"): long-context sessions parked into
@@ -1017,6 +1022,246 @@ def bench_fleet(replicas: int, sessions: int, slots: int) -> dict:
             "p50_ttft_speedup": ttft_speedup}
 
 
+# ---- fleet fabric: migration-vs-reprefill + rolling restart --------
+
+def _fleet_fabric_cfg(slots: int):
+    """Two-replica fabric phases share one engine config: KV host pool
+    on, fast idle parks, long context for meaningful prefill."""
+    from fasttalk_tpu.utils.config import Config
+
+    return Config(llm_provider="tpu", model_name=MODEL,
+                  decode_slots=slots, max_model_len=2048,
+                  default_context_window=2048, prefill_chunk=512,
+                  dtype="bfloat16", port=PORT,
+                  monitoring_port=PORT + 1, enable_agent=False,
+                  kv_host_budget_mb=256.0, kv_park_idle_s=0.2,
+                  kv_restore_min_tokens=32,
+                  quantize=os.environ.get("BENCH_QUANTIZE", "int8"))
+
+
+async def _fleet_migration_phase(cfg, migrate_on: bool,
+                                 sessions: int) -> dict:
+    """One side of the migration-vs-reprefill comparison, in THIS
+    process: N long-context sessions run their first turn on replica 0
+    and idle-park there; replica 0 is then drained (rolling-restart
+    shape) and every follow-up turn is measured on replica 1. With
+    migration ON the drain moves the parked KV, so follow-ups RESTORE;
+    OFF reproduces the pre-fabric behaviour (drain releases, follow-ups
+    re-prefill the transcript). Follow-up TTFT p50 is the headline."""
+    from fasttalk_tpu.engine.engine import GenerationParams
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.router import FleetRouter, ReplicaHandle
+
+    engines = []
+    for i in range(2):
+        t0 = time.monotonic()
+        eng = build_engine(cfg)
+        eng.warmup(cfg.warmup)
+        engines.append(eng)
+        log(f"  replica {i} built+warmed in "
+            f"{time.monotonic() - t0:.1f}s")
+    handles = [ReplicaHandle(f"inproc-{i}", e)
+               for i, e in enumerate(engines)]
+    router = FleetRouter(handles, probe_interval_s=1.0,
+                         migrate=migrate_on, migrate_timeout_s=60.0)
+    router.start()
+    long_prompt = " ".join(f"[{i}] {PROMPT}" for i in range(6))
+    greedy = dict(temperature=0.0, top_k=1)
+
+    async def turn(rid, sid, messages, max_tokens=24):
+        t0 = time.monotonic()
+        ttft = None
+        text = []
+        async for ev in router.generate(
+                rid, sid, messages,
+                GenerationParams(max_tokens=max_tokens,
+                                 ignore_eos=IGNORE_EOS, **greedy)):
+            if ev["type"] == "token":
+                if ttft is None:
+                    ttft = (time.monotonic() - t0) * 1000.0
+                text.append(ev.get("text", ""))
+            elif ev["type"] == "error":
+                raise RuntimeError(f"bench turn failed: {ev}")
+        return ttft or 0.0, "".join(text)
+
+    # First turns, all pinned to replica 0 (the one we will drain).
+    replies = {}
+    for i in range(sessions):
+        sid = f"mig-{i}"
+        router.affinity.set(sid, "inproc-0")
+        _, replies[sid] = await turn(
+            f"t1-{i}", sid,
+            [{"role": "user", "content": long_prompt}])
+    # Wait for the idle parks (KV_PARK_IDLE_S=0.2 + the 1 Hz tick).
+    deadline = time.monotonic() + 30
+    pool = engines[0]._kv_pool
+    while time.monotonic() < deadline and any(
+            pool.parked_len(f"mig-{i}") == 0 for i in range(sessions)):
+        await asyncio.sleep(0.05)
+    parked = sum(1 for i in range(sessions)
+                 if pool.parked_len(f"mig-{i}") > 0)
+    summary = await asyncio.to_thread(router.drain_replica, "inproc-0")
+    log(f"  drained inproc-0: parked={parked} "
+        f"migrated_kv={summary['migrated_kv']} "
+        f"released={summary['released']}")
+    # Follow-up turns: placement now lands on replica 1.
+    ttfts = []
+    for i in range(sessions):
+        sid = f"mig-{i}"
+        msgs = [{"role": "user", "content": long_prompt},
+                {"role": "assistant", "content": replies[sid]},
+                {"role": "user", "content": "and a short follow-up"}]
+        ttft, _ = await turn(f"t2-{i}", sid, msgs, max_tokens=8)
+        ttfts.append(ttft)
+    ttfts.sort()
+    restored = engines[1].get_stats()["kv_host"]["restored_total"]
+    return {
+        "migrate": migrate_on,
+        "sessions": sessions,
+        "parked_before_drain": parked,
+        "migrated_kv": summary["migrated_kv"],
+        "released": summary["released"],
+        "followups_restored": restored,
+        "followup_ttft_ms": {
+            "p50": round(statistics.median(ttfts), 1),
+            "max": round(ttfts[-1], 1),
+        },
+        "migration_policy": router.kv_policy.stats(),
+    }
+    # Deliberately no engine shutdown (see _fleet_phase note); the
+    # child prints its JSON and hard-exits.
+
+
+async def _fleet_rolling_phase(cfg, n_replicas: int,
+                               sessions: int) -> dict:
+    """The rolling-restart drill, in THIS process: long streams run
+    across the fleet while every replica in turn is drained, KILLED
+    mid-stream, and REPLACED by a pre-warmed successor through the
+    elastic membership hooks (the k8s rolling-update shape: the new
+    pod joins, the old one never comes back). Acceptance: zero
+    client-visible error frames — affected streams see ``resumed``
+    events and finish normally."""
+    from fasttalk_tpu.engine.engine import GenerationParams
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.router import FleetRouter, ReplicaHandle
+
+    engines = {}
+    spares = []
+    for i in range(n_replicas * 2):  # fleet + one successor each
+        t0 = time.monotonic()
+        eng = build_engine(cfg)
+        eng.warmup(cfg.warmup)
+        log(f"  engine {i} built+warmed in "
+            f"{time.monotonic() - t0:.1f}s")
+        if i < n_replicas:
+            engines[f"inproc-{i}"] = eng
+        else:
+            eng.start()  # successors boot warm, ready to join
+            spares.append(eng)
+    handles = [ReplicaHandle(rid, e, dead_probes=1)
+               for rid, e in engines.items()]
+    router = FleetRouter(handles, probe_interval_s=0,
+                         failover_retries=n_replicas)
+    router.start()
+    n_streams = n_replicas * 2
+    frames = [[] for _ in range(n_streams)]
+    greedy = dict(temperature=0.0, top_k=1)
+
+    async def stream(i):
+        async for ev in router.generate(
+                f"roll-{i}", f"roll-s{i}",
+                [{"role": "user", "content": f"[{i}] {PROMPT}"}],
+                GenerationParams(max_tokens=1500, ignore_eos=IGNORE_EOS,
+                                 **greedy)):
+            frames[i].append(ev)
+
+    tasks = [asyncio.create_task(stream(i)) for i in range(n_streams)]
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if all(any(e["type"] == "token" for e in f) for f in frames):
+            break
+        await asyncio.sleep(0.02)
+    rounds = []
+    for i in range(n_replicas):
+        rid = f"inproc-{i}"
+        t0 = time.monotonic()
+        await asyncio.to_thread(router.drain_replica, rid)
+        await asyncio.to_thread(engines[rid].shutdown)  # hard kill
+        router.probe_once()  # dead within one probe (dead_probes=1)
+        await asyncio.sleep(0.3)  # let affected streams resume
+        successor = ReplicaHandle(f"{rid}-new", spares[i],
+                                  dead_probes=1)
+        successor.probe_now()
+        router.add_replica(successor)
+        router.remove_replica(rid)
+        rounds.append({
+            "replica": rid, "successor": successor.replica_id,
+            "round_s": round(time.monotonic() - t0, 2),
+            "successor_state": successor.state,
+        })
+        log(f"  rolled {rid} -> {successor.replica_id} "
+            f"({successor.state}) in {rounds[-1]['round_s']}s")
+    await asyncio.gather(*tasks)
+    errors = sum(1 for f in frames
+                 for e in f if e["type"] == "error")
+    resumed = sum(1 for f in frames
+                  for e in f if e["type"] == "resumed")
+    completed = sum(1 for f in frames if f and f[-1]["type"] == "done")
+    return {
+        "replicas": n_replicas,
+        "streams": n_streams,
+        "rounds": rounds,
+        "error_frames": errors,
+        "resumed_events": resumed,
+        "completed": completed,
+        "migrations": router.fleet_stats()["counters"]["migrations"],
+    }
+
+
+def _fleet_fabric_subprocess(env_key: str, env_val: str) -> dict:
+    """Run one fabric phase in a child process (fresh XLA state — the
+    same isolation discipline as every other multi-engine bench)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env[env_key] = env_val
+    env["TPU_COMPILE_CACHE"] = "off"
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"fleet fabric phase {env_key}={env_val} "
+                           f"exited {proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_fleet_fabric(replicas: int, sessions: int) -> dict:
+    """The fabric acceptance pair (docs/ROUTER.md): (1) drain-migrate
+    vs drain-release follow-up TTFT on long sessions — migration must
+    beat re-prefill; (2) a rolling restart of N replicas with zero
+    client-visible error frames."""
+    log("--- fabric 1/3: drain + follow-up, migration ON ---")
+    mig = _fleet_fabric_subprocess("BENCH_FLEET_MIGRATE", "on")
+    log("--- fabric 2/3: drain + follow-up, migration OFF "
+        "(re-prefill) ---")
+    pre = _fleet_fabric_subprocess("BENCH_FLEET_MIGRATE", "off")
+    speedup = None
+    if mig["followup_ttft_ms"]["p50"]:
+        speedup = round(pre["followup_ttft_ms"]["p50"]
+                        / mig["followup_ttft_ms"]["p50"], 2)
+    log(f"  follow-up TTFT p50: migrate "
+        f"{mig['followup_ttft_ms']['p50']} ms vs re-prefill "
+        f"{pre['followup_ttft_ms']['p50']} ms ({speedup}x)")
+    log(f"--- fabric 3/3: rolling restart of {replicas} replicas ---")
+    roll = _fleet_fabric_subprocess("BENCH_FLEET_ROLLING",
+                                    str(replicas))
+    log(f"  rolling restart: {roll['error_frames']} error frames, "
+        f"{roll['resumed_events']} resumed, "
+        f"{roll['completed']}/{roll['streams']} streams completed")
+    return {"migrate": mig, "reprefill": pre,
+            "followup_ttft_speedup": speedup,
+            "rolling_restart": roll}
+
+
 # ---------------- overload mode (admission control) ----------------
 
 async def bench_overload(cfg) -> dict:
@@ -1774,6 +2019,23 @@ def main() -> None:
         slots = int(os.environ.get("BENCH_FLEET_SLOTS",
                                    str(max(1, sessions // replicas))))
         max_tokens = int(os.environ.get("BENCH_FLEET_MAX_TOKENS", "32"))
+        if os.environ.get("BENCH_FLEET_MIGRATE"):
+            # Child: one side of the migration-vs-reprefill pair.
+            on = os.environ["BENCH_FLEET_MIGRATE"] == "on"
+            phase = asyncio.run(_fleet_migration_phase(
+                _fleet_fabric_cfg(slots), on,
+                int(os.environ.get("BENCH_FLEET_MIG_SESSIONS", "4"))))
+            print(json.dumps(phase), flush=True)
+            sys.stdout.flush()
+            os._exit(0)
+        if os.environ.get("BENCH_FLEET_ROLLING"):
+            # Child: the rolling-restart drill.
+            n = int(os.environ["BENCH_FLEET_ROLLING"])
+            phase = asyncio.run(_fleet_rolling_phase(
+                _fleet_fabric_cfg(slots), n, sessions))
+            print(json.dumps(phase), flush=True)
+            sys.stdout.flush()
+            os._exit(0)
         if os.environ.get("BENCH_FLEET_PHASE"):
             # Child process: one fleet size, then hard-exit (no XLA
             # multi-engine teardown).
@@ -1792,7 +2054,14 @@ def main() -> None:
             sys.stdout.flush()
             os._exit(0)
         r = bench_fleet(replicas, sessions, slots)
+        fabric = bench_fleet_fabric(replicas, sessions)
+        r["fabric"] = fabric
         fo = (r["fleet"].get("failover") or {})
+        roll = fabric["rolling_restart"]
+        log(f"fabric headline: migration follow-up TTFT "
+            f"{fabric['followup_ttft_speedup']}x vs re-prefill; "
+            f"rolling restart {roll['error_frames']} error frames / "
+            f"{roll['resumed_events']} resumed")
         print(json.dumps({
             "metric": (f"fleet aggregate WS tok/s, {MODEL}: "
                        f"{r['sessions']} sessions on "
@@ -1806,7 +2075,11 @@ def main() -> None:
                        f"streams, {fo.get('errors')} errors, resume "
                        f"p50 "
                        f"{(fo.get('resume_latency_ms') or {}).get('p50')}"
-                       f" ms)"),
+                       f" ms; drain-migrate follow-up TTFT "
+                       f"{fabric['followup_ttft_speedup']}x vs "
+                       f"re-prefill, rolling restart "
+                       f"{roll['error_frames']} error frames / "
+                       f"{roll['resumed_events']} resumed)"),
             "value": r["fleet"]["agg_tps"],
             "unit": "tok/s",
             # For this mode the baseline is the single-replica run:

@@ -69,7 +69,8 @@ import numpy as np
 from fasttalk_tpu.engine.slots import Slot, SlotManager, _lcp
 from fasttalk_tpu.engine.tokenizer import StreamDetokenizer, Tokenizer
 from fasttalk_tpu.kvcache import (HostKVPool, KVOffloader, RestorePolicy,
-                                  kv_env_defaults)
+                                  entry_problem, kv_env_defaults,
+                                  strip_device)
 from fasttalk_tpu.kvcache.blocks import BlockAllocator, blocks_for
 from fasttalk_tpu.kvcache.offload import (kv_bucket, make_kv_restore_fn,
                                           make_kv_slice_fn,
@@ -304,6 +305,35 @@ class EngineBase:
     def pending_requests(self) -> int:
         """Requests still queued or running (drain-progress probe)."""
         return 0
+
+    # ---- fleet fabric: cross-replica KV migration (docs/ROUTER.md).
+    # Engines without a host pool answer None/False — the router then
+    # falls back to re-prefill, which is always safe.
+
+    def export_parked_kv(self, session_id: str):
+        """A session's parked host-KV entry (``ParkedKV``), stripped of
+        device-staged buffers, or None. Peek only: the source keeps
+        owning the entry until the migration confirms and calls
+        :meth:`drop_parked_kv`."""
+        return None
+
+    def import_parked_kv(self, entry) -> bool:
+        """Adopt a migrated entry into this engine's host pool. False
+        when the entry is refused (shape/tier mismatch, pool disabled,
+        over budget) — the refusal leaves the pool untouched."""
+        return False
+
+    def drop_parked_kv(self, session_id: str) -> bool:
+        """Purge one session's parked entry (migration source cleanup;
+        touches ONLY the host pool, so it is safe on a replica whose
+        engine thread is down)."""
+        return False
+
+    def parked_kv_info(self, session_id: str) -> tuple[int, int] | None:
+        """(kept_tokens, nbytes) of a session's parked entry, or None —
+        the cheap metadata the migration policy prices before moving
+        any bytes."""
+        return None
 
 
 class TPUEngine(EngineBase):
@@ -2403,6 +2433,103 @@ class TPUEngine(EngineBase):
                     or self._kv_offload.parking(slot.session_id):
                 continue  # snapshot current or in flight
             self._park_slot(slot, kept)
+
+    # ---- fleet fabric: cross-replica KV migration (docs/ROUTER.md).
+    # All four run off the engine thread (router migrate worker /
+    # serving handlers) and touch ONLY the thread-safe host pool — so
+    # they keep working on a replica whose engine thread has died,
+    # which is exactly when failover migration needs them.
+
+    def export_parked_kv(self, session_id: str):
+        entry = self._kv_pool.get(session_id)
+        return None if entry is None else strip_device(entry)
+
+    def parked_kv_info(self, session_id: str) -> tuple[int, int] | None:
+        entry = self._kv_pool.get(session_id)
+        return None if entry is None else (entry.kept, entry.nbytes)
+
+    def drop_parked_kv(self, session_id: str) -> bool:
+        return self._kv_pool.purge(session_id)
+
+    def import_parked_kv(self, entry) -> bool:
+        """Adopt a migrated entry: validate it against THIS engine's
+        cache geometry (a mixed-tier fleet must refuse, never restore
+        garbage), normalise the stored rows to this engine's layout
+        (paged targets trim to exact block bytes, dense targets pad
+        back to the power-of-two bucket), then insert. The put is
+        atomic — a refusal at any step leaves the pool untouched."""
+        from dataclasses import replace
+
+        if not self._kv_pool.enabled:
+            return False
+        problem = entry_problem(entry)
+        if problem is not None:
+            log.warning(f"refused migrated KV for {entry.session_id}: "
+                        f"{problem}")
+            return False
+        L, _, Kv, H = entry.k.shape
+        if (L, Kv, H) != (self.cfg.num_layers, self.cfg.num_kv_heads,
+                          self.cfg.head_dim):
+            log.warning(
+                f"refused migrated KV for {entry.session_id}: geometry "
+                f"[{L},{Kv},{H}] != engine "
+                f"[{self.cfg.num_layers},{self.cfg.num_kv_heads},"
+                f"{self.cfg.head_dim}]")
+            return False
+        if entry.kept > self.max_len:
+            log.warning(f"refused migrated KV for {entry.session_id}: "
+                        f"kept {entry.kept} exceeds max_len "
+                        f"{self.max_len}")
+            return False
+        if self.kv_quant:
+            if entry.k_scale is None or entry.k.dtype != np.int8 \
+                    or entry.k_scale.shape[2] != self.kv_scale_granule:
+                log.warning(f"refused migrated KV for "
+                            f"{entry.session_id}: not int8 rows with "
+                            f"granule {self.kv_scale_granule} scales")
+                return False
+        elif entry.k_scale is not None or entry.k.dtype == np.int8:
+            log.warning(f"refused migrated KV for {entry.session_id}: "
+                        "quantized entry into a bf16-tier cache")
+            return False
+        elif entry.k.dtype != jnp.dtype(self.dtype):
+            # dtype is part of the tier: a float32 entry in a bf16
+            # cache passes every shape check but fails inside the
+            # jitted restore program — refuse at import, not at
+            # restore time.
+            log.warning(f"refused migrated KV for {entry.session_id}: "
+                        f"row dtype {entry.k.dtype} != engine cache "
+                        f"dtype {jnp.dtype(self.dtype)}")
+            return False
+        bucket = kv_bucket(entry.kept, self.max_len)
+        rows = bucket
+        if self.paged:
+            bucket = max(bucket, self.kv_block_size)
+            rows = (blocks_for(entry.kept, self.kv_block_size)
+                    * self.kv_block_size)
+
+        def fit(arr):
+            if arr is None:
+                return None
+            if arr.shape[1] > rows:
+                return np.ascontiguousarray(arr[:, :rows])
+            return pad_rows(arr, rows)
+
+        k, v = fit(entry.k), fit(entry.v)
+        ks, vs = fit(entry.k_scale), fit(entry.v_scale)
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        if ks is not None:
+            nbytes += int(ks.nbytes) + int(vs.nbytes)
+        entry = replace(strip_device(entry), k=k, v=v, k_scale=ks,
+                        v_scale=vs, bucket=bucket, nbytes=nbytes,
+                        tokens=list(entry.tokens),
+                        parked_at=time.monotonic(),
+                        last_used=time.monotonic())
+        # The session may have been released here before (tombstoned):
+        # it is coming BACK via migration, so it may return — but the
+        # tombstone falls only with a successful insert (a refused
+        # import must keep guarding against stale in-flight parks).
+        return self._kv_pool.put(entry, revive=True)
 
     # ---------------- paged KV tier ----------------
     # (KV_LAYOUT=paged — kvcache/blocks.py; docs/KVCACHE.md "Paged
@@ -4777,6 +4904,12 @@ class TPUEngine(EngineBase):
                 if duration > 0 else 0.0,
                 "ttft_ms": ttft_ms,
                 "prompt_tokens": len(req.prompt_tokens),
+                # Tokens actually PREFILLED (the delta after resident/
+                # restore reuse) — the honest prefill-throughput feed
+                # for the fleet's migration policy; prompt_tokens over
+                # TTFT would overstate throughput by the cache-hit
+                # fraction.
+                "prefill_tokens": req.prefill_tokens,
             },
         })
 

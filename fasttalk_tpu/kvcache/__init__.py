@@ -14,9 +14,10 @@ O(host→device copy + delta prefill).
 - policy.py   — the park/restore decision (copy cost vs prefill cost)
 """
 
-from fasttalk_tpu.kvcache.hostpool import HostKVPool, ParkedKV
+from fasttalk_tpu.kvcache.hostpool import (HostKVPool, ParkedKV,
+                                           entry_problem, strip_device)
 from fasttalk_tpu.kvcache.offload import KVOffloader
 from fasttalk_tpu.kvcache.policy import RestorePolicy, kv_env_defaults
 
 __all__ = ["HostKVPool", "ParkedKV", "KVOffloader", "RestorePolicy",
-           "kv_env_defaults"]
+           "kv_env_defaults", "entry_problem", "strip_device"]

@@ -73,6 +73,62 @@ class ParkedKV:
     v_scale_dev: Any = None
 
 
+def strip_device(entry: ParkedKV) -> ParkedKV:
+    """A copy of an entry safe to hand to another replica (fleet KV
+    migration, router/migrate.py): device-staged buffers (prestage
+    uploads) belong to the SOURCE replica's HBM and must never travel
+    with the host bytes."""
+    from dataclasses import replace
+
+    return replace(entry, k_dev=None, v_dev=None, k_scale_dev=None,
+                   v_scale_dev=None, staged_nbytes=0)
+
+
+def entry_problem(entry: ParkedKV) -> str | None:
+    """Structural validation every migration-import path runs BEFORE
+    touching a pool: a corrupted transfer must be refused with byte
+    accounting intact, never inserted and trusted at restore time.
+    Returns a reason string, or None when the entry is coherent."""
+    import numpy as np
+
+    if entry.kept < 1:
+        return f"kept={entry.kept} (no trusted rows)"
+    if len(entry.tokens) != entry.kept:
+        return (f"token list length {len(entry.tokens)} != kept "
+                f"{entry.kept}")
+    for name in ("k", "v"):
+        arr = getattr(entry, name)
+        if not isinstance(arr, np.ndarray) or arr.ndim != 4:
+            return f"{name} is not a [L, rows, Kv, H] array"
+    if entry.k.shape != entry.v.shape:
+        return f"k/v shape mismatch {entry.k.shape} vs {entry.v.shape}"
+    # Every legitimate entry stores at least `kept` rows (dense parks
+    # the pow2 bucket >= kept; paged trims to whole blocks >= kept) —
+    # a small declared bucket must not let an under-stored entry slip
+    # through to be zero-padded into "trusted" rows at import time.
+    if entry.bucket < entry.kept:
+        return (f"bucket {entry.bucket} cannot cover kept "
+                f"{entry.kept}")
+    if entry.k.shape[1] < entry.kept:
+        return (f"stored rows {entry.k.shape[1]} cannot cover kept "
+                f"{entry.kept}")
+    if (entry.k_scale is None) != (entry.v_scale is None):
+        return "one of k_scale/v_scale missing"
+    if entry.k_scale is not None:
+        for name in ("k_scale", "v_scale"):
+            arr = getattr(entry, name)
+            if not isinstance(arr, np.ndarray) or arr.ndim != 3 \
+                    or arr.shape[:2] != entry.k.shape[:2]:
+                return f"{name} does not match the row arrays"
+    nbytes = int(entry.k.nbytes) + int(entry.v.nbytes)
+    if entry.k_scale is not None:
+        nbytes += int(entry.k_scale.nbytes) + int(entry.v_scale.nbytes)
+    if entry.nbytes != nbytes:
+        return (f"declared nbytes {entry.nbytes} != actual array "
+                f"bytes {nbytes}")
+    return None
+
+
 class HostKVPool:
     """LRU + TTL + budget-bounded session_id → ParkedKV map."""
 
@@ -133,15 +189,22 @@ class HostKVPool:
 
     # ---------------- write side ----------------
 
-    def put(self, entry: ParkedKV) -> bool:
+    def put(self, entry: ParkedKV, *, revive: bool = False) -> bool:
         """Insert (or replace) a session's parked entry, evicting LRU
         entries while over budget. Returns False when the entry alone
         exceeds the whole budget (emits a ``kv_pressure`` event — the
-        operator sized the pool below one session's history)."""
+        operator sized the pool below one session's history).
+
+        ``revive=True`` re-admits a released (tombstoned) session —
+        the migration import path, where the session is coming BACK.
+        The tombstone is cleared only together with a successful
+        insert: a refused import must leave the tombstone standing so
+        a stale park snapshot still in flight cannot re-insert the
+        dead session either."""
         if not self.enabled:
             return False
         with self._lock:
-            if entry.session_id in self._dead_set:
+            if not revive and entry.session_id in self._dead_set:
                 return False  # released while the copy was in flight
         if entry.nbytes > self.budget_bytes:
             self._m_rejected.inc()
@@ -155,6 +218,8 @@ class HostKVPool:
             return False
         evicted = 0
         with self._lock:
+            if revive:
+                self._dead_set.discard(entry.session_id)
             old = self._entries.pop(entry.session_id, None)
             if old is not None:
                 self._bytes -= old.nbytes

@@ -171,10 +171,14 @@ class FlightRecorder:
                 self.trigger(f"slo_page:{ev.attrs.get('cls', '?')}",
                              kind=kind)
         elif kind in ("stall_detected", "watchdog_cancel",
-                      "engine_restart", "router_failover"):
+                      "engine_restart", "router_failover",
+                      "router_partition"):
             # router_failover: a replica died with a stream on it — the
             # evidence (events, traces, per-replica stats) is exactly
             # what the post-mortem needs and is gone minutes later.
+            # router_partition: the probe-death flavour — the replica
+            # may be healthy but unreachable; the bundle captures the
+            # router's view before recovery overwrites it.
             self.trigger(kind, kind=kind)
         elif kind == "recompile":
             now = self._clock()

@@ -120,6 +120,18 @@ CATALOG: dict[str, str] = {
         "remote backend HTTP connect, pre-first-byte (vllm/ollama)",
     "remote.stream":
         "remote backend response stream, per chunk",
+    "router.probe":
+        "router health/load probe of one replica (error = the probe "
+        "cannot reach it: a network partition as the router sees it)",
+    "router.place":
+        "router placement decision for one request (error sheds the "
+        "placement the way a fully-partitioned fleet would)",
+    "router.migrate_send":
+        "cross-replica KV migration, source-side export of the parked "
+        "entry",
+    "router.migrate_recv":
+        "cross-replica KV migration, target-side import (corrupt = "
+        "the transferred entry fails validation and is refused)",
     "serving.ws.send":
         "WebSocket frame send to a client",
     "spmd.send":

@@ -1,3 +1,6 @@
+from fasttalk_tpu.router.elastic import ElasticScaler
+from fasttalk_tpu.router.migrate import (deserialize_parked,
+                                         serialize_parked, transfer)
 from fasttalk_tpu.router.policy import AffinityMap, PlacementPolicy
 from fasttalk_tpu.router.replica import (RemoteReplicaHandle,
                                          ReplicaHandle)
@@ -6,4 +9,6 @@ from fasttalk_tpu.router.router import FleetRouter, build_fleet
 __all__ = [
     "AffinityMap", "PlacementPolicy", "ReplicaHandle",
     "RemoteReplicaHandle", "FleetRouter", "build_fleet",
+    "ElasticScaler", "serialize_parked", "deserialize_parked",
+    "transfer",
 ]

@@ -1,0 +1,189 @@
+"""Cross-replica KV migration: move a parked session's host-KV entry
+between replica pools so failover, drain and rebalancing RESTORE
+instead of re-prefilling the transcript (docs/ROUTER.md).
+
+The channel is deliberately dumb: one parked entry (block-trimmed
+int8/bf16 rows + scales + token ids, exactly what ``HostKVPool``
+holds) moves from the source replica's pool to the target's. In-proc
+replicas hand the numpy arrays over directly; remote replicas ship the
+``serialize_parked`` wire form through the serving port's
+``/kv/parked/{session_id}`` endpoints. Either way the transfer is
+bracketed by the ``router.migrate_send`` / ``router.migrate_recv``
+failpoints and validated before insertion, so the chaos suite can
+prove the two invariants the fabric promises:
+
+- a migration that fails (or corrupts) mid-transfer leaves byte
+  accounting EXACT on both pools — the source entry is untouched until
+  the target confirmed the import, and the target's ``put`` is atomic;
+- a hung migration never wedges the caller — the router runs the
+  transfer on a disposable worker thread bounded by
+  ``ROUTER_MIGRATE_TIMEOUT_S`` and falls back to re-prefill.
+
+Wire format: a JSON header (length-prefixed) carrying the entry
+metadata + dtype/shape descriptors, followed by the raw array bytes in
+declaration order. No pickle anywhere — the import side rebuilds the
+arrays from the descriptors and refuses anything malformed.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import replace
+from typing import Any
+
+from fasttalk_tpu.kvcache.hostpool import (ParkedKV, entry_problem,
+                                           strip_device)
+from fasttalk_tpu.utils.logger import get_logger
+
+__all__ = ["serialize_parked", "deserialize_parked", "transfer",
+           "entry_problem", "strip_device"]
+
+log = get_logger("router.migrate")
+
+_MAGIC = b"FTKV1"
+_ARRAYS = ("k", "v", "k_scale", "v_scale")
+
+
+# ---------------- wire form (remote replicas) ----------------
+
+def serialize_parked(entry: ParkedKV) -> bytes:
+    """Entry → bytes: MAGIC + u32 header length + JSON header + raw
+    array bytes in ``_ARRAYS`` order. dtype travels by name (numpy
+    extension dtypes like bfloat16 round-trip through ml_dtypes, which
+    the jax stack always has)."""
+    import numpy as np
+
+    header: dict[str, Any] = {
+        "session_id": entry.session_id,
+        "tokens": list(entry.tokens),
+        "kept": entry.kept,
+        "bucket": entry.bucket,
+        "nbytes": entry.nbytes,
+        "arrays": {},
+    }
+    blobs: list[bytes] = []
+    for name in _ARRAYS:
+        arr = getattr(entry, name)
+        if arr is None:
+            continue
+        arr = np.ascontiguousarray(arr)
+        header["arrays"][name] = {"dtype": arr.dtype.name,
+                                  "shape": list(arr.shape)}
+        blobs.append(arr.tobytes())
+    hdr = json.dumps(header).encode()
+    return b"".join([_MAGIC, struct.pack("<I", len(hdr)), hdr, *blobs])
+
+
+def deserialize_parked(data: bytes) -> ParkedKV:
+    """bytes → entry. Raises ValueError on anything malformed —
+    callers treat that exactly like a corrupt transfer (refused,
+    accounting untouched)."""
+    import numpy as np
+
+    if len(data) < len(_MAGIC) + 4 or not data.startswith(_MAGIC):
+        raise ValueError("not a serialized parked-KV entry")
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack_from("<I", data, off)
+    off += 4
+    try:
+        header = json.loads(data[off:off + hlen].decode())
+    except Exception as e:
+        raise ValueError(f"bad migration header: {e}") from e
+    off += hlen
+    arrays: dict[str, Any] = {}
+    for name in _ARRAYS:
+        arr_descs = header.get("arrays")
+        desc = arr_descs.get(name) if isinstance(arr_descs, dict) \
+            else None
+        if desc is None:
+            arrays[name] = None
+            continue
+        if not isinstance(desc, dict) or "dtype" not in desc \
+                or "shape" not in desc:
+            raise ValueError(f"malformed descriptor for array {name}")
+        try:
+            dtype = np.dtype(desc["dtype"])
+        except TypeError:
+            # bfloat16 and friends live in ml_dtypes, not core numpy.
+            # Anything neither library knows is a malformed header and
+            # must keep the ValueError contract (clean 400 refusal),
+            # not leak an AttributeError into the handler.
+            import ml_dtypes
+
+            try:
+                dtype = np.dtype(getattr(ml_dtypes, desc["dtype"]))
+            except (AttributeError, TypeError) as e:
+                raise ValueError(
+                    f"unknown dtype {desc['dtype']!r} in migration "
+                    "header") from e
+        shape = tuple(int(s) for s in desc["shape"])
+        n = int(np.prod(shape)) * dtype.itemsize
+        if off + n > len(data):
+            raise ValueError(f"truncated array {name}")
+        arrays[name] = np.frombuffer(
+            data[off:off + n], dtype=dtype).reshape(shape).copy()
+        off += n
+    try:
+        entry = ParkedKV(
+            session_id=str(header["session_id"]),
+            tokens=[int(t) for t in header["tokens"]],
+            kept=int(header["kept"]), bucket=int(header["bucket"]),
+            k=arrays["k"], v=arrays["v"], k_scale=arrays["k_scale"],
+            v_scale=arrays["v_scale"], nbytes=int(header["nbytes"]))
+    except (KeyError, TypeError) as e:
+        raise ValueError(f"malformed migration header: {e}") from e
+    problem = entry_problem(entry)
+    if problem is not None:
+        raise ValueError(f"invalid migrated entry: {problem}")
+    return entry
+
+
+# ---------------- the transfer itself ----------------
+
+def transfer(src, dst, session_id: str) -> tuple[bool, int, str, int]:
+    """Move one parked session's entry ``src`` → ``dst`` (replica
+    handles). Returns ``(ok, nbytes, reason, kept)`` — ``kept`` is the
+    moved entry's trusted-token count (0 on failure), the identity the
+    router's abandoned-worker undo checks before dropping anything. On
+    any failure the source entry is left in place (the caller decides
+    whether drain semantics then release it) and the target pool is
+    untouched.
+
+    Runs on the router's disposable migrate worker thread — both the
+    export (remote: an HTTP GET) and the import (remote: an HTTP POST)
+    may block; the router bounds the whole call with its timeout."""
+    from fasttalk_tpu.resilience import failpoints as _fp
+
+    try:
+        if _fp.enabled:
+            # Chaos seam, source side: a dead/partitioned source looks
+            # like an export failure — the fabric must fall back to
+            # re-prefill with both pools' accounting intact.
+            _fp.fire("router.migrate_send", session_id=session_id,
+                     replica=src.replica_id)
+        entry = src.export_parked(session_id)
+    except Exception as e:
+        return False, 0, f"export failed: {e}", 0
+    if entry is None:
+        return False, 0, "no parked entry", 0
+    try:
+        if _fp.enabled:
+            corrupt = _fp.fire("router.migrate_recv",
+                               session_id=session_id,
+                               replica=dst.replica_id)
+            if corrupt == "corrupt":
+                # In-proc corruption: clip the token list so the
+                # import validation refuses the entry (the wire form
+                # corrupts the same way — a truncated body fails
+                # deserialize).
+                entry = replace(entry, tokens=entry.tokens[:-1])
+        problem = entry_problem(entry)
+        if problem is not None:
+            return False, 0, f"corrupt entry refused: {problem}", 0
+        ok = dst.import_parked(entry)
+    except Exception as e:
+        return False, 0, f"import failed: {e}", 0
+    if not ok:
+        return False, 0, "target refused the entry", 0
+    return True, entry.nbytes, "ok", entry.kept

@@ -15,6 +15,17 @@ penalties, replica.py) is compared and the minimum wins; ties break by
 rotation so equal replicas share arrivals instead of all landing on
 index 0.
 
+Shared-prefix affinity (the fleet mirror of the engine's block-
+aliasing/prefix-stamp tier, docs/KVCACHE.md): sessions carrying the
+same system prompt CO-LOCATE when it is nearly free — the placement
+remembers which replica last served each ``prefix_key`` and prefers it
+while its load score is within ``PREFIX_SLACK`` of the best candidate.
+On the preferred replica the new session's prompt prefix is already
+device-resident (alias stamp: zero row copies on the paged tier), so a
+small amount of extra queue is cheaper than a cold prefill elsewhere;
+past the slack, load wins — prefix affinity must never pile a hot
+tenant onto one replica.
+
 Thread-safety: placement runs on the asyncio loop while the probe
 thread reads for pruning — one lock, a few dict ops.
 """
@@ -23,6 +34,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Iterable
 
 from fasttalk_tpu.router.replica import ReplicaHandle
@@ -96,19 +108,43 @@ class AffinityMap:
 
 
 class PlacementPolicy:
-    """Affinity-then-least-loaded placement over a replica list."""
+    """Affinity-then-prefix-then-least-loaded placement."""
 
-    def __init__(self, affinity: AffinityMap):
+    # How much extra load score a prefix co-location may cost: one
+    # queued request's worth. Past this, spreading wins — a hot tenant
+    # must not pile onto one replica just to share a system prompt.
+    PREFIX_SLACK = 1.0
+    # prefix_key → replica_id memory is a bounded LRU: tenant count is
+    # unbounded, the placement hint is best-effort.
+    PREFIX_CAP = 512
+
+    def __init__(self, affinity: AffinityMap,
+                 prefix_affinity: bool = True,
+                 on_prefix_hit=None):
         self.affinity = affinity
+        self.prefix_affinity = prefix_affinity
+        self._on_prefix_hit = on_prefix_hit
+        self._prefix: "OrderedDict[str, str]" = OrderedDict()
         self._rr = 0  # tie-break rotation counter
         self._lock = threading.Lock()
 
+    def drop_replica(self, replica_id: str) -> None:
+        """Forget prefix hints pointing at a dead/drained/removed
+        replica (the affinity map's drop_replica is separate)."""
+        with self._lock:
+            for key in [k for k, rid in self._prefix.items()
+                        if rid == replica_id]:
+                del self._prefix[key]
+
     def place(self, session_id: str, replicas: list[ReplicaHandle],
               exclude: frozenset[str] | set[str] = frozenset(),
+              prefix_key: str | None = None,
               ) -> tuple[ReplicaHandle | None, bool]:
         """Pick a replica for one request. Returns (handle, affine) —
         ``affine`` True when the session's pinned replica served (KV
-        reuse preserved); None when no replica is placeable."""
+        reuse preserved); None when no replica is placeable.
+        ``prefix_key`` identifies the request's shared prefix (system
+        prompt hash) for co-location."""
         by_id = {h.replica_id: h for h in replicas}
         pinned = self.affinity.get(session_id)
         if pinned is not None and pinned not in exclude:
@@ -122,9 +158,28 @@ class PlacementPolicy:
             return None, False
         scored = [(h.load_score(), h) for h in candidates]
         best = min(s for s, _ in scored)
-        tied = [h for s, h in scored if s == best]
-        with self._lock:
-            h = tied[self._rr % len(tied)]
-            self._rr += 1
-        self.affinity.set(session_id, h.replica_id)
-        return h, False
+        chosen: ReplicaHandle | None = None
+        if self.prefix_affinity and prefix_key is not None:
+            with self._lock:
+                hinted = self._prefix.get(prefix_key)
+            if hinted is not None:
+                for s, h in scored:
+                    if h.replica_id == hinted \
+                            and s <= best + self.PREFIX_SLACK:
+                        chosen = h
+                        if self._on_prefix_hit is not None:
+                            self._on_prefix_hit()
+                        break
+        if chosen is None:
+            tied = [h for s, h in scored if s == best]
+            with self._lock:
+                chosen = tied[self._rr % len(tied)]
+                self._rr += 1
+        if self.prefix_affinity and prefix_key is not None:
+            with self._lock:
+                self._prefix[prefix_key] = chosen.replica_id
+                self._prefix.move_to_end(prefix_key)
+                while len(self._prefix) > self.PREFIX_CAP:
+                    self._prefix.popitem(last=False)
+        self.affinity.set(session_id, chosen.replica_id)
+        return chosen, False

@@ -33,6 +33,7 @@ import time
 from typing import Any
 
 from fasttalk_tpu.engine.engine import EngineBase
+from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.utils.logger import get_logger
 
 log = get_logger("router.replica")
@@ -63,6 +64,15 @@ class ReplicaHandle:
         self._lock = threading.Lock()
         self.state = STATE_HEALTHY
         self.draining = False
+        # Why the replica is dead ("probe" = consecutive probe
+        # failures, the network-partition signature; "stream" = a
+        # stream failed with the backend unreachable). None while not
+        # dead. The router emits `router_partition` for probe deaths.
+        self.dead_reason: str | None = None
+        # Last begin_drain/drain_replica failure against this replica
+        # (None = drains clean) — surfaced on GET /fleet so a stuck
+        # drain is visible, not a log line (docs/ROUTER.md).
+        self.drain_error: str | None = None
         self._consec_failures = 0
         self.last_probe: dict[str, Any] = {}
         self.last_probe_at: float | None = None
@@ -78,6 +88,11 @@ class ReplicaHandle:
         """One synchronous health/load probe. Updates ``state`` and
         ``last_probe``; returns the signal dict. Never raises."""
         try:
+            if _fp.enabled:
+                # Chaos seam: `error` here IS a network partition as
+                # the router experiences one — the backend may be
+                # perfectly alive, the router just cannot see it.
+                _fp.fire("router.probe", replica=self.replica_id)
             alive = self.engine.check_connection()
         except Exception:
             alive = False
@@ -90,6 +105,7 @@ class ReplicaHandle:
         with self._lock:
             self._consec_failures = 0
             recovered = self.state == STATE_DEAD
+            self.dead_reason = None
             self.state = (STATE_DEGRADED
                           if signals.get("overload_state")
                           in ("pressured", "shedding")
@@ -132,6 +148,7 @@ class ReplicaHandle:
                     and self._consec_failures >= self.dead_probes)
             if died:
                 self.state = STATE_DEAD
+                self.dead_reason = "probe"
             self.last_probe = {"alive": False, "error": reason}
             self.last_probe_at = self._clock()
         if died:
@@ -152,6 +169,7 @@ class ReplicaHandle:
             self.failovers += 1
             if not alive and self.state != STATE_DEAD:
                 self.state = STATE_DEAD
+                self.dead_reason = "stream"
                 self._consec_failures = self.dead_probes
                 log.warning(f"replica {self.replica_id} marked dead "
                             "(stream failed and backend unreachable)")
@@ -188,12 +206,34 @@ class ReplicaHandle:
         score += _SLO_PENALTY.get(p.get("slo_alert", "ok"), 0.0)
         return score
 
+    # ---------------- KV migration channel (router/migrate.py) ----
+
+    # In-proc replicas hand the parked entry's numpy arrays over
+    # directly through the engine seam; RemoteReplicaHandle overrides
+    # with the /kv/parked HTTP wire form. All four run on the router's
+    # migrate worker thread (never the event loop) and may raise — the
+    # transfer classifies and the router falls back to re-prefill.
+
+    def parked_info(self, session_id: str) -> tuple[int, int] | None:
+        return self.engine.parked_kv_info(session_id)
+
+    def export_parked(self, session_id: str):
+        return self.engine.export_parked_kv(session_id)
+
+    def import_parked(self, entry) -> bool:
+        return bool(self.engine.import_parked_kv(entry))
+
+    def drop_parked(self, session_id: str) -> bool:
+        return bool(self.engine.drop_parked_kv(session_id))
+
     def to_dict(self) -> dict[str, Any]:
         with self._lock:
             return {
                 "replica_id": self.replica_id,
                 "state": self.state,
+                "dead_reason": self.dead_reason,
                 "draining": self.draining,
+                "drain_error": self.drain_error,
                 "inflight": len(self.inflight),
                 "placements": self.placements,
                 "failovers": self.failovers,
@@ -232,6 +272,10 @@ class RemoteReplicaHandle(ReplicaHandle):
         import requests
 
         try:
+            if _fp.enabled:
+                # Chaos seam: the remote flavour of a partition — the
+                # health GET never arrives.
+                _fp.fire("router.probe", replica=self.replica_id)
             r = requests.get(f"{self.base_url}/health",
                              timeout=self.probe_timeout_s)
             body = r.json() if r.content else {}
@@ -259,6 +303,7 @@ class RemoteReplicaHandle(ReplicaHandle):
         with self._lock:
             self._consec_failures = 0
             recovered = self.state == STATE_DEAD
+            self.dead_reason = None
             self.state = (STATE_DEGRADED
                           if signals["overload_state"]
                           in ("pressured", "shedding")
@@ -287,8 +332,60 @@ class RemoteReplicaHandle(ReplicaHandle):
             self.failovers += 1
             if self.state != STATE_DEAD:
                 self.state = STATE_DEAD
+                self.dead_reason = "stream"
                 self._consec_failures = self.dead_probes
                 log.warning(f"replica {self.replica_id} marked dead "
                             "(stream failed)")
                 return True
         return False
+
+    # ---------------- KV migration over HTTP ----------------
+    # The serving port's /kv/parked/{session_id} endpoints
+    # (serving/server.py) carry the wire form from router/migrate.py.
+    # Synchronous `requests` by design: these run on the router's
+    # disposable migrate worker thread, which the router bounds with
+    # ROUTER_MIGRATE_TIMEOUT_S — never on the event loop.
+
+    MIGRATE_HTTP_TIMEOUT_S = 30.0
+
+    def parked_info(self, session_id: str) -> tuple[int, int] | None:
+        import requests
+
+        r = requests.get(f"{self.base_url}/kv/parked/{session_id}",
+                         params={"meta": "1"},
+                         timeout=self.probe_timeout_s)
+        if r.status_code != 200:
+            return None
+        body = r.json()
+        return int(body["kept"]), int(body["nbytes"])
+
+    def export_parked(self, session_id: str):
+        import requests
+
+        from fasttalk_tpu.router.migrate import deserialize_parked
+
+        r = requests.get(f"{self.base_url}/kv/parked/{session_id}",
+                         timeout=self.MIGRATE_HTTP_TIMEOUT_S)
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        return deserialize_parked(r.content)
+
+    def import_parked(self, entry) -> bool:
+        import requests
+
+        from fasttalk_tpu.router.migrate import serialize_parked
+
+        r = requests.post(
+            f"{self.base_url}/kv/parked/{entry.session_id}",
+            data=serialize_parked(entry),
+            headers={"Content-Type": "application/octet-stream"},
+            timeout=self.MIGRATE_HTTP_TIMEOUT_S)
+        return r.status_code == 200
+
+    def drop_parked(self, session_id: str) -> bool:
+        import requests
+
+        r = requests.delete(f"{self.base_url}/kv/parked/{session_id}",
+                            timeout=self.probe_timeout_s)
+        return r.status_code == 200

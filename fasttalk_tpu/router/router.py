@@ -26,8 +26,21 @@ What it adds over a bare engine (docs/ROUTER.md):
   healthy replica remains the request sheds with ``retry_after``.
 - **Coordinated drain.** ``drain_replica()`` stops placement to one
   replica, lets its in-flight streams finish, and migrates its idle
-  parked sessions' affinity (their next turn places fresh elsewhere)
-  — the fleet keeps serving through a rolling restart.
+  parked sessions to a survivor — the fleet keeps serving through a
+  rolling restart.
+- **Cross-replica KV migration** (router/migrate.py). Drain, failover
+  and rebalancing move a parked session's host-KV entry to the target
+  replica's pool, so the next turn RESTORES (copy + delta prefill)
+  instead of re-prefilling the transcript. The three-way decision —
+  migrate vs re-prefill vs restore-local — is priced by the
+  kvcache/policy.py EMAs with a migration-bandwidth term; transfers
+  are bounded by ``ROUTER_MIGRATE_TIMEOUT_S`` and fall back to
+  re-prefill on any failure with exact byte accounting on both pools.
+- **Prefix-aware placement + elastic replicas.** Same-system-prompt
+  tenants co-locate while nearly free (policy.py PREFIX_SLACK) to hit
+  the shared-prefix stamp; router/elastic.py scales the fleet up on
+  queue depth / SLO burn and down via drain-then-migrate
+  (client-invisible).
 
 Resume caveat: the survivor re-generates from the transcript, so with
 temperature > 0 the continuation may diverge from what the dead replica
@@ -38,13 +51,17 @@ is by character count of delivered text.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import threading
 import time
 from typing import Any, AsyncGenerator
 
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.kvcache import RestorePolicy, kv_env_defaults
 from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.observability.trace import get_tracer
+import fasttalk_tpu.router.migrate as _migrate
+from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.router.policy import AffinityMap, PlacementPolicy
 from fasttalk_tpu.router.replica import (STATE_DEAD, ReplicaHandle,
                                          RemoteReplicaHandle)
@@ -70,6 +87,9 @@ class FleetRouter(EngineBase):
                  affinity_ttl_s: float = 600.0,
                  failover_retries: int = 2,
                  resume: bool = True,
+                 migrate: bool = True,
+                 migrate_timeout_s: float = 10.0,
+                 prefix_affinity: bool = True,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
@@ -80,9 +100,19 @@ class FleetRouter(EngineBase):
         self.probe_interval_s = probe_interval_s
         self.failover_retries = max(0, failover_retries)
         self.resume_enabled = resume
+        self.migrate_enabled = migrate
+        self.migrate_timeout_s = max(0.05, migrate_timeout_s)
         self._clock = clock
+        # Three-way migrate/re-prefill/restore-local pricing
+        # (kvcache/policy.py): migration bandwidth learned from this
+        # router's own completed transfers, prefill throughput from the
+        # fleet's done-event stats (prompt_tokens / ttft).
+        self.kv_policy = RestorePolicy(
+            min_tokens=int(kv_env_defaults()["min_tokens"]))
         self.affinity = AffinityMap(ttl_s=affinity_ttl_s, clock=clock)
-        self.policy = PlacementPolicy(self.affinity)
+        self.policy = PlacementPolicy(
+            self.affinity, prefix_affinity=prefix_affinity,
+            on_prefix_hit=lambda: self._m_prefix.inc())
         self._routes: dict[str, tuple[str, ReplicaHandle]] = {}
         self._cancelled: set[str] = set()
         self._draining = False
@@ -112,6 +142,35 @@ class FleetRouter(EngineBase):
         self._m_sheds = m.counter(
             "router_sheds_total",
             "requests shed by the router (no placeable replica)")
+        self._m_migrations = m.counter(
+            "router_migrations_total",
+            "parked-KV entries migrated between replicas")
+        self._m_migration_failures = m.counter(
+            "router_migration_failures_total",
+            "cross-replica KV migrations that failed (both pools left "
+            "with exact byte accounting; session falls back to "
+            "re-prefill)")
+        self._m_migration_bytes = m.counter(
+            "router_migration_bytes",
+            "parked-KV bytes moved between replica pools")
+        self._m_migration_ms = m.histogram(
+            "router_migration_ms",
+            "cross-replica KV migration latency (export + transfer + "
+            "import)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000,
+                     4000, 10000))
+        self._m_drain_errors = m.counter(
+            "router_drain_errors_total",
+            "per-replica drain calls that failed (partial drain — "
+            "surfaced on GET /fleet)")
+        self._m_partitions = m.counter(
+            "router_partitions_total",
+            "replicas declared dead by consecutive probe failures "
+            "(the network-partition signature)")
+        self._m_prefix = m.counter(
+            "router_prefix_colocations_total",
+            "placements co-located with their shared-prefix tenant "
+            "replica (prefix-stamp reuse)")
         self._m_replicas.set(len(self.replicas))
 
     # ---------------- lifecycle ----------------
@@ -150,58 +209,271 @@ class FleetRouter(EngineBase):
 
     def begin_drain(self) -> None:
         """Fleet-wide drain (server shutdown): every replica stops
-        admitting; queued and in-flight work finishes."""
+        admitting; queued and in-flight work finishes. A replica whose
+        drain call fails is a PARTIAL drain — not a log line: it emits
+        a ``router_drain_error`` event, bumps the counter, and latches
+        ``drain_error`` on the handle so GET /fleet shows the operator
+        which replica is stuck."""
         self._draining = True
         self._events.emit("router_drain", severity="warning",
                           scope="fleet", replicas=len(self.replicas))
         for h in self.replicas:
             h.draining = True
-            try:
-                h.engine.begin_drain()
-            except Exception as e:
-                log.error(f"replica {h.replica_id} drain error: {e}")
+            self._drain_engine(h)
+
+    def _drain_engine(self, handle: ReplicaHandle) -> bool:
+        """begin_drain one replica's engine, recording failure as
+        visible partial-drain state. Returns True when clean."""
+        handle.drain_error = None
+        try:
+            handle.engine.begin_drain()
+            return True
+        except Exception as e:
+            handle.drain_error = str(e)[:500]
+            self._m_drain_errors.inc()
+            self._events.emit(
+                "router_drain_error", severity="critical",
+                replica=handle.replica_id, error=str(e)[:200])
+            log.error(f"replica {handle.replica_id} drain error: {e}")
+            return False
 
     def drain_replica(self, replica_id: str) -> dict[str, Any]:
         """Coordinated single-replica drain (rolling restart): stop
-        placement here, let in-flight streams finish, and migrate idle
-        sessions — their affinity is dropped (next turn places fresh on
-        a healthy replica) and their parked KV on this replica is
-        released so the pool frees. Sessions with a stream still
-        running here keep their pin until it completes.
+        placement here, let in-flight streams finish, and MIGRATE idle
+        sessions' parked KV to a healthy replica so their next turn
+        restores there instead of re-prefilling (docs/ROUTER.md). When
+        a session has no parked entry, migration is off, the policy
+        prices prefill cheaper, or the transfer fails/hangs, the old
+        behaviour is the fallback: the entry is released and the pin
+        dropped — the next turn places fresh and re-prefills. Sessions
+        with a stream still running here keep their pin until it
+        completes.
 
         Returns a summary dict; raises KeyError for an unknown id."""
         handle = self._handle(replica_id)
         handle.draining = True
-        try:
-            handle.engine.begin_drain()
-        except Exception as e:
-            log.error(f"replica {replica_id} drain error: {e}")
+        self._drain_engine(handle)
         busy_sessions = {sid for sid, h
                          in list(self._routes.values())
                          if h is handle}
-        migrated = self.affinity.drop_replica(replica_id,
-                                              keep=busy_sessions)
-        for sid in migrated:
-            # Idle parked sessions: purge their parked KV on the
-            # draining replica (their next turn re-prefills elsewhere;
-            # keeping the entry would only pin host RAM on a replica
-            # that is going away).
+        moved = self.affinity.drop_replica(replica_id,
+                                           keep=busy_sessions)
+        self.policy.drop_replica(replica_id)
+        migrated_kv = released = 0
+        channel_wedged = False
+        for sid in moved:
+            dst = None if channel_wedged \
+                else self._migrate_target(sid, handle)
+            if dst is not None:
+                status = self._migrate_session(sid, handle, dst)
+                if status == "ok":
+                    # The entry now lives on dst: re-pin the session
+                    # there so its next turn goes straight to its
+                    # restored KV — UNLESS a new turn already placed
+                    # it somewhere during the transfer window (that
+                    # replica holds fresher KV than what just moved;
+                    # the migrated copy ages out by TTL/LRU).
+                    if self.affinity.get(sid) is None:
+                        self.affinity.set(sid, dst.replica_id)
+                    migrated_kv += 1
+                    continue
+                if status == "timeout":
+                    # One hung transfer means the channel (NIC, peer)
+                    # is wedged: N sessions must not each pay the
+                    # full timeout — the drain stays bounded by ONE
+                    # timeout and the rest release immediately.
+                    channel_wedged = True
+            # Fallback: purge the parked KV on the draining replica
+            # (keeping the entry would only pin host RAM on a replica
+            # that is going away); the next turn re-prefills elsewhere.
             try:
                 handle.engine.release_session(sid)
             except Exception:
                 pass
+            released += 1
         self._events.emit("router_drain", severity="warning",
                           scope="replica", replica=replica_id,
-                          migrated_sessions=len(migrated),
-                          busy_sessions=len(busy_sessions))
+                          migrated_sessions=len(moved),
+                          migrated_kv=migrated_kv, released=released,
+                          busy_sessions=len(busy_sessions),
+                          drain_error=handle.drain_error)
         self._update_gauges()
         return {"replica_id": replica_id, "draining": True,
-                "migrated_sessions": len(migrated),
+                "migrated_sessions": len(moved),
+                "migrated_kv": migrated_kv, "released": released,
+                "drain_error": handle.drain_error,
                 "busy_sessions": sorted(busy_sessions)}
+
+    # ---------------- cross-replica KV migration ----------------
+
+    def _migrate_target(self, session_id: str,
+                        src: ReplicaHandle) -> ReplicaHandle | None:
+        """Pick where a parked session's KV should go — or None when
+        migration is off, nothing is parked, or the three-way policy
+        prices re-prefill cheaper than the transfer. Least-loaded
+        available replica wins (no affinity side effects here)."""
+        if not self.migrate_enabled:
+            return None
+        if not self._migration_priced(session_id, src):
+            return None
+        candidates = [h for h in self.replicas
+                      if h is not src and h.available()]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda h: h.load_score())
+
+    def _migration_priced(self, session_id: str,
+                          src: ReplicaHandle) -> bool:
+        """True when ``src`` holds a parked entry for the session AND
+        the three-way policy prices moving it cheaper than
+        re-prefilling — the single gate both drain and failover
+        migration run."""
+        try:
+            info = src.parked_info(session_id)
+        except Exception:
+            return False
+        if info is None:
+            return False
+        kept, nbytes = info
+        return self.kv_policy.decide(kept, nbytes, local=False,
+                                     migratable=True) == "migrate"
+
+    def _migrate_session(self, session_id: str, src: ReplicaHandle,
+                         dst: ReplicaHandle) -> str:
+        """One bounded migration: run the transfer on a disposable
+        worker thread so a hung channel (router.migrate_send=hang, a
+        wedged NIC) can NEVER wedge the caller — drain and failover
+        wait at most ``migrate_timeout_s`` and fall back to
+        re-prefill. On success the source entry is dropped (its bytes
+        leave that pool exactly); on any failure both pools are
+        untouched by construction (transfer() exports a peek and the
+        target's put is atomic; a worker that outlives the deadline
+        undoes its own late import). Returns ``"ok"``, ``"failed"``,
+        or ``"timeout"`` — drain treats a timeout as the channel being
+        wedged and stops attempting further migrations."""
+        t0 = self._clock()
+        done = threading.Event()
+        abandoned = threading.Event()
+        handoff = threading.Lock()
+        box: dict[str, Any] = {}
+
+        def work() -> None:
+            try:
+                result = _migrate.transfer(src, dst, session_id)
+            except BaseException as e:  # disposable thread: report all
+                result = (False, 0, str(e), 0)
+            with handoff:
+                if not abandoned.is_set():
+                    box["result"] = result
+                    done.set()
+                    return
+            # The caller already timed out and fell back to re-prefill
+            # (drain may have released the source entry, failover
+            # re-prefilled). If the slow transfer then LANDED, the
+            # entry would exist on the target with nobody owning it —
+            # undo the import so exact-accounting holds even for a
+            # worker that outlives its deadline. Guarded: the session
+            # may have parked a FRESH entry on the target since (the
+            # resumed turn completed there) — only drop when the pool
+            # still holds what THIS transfer imported (same kept);
+            # otherwise leave it (an orphan ages out by TTL/LRU, a
+            # destroyed fresh entry costs the session a full
+            # re-prefill).
+            if result[0]:
+                try:
+                    info = dst.parked_info(session_id)
+                    if info is not None and info[0] == result[3]:
+                        dst.drop_parked(session_id)
+                except Exception:
+                    pass
+
+        threading.Thread(target=work, daemon=True,
+                         name="router-migrate").start()
+        timed_out = not done.wait(self.migrate_timeout_s)
+        if timed_out:
+            # Atomic handoff: either the worker already posted its
+            # result (use it), or it is now marked abandoned and will
+            # undo a late success itself.
+            with handoff:
+                if "result" not in box:
+                    abandoned.set()
+                else:
+                    timed_out = False
+        if timed_out:
+            self._m_migration_failures.inc()
+            self._events.emit(
+                "router_migration_failed", severity="warning",
+                session=session_id, src=src.replica_id,
+                dst=dst.replica_id, reason="timeout",
+                timeout_s=self.migrate_timeout_s)
+            log.warning(f"KV migration {src.replica_id} -> "
+                        f"{dst.replica_id} for {session_id} timed out "
+                        f"after {self.migrate_timeout_s}s; falling "
+                        "back to re-prefill")
+            return "timeout"
+        ok, nbytes, reason = (box.get("result")
+                              or (False, 0, "worker died", 0))[:3]
+        if not ok:
+            self._m_migration_failures.inc()
+            self._events.emit(
+                "router_migration_failed", severity="warning",
+                session=session_id, src=src.replica_id,
+                dst=dst.replica_id, reason=str(reason)[:200])
+            log.warning(f"KV migration {src.replica_id} -> "
+                        f"{dst.replica_id} for {session_id} failed: "
+                        f"{reason}")
+            return "failed"
+        dt = max(self._clock() - t0, 1e-6)
+        # Target confirmed: NOW the source gives its copy up (exact
+        # byte accounting — the entry was owned by exactly one pool at
+        # every instant an observer could look).
+        try:
+            src.drop_parked(session_id)
+        except Exception:
+            pass  # a dead source's pool entry dies with the replica
+        self._m_migrations.inc()
+        self._m_migration_bytes.inc(nbytes)
+        self._m_migration_ms.observe(dt * 1000.0)
+        self.kv_policy.note_migrate(nbytes, dt)
+        self._events.emit("router_migration", severity="info",
+                          session=session_id, src=src.replica_id,
+                          dst=dst.replica_id, bytes=nbytes,
+                          ms=round(dt * 1000.0, 2))
+        log.info(f"migrated {nbytes} parked-KV bytes for {session_id}: "
+                 f"{src.replica_id} -> {dst.replica_id} in "
+                 f"{dt * 1000:.1f} ms")
+        return "ok"
 
     def pending_requests(self) -> int:
         return sum(self._safe(h, "pending_requests", 0)
                    for h in self.replicas)
+
+    # ---------------- elastic membership (router/elastic.py) -------
+
+    def add_replica(self, handle: ReplicaHandle) -> None:
+        """Register a freshly built replica (scale-up). The list is
+        REBOUND, never mutated in place — every reader (placement,
+        probe loop, failover) sees either the old or the new list."""
+        if any(h.replica_id == handle.replica_id for h in self.replicas):
+            raise ValueError(f"duplicate replica id "
+                             f"{handle.replica_id!r}")
+        self.replicas = self.replicas + [handle]
+        self._m_replicas.set(len(self.replicas))
+        self._update_gauges()
+
+    def remove_replica(self, replica_id: str) -> ReplicaHandle:
+        """Deregister a replica (scale-down, after its drain-then-
+        migrate emptied it). The caller owns shutting the engine down.
+        Raises KeyError for an unknown id."""
+        handle = self._handle(replica_id)
+        if len(self.replicas) <= 1:
+            raise ValueError("cannot remove the last replica")
+        self.replicas = [h for h in self.replicas if h is not handle]
+        self.affinity.drop_replica(replica_id)
+        self.policy.drop_replica(replica_id)
+        self._m_replicas.set(len(self.replicas))
+        self._update_gauges()
+        return handle
 
     # ---------------- probing ----------------
 
@@ -233,7 +505,23 @@ class FleetRouter(EngineBase):
                     busy = {sid for sid, hh
                             in list(self._routes.values())
                             if hh is h}
-                    self.affinity.drop_replica(h.replica_id, keep=busy)
+                    pinned = self.affinity.drop_replica(h.replica_id,
+                                                        keep=busy)
+                    self.policy.drop_replica(h.replica_id)
+                    if h.dead_reason == "probe":
+                        # Death by consecutive probe failures is the
+                        # network-partition signature (the backend may
+                        # be fine — the router just cannot reach it).
+                        # The event triggers the flight recorder: the
+                        # evidence of WHY the fleet shrank is gone
+                        # minutes later.
+                        self._m_partitions.inc()
+                        self._events.emit(
+                            "router_partition", severity="critical",
+                            replica=h.replica_id,
+                            dead_probes=h.dead_probes,
+                            pinned_sessions=len(pinned),
+                            busy_streams=len(busy))
         self.affinity.prune()
         self._update_gauges()
 
@@ -249,10 +537,11 @@ class FleetRouter(EngineBase):
                 return h
         raise KeyError(f"unknown replica {replica_id!r}")
 
-    def _place(self, session_id: str,
-               exclude: set[str]) -> ReplicaHandle:
+    def _place(self, session_id: str, exclude: set[str],
+               prefix_key: str | None = None) -> ReplicaHandle:
         handle, affine = self.policy.place(session_id, self.replicas,
-                                           exclude)
+                                           exclude,
+                                           prefix_key=prefix_key)
         if handle is None:
             self._m_sheds.inc()
             raise AdmissionRejected(
@@ -265,6 +554,35 @@ class FleetRouter(EngineBase):
             self._m_affinity_hits.inc()
         return handle
 
+    @staticmethod
+    def _prefix_key(messages: list[dict]) -> str | None:
+        """Shared-prefix identity of a request: the system prompt's
+        hash (tenants sharing one co-locate to hit the prefix stamp).
+        None when there is no system message — generic traffic spreads
+        least-loaded as before."""
+        for m in messages:
+            if m.get("role") == "system":
+                content = m.get("content") or ""
+                if content:
+                    return hashlib.sha1(
+                        content.encode("utf-8", "replace")).hexdigest()[:16]
+                return None
+        return None
+
+    def _failover_migrate(self, session_id: str, src: ReplicaHandle,
+                          dst: ReplicaHandle) -> bool:
+        """Best-effort parked-KV pull from the failed replica to the
+        chosen survivor (migrate worker thread via to_thread). Never
+        raises."""
+        try:
+            if not self._migration_priced(session_id, src):
+                return False
+            return self._migrate_session(session_id, src, dst) == "ok"
+        except Exception as e:
+            log.debug(f"failover migration probe failed for "
+                      f"{session_id}: {e}")
+            return False
+
     async def generate(self, request_id: str, session_id: str,
                        messages: list[dict], params: GenerationParams,
                        ) -> AsyncGenerator[dict, None]:
@@ -275,9 +593,12 @@ class FleetRouter(EngineBase):
                 "accepting new ones", retry_after=5.0, reason="draining")
         excluded: set[str] = set()
         delivered = 0          # chars already yielded to the caller
+        progress_mark = 0      # delivered at the last failure
         attempt = 0
         resumed_total = 0
         pending_resume = False
+        prefix_key = self._prefix_key(messages)
+        failed_handle: ReplicaHandle | None = None
         try:
             while True:
                 # A cancel can land while no replica owns the stream —
@@ -289,7 +610,43 @@ class FleetRouter(EngineBase):
                     yield {"type": "cancelled",
                            "finish_reason": "cancelled", "stats": {}}
                     return
-                handle = self._place(session_id, excluded)
+                if _fp.enabled:
+                    try:
+                        # Chaos seam: a placement fault is what a fully
+                        # partitioned fleet looks like — it must
+                        # surface as a shed with retry_after
+                        # (rate-limit taxonomy, breaker untouched),
+                        # never an internal error. fire_ASYNC: this
+                        # runs on the event loop, so delay/hang rules
+                        # must yield instead of freezing every stream
+                        # and the /debug/fault clear path.
+                        await _fp.fire_async("router.place",
+                                             session_id=session_id)
+                    except _fp.FaultInjected as e:
+                        self._m_sheds.inc()
+                        raise AdmissionRejected(
+                            f"placement failed: {e}",
+                            retry_after=max(1.0,
+                                            self.probe_interval_s
+                                            or 1.0),
+                            reason="no_replica") from e
+                handle = self._place(session_id, excluded, prefix_key)
+                if failed_handle is not None \
+                        and failed_handle is not handle:
+                    # Failover migration: the dead/failed replica may
+                    # still hold this session's parked KV (an in-proc
+                    # pool survives its engine thread; a drained
+                    # remote still answers /kv). Pulling it to the
+                    # survivor BEFORE re-dispatching turns the resume's
+                    # transcript re-prefill into a restore + delta
+                    # prefill. Bounded by migrate_timeout_s and fully
+                    # best-effort — a failure changes nothing.
+                    src = failed_handle
+                    failed_handle = None
+                    if self.migrate_enabled:
+                        await asyncio.to_thread(
+                            self._failover_migrate, session_id, src,
+                            handle)
                 if pending_resume:
                     pending_resume = False
                     resumed_total += 1
@@ -325,9 +682,28 @@ class FleetRouter(EngineBase):
                             delivered += len(text)
                             yield {**ev, "text": text}
                         elif et in ("done", "cancelled"):
+                            st = ev.get("stats") or {}
+                            if et == "done" and st.get("ttft_ms") \
+                                    and st.get("prefill_tokens"):
+                                # Feed the three-way policy's prefill
+                                # EMA from the fleet's own completions
+                                # — tokens actually PREFILLED over
+                                # TTFT, so the migrate-vs-reprefill
+                                # pricing tracks real hardware. NOT
+                                # prompt_tokens: a cache-hit turn
+                                # prefills only the delta, and pricing
+                                # with the full prompt would inflate
+                                # the EMA by the hit fraction and turn
+                                # migration off exactly in the warm
+                                # steady state it serves. Engines that
+                                # don't report the field (remote,
+                                # fakes) just don't feed the EMA.
+                                self.kv_policy.note_prefill(
+                                    int(st["prefill_tokens"]),
+                                    float(st["ttft_ms"]) / 1000.0)
                             if resumed_total:
                                 ev = {**ev,
-                                      "stats": {**(ev.get("stats") or {}),
+                                      "stats": {**st,
                                                 "resumed": resumed_total}}
                             yield ev
                             return
@@ -395,6 +771,8 @@ class FleetRouter(EngineBase):
                             if hh is handle}
                     self.affinity.drop_replica(handle.replica_id,
                                                keep=busy)
+                    self.policy.drop_replica(handle.replica_id)
+                failed_handle = handle
                 self._update_gauges()
                 log.warning(
                     f"[{request_id}] replica {handle.replica_id} failed "
@@ -404,6 +782,22 @@ class FleetRouter(EngineBase):
                     yield {"type": "cancelled",
                            "finish_reason": "cancelled", "stats": {}}
                     return
+                if delivered > progress_mark:
+                    # The stream made progress since its last failure,
+                    # so earlier exclusions (and spent retries) are
+                    # stale: during a rolling restart every replica
+                    # fails ONCE but is healthy again by the time a
+                    # long-lived stream comes back around —
+                    # accumulating them forever would shed a stream
+                    # that merely outlives N sequential restarts. Only
+                    # the replica that JUST failed is suspect;
+                    # back-to-back failures with no progress still
+                    # accumulate (no ping-pong between two dying
+                    # replicas, and the retry budget still bounds
+                    # them).
+                    excluded.clear()
+                    attempt = 0
+                progress_mark = delivered
                 excluded.add(handle.replica_id)
                 attempt += 1
                 if attempt > self.failover_retries:
@@ -515,6 +909,8 @@ class FleetRouter(EngineBase):
                 "failovers": self._m_failovers.value,
                 "resumes": self._m_resumes.value,
                 "sheds": self._m_sheds.value,
+                "migrations": self._m_migrations.value,
+                "migration_failures": self._m_migration_failures.value,
                 "draining": self._draining,
             },
             "per_replica": per_replica,
@@ -535,12 +931,28 @@ class FleetRouter(EngineBase):
             "replicas": replicas,
             "affinity_sessions": len(self.affinity),
             "draining": self._draining,
+            # A drain that failed on some replica is a PARTIAL drain:
+            # operators watching /fleet see which handle is stuck
+            # (drain_error per replica) instead of a silent log line.
+            "partial_drain": any(h.drain_error is not None
+                                 for h in self.replicas),
+            "migration": {
+                "enabled": self.migrate_enabled,
+                "timeout_s": self.migrate_timeout_s,
+                "policy": self.kv_policy.stats(),
+            },
             "counters": {
                 "placements": self._m_placements.value,
                 "affinity_hits": self._m_affinity_hits.value,
                 "failovers": self._m_failovers.value,
                 "resumes": self._m_resumes.value,
                 "sheds": self._m_sheds.value,
+                "migrations": self._m_migrations.value,
+                "migration_failures": self._m_migration_failures.value,
+                "migration_bytes": self._m_migration_bytes.value,
+                "drain_errors": self._m_drain_errors.value,
+                "partitions": self._m_partitions.value,
+                "prefix_colocations": self._m_prefix.value,
             },
         }
 
@@ -579,4 +991,7 @@ def build_fleet(cfg) -> FleetRouter:
         probe_interval_s=cfg.router_probe_interval_s,
         affinity_ttl_s=cfg.router_affinity_ttl_s,
         failover_retries=cfg.router_failover_retries,
-        resume=cfg.router_resume)
+        resume=cfg.router_resume,
+        migrate=cfg.router_migrate,
+        migrate_timeout_s=cfg.router_migrate_timeout_s,
+        prefix_affinity=cfg.router_prefix_affinity)

@@ -141,6 +141,11 @@ class ServerLauncher:
             engine = build_fleet(config)
         self.engine = engine if engine is not None else build_engine(config)
         self.agent = build_agent(config, self.engine)
+        # Elastic replicas (docs/ROUTER.md, router/elastic.py): grow
+        # the fleet on queue depth / SLO burn, shrink it back via
+        # client-invisible drain-then-migrate. Only meaningful over a
+        # FleetRouter (config validates FLEET_SCALE_MAX x router).
+        self.scaler = self._build_scaler()
         self.server = WebSocketLLMServer(config, self.engine, self.agent)
         self._stop = asyncio.Event()
         # Restart-storm guard: bounded budget + exponential backoff;
@@ -156,6 +161,28 @@ class ServerLauncher:
         self._m_restarts = get_metrics().counter(
             "engine_restarts_total",
             "supervised engine restarts after a crash")
+
+    def _build_scaler(self):
+        cfg = self.config
+        if cfg.fleet_scale_max <= 0 \
+                or not hasattr(self.engine, "add_replica"):
+            return None
+        from fasttalk_tpu.observability.slo import get_slo
+        from fasttalk_tpu.router.elastic import ElasticScaler
+        from fasttalk_tpu.router.replica import ReplicaHandle
+
+        def build_replica(replica_id: str) -> ReplicaHandle:
+            return ReplicaHandle(replica_id, build_engine(cfg),
+                                 dead_probes=cfg.router_dead_probes)
+
+        return ElasticScaler(
+            self.engine, build_replica,
+            min_replicas=cfg.fleet_scale_min,
+            max_replicas=cfg.fleet_scale_max,
+            up_queue_depth=cfg.fleet_scale_up_queue,
+            down_idle_s=cfg.fleet_scale_down_idle_s,
+            check_interval_s=cfg.fleet_scale_check_s,
+            slo_alerts=lambda: get_slo().alert_summary())
 
     def supervisor_info(self) -> dict:
         """Supervisor state for the monitoring port's /health: while
@@ -293,10 +320,17 @@ class ServerLauncher:
                  f"{self.config.monitoring_port}/health")
 
         watchdog = asyncio.create_task(self._watchdog())
+        if self.scaler is not None:
+            self.scaler.start()
+            log.info("elastic scaler on: fleet "
+                     f"[{self.config.fleet_scale_min}, "
+                     f"{self.config.fleet_scale_max}] replicas")
         try:
             await self._stop.wait()
         finally:
             log.info("shutting down")
+            if self.scaler is not None:
+                self.scaler.stop()
             watchdog.cancel()
             await main_runner.cleanup()
             await mon_runner.cleanup()

@@ -108,7 +108,14 @@ class WebSocketLLMServer:
                      1000))
         self._tracer = get_tracer()
 
-        self.app = web.Application()
+        # client_max_size: the KV migration import (/kv/parked POST)
+        # carries a whole parked session's rows — tens of MB for long
+        # contexts, far past aiohttp's 1 MB default. Only raised when
+        # the channel is actually served.
+        kv_http = bool(getattr(config, "kv_migrate_http", False))
+        self.app = web.Application(
+            client_max_size=(256 * 1024 * 1024) if kv_http
+            else 1024 ** 2)
         self.app.router.add_get("/", self._http_root)
         self.app.router.add_get("/health", self._http_health)
         self.app.router.add_get("/stats", self._http_stats)
@@ -121,6 +128,21 @@ class WebSocketLLMServer:
             self.app.router.add_get("/fleet", self._http_fleet)
             self.app.router.add_post("/fleet/drain/{replica_id}",
                                      self._http_fleet_drain)
+        # Cross-replica KV migration channel (docs/ROUTER.md,
+        # router/migrate.py): a remote router moves parked session KV
+        # in and out of THIS replica's host pool through these. Engines
+        # without a pool answer 404/409 via the EngineBase defaults.
+        # Gated by KV_MIGRATE_HTTP (default off): the serving port is
+        # unauthenticated and the export side returns a session's
+        # token ids — only replicas whose port is reachable solely
+        # from the router network may serve it.
+        if kv_http:
+            self.app.router.add_get("/kv/parked/{session_id}",
+                                    self._http_kv_export)
+            self.app.router.add_post("/kv/parked/{session_id}",
+                                     self._http_kv_import)
+            self.app.router.add_delete("/kv/parked/{session_id}",
+                                       self._http_kv_release)
         from fasttalk_tpu.serving.openai_api import register_openai_routes
 
         register_openai_routes(
@@ -305,6 +327,68 @@ class WebSocketLLMServer:
             return web.json_response(
                 {"error": f"unknown replica {replica_id!r}"}, status=404)
         return web.json_response(summary)
+
+    # ---------------- KV migration channel ----------------
+
+    async def _http_kv_export(self, request: web.Request,
+                              ) -> web.Response:
+        session_id = request.match_info["session_id"]
+        if request.query.get("meta"):
+            info = await asyncio.to_thread(self.engine.parked_kv_info,
+                                           session_id)
+            if info is None:
+                return web.json_response(
+                    {"error": "no parked entry"}, status=404)
+            return web.json_response({"session_id": session_id,
+                                      "kept": info[0],
+                                      "nbytes": info[1]})
+        entry = await asyncio.to_thread(self.engine.export_parked_kv,
+                                        session_id)
+        if entry is None:
+            return web.json_response({"error": "no parked entry"},
+                                     status=404)
+        from fasttalk_tpu.router.migrate import serialize_parked
+
+        data = await asyncio.to_thread(serialize_parked, entry)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def _http_kv_import(self, request: web.Request,
+                              ) -> web.Response:
+        from fasttalk_tpu.router.migrate import deserialize_parked
+
+        session_id = request.match_info["session_id"]
+        data = await request.read()
+        try:
+            entry = await asyncio.to_thread(deserialize_parked, data)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if entry.session_id != session_id:
+            return web.json_response(
+                {"error": f"entry is for session "
+                 f"{entry.session_id!r}, not {session_id!r}"},
+                status=400)
+        ok = await asyncio.to_thread(self.engine.import_parked_kv,
+                                     entry)
+        if not ok:
+            return web.json_response(
+                {"error": "entry refused (pool disabled, over budget, "
+                 "or cache-geometry mismatch)"}, status=409)
+        return web.json_response({"imported": True,
+                                  "session_id": session_id,
+                                  "kept": entry.kept,
+                                  "nbytes": entry.nbytes})
+
+    async def _http_kv_release(self, request: web.Request,
+                               ) -> web.Response:
+        session_id = request.match_info["session_id"]
+        ok = await asyncio.to_thread(self.engine.drop_parked_kv,
+                                     session_id)
+        if not ok:
+            return web.json_response({"error": "no parked entry"},
+                                     status=404)
+        return web.json_response({"released": True,
+                                  "session_id": session_id})
 
     # ---------------- WebSocket ----------------
 

@@ -401,6 +401,57 @@ class Config:
     # immediately, independent of this).
     router_dead_probes: int = field(
         default_factory=lambda: _env_int("ROUTER_DEAD_PROBES", 2))
+    # ---- Fleet session fabric (docs/ROUTER.md "Cross-replica KV
+    # migration" / "Elastic replicas") ----
+    # Move parked session KV between replicas on drain/failover so the
+    # next turn RESTORES on the target instead of re-prefilling the
+    # transcript. Off = the pre-fabric behaviour (drain releases the
+    # entry, failover re-prefills).
+    router_migrate: bool = field(
+        default_factory=lambda: _env_bool("ROUTER_MIGRATE", True))
+    # Hard bound on one migration transfer (export + wire + import).
+    # A hung channel falls back to re-prefill — it must never wedge a
+    # drain or a failover.
+    router_migrate_timeout_s: float = field(
+        default_factory=lambda: _env_float("ROUTER_MIGRATE_TIMEOUT_S",
+                                           10.0))
+    # Serve the /kv/parked/{session_id} migration endpoints on THIS
+    # replica's serving port. Off by default: the port is
+    # unauthenticated, and the channel exposes parked transcripts
+    # (read), pool writes, and purges. Enable ONLY on replicas whose
+    # serving port is reachable solely from the router network —
+    # a remote router needs it to migrate KV in and out; in-process
+    # fleets hand entries over directly and never need it.
+    kv_migrate_http: bool = field(
+        default_factory=lambda: _env_bool("KV_MIGRATE_HTTP", False))
+    # Co-locate sessions sharing a system prompt on the replica that
+    # already serves that prefix (hits the shared-prefix stamp /
+    # paged block aliasing) while its load is within one queued
+    # request of the best candidate.
+    router_prefix_affinity: bool = field(
+        default_factory=lambda: _env_bool("ROUTER_PREFIX_AFFINITY",
+                                          True))
+    # Elastic replica scaling (router/elastic.py). FLEET_SCALE_MAX=0
+    # disables the scaler (fixed fleet); > 0 lets the launcher grow
+    # the in-process fleet up to this size on queue depth / SLO burn
+    # and shrink it back to FLEET_SCALE_MIN via client-invisible
+    # drain-then-migrate after sustained idleness.
+    fleet_scale_min: int = field(
+        default_factory=lambda: _env_int("FLEET_SCALE_MIN", 1))
+    fleet_scale_max: int = field(
+        default_factory=lambda: _env_int("FLEET_SCALE_MAX", 0))
+    # Aggregate queued requests across the fleet that trigger a
+    # scale-up (an SLO page-burn triggers one regardless of depth).
+    fleet_scale_up_queue: int = field(
+        default_factory=lambda: _env_int("FLEET_SCALE_UP_QUEUE", 8))
+    # Whole-fleet idle time (no queued, no running work) before one
+    # replica is retired.
+    fleet_scale_down_idle_s: float = field(
+        default_factory=lambda: _env_float("FLEET_SCALE_DOWN_IDLE_S",
+                                           120.0))
+    # Scaler control-loop cadence.
+    fleet_scale_check_s: float = field(
+        default_factory=lambda: _env_float("FLEET_SCALE_CHECK_S", 5.0))
     # ---- Session KV host-offload tier (fasttalk_tpu/kvcache/,
     # docs/KVCACHE.md) ----
     # Host-RAM budget for parked session KV (MB). 0 disables the tier
@@ -753,6 +804,31 @@ class Config:
             errs.append("router_failover_retries must be >= 0")
         if self.router_dead_probes < 1:
             errs.append("router_dead_probes must be >= 1")
+        if self.router_migrate_timeout_s <= 0:
+            errs.append("router_migrate_timeout_s must be > 0 (a hung "
+                        "migration must never wedge a drain; disable "
+                        "migration with ROUTER_MIGRATE=false instead)")
+        if self.fleet_scale_min < 1:
+            errs.append("fleet_scale_min must be >= 1 (the fleet "
+                        "never scales to zero replicas)")
+        if self.fleet_scale_max < 0:
+            errs.append("fleet_scale_max must be >= 0 (0 disables "
+                        "elastic scaling)")
+        if self.fleet_scale_max > 0 \
+                and self.fleet_scale_max < self.fleet_scale_min:
+            errs.append(f"fleet_scale_max ({self.fleet_scale_max}) "
+                        f"must be >= fleet_scale_min "
+                        f"({self.fleet_scale_min})")
+        if self.fleet_scale_max > 0 and not self.router_enabled:
+            errs.append("FLEET_SCALE_MAX > 0 requires "
+                        "ROUTER_ENABLED=true (the elastic scaler "
+                        "drives a FleetRouter)")
+        if self.fleet_scale_up_queue < 1:
+            errs.append("fleet_scale_up_queue must be >= 1")
+        if self.fleet_scale_down_idle_s <= 0:
+            errs.append("fleet_scale_down_idle_s must be > 0")
+        if self.fleet_scale_check_s <= 0:
+            errs.append("fleet_scale_check_s must be > 0")
         if self.router_enabled:
             n_remote = len([u for u in self.router_backends.split(",")
                             if u.strip()])

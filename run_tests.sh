@@ -57,6 +57,18 @@
 #                             `resumed` integration, /fleet endpoints,
 #                             and the remote-client pre-first-token
 #                             retry discipline (docs/ROUTER.md).
+#   ./run_tests.sh --fleet    fleet session-fabric group: the
+#                             failpoint coverage lint (router seams
+#                             included), cross-replica KV migration
+#                             (wire form, drain-migrate byte
+#                             accounting, failover pull, chaos drills
+#                             for failed/corrupt/hung transfers and
+#                             probe partitions), prefix-aware
+#                             placement, the elastic scaler, the
+#                             rolling-restart drill, the /kv/parked
+#                             HTTP channel, and the real-engine
+#                             drain -> migrate -> restore regression
+#                             (docs/ROUTER.md).
 #   ./run_tests.sh --structured  structured-decoding group: the
 #                             schema→regex→DFA→token-FSM compiler
 #                             (tokenizer-boundary cases incl.
@@ -222,6 +234,36 @@ except client.Backoff as b:
 client._maybe_backoff({"error": {"code": "model_error",
                                  "message": "boom"}})
 print("client backoff classifier OK")
+EOF
+    exit 0
+fi
+
+if [[ "${1:-}" == "--fleet" ]]; then
+    shift
+    echo "--- check_failpoints lint (router seams; docs/RESILIENCE.md) ---"
+    "${PYENV[@]}" python scripts/check_failpoints.py
+    "${PYENV[@]}" python -m pytest tests/test_fleet_fabric.py "$@"
+    echo "--- migration channel smoke (serialize -> transfer -> import"
+    echo "    between two real pools, in-process) ---"
+    "${PYENV[@]}" python - <<'EOF'
+import numpy as np
+from fasttalk_tpu.kvcache.hostpool import HostKVPool, ParkedKV
+from fasttalk_tpu.router.migrate import (deserialize_parked,
+                                         serialize_parked)
+
+k = np.random.default_rng(0).standard_normal((2, 64, 2, 4)).astype(
+    np.float32)
+entry = ParkedKV(session_id="smoke", tokens=list(range(64)), kept=64,
+                 bucket=64, k=k, v=k.copy(),
+                 nbytes=2 * int(k.nbytes))
+wire = serialize_parked(entry)
+out = deserialize_parked(wire)
+np.testing.assert_array_equal(out.k, entry.k)
+dst = HostKVPool(budget_mb=4.0)
+assert dst.put(out)
+assert dst.stats()["bytes"] == entry.nbytes
+print(f"migration smoke OK: {len(wire)} wire bytes, "
+      f"{entry.nbytes} pool bytes accounted exactly")
 EOF
     exit 0
 fi

@@ -11,9 +11,9 @@ it runs in milliseconds anywhere:
    and every fire("...") literal uses a catalog name (a typo'd name
    would assert at runtime — catch it here first).
 3. Every catalog name is INJECTED by at least one chaos test in
-   tests/test_chaos.py (a failpoint no chaos test exercises is an
-   unproven recovery path — the exact gap this PR closes), and no
-   test references a nonexistent point.
+   tests/test_chaos.py or tests/test_fleet_fabric.py (a failpoint no
+   chaos test exercises is an unproven recovery path — the exact gap
+   this lint closes), and no test references a nonexistent point.
 
 Exit 0 = clean; exit 1 = problems, each printed on its own line.
 """
@@ -27,7 +27,11 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 FAILPOINTS = REPO / "fasttalk_tpu" / "resilience" / "failpoints.py"
-CHAOS_TEST = REPO / "tests" / "test_chaos.py"
+# Every file here is scanned for injections; a catalog point must be
+# exercised by at least one of them (router seams live in the fleet
+# fabric suite, everything else in the original chaos suite).
+CHAOS_TESTS = (REPO / "tests" / "test_chaos.py",
+               REPO / "tests" / "test_fleet_fabric.py")
 
 
 def catalog_names() -> set[str]:
@@ -76,11 +80,11 @@ def fire_call_sites() -> dict[str, list[str]]:
 
 
 def chaos_test_refs(names: set[str]) -> tuple[set[str], set[str]]:
-    """(catalog names referenced in test_chaos.py, point-shaped
+    """(catalog names referenced in the chaos test files, point-shaped
     strings referenced that are NOT in the catalog). Points appear in
     spec strings ("point=action") and fire() calls, so a plain string
     scan over dotted names is the robust form."""
-    text = CHAOS_TEST.read_text()
+    text = "\n".join(p.read_text() for p in CHAOS_TESTS if p.exists())
     referenced = {n for n in names if n in text}
     # Any dotted token that appears on the left of '=<action>' in a
     # spec literal must be a real point.
@@ -108,25 +112,28 @@ def main() -> int:
             f"fire({name!r}) in {', '.join(sites[name])} is not in "
             "the failpoints CATALOG")
 
-    if not CHAOS_TEST.exists():
-        problems.append(f"{CHAOS_TEST} does not exist")
+    missing = [p for p in CHAOS_TESTS if not p.exists()]
+    if missing:
+        problems.extend(f"{p} does not exist" for p in missing)
     else:
         referenced, unknown = chaos_test_refs(names)
+        chaos_names = ", ".join(str(p.relative_to(REPO))
+                                for p in CHAOS_TESTS)
         for name in sorted(names - referenced):
             problems.append(
                 f"catalog point {name!r} is not injected by any test "
-                "in tests/test_chaos.py (unproven recovery path)")
+                f"in {chaos_names} (unproven recovery path)")
         for name in sorted(unknown):
             problems.append(
-                f"tests/test_chaos.py injects nonexistent point "
-                f"{name!r}")
+                f"chaos tests inject nonexistent point {name!r}")
 
     if problems:
         for p in problems:
             print(f"PROBLEM: {p}")
         return 1
     print(f"check_failpoints: {len(names)} catalog points, all fired "
-          f"in-tree and all injected by tests/test_chaos.py")
+          "in-tree and all injected by the chaos suites "
+          f"({', '.join(str(p.name) for p in CHAOS_TESTS)})")
     return 0
 
 

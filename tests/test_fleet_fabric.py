@@ -1,0 +1,1179 @@
+"""Fleet session fabric (ISSUE 12, docs/ROUTER.md): cross-replica KV
+migration, partition-proven failover, prefix-aware placement, and
+elastic replicas.
+
+The chaos half injects every router failpoint (router.probe /
+router.place / router.migrate_send / router.migrate_recv —
+scripts/check_failpoints.py statically enforces coverage here) and
+asserts the fabric invariants:
+
+- a partitioned replica is declared dead within ROUTER_DEAD_PROBES
+  probe intervals and its sessions resume elsewhere with exactly one
+  terminal (or ``resumed``) event;
+- a migration that fails, corrupts, or hangs mid-transfer leaves byte
+  accounting EXACT on both pools and falls back to re-prefill — and a
+  hung migration never wedges drain;
+- a rolling restart of N replicas completes with zero client-visible
+  error frames.
+
+Fakes carry REAL ``HostKVPool``s (real numpy entries, real byte
+accounting) so the router-level machinery is tested against the
+product pool discipline; the real-engine class at the bottom drives
+TPUEngine park → drain-migrate → restore end to end on the CPU tiny
+model (the satellite-2 regression: a drained replica's sessions get
+restore-grade follow-up, not re-prefill).
+"""
+
+import asyncio
+import threading
+import time
+from dataclasses import replace
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.engine.engine import GenerationParams
+from fasttalk_tpu.engine.fake import FakeEngine
+from fasttalk_tpu.kvcache.hostpool import (HostKVPool, ParkedKV,
+                                           strip_device)
+from fasttalk_tpu.kvcache.offload import kv_bucket
+from fasttalk_tpu.observability.events import EventLog, get_events
+from fasttalk_tpu.resilience import failpoints as fp
+from fasttalk_tpu.router import (ElasticScaler, FleetRouter,
+                                 ReplicaHandle)
+from fasttalk_tpu.router import migrate as migrate_mod
+from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
+                                       LLMServiceError)
+from fasttalk_tpu.utils.metrics import get_metrics
+
+GREEDY = dict(temperature=0.0, top_k=1)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+# ---------------------------------------------------------------------
+# Fakes with REAL pools
+# ---------------------------------------------------------------------
+
+class PoolEngine(FakeEngine):
+    """FakeEngine + a real HostKVPool speaking the migration seam the
+    way TPUEngine does (peek export, validated atomic import, purge
+    drop) — router-level tests get real byte accounting without a
+    device. Can also die like test_router's MortalEngine."""
+
+    def __init__(self, budget_mb: float = 16.0,
+                 reply: str = "alpha beta gamma delta epsilon zeta "
+                 "eta theta", delay_s: float = 0.0):
+        super().__init__(reply=reply, n_repeats=1, delay_s=delay_s)
+        self.pool = HostKVPool(budget_mb=budget_mb)
+        self.dead = False
+        self.die_after_tokens: int | None = None
+
+    def kill(self) -> None:
+        self.dead = True
+        self._started = False
+
+    def revive(self) -> None:
+        self.dead = False
+        self.die_after_tokens = None
+        self._started = True
+
+    def check_connection(self) -> bool:
+        return not self.dead and super().check_connection()
+
+    # ---- migration seam (mirrors TPUEngine's pool-only contract) ----
+
+    def export_parked_kv(self, session_id):
+        entry = self.pool.get(session_id)
+        return None if entry is None else strip_device(entry)
+
+    def parked_kv_info(self, session_id):
+        entry = self.pool.get(session_id)
+        return None if entry is None else (entry.kept, entry.nbytes)
+
+    def import_parked_kv(self, entry) -> bool:
+        from fasttalk_tpu.kvcache.hostpool import entry_problem
+
+        if entry_problem(entry) is not None:
+            return False
+        self.pool.revive(entry.session_id)
+        return self.pool.put(strip_device(entry))
+
+    def drop_parked_kv(self, session_id) -> bool:
+        return self.pool.purge(session_id)
+
+    def release_session(self, session_id) -> None:
+        super().release_session(session_id)
+        self.pool.purge(session_id)
+
+    async def generate(self, request_id, session_id, messages, params):
+        self.requests_seen.append({
+            "request_id": request_id, "session_id": session_id,
+            "messages": messages, "params": params,
+        })
+        if self.dead:
+            raise LLMServiceError("replica down",
+                                  category=ErrorCategory.CONNECTION)
+        words = self.reply.split(" ")
+        n = 0
+        self._active.add(request_id)
+        try:
+            for i, w in enumerate(words):
+                if self.dead:
+                    raise LLMServiceError(
+                        "replica died mid-stream",
+                        category=ErrorCategory.CONNECTION)
+                if self.die_after_tokens is not None \
+                        and n >= self.die_after_tokens:
+                    self.kill()
+                    raise LLMServiceError(
+                        "replica died mid-stream",
+                        category=ErrorCategory.CONNECTION)
+                if request_id in self._cancelled:
+                    yield {"type": "cancelled",
+                           "finish_reason": "cancelled", "stats": {}}
+                    return
+                if n >= params.max_tokens:
+                    break
+                await asyncio.sleep(self.delay_s)
+                n += 1
+                yield {"type": "token",
+                       "text": w + (" " if i < len(words) - 1 else "")}
+            yield {"type": "done", "finish_reason": "stop",
+                   "stats": {"tokens_generated": n,
+                             "processing_time_ms": 1.0,
+                             "tokens_per_second": 100.0,
+                             "ttft_ms": 1.0, "prompt_tokens": 5}}
+        finally:
+            self._active.discard(request_id)
+            self._cancelled.discard(request_id)
+
+
+def make_entry(sid, n_tokens=64, layers=2, kv_heads=2, head_dim=4,
+               quantized=False):
+    """A parked entry with real arrays and honest nbytes."""
+    bucket = kv_bucket(n_tokens, 256)
+    rng = np.random.default_rng(hash(sid) % (2**32))
+    shape = (layers, bucket, kv_heads, head_dim)
+    if quantized:
+        k = rng.integers(-127, 127, shape, dtype=np.int8)
+        v = rng.integers(-127, 127, shape, dtype=np.int8)
+        ks = rng.random((layers, bucket, 1), np.float32)
+        vs = rng.random((layers, bucket, 1), np.float32)
+    else:
+        k = rng.standard_normal(shape).astype(np.float32)
+        v = rng.standard_normal(shape).astype(np.float32)
+        ks = vs = None
+    nbytes = int(k.nbytes) + int(v.nbytes)
+    if ks is not None:
+        nbytes += int(ks.nbytes) + int(vs.nbytes)
+    return ParkedKV(session_id=sid, tokens=list(range(n_tokens)),
+                    kept=n_tokens, bucket=bucket, k=k, v=v,
+                    k_scale=ks, v_scale=vs, nbytes=nbytes)
+
+
+def make_fleet(n=2, clock=None, **router_kw):
+    engines = [PoolEngine() for _ in range(n)]
+    handles = [ReplicaHandle(f"r{i}", e, dead_probes=2)
+               for i, e in enumerate(engines)]
+    kw = dict(probe_interval_s=0, failover_retries=2,
+              migrate_timeout_s=2.0)
+    kw.update(router_kw)
+    if clock is not None:
+        kw["clock"] = clock
+        for h in handles:
+            h._clock = clock
+    router = FleetRouter(handles, **kw)
+    router.start()
+    return router, engines, handles
+
+
+async def collect(router, rid, sid, max_tokens=64, messages=None,
+                  **params):
+    events = []
+    async for ev in router.generate(
+            rid, sid, messages or [{"role": "user", "content": "hi"}],
+            GenerationParams(max_tokens=max_tokens, **GREEDY,
+                             **params)):
+        events.append(ev)
+    return events
+
+
+# ---------------------------------------------------------------------
+# Wire form
+# ---------------------------------------------------------------------
+
+class TestWireForm:
+    def test_roundtrip_bf16_tier(self):
+        e = make_entry("s-wire")
+        data = migrate_mod.serialize_parked(e)
+        out = migrate_mod.deserialize_parked(data)
+        assert out.session_id == "s-wire"
+        assert out.tokens == e.tokens
+        assert out.kept == e.kept and out.bucket == e.bucket
+        assert out.nbytes == e.nbytes
+        np.testing.assert_array_equal(out.k, e.k)
+        np.testing.assert_array_equal(out.v, e.v)
+        assert out.k_scale is None
+
+    def test_roundtrip_quantized_tier(self):
+        e = make_entry("s-q", quantized=True)
+        out = migrate_mod.deserialize_parked(
+            migrate_mod.serialize_parked(e))
+        assert out.k.dtype == np.int8
+        np.testing.assert_array_equal(out.k_scale, e.k_scale)
+        np.testing.assert_array_equal(out.v, e.v)
+
+    def test_garbage_and_truncation_rejected(self):
+        with pytest.raises(ValueError):
+            migrate_mod.deserialize_parked(b"not an entry")
+        data = migrate_mod.serialize_parked(make_entry("s-t"))
+        with pytest.raises(ValueError):
+            migrate_mod.deserialize_parked(data[:len(data) // 2])
+
+    def test_entry_problem_catches_incoherence(self):
+        e = make_entry("s-p")
+        assert migrate_mod.entry_problem(e) is None
+        assert migrate_mod.entry_problem(
+            replace(e, tokens=e.tokens[:-1])) is not None
+        assert migrate_mod.entry_problem(
+            replace(e, nbytes=e.nbytes - 1)) is not None
+        assert migrate_mod.entry_problem(
+            replace(e, v_scale=np.zeros((1, 1, 1), np.float32))) \
+            is not None
+
+
+# ---------------------------------------------------------------------
+# Migration on drain (the tentpole path)
+# ---------------------------------------------------------------------
+
+class TestDrainMigration:
+    def test_drain_migrates_parked_kv_with_exact_bytes(self):
+        router, engines, handles = make_fleet()
+        try:
+            entry = make_entry("s-a")
+            engines[0].pool.put(entry)
+            router.affinity.set("s-a", "r0")
+            src_bytes = engines[0].pool.stats()["bytes"]
+            assert src_bytes == entry.nbytes
+            summary = router.drain_replica("r0")
+            assert summary["migrated_kv"] == 1
+            assert summary["released"] == 0
+            # Exact byte accounting on BOTH pools: the entry left the
+            # source whole and landed on the target whole.
+            assert engines[0].pool.stats()["bytes"] == 0
+            assert engines[0].pool.stats()["sessions"] == 0
+            dst = engines[1].pool
+            assert dst.stats()["bytes"] == entry.nbytes
+            got = dst.get("s-a")
+            assert got is not None and got.kept == entry.kept
+            np.testing.assert_array_equal(got.k, entry.k)
+            # The pin moved WITH the bytes: the next turn goes straight
+            # to the replica now holding the restorable entry.
+            assert router.affinity.get("s-a") == "r1"
+            st = router.fleet_stats()
+            assert st["counters"]["migrations"] == 1
+            assert st["counters"]["migration_bytes"] == entry.nbytes
+            assert st["migration"]["policy"]["migrate_bytes_per_s"] > 0
+            kinds = [e["kind"] for e in get_events().recent(20)]
+            assert "router_migration" in kinds
+        finally:
+            router.shutdown()
+
+    def test_policy_prices_short_entries_as_prefill(self):
+        """Below the restore token floor the three-way decision is
+        'prefill': drain releases instead of moving bytes that are
+        cheaper to recompute."""
+        router, engines, handles = make_fleet()
+        try:
+            engines[0].pool.put(make_entry("s-short", n_tokens=8))
+            router.affinity.set("s-short", "r0")
+            summary = router.drain_replica("r0")
+            assert summary["migrated_kv"] == 0
+            assert summary["released"] == 1
+            assert engines[1].pool.stats()["sessions"] == 0
+            assert engines[0].pool.stats()["sessions"] == 0  # released
+            assert "s-short" in engines[0].released_sessions
+        finally:
+            router.shutdown()
+
+    def test_migrate_disabled_falls_back_to_release(self):
+        router, engines, handles = make_fleet(migrate=False)
+        try:
+            engines[0].pool.put(make_entry("s-off"))
+            router.affinity.set("s-off", "r0")
+            summary = router.drain_replica("r0")
+            assert summary["migrated_kv"] == 0
+            assert summary["released"] == 1
+            assert engines[1].pool.stats()["sessions"] == 0
+        finally:
+            router.shutdown()
+
+    async def test_drained_session_follow_up_lands_on_target(self):
+        """After a drain-migrate, the session's next turn is served by
+        the replica holding its migrated KV (restore-grade follow-up —
+        the real-engine regression below proves the restore itself)."""
+        router, engines, handles = make_fleet()
+        try:
+            engines[0].pool.put(make_entry("s-f"))
+            router.affinity.set("s-f", "r0")
+            router.drain_replica("r0")
+            events = await collect(router, "q-f", "s-f")
+            assert events[-1]["type"] == "done"
+            assert len(engines[1].requests_seen) == 1
+            assert len(engines[0].requests_seen) == 0
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Migration on failover
+# ---------------------------------------------------------------------
+
+class TestFailoverMigration:
+    async def test_mid_stream_death_migrates_kv_to_survivor(self):
+        """A replica dying mid-stream: the session resumes on the
+        survivor AND its parked KV (the in-proc pool survives the
+        engine) is pulled over before the resume re-dispatches."""
+        router, engines, handles = make_fleet()
+        try:
+            entry = make_entry("s-fo")
+            engines[0].pool.put(entry)
+            router.affinity.set("s-fo", "r0")
+            engines[0].die_after_tokens = 3
+            events = await collect(router, "q-fo", "s-fo")
+            types = [e["type"] for e in events]
+            assert types.count("resumed") == 1
+            assert events[-1]["type"] == "done"
+            assert "error" not in types
+            # The KV moved: survivor holds it byte-exact, source empty.
+            assert engines[1].pool.stats()["bytes"] == entry.nbytes
+            assert engines[0].pool.stats()["sessions"] == 0
+            assert router.fleet_stats()["counters"]["migrations"] == 1
+        finally:
+            router.shutdown()
+
+    async def test_failover_without_parked_entry_still_resumes(self):
+        router, engines, handles = make_fleet()
+        try:
+            router.affinity.set("s-np", "r0")
+            engines[0].die_after_tokens = 2
+            events = await collect(router, "q-np", "s-np")
+            assert events[-1]["type"] == "done"
+            assert [e["type"] for e in events].count("resumed") == 1
+            assert router.fleet_stats()["counters"]["migrations"] == 0
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Partition chaos (router.probe / router.place)
+# ---------------------------------------------------------------------
+
+class TestPartitionChaos:
+    async def test_partition_declared_dead_within_probe_deadline(self):
+        """router.probe=error against one replica: after exactly
+        ROUTER_DEAD_PROBES failed probes the replica is dead with
+        dead_reason 'probe', a router_partition event fires, and the
+        pinned session's next turn serves elsewhere with exactly one
+        terminal event."""
+        router, engines, handles = make_fleet()
+        try:
+            router.affinity.set("s-part", "r0")
+            before = get_metrics().counter(
+                "router_partitions_total").value
+            fp.activate("router.probe=error;match=r0")
+            router.probe_once()  # failure 1 of dead_probes=2
+            assert handles[0].state != "dead"
+            router.probe_once()  # failure 2 -> dead, within deadline
+            assert handles[0].state == "dead"
+            assert handles[0].dead_reason == "probe"
+            assert get_metrics().counter(
+                "router_partitions_total").value == before + 1
+            kinds = [e["kind"] for e in get_events().recent(20)]
+            assert "router_partition" in kinds
+            # The pin is gone; the session serves on the reachable
+            # replica with exactly one terminal event.
+            assert router.affinity.get("s-part") is None
+            events = await collect(router, "q-part", "s-part")
+            terminals = [e for e in events
+                         if e["type"] in ("done", "error", "cancelled")]
+            assert len(terminals) == 1
+            assert events[-1]["type"] == "done"
+            assert len(engines[1].requests_seen) == 1
+            # Partition heals -> the replica recovers on the next probe.
+            fp.clear()
+            router.probe_once()
+            assert handles[0].state == "healthy"
+            assert handles[0].dead_reason is None
+        finally:
+            router.shutdown()
+
+    def test_partition_triggers_flight_recorder(self, tmp_path):
+        from fasttalk_tpu.observability.flight import FlightRecorder
+
+        events = EventLog(ring_size=32, jsonl_path="")
+        rec = FlightRecorder(enabled=True,
+                             base_dir=str(tmp_path / "flight"),
+                             max_bundles=4, min_interval_s=0.0,
+                             autoprof_s=0.0, inline=True,
+                             config_provider=lambda: {})
+        rec.install(events)
+        events.emit("router_partition", severity="critical",
+                    replica="r0", dead_probes=2)
+        assert len(rec.list_bundles()) == 1
+        rec.uninstall()
+
+    async def test_place_fault_sheds_with_retry_after(self):
+        """router.place=error surfaces as an AdmissionRejected shed
+        (rate-limit taxonomy: retry_after, breaker untouched) — what a
+        fully partitioned fleet looks like to a client."""
+        router, engines, handles = make_fleet()
+        try:
+            fp.activate("router.place=error")
+            with pytest.raises(AdmissionRejected) as ei:
+                await collect(router, "q-pl", "s-pl")
+            assert ei.value.retry_after is not None
+            assert ei.value.category == ErrorCategory.RATE_LIMIT
+            assert ei.value.reason == "no_replica"
+            fp.clear()
+            events = await collect(router, "q-pl2", "s-pl")
+            assert events[-1]["type"] == "done"
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Migration chaos (router.migrate_send / router.migrate_recv)
+# ---------------------------------------------------------------------
+
+class TestMigrationChaos:
+    def _seeded_fleet(self, **kw):
+        router, engines, handles = make_fleet(**kw)
+        entry = make_entry("s-mc")
+        engines[0].pool.put(entry)
+        router.affinity.set("s-mc", "r0")
+        return router, engines, handles, entry
+
+    def test_send_fault_exact_accounting_and_fallback(self):
+        router, engines, handles, entry = self._seeded_fleet()
+        try:
+            fp.activate("router.migrate_send=error")
+            # Pure accounting first: a failed transfer moves NOTHING.
+            status = router._migrate_session("s-mc", handles[0],
+                                             handles[1])
+            assert status == "failed"
+            assert engines[0].pool.stats()["bytes"] == entry.nbytes
+            assert engines[1].pool.stats()["bytes"] == 0
+            # Through drain: the fallback releases on the source and
+            # the session re-prefills elsewhere (done, not error).
+            summary = router.drain_replica("r0")
+            assert summary["migrated_kv"] == 0
+            assert summary["released"] == 1
+            assert engines[0].pool.stats()["bytes"] == 0
+            assert engines[1].pool.stats()["bytes"] == 0
+            st = router.fleet_stats()["counters"]
+            assert st["migration_failures"] >= 2
+            kinds = [e["kind"] for e in get_events().recent(30)]
+            assert "router_migration_failed" in kinds
+        finally:
+            router.shutdown()
+
+    def test_recv_fault_exact_accounting(self):
+        router, engines, handles, entry = self._seeded_fleet()
+        try:
+            fp.activate("router.migrate_recv=error")
+            assert router._migrate_session(
+                "s-mc", handles[0], handles[1]) == "failed"
+            assert engines[0].pool.stats()["bytes"] == entry.nbytes
+            assert engines[1].pool.stats()["bytes"] == 0
+        finally:
+            router.shutdown()
+
+    def test_recv_corrupt_refused_with_exact_accounting(self):
+        """A corrupted transfer fails validation at the import seam:
+        the target refuses it, the source keeps its entry whole."""
+        router, engines, handles, entry = self._seeded_fleet()
+        try:
+            fp.activate("router.migrate_recv=corrupt")
+            assert router._migrate_session(
+                "s-mc", handles[0], handles[1]) == "failed"
+            assert engines[1].pool.stats()["sessions"] == 0
+            src = engines[0].pool.get("s-mc")
+            assert src is not None
+            assert len(src.tokens) == src.kept  # source NOT corrupted
+            assert engines[0].pool.stats()["bytes"] == entry.nbytes
+        finally:
+            router.shutdown()
+
+    def test_hung_migration_never_wedges_drain(self):
+        """router.migrate_send=hang: drain must complete within the
+        migrate timeout (worker abandoned, fallback release), never
+        wait out the hang."""
+        router, engines, handles, entry = self._seeded_fleet(
+            migrate_timeout_s=0.2)
+        try:
+            fp.activate("router.migrate_send=hang")
+            t0 = time.monotonic()
+            summary = router.drain_replica("r0")
+            wall = time.monotonic() - t0
+            assert wall < 2.0, f"drain wedged for {wall:.1f}s"
+            assert summary["migrated_kv"] == 0
+            assert summary["released"] == 1
+            assert engines[1].pool.stats()["bytes"] == 0
+        finally:
+            fp.clear()  # releases the parked worker thread
+            router.shutdown()
+
+    def test_hung_channel_pays_one_timeout_for_n_sessions(self):
+        """The per-transfer timeout must not multiply across a drain:
+        one hung transfer marks the channel wedged and the remaining
+        sessions release immediately — the drain is bounded by ONE
+        timeout, not N of them."""
+        router, engines, handles, entry = self._seeded_fleet(
+            migrate_timeout_s=0.3)
+        for i in range(2):
+            engines[0].pool.put(make_entry(f"s-mc{i}"))
+            router.affinity.set(f"s-mc{i}", "r0")
+        try:
+            fp.activate("router.migrate_send=hang")
+            t0 = time.monotonic()
+            summary = router.drain_replica("r0")
+            wall = time.monotonic() - t0
+            assert wall < 1.0, (f"drain paid {wall:.1f}s for 3 "
+                                "sessions — the timeout multiplied")
+            assert summary["migrated_kv"] == 0
+            assert summary["released"] == 3
+        finally:
+            fp.clear()
+            router.shutdown()
+
+    def test_abandoned_late_import_is_undone(self):
+        """A worker that outlives the deadline but then LANDS its
+        import must undo it: the caller already fell back to
+        re-prefill, so a late success would leave the entry on the
+        target with nobody owning it."""
+        import threading as _threading
+
+        router, engines, handles, entry = self._seeded_fleet(
+            migrate_timeout_s=0.2)
+        try:
+            fp.activate("router.migrate_recv=hang")
+            status = router._migrate_session("s-mc", handles[0],
+                                             handles[1])
+            assert status == "timeout"
+            # Source untouched by the timeout fallback.
+            assert engines[0].pool.stats()["bytes"] == entry.nbytes
+            fp.clear()  # the abandoned worker resumes its import
+            assert _wait(lambda: not any(
+                t.name == "router-migrate" and t.is_alive()
+                for t in _threading.enumerate()))
+            # ...and undid it: exactly one owner at the end.
+            assert engines[1].pool.stats()["sessions"] == 0
+            assert engines[0].pool.stats()["bytes"] == entry.nbytes
+        finally:
+            fp.clear()
+            router.shutdown()
+
+    def test_metrics_prometheus_valid_mid_incident(self):
+        import importlib.util
+        import pathlib
+
+        router, engines, handles, entry = self._seeded_fleet()
+        try:
+            fp.activate("router.migrate_recv=error")
+            router.drain_replica("r0")
+            fp.clear()
+            spec = importlib.util.spec_from_file_location(
+                "check_prometheus",
+                pathlib.Path(__file__).parent.parent / "scripts"
+                / "check_prometheus.py")
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            text = get_metrics().prometheus()
+            for name in ("router_migrations_total",
+                         "router_migration_failures_total",
+                         "router_migration_bytes",
+                         "router_migration_ms",
+                         "router_drain_errors_total",
+                         "router_partitions_total",
+                         "router_prefix_colocations_total"):
+                assert name in text, name
+            problems = mod.validate(text)
+            assert not problems, problems
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Partial-drain surfacing (satellite 1)
+# ---------------------------------------------------------------------
+
+class BrokenDrainEngine(PoolEngine):
+    def begin_drain(self) -> None:
+        raise RuntimeError("drain RPC lost")
+
+
+class TestDrainErrorSurfacing:
+    def test_drain_replica_failure_is_visible(self):
+        engines = [BrokenDrainEngine(), PoolEngine()]
+        handles = [ReplicaHandle(f"r{i}", e)
+                   for i, e in enumerate(engines)]
+        router = FleetRouter(handles, probe_interval_s=0)
+        router.start()
+        try:
+            before = get_metrics().counter(
+                "router_drain_errors_total").value
+            summary = router.drain_replica("r0")
+            assert "drain RPC lost" in summary["drain_error"]
+            st = router.fleet_stats()
+            assert st["partial_drain"] is True
+            r0 = next(r for r in st["replicas"]
+                      if r["replica_id"] == "r0")
+            assert "drain RPC lost" in r0["drain_error"]
+            assert get_metrics().counter(
+                "router_drain_errors_total").value == before + 1
+            kinds = [e["kind"] for e in get_events().recent(20)]
+            assert "router_drain_error" in kinds
+        finally:
+            router.shutdown()
+
+    def test_fleet_begin_drain_records_per_replica_errors(self):
+        engines = [PoolEngine(), BrokenDrainEngine()]
+        handles = [ReplicaHandle(f"r{i}", e)
+                   for i, e in enumerate(engines)]
+        router = FleetRouter(handles, probe_interval_s=0)
+        router.start()
+        try:
+            router.begin_drain()
+            st = router.fleet_stats()
+            assert st["partial_drain"] is True
+            by_id = {r["replica_id"]: r for r in st["replicas"]}
+            assert by_id["r0"]["drain_error"] is None
+            assert by_id["r1"]["drain_error"] is not None
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Rolling restart (the acceptance drill, fake-fleet form)
+# ---------------------------------------------------------------------
+
+class TestRollingRestart:
+    async def test_rolling_restart_zero_error_frames(self):
+        """Drain + kill + restart each replica in sequence while long
+        streams run: every stream finishes with zero error frames —
+        only ``resumed`` events mark the restarts."""
+        long_reply = " ".join(f"w{i}" for i in range(160))
+        engines = [PoolEngine(reply=long_reply, delay_s=0.004)
+                   for _ in range(3)]
+        handles = [ReplicaHandle(f"r{i}", e, dead_probes=1)
+                   for i, e in enumerate(engines)]
+        router = FleetRouter(handles, probe_interval_s=0,
+                             failover_retries=3)
+        router.start()
+        sinks = [[] for _ in range(6)]
+
+        async def run(i):
+            async for ev in router.generate(
+                    f"q{i}", f"s{i}",
+                    [{"role": "user", "content": "go"}],
+                    GenerationParams(max_tokens=160, **GREEDY)):
+                sinks[i].append(ev)
+
+        async def wait_for(pred, timeout=10.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return True
+                await asyncio.sleep(0.01)
+            return False
+
+        try:
+            tasks = [asyncio.create_task(run(i)) for i in range(6)]
+            assert await wait_for(lambda: all(
+                any(e["type"] == "token" for e in s) for s in sinks))
+            for i in range(3):  # the rolling restart, replica by replica
+                router.drain_replica(f"r{i}")
+                engines[i].kill()
+                router.probe_once()
+                # Let affected streams land on survivors before the
+                # next round.
+                await asyncio.sleep(0.15)
+                engines[i].revive()
+                handles[i].draining = False
+                router.probe_once()
+                assert handles[i].state == "healthy"
+            await asyncio.gather(*tasks)
+            resumed = 0
+            for s in sinks:
+                types = [e["type"] for e in s]
+                assert "error" not in types, s[-1]
+                assert types[-1] == "done"
+                resumed += types.count("resumed")
+            assert resumed >= 1  # at least the streams on killed nodes
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Prefix-aware placement
+# ---------------------------------------------------------------------
+
+SYS_A = [{"role": "system", "content": "You are tenant A's bot."},
+         {"role": "user", "content": "hi"}]
+SYS_B = [{"role": "system", "content": "You are tenant B's bot."},
+         {"role": "user", "content": "hi"}]
+
+
+class TestPrefixPlacement:
+    async def test_same_system_prompt_colocates(self):
+        router, engines, handles = make_fleet(n=2)
+        try:
+            before = get_metrics().counter(
+                "router_prefix_colocations_total").value
+            await collect(router, "qa1", "sa1", messages=SYS_A)
+            await collect(router, "qa2", "sa2", messages=SYS_A)
+            await collect(router, "qa3", "sa3", messages=SYS_A)
+            # Without the prefix hint, rotation would have spread these
+            # across both replicas; with it, one replica serves all.
+            seen = sorted(len(e.requests_seen) for e in engines)
+            assert seen == [0, 3]
+            assert get_metrics().counter(
+                "router_prefix_colocations_total").value >= before + 2
+        finally:
+            router.shutdown()
+
+    async def test_different_prompts_still_spread(self):
+        router, engines, handles = make_fleet(n=2)
+        try:
+            await collect(router, "qa", "sa", messages=SYS_A)
+            await collect(router, "qb", "sb", messages=SYS_B)
+            seen = sorted(len(e.requests_seen) for e in engines)
+            assert seen == [1, 1]
+        finally:
+            router.shutdown()
+
+    def test_loaded_prefix_replica_loses_to_slack(self):
+        """Prefix affinity yields once the hinted replica's load score
+        is more than PREFIX_SLACK above the best candidate — a hot
+        tenant must not pile onto one replica."""
+        router, engines, handles = make_fleet(n=2)
+        try:
+            key = "tenant-key"
+            h0, _ = router.policy.place("s1", router.replicas,
+                                        prefix_key=key)
+            # Load the hinted replica past the slack.
+            h0.inflight.update({"x1", "x2", "x3"})
+            h1, _ = router.policy.place("s2", router.replicas,
+                                        prefix_key=key)
+            assert h1 is not h0
+        finally:
+            router.shutdown()
+
+    async def test_prefix_affinity_disabled(self):
+        router, engines, handles = make_fleet(n=2,
+                                              prefix_affinity=False)
+        try:
+            await collect(router, "qa1", "sa1", messages=SYS_A)
+            await collect(router, "qa2", "sa2", messages=SYS_A)
+            seen = sorted(len(e.requests_seen) for e in engines)
+            assert seen == [1, 1]  # rotation, no co-location
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Elastic replicas
+# ---------------------------------------------------------------------
+
+class QueueEngine(PoolEngine):
+    """PoolEngine reporting a settable queue depth and drain debt."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.waiting = 0
+        self.pending = 0
+
+    def get_stats(self) -> dict:
+        stats = super().get_stats()
+        stats["waiting"] = self.waiting
+        return stats
+
+    def pending_requests(self) -> int:
+        return self.pending
+
+
+class TestElasticScaler:
+    def _scaler(self, n=1, clock=None, slo=None, **kw):
+        engines = [QueueEngine() for _ in range(n)]
+        handles = [ReplicaHandle(f"r{i}", e)
+                   for i, e in enumerate(engines)]
+        router = FleetRouter(handles, probe_interval_s=0)
+        router.start()
+        built = []
+
+        def build_replica(replica_id):
+            e = QueueEngine()
+            built.append(e)
+            return ReplicaHandle(replica_id, e)
+
+        defaults = dict(min_replicas=1, max_replicas=3,
+                        up_queue_depth=4, down_idle_s=10.0,
+                        check_interval_s=1.0)
+        defaults.update(kw)
+        scaler = ElasticScaler(router, build_replica,
+                               slo_alerts=slo,
+                               clock=clock or time.monotonic,
+                               **defaults)
+        return router, engines, scaler, built
+
+    def test_scale_up_on_queue_depth(self):
+        router, engines, scaler, built = self._scaler()
+        try:
+            engines[0].waiting = 10
+            out = scaler.check_once()
+            assert out["decision"] == "up"
+            assert len(router.replicas) == 2
+            assert len(built) == 1
+            assert built[0].check_connection()  # started
+            kinds = [e["kind"] for e in get_events().recent(10)]
+            assert "router_scale" in kinds
+        finally:
+            router.shutdown()
+
+    def test_scale_up_on_slo_page_and_cap(self):
+        router, engines, scaler, built = self._scaler(
+            slo={"interactive": "page"}.copy,
+            max_replicas=2)
+        try:
+            assert scaler.check_once()["decision"] == "up"
+            assert len(router.replicas) == 2
+            # At the cap: page-burn no longer grows the fleet.
+            assert scaler.check_once()["decision"] == "hold"
+            assert len(router.replicas) == 2
+        finally:
+            router.shutdown()
+
+    def test_scale_down_is_drain_then_migrate(self):
+        """Sustained idleness retires one replica — after its parked
+        KV migrated to a survivor and its streams drained (client-
+        invisible retirement)."""
+        now = [0.0]
+        router, engines, scaler, built = self._scaler(
+            n=2, clock=lambda: now[0], down_idle_s=10.0)
+        try:
+            entry = make_entry("s-down")
+            engines[0].pool.put(entry)
+            router.affinity.set("s-down", "r0")
+            assert scaler.check_once()["decision"] == "hold"  # arms idle
+            now[0] = 11.0
+            out = scaler.check_once()
+            assert out["decision"] in ("down_draining", "hold")
+            # r0 (least loaded tie -> first) drained out; its KV moved.
+            assert len(router.replicas) == 1
+            assert router.replicas[0].replica_id == "r1"
+            assert engines[1].pool.stats()["bytes"] == entry.nbytes
+            assert not engines[0].check_connection()  # shut down
+            assert router.affinity.get("s-down") == "r1"
+        finally:
+            router.shutdown()
+
+    def test_busy_victim_not_reaped_until_drained(self):
+        now = [0.0]
+        router, engines, scaler, built = self._scaler(
+            n=2, clock=lambda: now[0], down_idle_s=5.0)
+        try:
+            # r0 (the tie-break victim) still owes drained work: the
+            # retirement must wait for it, client-invisibly.
+            engines[0].pending = 1
+            scaler.check_once()
+            now[0] = 6.0
+            scaler.check_once()
+            assert len(router.replicas) == 2
+            assert scaler.stats()["pending_down"] == "r0"
+            engines[0].pending = 0
+            scaler.check_once()
+            assert len(router.replicas) == 1
+            assert scaler.stats()["pending_down"] is None
+        finally:
+            router.shutdown()
+
+    def test_never_scales_below_min(self):
+        now = [0.0]
+        router, engines, scaler, built = self._scaler(
+            n=1, clock=lambda: now[0], down_idle_s=1.0)
+        try:
+            scaler.check_once()
+            now[0] = 100.0
+            assert scaler.check_once()["decision"] == "hold"
+            assert len(router.replicas) == 1
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# HTTP migration channel (remote replicas)
+# ---------------------------------------------------------------------
+
+def make_config(**env):
+    import os
+
+    from fasttalk_tpu.utils.config import Config
+    old = {}
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    try:
+        return Config()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class TestKVHttpChannel:
+    async def _server(self, engine):
+        from fasttalk_tpu.serving.server import WebSocketLLMServer
+
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false",
+                             KV_MIGRATE_HTTP="true")
+        server = WebSocketLLMServer(config, engine)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        return client
+
+    async def test_export_import_release_roundtrip(self):
+        src_engine, dst_engine = PoolEngine(), PoolEngine()
+        entry = make_entry("s-http")
+        src_engine.pool.put(entry)
+        src = await self._server(src_engine)
+        dst = await self._server(dst_engine)
+        try:
+            # Meta probe (the policy's cheap pricing input).
+            meta = await src.get("/kv/parked/s-http", params={"meta": "1"})
+            assert meta.status == 200
+            body = await meta.json()
+            assert body["kept"] == entry.kept
+            assert body["nbytes"] == entry.nbytes
+            # Export -> import moves the bytes exactly.
+            resp = await src.get("/kv/parked/s-http")
+            assert resp.status == 200
+            data = await resp.read()
+            put = await dst.post("/kv/parked/s-http", data=data)
+            assert put.status == 200
+            assert (await put.json())["nbytes"] == entry.nbytes
+            assert dst_engine.pool.stats()["bytes"] == entry.nbytes
+            # Source release completes the hand-off.
+            rel = await src.delete("/kv/parked/s-http")
+            assert rel.status == 200
+            assert src_engine.pool.stats()["sessions"] == 0
+            assert (await src.delete("/kv/parked/s-http")).status == 404
+            assert (await src.get("/kv/parked/s-http")).status == 404
+        finally:
+            await src.close()
+            await dst.close()
+
+    async def test_import_rejects_garbage_and_mismatch(self):
+        engine = PoolEngine()
+        client = await self._server(engine)
+        try:
+            resp = await client.post("/kv/parked/s-x", data=b"garbage")
+            assert resp.status == 400
+            data = migrate_mod.serialize_parked(make_entry("s-y"))
+            resp = await client.post("/kv/parked/s-OTHER", data=data)
+            assert resp.status == 400
+            assert engine.pool.stats()["sessions"] == 0
+        finally:
+            await client.close()
+
+    async def test_channel_off_by_default(self):
+        """The serving port is unauthenticated and the export side
+        returns a session's token ids — without the explicit
+        KV_MIGRATE_HTTP opt-in the routes must not exist at all."""
+        from fasttalk_tpu.serving.server import WebSocketLLMServer
+
+        engine = PoolEngine()
+        engine.pool.put(make_entry("s-closed"))
+        config = make_config(LLM_PROVIDER="fake",
+                             ENABLE_PYDANTIC_AI="false")
+        assert config.kv_migrate_http is False
+        server = WebSocketLLMServer(config, engine)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            assert (await client.get("/kv/parked/s-closed")).status == 404
+            data = migrate_mod.serialize_parked(make_entry("s-new"))
+            assert (await client.post("/kv/parked/s-new",
+                                      data=data)).status == 404
+            assert (await client.delete(
+                "/kv/parked/s-closed")).status == 404
+            assert engine.pool.stats()["sessions"] == 1
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------
+# Real engines: park -> drain-migrate -> restore (satellite 2)
+# ---------------------------------------------------------------------
+
+MSG1 = [{"role": "user", "content":
+         "this is a reasonably long first turn message for session A "
+         "with enough text to clear the restore floor comfortably"}]
+
+
+def _make_engine(**kw):
+    import jax
+
+    from fasttalk_tpu.engine.engine import TPUEngine
+    from fasttalk_tpu.engine.tokenizer import ByteTokenizer
+    from fasttalk_tpu.models import get_model_config, init_params
+
+    tiny = get_model_config("test-tiny")
+    params = init_params(tiny, jax.random.PRNGKey(0))
+    defaults = dict(num_slots=2, max_len=256, prefill_chunk=64,
+                    kv_host_budget_mb=64.0, kv_park_ttl_s=600.0,
+                    kv_park_idle_s=0.05, kv_restore_min_tokens=8)
+    defaults.update(kw)
+    eng = TPUEngine(tiny, params, ByteTokenizer(), **defaults)
+    eng.start()
+    return eng
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestRealEngineMigration:
+    """TPUEngine end to end on the CPU tiny model: the drained
+    replica's session gets a RESTORE-grade follow-up on the target
+    (the engine's restored_total moves), not a re-prefill."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self):
+        engines = [_make_engine(), _make_engine()]
+        handles = [ReplicaHandle(f"r{i}", e)
+                   for i, e in enumerate(engines)]
+        router = FleetRouter(handles, probe_interval_s=0,
+                             migrate_timeout_s=20.0)
+        router.start()
+        # Make the router's three-way pricing deterministic for the
+        # tiny model (its measured prefill is fast enough to beat a
+        # cold-start transfer estimate).
+        router.kv_policy.note_migrate(64 * 1024 * 1024, 0.01)
+        yield router, engines, handles
+        router.shutdown()
+
+    def _collect(self, router, rid, sid, msgs, max_tokens=8):
+        async def run():
+            out = []
+            async for ev in router.generate(
+                    rid, sid, msgs,
+                    GenerationParams(max_tokens=max_tokens,
+                                     temperature=0.0, top_k=0,
+                                     top_p=1.0)):
+                out.append(ev)
+            return out
+        return asyncio.run(run())
+
+    def test_drain_migrates_then_restores_on_target(self, fleet):
+        router, engines, handles = fleet
+        router.affinity.set("A", "r0")
+        events = self._collect(router, "t1", "A", MSG1)
+        assert events[-1]["type"] == "done"
+        assert _wait(lambda: engines[0]._kv_pool.parked_len("A") > 0), \
+            "idle park never happened on the source replica"
+        parked = engines[0]._kv_pool.get("A")
+        summary = router.drain_replica("r0")
+        assert summary["migrated_kv"] == 1, summary
+        # Byte-exact on both pools.
+        assert engines[0]._kv_pool.stats()["bytes"] == 0
+        assert engines[1]._kv_pool.stats()["bytes"] == parked.nbytes
+        assert router.affinity.get("A") == "r1"
+        # The follow-up turn lands on r1 and RESTORES (not re-prefill):
+        # its pool's restored counter moves.
+        restored_before = \
+            engines[1].get_stats()["kv_host"]["restored_total"]
+        reply = "".join(e.get("text", "") for e in events
+                        if e["type"] == "token")
+        msg2 = MSG1 + [{"role": "assistant", "content": reply},
+                       {"role": "user", "content": "and a follow-up"}]
+        events2 = self._collect(router, "t2", "A", msg2)
+        assert events2[-1]["type"] == "done"
+        assert engines[1].get_stats()["kv_host"]["restored_total"] \
+            == restored_before + 1, "follow-up re-prefilled instead " \
+            "of restoring the migrated KV"
+
+    def test_import_refuses_geometry_mismatch(self, fleet):
+        router, engines, handles = fleet
+        bad = make_entry("s-geom", layers=5)  # tiny model has != 5
+        assert engines[0].import_parked_kv(bad) is False
+        quant = make_entry("s-tier", quantized=True)
+        assert engines[0].import_parked_kv(quant) is False
+        assert engines[0]._kv_pool.get("s-geom") is None
+        assert engines[0]._kv_pool.get("s-tier") is None
+
+
+# ---------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------
+
+class TestFabricConfig:
+    def test_knobs_validated_with_named_errors(self):
+        with pytest.raises(ValueError, match="router_migrate_timeout_s"):
+            make_config(ROUTER_MIGRATE_TIMEOUT_S="0")
+        with pytest.raises(ValueError, match="fleet_scale_min"):
+            make_config(FLEET_SCALE_MIN="0")
+        with pytest.raises(ValueError, match="fleet_scale_max"):
+            make_config(ROUTER_ENABLED="true", FLEET_SCALE_MAX="2",
+                        FLEET_SCALE_MIN="3")
+        with pytest.raises(ValueError, match="ROUTER_ENABLED"):
+            make_config(FLEET_SCALE_MAX="2")
+        with pytest.raises(ValueError, match="fleet_scale_up_queue"):
+            make_config(ROUTER_ENABLED="true", FLEET_SCALE_MAX="2",
+                        FLEET_SCALE_UP_QUEUE="0")
+        with pytest.raises(ValueError,
+                           match="fleet_scale_down_idle_s"):
+            make_config(ROUTER_ENABLED="true", FLEET_SCALE_MAX="2",
+                        FLEET_SCALE_DOWN_IDLE_S="0")
+
+    def test_knobs_surface_in_config_show(self):
+        cfg = make_config(ROUTER_ENABLED="true", FLEET_SCALE_MAX="3",
+                          ROUTER_MIGRATE="false")
+        d = cfg.to_dict()
+        assert d["router_migrate"] is False
+        assert d["router_migrate_timeout_s"] == 10.0
+        assert d["router_prefix_affinity"] is True
+        assert d["fleet_scale_max"] == 3
+        assert d["fleet_scale_min"] == 1
+        assert d["fleet_scale_up_queue"] == 8
+        assert d["fleet_scale_down_idle_s"] == 120.0
+        assert d["fleet_scale_check_s"] == 5.0
+
+    def test_build_fleet_threads_fabric_knobs(self):
+        from fasttalk_tpu.router import build_fleet
+        from fasttalk_tpu.utils.config import Config
+
+        cfg = Config(llm_provider="fake", router_enabled=True,
+                     fleet_replicas=2, router_probe_interval_s=0,
+                     router_migrate=False,
+                     router_migrate_timeout_s=3.5,
+                     router_prefix_affinity=False)
+        router = build_fleet(cfg)
+        assert router.migrate_enabled is False
+        assert router.migrate_timeout_s == 3.5
+        assert router.policy.prefix_affinity is False
