@@ -39,6 +39,17 @@ ratio), the same-slot-count short-context decode tok/s pair (the
 gather/scatter overhead bound, target within 10%), and aliased-prefix
 HBM savings.
 
+``BENCH_MODE=int4`` runs the weight-tier capacity scenario
+(docs/QUANTIZATION.md): a FIXED device-HBM budget (default 1.5x the
+bf16 weight footprint, ``BENCH_I4_BUDGET_MB`` to override) priced per
+tier with the SAME math the factory's admission check uses
+(engine/factory.py weight_bytes_by_tier) — the headline is the
+resident sessions x context envelope ratio (int4+scales vs bf16,
+expected >= 2x: whatever the weights stop eating, the KV cache gets) —
+plus measured decode tok/s per tier (off/int8/int4) in
+subprocess-isolated phases (int4 must stay within noise of int8: both
+stream the same dequant-fused matmul shape).
+
 ``BENCH_MODE=structured`` runs the constrained-decoding scenario
 (docs/STRUCTURED.md): per-step mask-apply overhead vs an unconstrained
 control (target <5% tok/s), and jump-forward's forced-token fraction +
@@ -623,6 +634,115 @@ def bench_longctx() -> dict:
             "parked_capacity_ratio": cap_ratio,
             "restore_p50_speedup": restore_speedup,
             "decode_tok_s_ratio": tok_ratio}
+
+
+# ---------------- int4 mode (weight-tier capacity) ----------------
+
+async def _i4_phase(cfg, max_tokens: int) -> dict:
+    """One weight-tier phase against a freshly built engine: a warmup
+    decode wave (XLA compile), then a measured full-batch decode wave.
+    Reports the tier's RESIDENT weight bytes (what admission prices),
+    the per-step STREAMED bytes (what the perf ledger records), and
+    decode tok/s."""
+    import jax
+
+    from fasttalk_tpu.engine.factory import build_engine
+
+    engine = build_engine(cfg)
+    engine.warmup(cfg.warmup)
+    engine.start()
+    try:
+        resident = int(sum(x.nbytes for x in
+                           jax.tree_util.tree_leaves(engine.params)))
+
+        async def wave(tag: str) -> float:
+            t0 = time.monotonic()
+            results = await asyncio.gather(*(
+                run_session_msgs(
+                    engine, f"i4-{tag}-{i}", f"i4-{tag}-sess-{i}",
+                    [{"role": "user", "content": f"[{tag}{i}] {PROMPT}"}],
+                    max_tokens)
+                for i in range(cfg.decode_slots)))
+            wall = time.monotonic() - t0
+            return sum(r["tokens"] for r in results) / wall
+
+        await wave("warm")
+        tok_s = await wave("run")
+    finally:
+        engine.shutdown()
+    return {
+        "weight_quant": cfg.weight_quant,
+        "resident_weight_bytes": resident,
+        "resident_weight_mb": round(resident / 2**20, 3),
+        "streamed_bytes_per_step": engine._weight_bytes_per_step,
+        "decode_tok_s": round(tok_s, 2),
+    }
+
+
+def _i4_run_phase_subprocess(tier: str) -> dict:
+    """One tier per child process (same isolation rationale as
+    multiturn/longctx: two warmed engines in one process trip the
+    XLA-CPU teardown crash, and fresh processes keep the tiers fair)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["BENCH_I4_PHASE"] = tier
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"int4 phase (weight_quant={tier}) exited "
+            f"{proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_int4() -> dict:
+    """The weight-tier capacity scenario (docs/QUANTIZATION.md): price
+    a FIXED device-HBM budget per tier with the factory's own
+    admission math, then measure decode tok/s per tier in isolated
+    child processes. The envelope is analytic ON PURPOSE — it is the
+    exact formula check_hbm_budget admits sessions by, so the headline
+    is the serving capacity the factory will actually grant, not a
+    simulation of it."""
+    from fasttalk_tpu.engine.factory import weight_bytes_by_tier
+    from fasttalk_tpu.models.configs import get_model_config
+
+    m = get_model_config(MODEL, os.environ.get("MODEL_PATH"))
+    group = int(os.environ.get("WEIGHT_QUANT_GROUP", "128"))
+    dsize = 2  # bf16 serving dtype
+    tiers = weight_bytes_by_tier(m, dsize, tp=1, group=group)
+    budget = float(os.environ.get(
+        "BENCH_I4_BUDGET_MB",
+        str(round(1.5 * tiers["off"] / 2**20, 3)))) * 2**20
+    # bf16 KV bytes per resident token (K+V): the KV tier is held
+    # fixed so the envelope isolates what the WEIGHT tier frees.
+    kv_row = 2 * m.num_layers * m.num_kv_heads * m.head_dim * dsize
+    envelope = {t: max(0, int(budget) - b) // kv_row
+                for t, b in tiers.items()}
+    log(f"int4: fixed HBM budget {budget / 2**20:.1f} MB, weight "
+        f"bytes off={tiers['off'] / 2**20:.1f} / "
+        f"int8={tiers['int8'] / 2**20:.1f} / "
+        f"int4={tiers['int4'] / 2**20:.1f} MB (group {group}) -> "
+        f"resident KV envelope {envelope['off']} / {envelope['int8']}"
+        f" / {envelope['int4']} token-rows")
+    phases = {}
+    for i, tier in enumerate(("off", "int8", "int4")):
+        log(f"--- phase {i + 1}/3: WEIGHT_QUANT={tier} ---")
+        phases[tier] = _i4_run_phase_subprocess(tier)
+        log(f"  {tier}: {phases[tier]['resident_weight_mb']} MB "
+            f"resident, decode {phases[tier]['decode_tok_s']} tok/s")
+    cap_ratio = (round(envelope["int4"] / envelope["off"], 2)
+                 if envelope["off"] else None)
+    tok_vs_int8 = (round(phases["int4"]["decode_tok_s"]
+                         / phases["int8"]["decode_tok_s"], 3)
+                   if phases["int8"]["decode_tok_s"] else None)
+    return {"budget_mb": round(budget / 2**20, 3), "group": group,
+            "weight_bytes": tiers, "kv_row_bytes": kv_row,
+            "envelope_token_rows": envelope,
+            "envelope_ratio_int4_vs_bf16": cap_ratio,
+            "off": phases["off"], "int8": phases["int8"],
+            "int4": phases["int4"],
+            "decode_tok_s_int4_vs_int8": tok_vs_int8}
 
 
 # ---------------- paged mode (block-table KV cache) ----------------
@@ -1948,6 +2068,48 @@ def main() -> None:
             # ~double the sessions per byte.
             "vs_baseline": r["parked_capacity_ratio"],
             "longctx": r,
+        }), flush=True)
+        return
+    if MODE == "int4":
+        max_tokens = int(os.environ.get("BENCH_I4_MAX_TOKENS", "64"))
+        slots = int(os.environ.get("BENCH_I4_SLOTS", "4"))
+        if os.environ.get("BENCH_I4_PHASE"):
+            # Child process: one weight tier. KV knobs at defaults and
+            # spec decode off in every phase — only the weight tier
+            # may differ between the children.
+            cfg = Config(llm_provider="tpu", model_name=MODEL,
+                         decode_slots=slots, max_model_len=512,
+                         default_context_window=512,
+                         prefill_chunk=512, dtype="bfloat16",
+                         port=PORT, monitoring_port=PORT + 1,
+                         enable_agent=False, spec_decode="off",
+                         weight_quant=os.environ["BENCH_I4_PHASE"])
+            phase = asyncio.run(_i4_phase(cfg, max_tokens))
+            print(json.dumps(phase), flush=True)
+            return
+        r = bench_int4()
+        print(json.dumps({
+            "metric": (f"int4 resident sessions x context envelope "
+                       f"ratio (int4+scales weights vs bf16), {MODEL}: "
+                       f"fixed {r['budget_mb']:.1f} MB HBM budget, "
+                       f"weight bytes "
+                       f"{r['weight_bytes']['off'] / 2**20:.1f} -> "
+                       f"{r['weight_bytes']['int4'] / 2**20:.1f} MB "
+                       f"(group {r['group']}), KV envelope "
+                       f"{r['envelope_token_rows']['off']} -> "
+                       f"{r['envelope_token_rows']['int4']} token-rows"
+                       f"; decode tok/s off/int8/int4 "
+                       f"{r['off']['decode_tok_s']}/"
+                       f"{r['int8']['decode_tok_s']}/"
+                       f"{r['int4']['decode_tok_s']} (int4 vs int8 "
+                       f"{r['decode_tok_s_int4_vs_int8']})"),
+            "value": r["envelope_ratio_int4_vs_bf16"],
+            "unit": "x",
+            # For this mode the baseline is bf16 weights on the SAME
+            # budget: >= 2 means the 4-bit tier at least doubles what
+            # the budget can hold resident.
+            "vs_baseline": r["envelope_ratio_int4_vs_bf16"],
+            "int4": r,
         }), flush=True)
         return
     if MODE == "paged":

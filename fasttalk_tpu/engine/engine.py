@@ -346,6 +346,9 @@ class TPUEngine(EngineBase):
                  context_window: int | None = None, mesh: Any = None,
                  use_pallas_attention: bool = False,
                  use_pallas_int8: bool = True,
+                 weight_quant: str = "off",
+                 weight_quant_group: int = 128,
+                 use_pallas_int4: bool = False,
                  steps_per_call: int = 8, pipeline_depth: int = 2,
                  sampling_method: str = "fast",
                  spec_decode: str = "off", spec_draft_len: int = 7,
@@ -390,6 +393,38 @@ class TPUEngine(EngineBase):
         # int8-matmul kernels gate independently.
         self.use_pallas_attention = use_pallas_attention and mesh is None
         self.use_pallas_int8 = use_pallas_int8 and mesh is None
+        # Int4 weight tier (fasttalk_tpu/quantization/, docs/
+        # QUANTIZATION.md): the seven layer matmuls carry nibble-packed
+        # {"q4", "s"} leaves and dequantize inside the matmul operand
+        # read (ops/quant.py). The compat matrix is EXPLICIT, mirroring
+        # the Config checks so library callers get the same named
+        # errors: int4 COMPOSES with KV_QUANT=int8, KV_LAYOUT=paged,
+        # speculative and structured decoding (all downstream of the
+        # logits); it rejects a mesh (the sharded load/init path for
+        # packed leaves is unvalidated — the partition rules exist in
+        # parallel/sharding.py).
+        if weight_quant not in ("off", "int8", "int4"):
+            raise ValueError(f"weight_quant must be 'off', 'int8' or "
+                             f"'int4', got {weight_quant!r}")
+        self.weight_quant = weight_quant
+        self.weight_quant_group = int(weight_quant_group)
+        if weight_quant == "int4":
+            from fasttalk_tpu.quantization.int4 import validate_group
+
+            if mesh is not None:
+                raise ValueError(
+                    "WEIGHT_QUANT=int4 is single-device only in v1: the "
+                    "partition rules for {'q4','s'} leaves exist "
+                    "(parallel/sharding.py) but the sharded load/init "
+                    "path is unvalidated — set TPU_TP_SIZE=TPU_DP_SIZE="
+                    "TPU_SP_SIZE=1")
+            validate_group(model_cfg, self.weight_quant_group)
+        if use_pallas_int4 and weight_quant != "int4":
+            raise ValueError(
+                "TPU_USE_PALLAS_INT4=true requires WEIGHT_QUANT=int4 "
+                "(the kernel reads nibble-packed {'q4','s'} leaves)")
+        self.use_pallas_int4 = (use_pallas_int4 and mesh is None
+                                and weight_quant == "int4")
         # Int8 KV-cache tier (ops/kv_quant.py, docs/KVCACHE.md): the
         # cache stores int8 rows + per-row float32 scales; every KV
         # touchpoint (decode scatter, the prefill paths, prefix copy,
@@ -434,6 +469,12 @@ class TPUEngine(EngineBase):
         # quantized tier's executables get their own ledger keys, the
         # bf16 tier's keys stay byte-identical to before.
         self._kvq_attrs = {"kv_quant": "int8"} if self.kv_quant else {}
+        if self.weight_quant == "int4":
+            # Int4 executables get their own ledger keys; the off/int8
+            # tiers' keys stay byte-identical to before this tier
+            # existed (the acceptance bar for WEIGHT_QUANT=off).
+            self._kvq_attrs = dict(self._kvq_attrs,
+                                   weight_quant="int4")
         # Paged KV tier (KV_LAYOUT=paged — kvcache/blocks.py,
         # docs/KVCACHE.md "Paged tier"): the cache becomes one flat
         # block pool [L, blocks*block_size, Kv, H] and per-slot block
@@ -792,11 +833,27 @@ class TPUEngine(EngineBase):
         self._kv_row_bytes = 2 * model_cfg.num_layers * (
             model_cfg.num_kv_heads * model_cfg.head_dim * kv_elt
             + self.kv_scale_granule * 4)
+        # Weight bytes one decode step streams from HBM: every resident
+        # leaf is read once per step — except an UNTIED embedding, which
+        # the step only gathers a few rows of (the tied table doubles as
+        # the head matmul and is streamed in full). Summing actual leaf
+        # nbytes keeps the figure honest per tier: bf16 arrays, int8
+        # {"q","s"} and int4 {"q4","s"} dicts alike, scales included.
+        def _tree_bytes(t: Any) -> int:
+            return int(sum(x.nbytes
+                           for x in jax.tree_util.tree_leaves(t)))
+
+        self._weight_bytes_per_step = _tree_bytes(params)
+        if "lm_head" in params:
+            self._weight_bytes_per_step -= _tree_bytes(params["embed"])
         self._perf = get_perf()
         self._perf.bind_model(model_cfg, num_slots,
                               jnp.dtype(dtype).name,
                               kv_quant=kv_quant,
-                              kv_row_bytes=self._kv_row_bytes)
+                              kv_row_bytes=self._kv_row_bytes,
+                              weight_quant=self.weight_quant,
+                              weight_bytes_per_step=(
+                                  self._weight_bytes_per_step))
 
     def _make_cache(self) -> KVCache:
         if self.paged:
@@ -1557,6 +1614,7 @@ class TPUEngine(EngineBase):
             "dtype": jnp.dtype(self.dtype).name,
             "kv_quant": "int8" if self.kv_quant else "none",
             "kv_layout": "paged" if self.paged else "dense",
+            "weight_quant": self.weight_quant,
             "devices": [str(d) for d in jax.devices()],
             "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
         }
@@ -1744,6 +1802,7 @@ class TPUEngine(EngineBase):
                         KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
                         pallas_int8=self.use_pallas_int8,
+                        pallas_int4=self.use_pallas_int4,
                         block_table=bt, block_size=bsz,
                         pallas_paged=pallas_paged)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
@@ -1790,6 +1849,7 @@ class TPUEngine(EngineBase):
                         KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
                         pallas_int8=self.use_pallas_int8,
+                        pallas_int4=self.use_pallas_int4,
                         block_table=bt, block_size=bsz,
                         pallas_paged=pallas_paged)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
@@ -1821,6 +1881,7 @@ class TPUEngine(EngineBase):
                     KVCache(sk, sv), pos, write_mask=act,
                     pallas_decode=use_pallas,
                     pallas_int8=self.use_pallas_int8,
+                    pallas_int4=self.use_pallas_int4,
                     cache_attn_override=cache_override)
                 lg = apply_penalties(logits[:, -1, :self.sample_vocab],
                                      cnt, reps, press, freqs)
@@ -1902,6 +1963,7 @@ class TPUEngine(EngineBase):
                         KVCache(ck, cv, ks, vs), act,
                         attn_len=kv_len,
                         pallas_int8=self.use_pallas_int8,
+                        pallas_int4=self.use_pallas_int4,
                         block_table=bt, block_size=bsz,
                         pallas_paged=pallas_paged)
                     lg = apply_penalties(logits[:, :sv], cnt, reps,
@@ -1941,6 +2003,7 @@ class TPUEngine(EngineBase):
                     KVCache(ck, cv, ks, vs), act,
                     attn_len=kv_len,
                     pallas_int8=self.use_pallas_int8,
+                    pallas_int4=self.use_pallas_int4,
                     block_table=bt, block_size=bsz,
                     pallas_paged=pallas_paged)
                 lg = apply_penalties(logits[:, :sv], cnt, reps,
@@ -2037,6 +2100,7 @@ class TPUEngine(EngineBase):
                     params, self.cfg, tokens_in, pos, KVCache(ck, cv),
                     act, attn_len=kv_len,
                     pallas_int8=self.use_pallas_int8,
+                    pallas_int4=self.use_pallas_int4,
                     block_table=bt, block_size=bsz)
                 key, sub = jax.random.split(key)
                 # EXACT per-position penalty counts, without vocab-wide
@@ -3106,6 +3170,7 @@ class TPUEngine(EngineBase):
                 params, self.cfg, tokens[None, :], positions,
                 small, start[None], blockwise=True,
                 pallas_int8=self.use_pallas_int8,
+                pallas_int4=self.use_pallas_int4,
                 logits_indices=last_index[None])
             new_k = jax.lax.dynamic_update_slice(
                 cache.k, updated.k, (0, slot, 0, 0, 0))
@@ -3157,6 +3222,7 @@ class TPUEngine(EngineBase):
                 params, self.cfg, tokens[None, :], positions,
                 small, start[None], blockwise=True,
                 pallas_int8=self.use_pallas_int8,
+                pallas_int4=self.use_pallas_int4,
                 logits_indices=last_index[None])
 
             def written(arr):  # [L, 1, ctx, ...] -> the chunk's rows
@@ -3324,6 +3390,7 @@ class TPUEngine(EngineBase):
                 params, self.cfg, tokens, positions, small,
                 starts, blockwise=True, write_mask=mask,
                 pallas_int8=self.use_pallas_int8,
+                pallas_int4=self.use_pallas_int4,
                 logits_indices=last_idx)
             new_k = cache.k.at[:, slot_idx, :ctx].set(
                 upd.k, mode="drop", unique_indices=True)
@@ -3393,6 +3460,7 @@ class TPUEngine(EngineBase):
                 params, self.cfg, tokens, positions, small,
                 starts, blockwise=True, write_mask=mask,
                 pallas_int8=self.use_pallas_int8,
+                pallas_int4=self.use_pallas_int4,
                 logits_indices=last_idx)
             sel = positions  # [group, chunk] region rows each row wrote
 
@@ -4668,6 +4736,12 @@ class TPUEngine(EngineBase):
                 flops=self._perf.call_flops(consumed, kv_len),
                 kv_bytes=int(res.shape[0]) * self._kv_read_rows(
                     snapshot, kv_len) * self._kv_row_bytes,
+                # weight_bytes: the weights streamed once per step at
+                # their RESIDENT size (bf16 / int8+scales / packed
+                # int4+scales) — /perf's bandwidth and FLOP/byte read
+                # this instead of assuming a bf16 footprint.
+                weight_bytes=(int(res.shape[0])
+                              * self._weight_bytes_per_step),
                 # Mask-apply attribution (docs/STRUCTURED.md): rows
                 # with constrained>0 ran the fsm decode variant — the
                 # per-step mask gather/unpack cost is the step-duration

@@ -25,6 +25,62 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
 
 
+def weight_bytes_by_tier(m, dsize: int, tp: int = 1,
+                         group: int = 128) -> dict:
+    """Per-device weight bytes for each WEIGHT_QUANT tier — the one
+    place the weight-footprint math lives (budget check, overflow
+    remedies, BENCH_MODE=int4 envelopes, tests).
+
+    Sharding facts encoded (parallel/sharding.py): norm scales
+    replicate; matmuls/embedding shard over "tp"; every quantized
+    tensor gains float32 scales, counted replicated (conservative —
+    they are KiB-to-half-MiB scale).
+    """
+    norm_params = (2 * m.num_layers + 1) * m.hidden_size
+    # The seven stacked layer matmuls (quantization/int4.py INT4_LEAVES).
+    matmul_per_layer = (m.hidden_size * m.q_dim
+                        + 2 * m.hidden_size * m.kv_dim
+                        + m.q_dim * m.hidden_size
+                        + 3 * m.hidden_size * m.intermediate_size)
+    scales_per_layer = (m.q_dim + 2 * m.kv_dim + m.hidden_size
+                        + 2 * m.intermediate_size + m.hidden_size)
+    matmul = m.num_layers * matmul_per_layer
+    scales8 = m.num_layers * scales_per_layer
+    # Embedding (and untied lm_head) quantize per ROW at int8 in both
+    # quantized tiers — the gather and the streaming head kernel want
+    # per-row scales (quantization/__init__.py).
+    table = m.hidden_size * m.vocab_size
+    tscales = m.vocab_size
+    if not m.tie_embeddings:
+        table += m.hidden_size * m.vocab_size
+        tscales += m.vocab_size
+    other = m.param_count() - matmul - table - norm_params  # qkv biases
+    return {
+        "off": ((m.param_count() - norm_params) * dsize // tp
+                + norm_params * dsize),
+        "int8": ((matmul + table) // tp + other * dsize // tp
+                 + (scales8 + tscales) * 4 + norm_params * dsize),
+        # int4: two matmul weights per byte + one f32 scale per
+        # (group x out-channel); table stays int8 per-row.
+        "int4": (matmul // 2 // tp + (matmul // group) * 4
+                 + table // tp + tscales * 4
+                 + other * dsize // tp + norm_params * dsize),
+    }
+
+
+def _effective_weight_quant(cfg: Config) -> str:
+    """The weight tier the build will actually run. Config resolves
+    WEIGHT_QUANT and the legacy TPU_QUANTIZE alias at construction,
+    but callers that assign ``cfg.quantize`` AFTER construction
+    (tests, scripts predating the weight_quant knob) bypass
+    __post_init__ — honor the legacy attr the way the pre-int4
+    factory did."""
+    legacy = "off" if cfg.quantize in ("", "none", "off") else cfg.quantize
+    if cfg.weight_quant == "off" and legacy != "off":
+        return legacy
+    return cfg.weight_quant
+
+
 def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
     """Account weights + KV cache against the HBM budget before any
     allocation, so a bad TPU_DECODE_SLOTS / TPU_MAX_MODEL_LEN fails with
@@ -55,38 +111,13 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
     dsize = jnp.dtype(dtype).itemsize
     tp = max(1, cfg.tp_size)
     m = model_cfg
-    # Norm scales replicate on every chip (parallel/sharding.py
-    # _LAYER_RULES/_TOP_RULES); everything else — matmuls, embedding,
-    # qkv biases — shards over "tp". Counting replicated leaves at
-    # 1/tp size underestimates per-device bytes near the budget edge.
-    norm_params = (2 * m.num_layers + 1) * m.hidden_size
-    if cfg.quantize == "int8":
-        # Matmul weights AND the embedding quantize (ops/quant.py
-        # QUANTIZED_LEAVES + EMBED_LEAF); norms and biases stay at the
-        # engine dtype. Every quantized tensor gains a float32 scale
-        # vector (per output channel; per vocab row for the embedding).
-        # Row-parallel (wo/w_down) and embed scales replicate; the rest
-        # shard — all are KiB-to-half-MiB scale, so count them all
-        # replicated (conservative).
-        matmul_per_layer = (m.hidden_size * m.q_dim
-                            + 2 * m.hidden_size * m.kv_dim
-                            + m.q_dim * m.hidden_size
-                            + 3 * m.hidden_size * m.intermediate_size)
-        scales_per_layer = (m.q_dim + 2 * m.kv_dim + m.hidden_size
-                            + 2 * m.intermediate_size + m.hidden_size)
-        matmul = m.num_layers * matmul_per_layer
-        scales = m.num_layers * scales_per_layer
-        matmul += m.hidden_size * m.vocab_size  # embedding (row-quant)
-        scales += m.vocab_size
-        if not m.tie_embeddings:
-            matmul += m.hidden_size * m.vocab_size
-            scales += m.vocab_size
-        other_sharded = m.param_count() - matmul - norm_params
-        wbytes_dev = (matmul // tp + other_sharded * dsize // tp
-                      + scales * 4 + norm_params * dsize)
-    else:
-        wbytes_dev = ((m.param_count() - norm_params) * dsize // tp
-                      + norm_params * dsize)
+    # The per-tier footprint math lives in weight_bytes_by_tier (norm
+    # scales replicated, matmuls/embedding sharded over "tp", f32
+    # scales counted replicated).
+    weight_quant = _effective_weight_quant(cfg)
+    tiers = weight_bytes_by_tier(m, dsize, tp=tp,
+                                 group=cfg.weight_quant_group)
+    wbytes_dev = tiers.get(weight_quant, tiers["off"])
     if cfg.kv_quant == "int8":
         # Quantized KV tier (ops/kv_quant.py): int8 rows + per-row
         # float32 scales — the accounting sees honest quantized bytes,
@@ -148,14 +179,25 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
         if need > budget:
             # The blocks-available math, and the remedy that actually
             # changes the admission model — not just smaller numbers
-            # for the same dense layout.
+            # for the same dense layout. Always show the weight-bytes
+            # math per tier: quartering weight bytes is the other
+            # first-order lever, and the reader should see what each
+            # tier would cost on THEIR model before retuning KV knobs.
+            tier_math = (
+                f"Weight bytes/device by tier ("
+                f"WEIGHT_QUANT={weight_quant}): "
+                f"off(bf16)={tiers['off'] / 2**30:.2f} GiB, "
+                f"int8={tiers['int8'] / 2**30:.2f} GiB, "
+                f"int4+scales={tiers['int4'] / 2**30:.2f} GiB "
+                f"(group={cfg.weight_quant_group}).")
             if paged:
                 remedy = (
                     f"Lower KV_POOL_BLOCKS ({pool_blocks}; 0 = "
                     "fit-to-budget), KV_BLOCK_SIZE "
                     f"({cfg.kv_block_size}), or TPU_MAX_MODEL_LEN "
-                    f"({cfg.max_model_len}); enable KV_QUANT=int8; or "
-                    "raise TPU_HBM_UTILIZATION.")
+                    f"({cfg.max_model_len}); enable WEIGHT_QUANT=int4 "
+                    "/ KV_QUANT=int8; or raise TPU_HBM_UTILIZATION. "
+                    + tier_math)
             else:
                 dense_blocks = dense_rows // cfg.kv_block_size
                 remedy = (
@@ -168,9 +210,9 @@ def check_hbm_budget(model_cfg, cfg: Config, dtype, n_devices: int) -> dict:
                     "blocks after weights. Set KV_LAYOUT=paged to "
                     "admit by blocks actually in use (KV_BLOCK_SIZE="
                     f"{cfg.kv_block_size}), or lower TPU_DECODE_SLOTS "
-                    "/ TPU_MAX_MODEL_LEN, enable TPU_QUANTIZE=int8 / "
-                    "KV_QUANT=int8, or raise TPU_TP_SIZE to shard "
-                    "over more chips.")
+                    "/ TPU_MAX_MODEL_LEN, enable WEIGHT_QUANT=int4 "
+                    "(or int8) / KV_QUANT=int8, or raise TPU_TP_SIZE "
+                    "to shard over more chips. " + tier_math)
             raise ValueError(
                 f"Model + KV cache need {need / 2**30:.2f} GiB/device "
                 f"but the HBM budget is {budget / 2**30:.2f} GiB "
@@ -239,7 +281,8 @@ def build_engine(cfg: Config) -> EngineBase:
         # disk — a 70B checkpoint must never materialise on one chip.
         put = param_put(mesh, dtype)
         raw_put = param_put(mesh, None)
-    if cfg.quantize == "int8":
+    weight_quant = _effective_weight_quant(cfg)
+    if weight_quant in ("int8", "int4"):
         from fasttalk_tpu.ops.quant import quantizing_put
 
         import jax
@@ -248,8 +291,17 @@ def build_engine(cfg: Config) -> EngineBase:
             put = lambda arr, path: jax.device_put(jnp.asarray(arr, dtype))  # noqa: E731
             raw_put = lambda arr, path: jax.device_put(jnp.asarray(arr))  # noqa: E731
         # Quantize host-side, tensor by tensor, before placement: device
-        # HBM peaks at int8 bytes, not the transient bf16 copy.
-        put = quantizing_put(put, raw_put)
+        # HBM peaks at quantized bytes, not the transient bf16 copy.
+        if weight_quant == "int4":
+            # quantizing_put_int4 routes embed/lm_head through the int8
+            # putter itself — hand it the un-wrapped puts.
+            from fasttalk_tpu.quantization.int4 import (quantizing_put_int4,
+                                                        validate_group)
+
+            validate_group(model_cfg, cfg.weight_quant_group)
+            put = quantizing_put_int4(put, raw_put, cfg.weight_quant_group)
+        else:
+            put = quantizing_put(put, raw_put)
 
     ckpt = find_checkpoint_dir(cfg.model_path, model_cfg.name) \
         if cfg.model_path else None
@@ -258,21 +310,32 @@ def build_engine(cfg: Config) -> EngineBase:
                                                         load_prepared,
                                                         save_prepared)
 
-        quant = cfg.quantize == "int8"
+        quant = weight_quant
         params = load_prepared(model_cfg, cfg.model_path, dtype, quant,
-                               mesh, ckpt_dir=ckpt)
+                               mesh, ckpt_dir=ckpt,
+                               group=cfg.weight_quant_group)
         loaded = True
         if params is None:
             params = load_params(model_cfg, ckpt, dtype, put)
-            if quant:
+            if quant == "int8":
                 log.info("Quantized matmul weights to int8 "
                          "(per-channel symmetric, host-side per tensor)")
+            elif quant == "int4":
+                log.info(
+                    "Quantized layer matmuls to int4 (group-wise "
+                    f"symmetric, group={cfg.weight_quant_group}, "
+                    "data-free scales; run scripts/quantize_checkpoint.py "
+                    "for AWQ-calibrated scales — its output lands in the "
+                    "same prepared cache this load path reads)")
             # Cache the engine-ready pytree so the next restart skips
             # the whole safetensors->stack->cast->quantize->shard
-            # pipeline (best-effort).
+            # pipeline (best-effort). An AWQ-calibrated cache written by
+            # scripts/quantize_checkpoint.py has the same meta and wins
+            # by already existing.
             save_prepared(params, cfg.model_path,
                           cache_meta(model_cfg, dtype, quant, mesh,
-                                     ckpt_dir=ckpt))
+                                     ckpt_dir=ckpt,
+                                     group=cfg.weight_quant_group))
     else:
         # No checkpoint: random init directly on the device(s) — zero
         # host->device weight transfer (models/loader.py).
@@ -281,8 +344,8 @@ def build_engine(cfg: Config) -> EngineBase:
         log.warning(f"No checkpoint for {model_cfg.name!r} under "
                     f"{cfg.model_path!r}; using random-initialised weights")
         params, loaded = init_params_device(
-            model_cfg, dtype, mesh=mesh,
-            quantize=cfg.quantize == "int8"), False
+            model_cfg, dtype, mesh=mesh, quantize=weight_quant,
+            weight_quant_group=cfg.weight_quant_group), False
     tokenizer = load_tokenizer(cfg.model_path, cfg.model_name,
                                cfg.tokenizer_path,
                                template=model_cfg.chat_template)
@@ -312,8 +375,8 @@ def build_engine(cfg: Config) -> EngineBase:
         f"({model_cfg.param_count() / 1e9:.2f}B params, "
         f"weights {'loaded' if loaded else 'random-init'}), "
         f"slots={cfg.decode_slots}, max_len={cfg.max_model_len}, "
-        f"dtype={cfg.dtype}, kv_quant={cfg.kv_quant}, "
-        f"kv_layout={cfg.kv_layout}"
+        f"dtype={cfg.dtype}, weight_quant={weight_quant}, "
+        f"kv_quant={cfg.kv_quant}, kv_layout={cfg.kv_layout}"
         + (f" ({acct['kv_pool_blocks']} x {cfg.kv_block_size}-token "
            f"blocks)" if cfg.kv_layout == "paged" else "")
         + f", mesh={dict(mesh.shape) if mesh else 'single-device'}")
@@ -324,6 +387,9 @@ def build_engine(cfg: Config) -> EngineBase:
         context_window=min(cfg.default_context_window, cfg.max_model_len),
         mesh=mesh, use_pallas_attention=cfg.use_pallas_attention,
         use_pallas_int8=cfg.use_pallas_int8,
+        weight_quant=weight_quant,
+        weight_quant_group=cfg.weight_quant_group,
+        use_pallas_int4=cfg.use_pallas_int4,
         steps_per_call=cfg.decode_steps_per_call,
         pipeline_depth=cfg.pipeline_depth,
         sampling_method=cfg.sampling,

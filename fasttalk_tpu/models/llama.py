@@ -188,6 +188,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             write_mask: jnp.ndarray | None = None,
             pallas_decode: bool = False,
             pallas_int8: bool = False,
+            pallas_int4: bool = False,
             logits_indices: jnp.ndarray | None = None,
             attn_override: Any = None,
             override_write: bool = False,
@@ -243,6 +244,10 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     # attention kernel's (pallas_decode) — disabling one must not
     # silently disable the other.
     pok = pallas_int8 and t == 1
+    # Same regime for the int4 dequant-fused kernel (gated separately:
+    # TPU_USE_PALLAS_INT4); on {"q4","s"} leaves qmm's XLA path unpacks
+    # nibbles inline, so pok4=False still never materialises f32.
+    pok4 = pallas_int4 and t == 1
     # Int8 KV tier: quantize each fresh row at write time, dequantize
     # on the attention read (fused into the operand load — XLA path;
     # ops/kv_quant.py). The self-attention override regimes (ring
@@ -258,8 +263,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     def layer(x, scanned):
         lp, ck, cv, ks, vs = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
-                   qmm(h, lp["wv"], pok))
+        q, k, v = (qmm(h, lp["wq"], pok, pok4), qmm(h, lp["wk"], pok, pok4),
+                   qmm(h, lp["wv"], pok, pok4))
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
@@ -296,11 +301,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             else:
                 attn_fn = attend_blockwise if blockwise else attend
                 o = attn_fn(q, ak, av, positions)
-        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
+        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok, pok4)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
-        up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
-        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
+        gate = jax.nn.silu(
+            qmm(h, lp["w_gate"], pok, pok4).astype(jnp.float32))
+        up = qmm(h, lp["w_up"], pok, pok4).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok, pok4)
         return x, (ck, cv, ks, vs)
 
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
@@ -325,6 +331,7 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
                          tokens: jnp.ndarray, positions: jnp.ndarray,
                          cache: KVCache, write_mask: jnp.ndarray, *,
                          attn_len: int, pallas_int8: bool = False,
+                         pallas_int4: bool = False,
                          block_table: jnp.ndarray | None = None,
                          block_size: int = 0,
                          pallas_paged: bool = False,
@@ -395,9 +402,12 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
     def layer(carry, lp):
         x, ck_all, cv_all, ks_all, vs_all, li = carry
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        pok = pallas_int8
-        q, k, v = (qmm(h, lp["wq"], pok), qmm(h, lp["wk"], pok),
-                   qmm(h, lp["wv"], pok))
+        # The T=1 kernels self-gate on shape inside qmm (x.shape[1]==1),
+        # so the spec-decode verify block (T>1) transparently takes the
+        # XLA dequant paths with the same flags.
+        pok, pok4 = pallas_int8, pallas_int4
+        q, k, v = (qmm(h, lp["wq"], pok, pok4), qmm(h, lp["wk"], pok, pok4),
+                   qmm(h, lp["wv"], pok, pok4))
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
@@ -471,11 +481,12 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
                 ak = kv_dequantize(ak, aks, x.dtype)
                 av = kv_dequantize(av, avs, x.dtype)
             o = attend(q, ak, av, pos_mat)
-        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok)
+        x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok, pok4)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        gate = jax.nn.silu(qmm(h, lp["w_gate"], pok).astype(jnp.float32))
-        up = qmm(h, lp["w_up"], pok).astype(jnp.float32)
-        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok)
+        gate = jax.nn.silu(
+            qmm(h, lp["w_gate"], pok, pok4).astype(jnp.float32))
+        up = qmm(h, lp["w_up"], pok, pok4).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(x.dtype), lp["w_down"], pok, pok4)
         return (x, ck_all, cv_all, ks_all, vs_all, li + 1), None
 
     (x, new_k, new_v, new_ks, new_vs, _), _ = jax.lax.scan(
@@ -497,7 +508,7 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
 def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
                    positions: jnp.ndarray, cache: KVCache,
                    write_mask: jnp.ndarray, *, attn_len: int,
-                   pallas_int8: bool = False,
+                   pallas_int8: bool = False, pallas_int4: bool = False,
                    block_table: jnp.ndarray | None = None,
                    block_size: int = 0, pallas_paged: bool = False,
                    ) -> tuple[jnp.ndarray, KVCache]:
@@ -514,6 +525,7 @@ def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
     logits, new_cache = forward_decode_multi(
         params, cfg, cur[:, None], positions, cache, write_mask,
         attn_len=attn_len, pallas_int8=pallas_int8,
+        pallas_int4=pallas_int4,
         block_table=block_table, block_size=block_size,
         pallas_paged=pallas_paged)
     return logits[:, 0], new_cache

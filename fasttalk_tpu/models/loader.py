@@ -141,8 +141,9 @@ def load_params(cfg: ModelConfig, ckpt_dir: str,
 
 
 def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
-                       mesh=None, quantize: bool = False,
-                       seed: int = 0) -> Params:
+                       mesh=None, quantize: bool | str = False,
+                       seed: int = 0,
+                       weight_quant_group: int = 128) -> Params:
     """Architecture-faithful random init generated ON the device(s),
     one jitted program per leaf — zero host->device weight transfer,
     which matters both for multi-chip placement (each leaf materialises
@@ -151,11 +152,21 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
     through the relay; this ships one RNG key). ``quantize``
     int8-quantizes matmul leaves inside the same per-leaf program,
     layer by layer, so the f32 generation buffer never exceeds one
-    layer slice (see the peak-memory note below).
+    layer slice (see the peak-memory note below). It also accepts a
+    tier string — "none"/"off" | "int8" (== True) | "int4", the
+    WEIGHT_QUANT surface; int4 packs the seven layer matmuls group-wise
+    (``weight_quant_group``; fasttalk_tpu/quantization/int4.py) while
+    the embedding/lm_head keep their int8 per-row formats.
     """
     import zlib
 
     from fasttalk_tpu.ops.quant import QUANTIZED_LEAVES
+    from fasttalk_tpu.quantization.int4 import INT4_LEAVES
+
+    tier = (quantize if isinstance(quantize, str)
+            else ("int8" if quantize else "none"))
+    tier = {"off": "none", "": "none"}.get(tier, tier)
+    weight_quant_group = int(weight_quant_group)
 
     shapes = jax.eval_shape(
         lambda: init_params(cfg, jax.random.PRNGKey(seed), dtype))
@@ -174,7 +185,10 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
         # leaf_quantize: False | "out" (per-output-channel, matmul
         # weights) | "row" (per-row, the embedding) | "out_t" (the
         # untied lm_head, stored transposed — ops/quant.py
-        # _quantize_head_t; same scale math, kernel-streamable layout).
+        # _quantize_head_t; same scale math, kernel-streamable layout)
+        # | "group" (int4 group-wise + nibble packing, shared math with
+        # quantization/int4.py so generated and checkpoint-quantized
+        # leaves can never diverge).
         if kind == "ones":
             return jnp.ones(shape, dtype)
         if kind == "zeros":
@@ -200,6 +214,25 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
             # Layer-stacked: generate one [in, out] f32 slice per layer
             # and write it into the accumulator in place.
             num_layers = shape[0]
+            if leaf_quantize == "group":
+                from fasttalk_tpu.quantization.int4 import (
+                    pack_int4, quantize_math_group)
+
+                def body(layer, acc):
+                    accq, accs = acc
+                    sl = make_slice(jax.random.fold_in(key, layer),
+                                    shape[1:])
+                    q, s = quantize_math_group(sl, weight_quant_group)
+                    return (accq.at[layer].set(pack_int4(q)),
+                            accs.at[layer].set(s))
+
+                accq, accs = jax.lax.fori_loop(
+                    0, num_layers, body,
+                    (jnp.zeros((shape[0], shape[1] // 2, shape[2]),
+                               jnp.uint8),
+                     jnp.zeros((shape[0], shape[1] // weight_quant_group,
+                                shape[2]), jnp.float32)))
+                return {"q4": accq, "s": accs}
             if leaf_quantize:
                 def body(layer, acc):
                     accq, accs = acc
@@ -266,9 +299,11 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
         else:
             kind = "normal"
         leaf_quantize: bool | str = False
-        if quantize and kind == "normal":
+        if tier != "none" and kind == "normal":
             if name == "lm_head":
                 leaf_quantize = "out_t"
+            elif tier == "int4" and name in INT4_LEAVES:
+                leaf_quantize = "group"
             elif name in QUANTIZED_LEAVES:
                 leaf_quantize = "out"
             elif name == "embed":
@@ -285,7 +320,17 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
             from fasttalk_tpu.parallel.sharding import (_parent_name,
                                                         _spec_for)
 
-            if leaf_quantize:
+            if leaf_quantize == "group":
+                qshape = shape[:-2] + (shape[-2] // 2, shape[-1])
+                s_shape = shape[:-2] + (
+                    shape[-2] // weight_quant_group, shape[-1])
+                out_sh = {
+                    "q4": NamedSharding(mesh, _spec_for(
+                        "q4", len(qshape), qshape, parent=name)),
+                    "s": NamedSharding(mesh, _spec_for(
+                        "s", len(s_shape), s_shape, parent=name)),
+                }
+            elif leaf_quantize:
                 s_shape = (shape[:-1] if leaf_quantize == "row"
                            else shape[:-2] + shape[-1:])
                 qname = "qt" if leaf_quantize == "out_t" else "q"
@@ -307,7 +352,7 @@ def init_params_device(cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16,
 
     params = jax.tree_util.tree_map_with_path(gen, shapes)
     log.info(f"Random-initialised {cfg.name} on device "
-             f"({'int8' if quantize else jnp.dtype(dtype).name}"
+             f"({tier if tier != 'none' else jnp.dtype(dtype).name}"
              f"{', sharded' if mesh is not None else ''})")
     return params
 

@@ -46,16 +46,25 @@ def checkpoint_fingerprint(ckpt_dir: str | None) -> list | None:
     return out
 
 
-def cache_meta(cfg: ModelConfig, dtype, quantize: bool, mesh,
-               ckpt_dir: str | None = None) -> dict:
-    return {
+def _tier(quantize: bool | str) -> str:
+    """Normalize the quantize argument: legacy bool (int8 on/off) or a
+    WEIGHT_QUANT tier string. Returns "none" | "int8" | "int4"."""
+    t = quantize if isinstance(quantize, str) else (
+        "int8" if quantize else "none")
+    return {"off": "none", "": "none"}.get(t, t)
+
+
+def cache_meta(cfg: ModelConfig, dtype, quantize: bool | str, mesh,
+               ckpt_dir: str | None = None, group: int = 128) -> dict:
+    tier = _tier(quantize)
+    meta = {
         # 2: int8 now also row-quantizes the embedding (ops/quant.py
         # EMBED_LEAF) — format bump invalidates r2-era caches whose
         # pytree lacks the embed {q, s} dict.
         "format": 2,
         "model": cfg.name,
         "dtype": jnp.dtype(dtype).name,
-        "quantize": "int8" if quantize else "none",
+        "quantize": tier,
         "mesh": dict(mesh.shape) if mesh is not None else None,
         # Device topology: Orbax sharding metadata references concrete
         # device names, and restoring under a different topology (e.g.
@@ -66,22 +75,34 @@ def cache_meta(cfg: ModelConfig, dtype, quantize: bool, mesh,
         "devices": [jax.devices()[0].platform, jax.device_count()],
         "source": checkpoint_fingerprint(ckpt_dir),
     }
+    if tier == "int4":
+        # Group size changes the scale-leaf shapes; only present for
+        # int4 so pre-existing none/int8 metas keep comparing equal.
+        meta["group"] = int(group)
+    return meta
 
 
 def cache_dir(model_path: str, meta: dict) -> str:
     mesh = meta["mesh"] or {}
+    quant = meta["quantize"]
+    if meta.get("group"):
+        quant = f"{quant}-g{meta['group']}"
     tag = "-".join([meta["model"].replace(":", "_"), meta["dtype"],
-                    meta["quantize"],
+                    quant,
                     "x".join(f"{k}{v}" for k, v in sorted(mesh.items()))
                     or "single"])
     return os.path.join(model_path, ".prepared", tag)
 
 
-def abstract_params(cfg: ModelConfig, dtype, quantize: bool, mesh) -> Any:
+def abstract_params(cfg: ModelConfig, dtype, quantize: bool | str, mesh,
+                    group: int = 128) -> Any:
     """ShapeDtypeStruct pytree (with shardings when meshed) matching what
     the factory's load path produces — the restore target."""
     from fasttalk_tpu.ops.quant import QUANTIZED_LEAVES
+    from fasttalk_tpu.quantization.int4 import INT4_LEAVES
 
+    tier = _tier(quantize)
+    quantize = tier != "none"
     shapes = jax.eval_shape(
         lambda: init_params(cfg, jax.random.PRNGKey(0), dtype))
 
@@ -112,6 +133,15 @@ def abstract_params(cfg: ModelConfig, dtype, quantize: bool, mesh) -> Any:
             return {
                 "qt": with_sharding((v, d), jnp.int8, "qt", name),
                 "s": with_sharding((v,), jnp.float32, "s", name),
+            }
+        if tier == "int4" and name in INT4_LEAVES:
+            k, out = sds.shape[-2], sds.shape[-1]
+            lead = sds.shape[:-2]
+            return {
+                "q4": with_sharding(lead + (k // 2, out), jnp.uint8,
+                                    "q4", name),
+                "s": with_sharding(lead + (k // int(group), out),
+                                   jnp.float32, "s", name),
             }
         if quantize and name in QUANTIZED_LEAVES:
             out = sds.shape[-1]
@@ -179,10 +209,11 @@ def save_prepared(params: Any, model_path: str, meta: dict,
 
 
 def load_prepared(cfg: ModelConfig, model_path: str, dtype,
-                  quantize: bool, mesh,
-                  ckpt_dir: str | None = None) -> Any | None:
+                  quantize: bool | str, mesh,
+                  ckpt_dir: str | None = None,
+                  group: int = 128) -> Any | None:
     """Restore the engine-ready pytree, or None when absent/mismatched."""
-    meta = cache_meta(cfg, dtype, quantize, mesh, ckpt_dir)
+    meta = cache_meta(cfg, dtype, quantize, mesh, ckpt_dir, group=group)
     path = cache_dir(model_path, meta)
     meta_file = os.path.join(path, _META)
     if not os.path.isfile(meta_file):
@@ -195,7 +226,7 @@ def load_prepared(cfg: ModelConfig, model_path: str, dtype,
             return None
         import orbax.checkpoint as ocp
 
-        target = abstract_params(cfg, dtype, quantize, mesh)
+        target = abstract_params(cfg, dtype, quantize, mesh, group=group)
         ckptr = ocp.StandardCheckpointer()
         params = ckptr.restore(os.path.abspath(path), target)
         log.info(f"restored prepared weights from {path}")

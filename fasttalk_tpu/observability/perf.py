@@ -154,6 +154,12 @@ class PerfLedger:
         # size again.
         self._kv_quant = "none"
         self._kv_row_bytes = 0
+        # Weight-byte facts (bind_model): what one decode step streams
+        # of the RESIDENT weights — bf16, int8+scales, or nibble-packed
+        # int4+scales (WEIGHT_QUANT). The bandwidth and FLOP/byte
+        # figures read this instead of assuming params x 2 bytes.
+        self._weight_quant = "off"
+        self._weight_bytes_per_step = 0
         # Compile ledger: key -> {kind, count, serving, first/last ts}.
         self._compiles: dict[str, dict[str, Any]] = {}
         m = get_metrics()
@@ -198,6 +204,15 @@ class PerfLedger:
             "perf_kv_bw_util",
             "KV attention-read bandwidth vs the device HBM peak "
             "(0 when the peak is unknown; see PERF_PEAK_HBM_GBPS)")
+        self._m_w_gbps = m.gauge(
+            "perf_weight_read_gbps",
+            "weight bytes the decode calls streamed per wall second, at "
+            "the resident tier's size (bf16 / int8+scales / int4+scales "
+            "under WEIGHT_QUANT)")
+        self._m_hbm_bw = m.gauge(
+            "perf_hbm_bw_util",
+            "combined weight + KV read bandwidth vs the device HBM peak "
+            "(0 when the peak is unknown)")
         self._m_compiles = m.counter(
             "perf_serving_compiles_total",
             "jitted-executable compiles observed while serving traffic")
@@ -213,20 +228,25 @@ class PerfLedger:
 
     def bind_model(self, model_cfg: Any, num_slots: int,
                    dtype: str = "", kv_quant: str = "none",
-                   kv_row_bytes: int = 0) -> None:
+                   kv_row_bytes: int = 0, weight_quant: str = "off",
+                   weight_bytes_per_step: int = 0) -> None:
         """Attach the served model's cost estimate (engine __init__).
         FLOPs/token = 2·params (every weight partakes in one multiply-
         accumulate) + 4·layers·q_dim·kv_len (QKᵀ and A·V per head).
         ``kv_row_bytes``: what one attention read of one (slot,
         position) row costs across all layers, at the cache's actual
         element size — int8 rows + scales under KV_QUANT=int8, never
-        an assumed bf16."""
+        an assumed bf16. ``weight_bytes_per_step``: what one decode
+        step streams of the resident weights, at THEIR actual size
+        (WEIGHT_QUANT tier: bf16 / int8+scales / packed int4+scales)."""
         with self._lock:
             self._model_name = getattr(model_cfg, "name", "")
             self._num_slots = num_slots
             self._dtype = dtype
             self._kv_quant = kv_quant
             self._kv_row_bytes = int(kv_row_bytes)
+            self._weight_quant = weight_quant
+            self._weight_bytes_per_step = int(weight_bytes_per_step)
             self._params = int(model_cfg.param_count())
             self._flops_base = 2.0 * self._params
             self._flops_per_ctx = 4.0 * model_cfg.num_layers \
@@ -297,7 +317,10 @@ class PerfLedger:
             "model": {"name": self._model_name, "params": self._params,
                       "slots": self._num_slots, "dtype": self._dtype,
                       "kv_quant": self._kv_quant,
-                      "kv_row_bytes": self._kv_row_bytes},
+                      "kv_row_bytes": self._kv_row_bytes,
+                      "weight_quant": self._weight_quant,
+                      "weight_bytes_per_step":
+                          self._weight_bytes_per_step},
             "compiles": {
                 "total": sum(e["count"] for e in compiles),
                 "serving": sum(e["serving"] for e in compiles),
@@ -313,6 +336,11 @@ class PerfLedger:
             out["kv"] = {"bytes_read": 0, "read_gbps": 0.0,
                          "peak_hbm_gbps": peak_hbm or None,
                          "hbm_source": hbm_src, "bw_util": None}
+            out["weights"] = {"bytes_read": 0, "read_gbps": 0.0,
+                              "bw_util": None}
+            out["hbm"] = {"bytes_read": 0, "read_gbps": 0.0,
+                          "peak_hbm_gbps": peak_hbm or None,
+                          "bw_util": None, "flop_per_byte": None}
             return out
 
         # Wall-time decomposition: union the (clipped) call intervals,
@@ -365,7 +393,7 @@ class PerfLedger:
         decode_tokens = prefill_tokens = 0
         computed_rows = 0
         occ_weight = occ_sum = 0.0
-        flops = kv_bytes = 0.0
+        flops = kv_bytes = weight_bytes = 0.0
         for r in records:
             a = r.attrs
             flops += float(a.get("flops", 0.0))
@@ -375,6 +403,7 @@ class PerfLedger:
                                            int(a.get("steps", 0))
                                            * int(a.get("slots", 0))))
                 kv_bytes += float(a.get("kv_bytes", 0.0))
+                weight_bytes += float(a.get("weight_bytes", 0.0))
                 dur = max(0.0, r.t1 - r.t0)
                 occ_weight += dur
                 occ_sum += dur * float(a.get("occupancy", 0.0))
@@ -418,6 +447,30 @@ class PerfLedger:
             "bw_util": round(kv_gbps / peak_hbm, 6)
             if peak_hbm > 0 else None,
         }
+        # Weight-read bandwidth at the RESIDENT tier's size (recorded
+        # per step by the engine, never recomputed from an assumed
+        # bf16): WEIGHT_QUANT=int4 shows up directly as read_gbps
+        # dropping ~4x at the same tok/s. The combined "hbm" section is
+        # the honest roofline operand — decode arithmetic intensity
+        # (flop_per_byte) over weights + KV together.
+        w_gbps = weight_bytes / window / 1e9 if window > 0 else 0.0
+        out["weights"] = {
+            "bytes_read": weight_bytes,
+            "read_gbps": w_gbps,
+            "bw_util": round(w_gbps / peak_hbm, 6)
+            if peak_hbm > 0 else None,
+        }
+        hbm_bytes = kv_bytes + weight_bytes
+        hbm_gbps = kv_gbps + w_gbps
+        out["hbm"] = {
+            "bytes_read": hbm_bytes,
+            "read_gbps": hbm_gbps,
+            "peak_hbm_gbps": peak_hbm or None,
+            "bw_util": round(hbm_gbps / peak_hbm, 6)
+            if peak_hbm > 0 else None,
+            "flop_per_byte": round(flops / hbm_bytes, 4)
+            if hbm_bytes > 0 else None,
+        }
         return out
 
     def summary(self, now: float | None = None) -> dict[str, Any]:
@@ -438,6 +491,10 @@ class PerfLedger:
             "achieved_tflops": mfu.get("achieved_tflops"),
             "kv_read_gbps": kv.get("read_gbps"),
             "kv_bw_util": kv.get("bw_util"),
+            "weight_read_gbps": (rep.get("weights") or {}).get(
+                "read_gbps"),
+            "hbm_bw_util": (rep.get("hbm") or {}).get("bw_util"),
+            "flop_per_byte": (rep.get("hbm") or {}).get("flop_per_byte"),
             "serving_compiles": rep["compiles"]["serving"],
         }
 
@@ -460,6 +517,9 @@ class PerfLedger:
         self._m_peak.set(mfu.get("peak_tflops") or 0.0)
         self._m_kv_gbps.set(kv.get("read_gbps") or 0.0)
         self._m_kv_bw.set(kv.get("bw_util") or 0.0)
+        self._m_w_gbps.set((rep.get("weights") or {}).get("read_gbps")
+                           or 0.0)
+        self._m_hbm_bw.set((rep.get("hbm") or {}).get("bw_util") or 0.0)
 
     def clear(self) -> None:
         """Test hook: drop the compile ledger IN PLACE. The model

@@ -35,6 +35,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.x ships this dataclass as TPUCompilerParams; newer releases
+# renamed it. Resolve once so the kernels run on both.
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 # Per-block VMEM budget for the streamed q block (bytes, int8 elems).
 # Double-buffered by the pipeline: 2x this resides in VMEM. XLA's
 # scoped-vmem limit DEFAULTS to 16 MiB on this toolchain (measured:
@@ -104,7 +109,7 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray,
         out_specs=pl.BlockSpec((m, n), lambda kb: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((m, n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x, q, s.reshape(1, n))
@@ -145,7 +150,7 @@ def int8_matmul_t(x: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((m, bv), lambda vb: (0, vb)),
         out_shape=jax.ShapeDtypeStruct((m, v), x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x, q, s.reshape(1, v))
@@ -174,4 +179,114 @@ def supports_t(x_shape, q_shape, itemsize: int = 2) -> bool:
     if d % 128 != 0 or bv is None:
         return False
     vmem = 2 * bv * d + 2 * itemsize * m * bv + itemsize * m * d
+    return vmem <= _VMEM_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# Int4: nibble-packed weights (fasttalk_tpu/quantization/int4.py format),
+# unpacked IN-REGISTER per tile so the packed uint8 bytes are what
+# crosses HBM — a further 2x byte cut over the int8 kernel above.
+# ---------------------------------------------------------------------------
+
+
+def _row_block4(k: int, n: int, group: int) -> int | None:
+    """Unpacked-row block size for the int4 kernel: a multiple of the
+    scale group (so each block owns whole groups), >= 128 (lane-dim
+    floor, see _row_block), dividing ``k``, with the unpacked int8 tile
+    held to half the int8 kernel's block budget — the dequant pipeline
+    (unpack -> cast -> scale-multiply) keeps ~2 extra tiles of that
+    size live in VMEM."""
+    best = None
+    b = group
+    while b <= k and k % b == 0:
+        if b >= 128 and b * n <= _BLOCK_BYTES // 2:
+            best = b
+        b *= 2
+    return best
+
+
+def _mm4_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, k_blocks: int,
+                group: int, out_dtype):
+    kb = pl.program_id(0)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Unpack two's-complement nibbles: packed row j holds original row
+    # 2j in the low nibble, 2j+1 in the high one. int8 ``>>`` is
+    # arithmetic, so ``(b << 4) >> 4`` sign-extends the low nibble.
+    b = q_ref[:].astype(jnp.int8)  # [bk/2, n] packed pairs
+    lo = (b << 4) >> 4
+    hi = b >> 4
+    bkp, n = b.shape
+    w = jnp.stack([lo, hi], axis=1).reshape(2 * bkp, n).astype(x_ref.dtype)
+    # Expand group scales [gpb, n] -> [bk, n] with leading-dim-only
+    # broadcast+reshape (Mosaic-friendly: lane dim untouched). Group
+    # scales vary along K, so the multiply must happen per-tile inside
+    # the accumulation — it cannot factor out like the int8 kernel's
+    # per-N scale.
+    gpb = s_ref.shape[0]
+    sexp = jnp.broadcast_to(
+        s_ref[:].astype(x_ref.dtype)[:, None, :],
+        (gpb, group, n)).reshape(gpb * group, n)
+    acc_ref[:] += jax.lax.dot(x_ref[:], w * sexp,
+                              preferred_element_type=jnp.float32)
+
+    @pl.when(kb == k_blocks - 1)
+    def _out():
+        o_ref[:] = acc_ref[:].astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int4_matmul(x: jnp.ndarray, q4: jnp.ndarray, s: jnp.ndarray,
+                interpret: bool | None = None) -> jnp.ndarray:
+    """x [M, K] @ dequant(q4 [K/2, N] packed int4, s [K/G, N]) -> [M, N]."""
+    m, k = x.shape
+    kp, n = q4.shape
+    assert k == 2 * kp, (k, kp)
+    groups = s.shape[0]
+    assert s.shape == (groups, n) and k % groups == 0
+    group = k // groups
+    bk = _row_block4(k, n, group)
+    assert bk is not None, (k, n, group)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k_blocks = k // bk
+
+    return pl.pallas_call(
+        functools.partial(_mm4_kernel, k_blocks=k_blocks, group=group,
+                          out_dtype=x.dtype),
+        grid=(k_blocks,),
+        in_specs=[
+            pl.BlockSpec((m, bk), lambda kb: (0, kb)),
+            pl.BlockSpec((bk // 2, n), lambda kb: (kb, 0)),  # contiguous rows
+            pl.BlockSpec((bk // group, n), lambda kb: (kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((m, n), lambda kb: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((m, n), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x, q4, s)
+
+
+def supports_q4(x_shape, q4_shape, s_shape, itemsize: int = 2) -> bool:
+    """True when the int4 kernel's blocking constraints hold."""
+    if len(x_shape) != 2 or len(q4_shape) != 2 or len(s_shape) != 2:
+        return False
+    m = x_shape[0]
+    kp, n = q4_shape
+    k = 2 * kp
+    groups = s_shape[0]
+    if s_shape[1] != n or groups <= 0 or k % groups:
+        return False
+    group = k // groups
+    bk = _row_block4(k, n, group)
+    if n % 128 != 0 or bk is None:
+        return False
+    # Packed block double-buffered (bk//2 * n * 2 = bk*n) + unpacked
+    # int8 + dequantized/scaled tiles + accumulator + x + out.
+    vmem = (2 + 2 * itemsize) * bk * n + 4 * m * n + itemsize * m * (n + k)
     return vmem <= _VMEM_BUDGET

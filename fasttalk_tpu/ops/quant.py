@@ -102,15 +102,34 @@ def quantize_params(params: Any) -> Any:
     return out
 
 
-def matmul(x: jax.Array, w: Any, pallas_ok: bool = False) -> jax.Array:
+def matmul(x: jax.Array, w: Any, pallas_ok: bool = False,
+           pallas_int4: bool = False) -> jax.Array:
     """``x @ w`` for a plain or quantized weight leaf.
 
     For int8 weights the convert happens inside the matmul; with
     ``pallas_ok`` (single-device decode, T=1) the Pallas kernel
     (ops/pallas_int8.py) converts tile-by-tile in VMEM and scales the
     accumulator, avoiding XLA's per-step weight re-materialisation.
+    Int4 leaves (``{"q4", "s"}``, fasttalk_tpu/quantization/) dequantize
+    in the operand read: nibble unpack → int8 → x.dtype × group scales,
+    never a full f32 weight; ``pallas_int4`` (TPU_USE_PALLAS_INT4)
+    routes T=1 decode to the in-register unpacking kernel instead.
     """
     if isinstance(w, dict):
+        if "q4" in w:
+            if pallas_int4 and x.ndim == 3 and x.shape[1] == 1:
+                from fasttalk_tpu.ops.pallas_int8 import (int4_matmul,
+                                                          supports_q4)
+
+                if supports_q4((x.shape[0], x.shape[2]), w["q4"].shape,
+                               w["s"].shape, jnp.dtype(x.dtype).itemsize):
+                    return int4_matmul(x[:, 0], w["q4"], w["s"])[:, None]
+            from fasttalk_tpu.quantization.int4 import unpack_int4
+
+            group = (2 * w["q4"].shape[-2]) // w["s"].shape[-2]
+            wd = unpack_int4(w["q4"]).astype(x.dtype)
+            wd = wd * jnp.repeat(w["s"].astype(x.dtype), group, axis=-2)
+            return x @ wd
         if "qt" in w:
             # Transposed untied lm_head {"qt": [V, D], "s": [V]}: the
             # same contiguous row-block kernel as the tied embedding

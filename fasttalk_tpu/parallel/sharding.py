@@ -70,8 +70,19 @@ def _spec_for(name: str, ndim: int, shape=None, parent: str = "") -> P:
     Int8-quantized leaves (ops/quant.py) appear as {"q", "s"} dicts under
     the weight's name: "q" shards exactly like the original weight; the
     per-output-channel scale "s" shards like the weight's last axis.
+
+    Int4 leaves (fasttalk_tpu/quantization/int4.py) appear as
+    {"q4", "s"}: the nibble packing pairs ADJACENT contraction rows, so
+    a contiguous packed-row shard maps to a contiguous original-row
+    shard and "q4" reuses the weight's own spec unchanged; the rank-3
+    group scale [..., K/G, N] hits the generic scale branch below,
+    which keeps base[:-1] — the group axis inherits the contraction
+    axis's placement (sharded over "tp" for row-parallel wo/w_down,
+    replicated for column-parallel leaves), exactly where its rows
+    live. ``validate_int4_tp`` checks the divisibility those shards
+    need.
     """
-    if name in ("q", "qt", "s") and parent:
+    if name in ("q", "qt", "q4", "s") and parent:
         base = _TOP_RULES.get(parent) or _LAYER_RULES.get(parent)
         if base is not None:
             if name == "qt":
@@ -79,7 +90,7 @@ def _spec_for(name: str, ndim: int, shape=None, parent: str = "") -> P:
                 # _quantize_head_t): vocab axis stays TP-sharded,
                 # now leading.
                 spec = P(base[-1], *base[:-1])
-            elif name == "q":
+            elif name in ("q", "q4"):
                 spec = base
             elif parent == "embed":
                 # Embedding quantizes per ROW (ops/quant.py): the scale
@@ -144,6 +155,28 @@ def validate_tp(tp: int, num_kv_heads: int, num_heads: int,
     for dim, label in dims:
         if dim % tp:
             raise ValueError(f"tp={tp} does not divide {label}={dim}")
+
+
+def validate_int4_tp(tp: int, *, q_dim: int, intermediate: int,
+                     group: int) -> None:
+    """Divisibility the int4 leaves add on top of ``validate_tp``: the
+    row-parallel weights (wo, w_down) shard their PACKED contraction
+    axis and their group-scale axis over "tp", so tp must divide both
+    the packed row count (dim/2 — a shard boundary must never split a
+    nibble pair) and the group count (dim/group — nor split a scale
+    group)."""
+    for dim, label in ((q_dim, "q_dim (wo)"),
+                       (intermediate, "intermediate_size (w_down)")):
+        if (dim // 2) % tp:
+            raise ValueError(
+                f"tp={tp} does not divide the packed int4 row count "
+                f"{label.split(' ')[0]}/2={dim // 2} for {label}; a shard "
+                f"boundary would split a nibble pair")
+        if (dim // group) % tp:
+            raise ValueError(
+                f"tp={tp} does not divide the int4 scale-group count "
+                f"{dim}//{group}={dim // group} for {label}; a shard "
+                f"boundary would split a scale group")
 
 
 def validate_mesh(mesh: Mesh, *, num_kv_heads: int, num_heads: int,

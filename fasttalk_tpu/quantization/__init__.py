@@ -1,0 +1,28 @@
+"""Int4 group-wise weight quantization tier (WEIGHT_QUANT=int4).
+
+The reference's highest-throughput production config served an AWQ-INT4
+checkpoint — quantization it bought from its external vLLM container
+(reference: docker-compose.vllm.yml:38-41). This package is the in-tree
+answer: group-wise symmetric 4-bit weights with nibble-packed storage
+(int4.py), an activation-aware AWQ-style scale search run offline
+against the tinychat corpus (awq.py, scripts/quantize_checkpoint.py),
+and the serving hot path in ops/quant.py + ops/pallas_int8.py that
+dequantizes inside the matmul operand read so the packed bytes are what
+crosses HBM. See docs/QUANTIZATION.md.
+"""
+
+from fasttalk_tpu.quantization.int4 import (GROUP_DEFAULT, INT4_LEAVES,
+                                            dequantize_int4, group_size_of,
+                                            is_int4, pack_int4,
+                                            quantize_group,
+                                            quantize_math_group,
+                                            quantize_params_int4,
+                                            quantizing_put_int4, unpack_int4,
+                                            validate_group)
+
+__all__ = [
+    "GROUP_DEFAULT", "INT4_LEAVES", "dequantize_int4", "group_size_of",
+    "is_int4", "pack_int4", "quantize_group", "quantize_math_group",
+    "quantize_params_int4", "quantizing_put_int4", "unpack_int4",
+    "validate_group",
+]

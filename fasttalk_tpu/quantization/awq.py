@@ -1,0 +1,258 @@
+"""Activation-aware int4 scale search (AWQ-style) for the int4 tier.
+
+Data-free group quantization (int4.py) spends its 16 codes uniformly
+over each group's range — but a handful of input channels carry most of
+the activation magnitude (the AWQ observation, PAPERS.md), and rounding
+THOSE channels coarsely is what actually moves the logits. This module
+re-balances the codes with two classic moves, searched per layer
+against a real calibration batch:
+
+1. **Norm-fold channel scaling** for the matmuls fed directly by an
+   RMSNorm (wq/wk/wv after attn_norm; w_gate/w_up after mlp_norm).
+   Per input channel j, ``s_j = mean|h_j| ** alpha`` (geometric-mean
+   normalised); the weight rows are multiplied by ``s`` BEFORE
+   quantization and the norm's gain vector divided by ``s`` — exact in
+   float (``rms_norm(x, n/s) == rms_norm(x, n)/s``), so the only net
+   change is where the quantizer spends its precision. Alpha is
+   grid-searched per layer-group to minimise output MSE against the
+   float matmul on the calibration activations.
+2. **Clip search** for the matmuls with no foldable norm upstream
+   (wo reads the attention output, w_down the gated MLP product):
+   shrinking each group's maxabs by ``c < 1`` clips rare outliers but
+   refines the step for everything else; ``c`` is grid-searched per
+   layer the same way.
+
+The calibration forward runs the model layer-by-layer in float32 with
+the ORIGINAL weights (stats must reflect what the served activations
+look like), reusing the exact serving math — llama.rms_norm, ops.rope,
+ops.attention.attend — so the stats can never drift from the model.
+
+The embedding and lm_head keep their int8 per-row formats (int4.py
+module docstring). Offline entry point: scripts/quantize_checkpoint.py,
+which writes the result into the same prepared-weight cache the factory
+load path reads.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fasttalk_tpu.quantization.int4 import (pack_int4, quantize_math_group,
+                                            unpack_int4)
+from fasttalk_tpu.utils.logger import get_logger
+
+log = get_logger("quantization.awq")
+
+# Alpha grid for the norm-fold search; 0.0 is the data-free identity,
+# so AWQ can never do worse than the fallback on its own objective.
+ALPHA_GRID = tuple(i / 10.0 for i in range(11))
+# Clip grid for wo/w_down; 1.0 is the data-free identity.
+CLIP_GRID = (1.0, 0.95, 0.9, 0.85, 0.8)
+# Calibration rows actually used for the per-candidate output-MSE
+# evaluations (the full batch feeds the channel stats, which are cheap).
+_EVAL_ROWS = 512
+
+
+def calibration_tokens(tokenizer: Any, *, n_samples: int = 16,
+                       seq_len: int = 256, seed: int = 0,
+                       source: str = "corpus") -> jnp.ndarray:
+    """[n, seq_len] int32 calibration batch.
+
+    ``source``: "corpus" draws rendered tinychat training conversations
+    (training/corpus.py — the distribution the shipped checkpoint was
+    trained on); any other value is a path to a UTF-8 text file whose
+    non-empty lines are the calibration documents. Token streams are
+    PACKED (concatenated, then sliced into rows) rather than padded —
+    pad tokens would pollute the channel statistics.
+    """
+    if source in ("", "corpus"):
+        from fasttalk_tpu.training.corpus import corpus_texts
+
+        texts = list(corpus_texts(max(n_samples * 2, 8), seed))
+    else:
+        with open(source, encoding="utf-8") as f:
+            texts = [ln for ln in (l.strip() for l in f) if ln]
+        if not texts:
+            raise ValueError(
+                f"WEIGHT_QUANT_CALIB file {source!r} has no non-empty "
+                "lines to calibrate on")
+    stream: list[int] = []
+    need = n_samples * seq_len
+    for text in texts:
+        stream.extend(tokenizer.encode(text))
+        if len(stream) >= need:
+            break
+    n = min(n_samples, len(stream) // seq_len)
+    if n < 1:
+        raise ValueError(
+            f"calibration source yielded only {len(stream)} tokens; "
+            f"need at least seq_len={seq_len} for one sample")
+    arr = jnp.asarray(stream[:n * seq_len], jnp.int32)
+    return arr.reshape(n, seq_len)
+
+
+def _dequant_candidate(w: jnp.ndarray, group: int,
+                       clip: float = 1.0) -> jnp.ndarray:
+    """Quantize-dequantize ``w`` [K, N] f32 with the group's maxabs
+    shrunk by ``clip`` — the reconstruction a served int4 leaf would
+    compute, for candidate scoring."""
+    k, n = w.shape
+    g = w.reshape(k // group, group, n)
+    s = jnp.maximum(jnp.max(jnp.abs(g), axis=-2) * clip / 7.0, 1e-8)
+    q = jnp.clip(jnp.round(g / s[:, None, :]), -8, 7)
+    return (q * s[:, None, :]).reshape(k, n)
+
+
+def _fold_search(h: jnp.ndarray, weights: dict[str, jnp.ndarray],
+                 group: int) -> tuple[float, jnp.ndarray]:
+    """Best (alpha, s) for one norm-fed weight group.
+
+    ``h`` [N, K] f32: the calibration activations entering the group's
+    matmuls; ``weights``: name -> [K, out] f32. Scores each alpha by
+    the summed output MSE of ``(h/s) @ dq(s*W)`` against ``h @ W``.
+    """
+    m = jnp.maximum(jnp.mean(jnp.abs(h), axis=0), 1e-8)  # [K]
+    he = h[:_EVAL_ROWS]
+    refs = {name: he @ w for name, w in weights.items()}
+    best = (jnp.inf, 0.0, jnp.ones_like(m))
+    for alpha in ALPHA_GRID:
+        s = m ** alpha
+        s = s / jnp.exp(jnp.mean(jnp.log(s)))  # geo-mean 1: pure re-balance
+        s = jnp.maximum(s, 1e-4)
+        err = 0.0
+        hs = he / s[None, :]
+        for name, w in weights.items():
+            dq = _dequant_candidate(w * s[:, None], group)
+            err += float(jnp.mean((hs @ dq - refs[name]) ** 2))
+        if err < best[0]:
+            best = (err, alpha, s)
+    return best[1], best[2]
+
+
+def _clip_search(h: jnp.ndarray, w: jnp.ndarray, group: int) -> float:
+    """Best maxabs-shrink factor for one norm-less weight [K, out]."""
+    he = h[:_EVAL_ROWS]
+    ref = he @ w
+    best = (jnp.inf, 1.0)
+    for clip in CLIP_GRID:
+        err = float(jnp.mean(
+            (he @ _dequant_candidate(w, group, clip) - ref) ** 2))
+        if err < best[0]:
+            best = (err, clip)
+    return best[1]
+
+
+def _quantize_clipped(w: jnp.ndarray, group: int, clip: float) -> dict:
+    """Pack [..., K, N] with the group maxabs shrunk by ``clip``."""
+    if clip >= 1.0:
+        q, s = quantize_math_group(w, group)
+        return {"q4": pack_int4(q), "s": s}
+    k, n = w.shape[-2], w.shape[-1]
+    g = w.astype(jnp.float32).reshape(w.shape[:-2] + (k // group, group, n))
+    s = jnp.maximum(jnp.max(jnp.abs(g), axis=-2) * clip / 7.0, 1e-8)
+    q = jnp.clip(jnp.round(g / s[..., None, :]), -8, 7).astype(jnp.int8)
+    return {"q4": pack_int4(q.reshape(w.shape[:-2] + (k, n))), "s": s}
+
+
+def quantize_params_awq(params: dict, cfg: Any, tokens: jnp.ndarray,
+                        group: int) -> tuple[dict, dict]:
+    """AWQ-calibrated int4 quantization of a FLOAT param pytree.
+
+    ``params``: unquantized pytree (models/loader.py layout, any float
+    dtype); ``tokens`` [B, T] from :func:`calibration_tokens`. Returns
+    (quantized pytree, manifest dict with the chosen alpha/clip per
+    layer and the per-layer output MSEs) — the manifest is what
+    scripts/quantize_checkpoint.py writes next to the prepared cache.
+    """
+    from fasttalk_tpu.models.llama import rms_norm
+    from fasttalk_tpu.ops.attention import attend
+    from fasttalk_tpu.ops.quant import _quantize_embed, _quantize_head_t
+    from fasttalk_tpu.ops.rope import apply_rope, rope_frequencies
+
+    group = int(group)
+    layers = params["layers"]
+    f32 = lambda a: jnp.asarray(a, jnp.float32)  # noqa: E731
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                            cfg.rope_scaling))
+    x = f32(params["embed"])[tokens]
+    out_layers: dict[str, list] = {
+        name: [] for name in ("attn_norm", "mlp_norm", "wq", "wk", "wv",
+                              "wo", "w_gate", "w_up", "w_down")}
+    manifest: dict[str, Any] = {"group": group, "layers": []}
+    for li in range(cfg.num_layers):
+        lp = {name: f32(w[li]) for name, w in layers.items()}
+        # --- attention block, float forward with the ORIGINAL weights
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = apply_rope(q.reshape(b, t, cfg.num_heads, cfg.head_dim),
+                       positions, inv_freq)
+        k = apply_rope(k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim),
+                       positions, inv_freq)
+        v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        o = attend(q, k, v, positions).reshape(b, t, cfg.q_dim)
+        x = x + o @ lp["wo"]
+        # --- MLP block
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu(h2 @ lp["w_gate"])
+        up = h2 @ lp["w_up"]
+        prod = gate * up
+        x = x + prod @ lp["w_down"]
+
+        # --- searches on this layer's captured activations
+        h_flat = h.reshape(-1, cfg.hidden_size)
+        a_attn, s_attn = _fold_search(
+            h_flat, {n: lp[n] for n in ("wq", "wk", "wv")}, group)
+        h2_flat = h2.reshape(-1, cfg.hidden_size)
+        a_mlp, s_mlp = _fold_search(
+            h2_flat, {n: lp[n] for n in ("w_gate", "w_up")}, group)
+        c_wo = _clip_search(o.reshape(-1, cfg.q_dim), lp["wo"], group)
+        c_down = _clip_search(prod.reshape(-1, cfg.intermediate_size),
+                              lp["w_down"], group)
+        for name, s in (("wq", s_attn), ("wk", s_attn), ("wv", s_attn),
+                        ("w_gate", s_mlp), ("w_up", s_mlp)):
+            out_layers[name].append(
+                _quantize_clipped(lp[name] * s[:, None], group, 1.0))
+        out_layers["attn_norm"].append(lp["attn_norm"] / s_attn)
+        out_layers["mlp_norm"].append(lp["mlp_norm"] / s_mlp)
+        out_layers["wo"].append(_quantize_clipped(lp["wo"], group, c_wo))
+        out_layers["w_down"].append(
+            _quantize_clipped(lp["w_down"], group, c_down))
+        manifest["layers"].append({
+            "layer": li, "alpha_attn": float(a_attn),
+            "alpha_mlp": float(a_mlp), "clip_wo": float(c_wo),
+            "clip_w_down": float(c_down)})
+        log.info(f"AWQ layer {li}: alpha_attn={a_attn:.1f} "
+                 f"alpha_mlp={a_mlp:.1f} clip_wo={c_wo:.2f} "
+                 f"clip_w_down={c_down:.2f}")
+
+    norm_dtype = params["layers"]["attn_norm"].dtype
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    for name, per_layer in out_layers.items():
+        if isinstance(per_layer[0], dict):
+            out["layers"][name] = {
+                "q4": jnp.stack([d["q4"] for d in per_layer]),
+                "s": jnp.stack([d["s"] for d in per_layer])}
+        else:
+            out["layers"][name] = jnp.stack(per_layer).astype(norm_dtype)
+    out["embed"] = _quantize_embed(f32(params["embed"]))
+    if "lm_head" in out:
+        out["lm_head"] = _quantize_head_t(f32(params["lm_head"]))
+    return out, manifest
+
+
+def dequant_error(w4: dict, wf: jnp.ndarray) -> float:
+    """Mean-squared weight reconstruction error (tests, manifests)."""
+    group = (2 * w4["q4"].shape[-2]) // w4["s"].shape[-2]
+    dq = unpack_int4(w4["q4"]).astype(jnp.float32) * jnp.repeat(
+        w4["s"].astype(jnp.float32), group, axis=-2)
+    return float(jnp.mean((dq - jnp.asarray(wf, jnp.float32)) ** 2))

@@ -1,0 +1,211 @@
+"""Group-wise symmetric int4 weight format: pack/unpack/quantize math.
+
+Storage format (docs/QUANTIZATION.md has the diagram): a matmul leaf
+``w: [..., K, N]`` becomes
+
+    {"q4": uint8[..., K/2, N], "s": f32[..., K/G, N]}
+
+* **Symmetric, two's-complement nibbles.** Each weight is rounded to
+  [-8, 7] against a per-(group, out-channel) scale ``s = maxabs / 7``.
+  -8 is representable but never produced by quantization (maxabs maps
+  to ±7), which keeps the codebook symmetric like the int8 tier.
+* **Adjacent-pair packing along the contraction axis.** Packed row j
+  holds original row 2j in the LOW nibble and row 2j+1 in the HIGH
+  nibble. Pairing *adjacent* rows (not split-halves) means a contiguous
+  range of packed rows maps to a contiguous range of original rows, so
+  the tp partition specs for "q4" are the weight's own specs and a
+  shard boundary never splits a nibble pair as long as the shard size
+  is even (parallel/sharding.py validates this).
+* **Group scales along the same axis.** G contraction rows share one
+  f32 scale per out-channel. G must be even (a nibble pair must never
+  straddle a group boundary) and divide every contraction dim it is
+  applied to — ``validate_group`` checks the model's dims up front.
+
+Only the seven stacked layer matmuls (``INT4_LEAVES``) go to int4. The
+embedding table and untied lm_head stay per-row/per-column int8 exactly
+as in the int8 tier: the embedding gather wants per-row scales, and the
+untied head keeps the transposed int8 layout the streaming Pallas
+kernel (``int8_matmul_t``) already serves.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Default contraction-group size; divides every matmul dim of the
+# shipped model shapes (tinychat: hidden 256, q_dim 256, intermediate
+# 768) and matches the AWQ paper's common setting.
+GROUP_DEFAULT = 128
+
+# Stacked per-layer matmul leaves that take the int4 format. Embedding
+# and lm_head deliberately excluded (module docstring).
+INT4_LEAVES = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"})
+
+
+def validate_group(model_cfg, group: int) -> None:
+    """Raise a named ValueError unless ``group`` fits the model's dims.
+
+    Checked once at engine build / checkpoint quantization so hot-path
+    code can assume clean divisibility.
+    """
+    group = int(group)
+    if group < 2 or group % 2:
+        raise ValueError(
+            f"WEIGHT_QUANT_GROUP must be an even integer >= 2 (int4 packs "
+            f"adjacent contraction rows into one byte, so a scale group "
+            f"must never split a nibble pair), got {group}")
+    dims = {
+        "hidden_size (wq/wk/wv/w_gate/w_up contraction)":
+            model_cfg.hidden_size,
+        "q_dim (wo contraction)": model_cfg.q_dim,
+        "intermediate_size (w_down contraction)":
+            model_cfg.intermediate_size,
+    }
+    bad = {name: d for name, d in dims.items() if d % group}
+    if bad:
+        detail = ", ".join(f"{name}={d}" for name, d in bad.items())
+        raise ValueError(
+            f"WEIGHT_QUANT_GROUP={group} must divide every matmul "
+            f"contraction dim of model '{model_cfg.name}'; it does not "
+            f"divide: {detail}. Pick a common divisor (e.g. a power of "
+            f"two <= the smallest dim) or use WEIGHT_QUANT=int8.")
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack int8 values in [-8, 7] along axis -2, two per byte.
+
+    ``q: [..., K, N] int8 -> [..., K/2, N] uint8``; packed row j =
+    (row 2j+1 << 4) | (row 2j & 0xF).
+    """
+    lo = q[..., 0::2, :]
+    hi = q[..., 1::2, :]
+    return ((hi.astype(jnp.uint8) & 0xF) << 4) | (lo.astype(jnp.uint8) & 0xF)
+
+
+def unpack_int4(q4: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4`: ``[..., K/2, N] uint8 -> [..., K, N]``.
+
+    int8 ``>>`` is arithmetic, so ``(b << 4) >> 4`` sign-extends the low
+    nibble and ``b >> 4`` the high one — no lookup table needed.
+    """
+    b = q4.astype(jnp.int8)
+    lo = (b << 4) >> 4
+    hi = b >> 4
+    kp, n = q4.shape[-2], q4.shape[-1]
+    return jnp.stack([lo, hi], axis=-2).reshape(
+        q4.shape[:-2] + (2 * kp, n))
+
+
+def quantize_math_group(wf: jax.Array, group: int):
+    """Group-wise symmetric quantize: ``[..., K, N] -> (q int8, s f32)``.
+
+    ``s[..., g, n] = max(|w[..., g*G:(g+1)*G, n]|) / 7`` (clamped away
+    from zero so all-zero groups dequantize to exact zeros), ``q`` is
+    the rounded ratio clipped to [-8, 7]. Returns q UNPACKED so callers
+    can inspect/modify before :func:`pack_int4`.
+    """
+    k, n = wf.shape[-2], wf.shape[-1]
+    g = wf.astype(jnp.float32).reshape(wf.shape[:-2] + (k // group, group, n))
+    s = jnp.maximum(jnp.max(jnp.abs(g), axis=-2) / 7.0, 1e-8)
+    q = jnp.clip(jnp.round(g / s[..., None, :]), -8, 7).astype(jnp.int8)
+    return q.reshape(wf.shape[:-2] + (k, n)), s
+
+
+def quantize_group(wf: jax.Array, group: int) -> dict:
+    """Quantize + pack a float leaf into the ``{"q4", "s"}`` format."""
+    q, s = quantize_math_group(wf, group)
+    return {"q4": pack_int4(q), "s": s}
+
+
+def group_size_of(w4: dict) -> int:
+    """Recover G from a packed leaf's shapes."""
+    return (2 * w4["q4"].shape[-2]) // w4["s"].shape[-2]
+
+
+def dequantize_int4(w4: dict, dtype=jnp.float32) -> jax.Array:
+    """Materialize the float weight (tests/calibration only — the
+    serving path dequantizes inside the matmul, ops/quant.py)."""
+    group = group_size_of(w4)
+    w = unpack_int4(w4["q4"]).astype(dtype)
+    return w * jnp.repeat(w4["s"].astype(dtype), group, axis=-2)
+
+
+@functools.partial(jax.jit, static_argnames=("group",), donate_argnums=(0,))
+def _quantize_leaf_int4(w, group):
+    return quantize_group(w, group)
+
+
+def quantize_params_int4(params: dict, group: int) -> dict:
+    """Data-free quantization of a full param pytree.
+
+    INT4_LEAVES -> {"q4", "s"}; embedding (and untied lm_head) take the
+    int8 tier's per-row / transposed formats so lookups and the
+    streaming head kernel keep working. This is the fast fallback for
+    random/test weights; calibrated quantization lives in awq.py.
+    """
+    from fasttalk_tpu.ops.quant import _quantize_embed, _quantize_head_t
+
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    for name, w in out["layers"].items():
+        if name in INT4_LEAVES and not isinstance(w, dict):
+            out["layers"][name] = _quantize_leaf_int4(w, int(group))
+    if not isinstance(out["embed"], dict):
+        out["embed"] = _quantize_embed(out["embed"])
+    if "lm_head" in out and not isinstance(out["lm_head"], dict):
+        out["lm_head"] = _quantize_head_t(out["lm_head"])
+    return out
+
+
+def _np_quantize_group(a: np.ndarray, group: int):
+    """Host-side numpy twin of quantize_group (checkpoint load path)."""
+    k, n = a.shape[-2], a.shape[-1]
+    g = a.astype(np.float32).reshape(a.shape[:-2] + (k // group, group, n))
+    s = np.maximum(np.max(np.abs(g), axis=-2) / 7.0, 1e-8).astype(np.float32)
+    q = np.clip(np.round(g / s[..., None, :]), -8, 7).astype(np.int8)
+    q = q.reshape(a.shape[:-2] + (k, n))
+    lo = q[..., 0::2, :]
+    hi = q[..., 1::2, :]
+    q4 = ((hi.astype(np.uint8) & 0xF) << 4) | (lo.astype(np.uint8) & 0xF)
+    return q4, s
+
+
+def quantizing_put_int4(inner_put, raw_put, group: int):
+    """Wrap a loader ``put`` hook to quantize leaves host-side.
+
+    Mirrors ops.quant.quantizing_put: matmul leaves go through numpy
+    group quantization BEFORE device transfer (only packed bytes +
+    scales cross PCIe), embedding/lm_head reuse the int8 putter's
+    per-row formats, everything else (norms, biases) flows through
+    ``inner_put`` unchanged. ``path`` strings come from
+    models/loader.py ("layers/wq", "embed", "lm_head").
+    """
+    from fasttalk_tpu.ops.quant import quantizing_put
+
+    group = int(group)
+    int8_put = quantizing_put(inner_put, raw_put)
+
+    def put(arr, path: str):
+        name = path.split("/")[-1]
+        if name in INT4_LEAVES:
+            a = np.asarray(arr)
+            q4, s = _np_quantize_group(a, group)
+            return {"q4": raw_put(q4, f"{path}/q4"),
+                    "s": raw_put(s, f"{path}/s")}
+        # embed / lm_head / norms / biases: int8 tier behaviour.
+        return int8_put(arr, path)
+
+    return put
+
+
+def is_int4(params: dict) -> bool:
+    """True when the layer stack carries nibble-packed leaves."""
+    layers = params.get("layers", {})
+    for name in INT4_LEAVES:
+        w = layers.get(name)
+        if isinstance(w, dict) and "q4" in w:
+            return True
+    return False

@@ -317,8 +317,39 @@ class Config:
         default_factory=lambda: _env_str("TPU_SAMPLING", "fast"))
     # Weight quantization for serving: "none" | "int8" (per-output-channel
     # symmetric, in-tree replacement for the reference's external AWQ
-    # engine config, .env.vllm.example:21).
+    # engine config, .env.vllm.example:21) | "int4". Legacy alias of
+    # WEIGHT_QUANT below — __post_init__ resolves the two into
+    # agreement, and setting both to different tiers is a named startup
+    # error.
     quantize: str = field(default_factory=lambda: _env_str("TPU_QUANTIZE", "none"))
+    # ---- Int4 weight tier (fasttalk_tpu/quantization/,
+    # docs/QUANTIZATION.md) ----
+    # Serving weight tier: "" (unset -> resolved from TPU_QUANTIZE) |
+    # "off" | "int8" | "int4" (group-wise symmetric 4-bit, nibble-
+    # packed; the embedding/lm_head stay per-row int8 — the gather and
+    # the streaming head kernel want per-row scales).
+    weight_quant: str = field(
+        default_factory=lambda: _env_str("WEIGHT_QUANT", ""))
+    # Contraction rows sharing one int4 scale. Must be even (the nibble
+    # packing pairs adjacent rows, and a scale group must never split a
+    # pair); that it divides every matmul contraction dim of the model
+    # is validated at engine build (quantization/int4.py
+    # validate_group).
+    weight_quant_group: int = field(
+        default_factory=lambda: _env_int("WEIGHT_QUANT_GROUP", 128))
+    # AWQ calibration source for scripts/quantize_checkpoint.py: ""
+    # (data-free max-abs), "corpus" (the in-tree tinychat corpus), or a
+    # path to a text file with one prompt per line. The serving path
+    # never calibrates inline — it picks up the prepared cache the CLI
+    # writes.
+    weight_quant_calib: str = field(
+        default_factory=lambda: _env_str("WEIGHT_QUANT_CALIB", ""))
+    # Int4 dequant-fused Pallas matmul (single-device T=1 decode,
+    # requires WEIGHT_QUANT=int4; ops/pallas_int8.py int4_matmul). Off
+    # by default pending on-device benchmarking against the XLA
+    # unpack+dequant path, which is always available.
+    use_pallas_int4: bool = field(
+        default_factory=lambda: _env_bool("TPU_USE_PALLAS_INT4", False))
     # Persistent XLA compilation cache: "" = on at the default location
     # (MODEL_PATH/.xla_cache or a per-user tmp dir), a path = on there,
     # "off" = disabled. Makes warmup a one-time cost per configuration
@@ -683,6 +714,18 @@ class Config:
         if self.default_repeat_penalty < 0:  # unset: provider-resolved
             self.default_repeat_penalty = \
                 1.0 if self.llm_provider == "vllm" else 1.1
+        # WEIGHT_QUANT unset: resolve it from the legacy TPU_QUANTIZE
+        # knob; set: it is authoritative, and the legacy field is
+        # brought into agreement (everything downstream may read
+        # either). Both set to DIFFERENT tiers is a named error in
+        # _validate, not a silent precedence.
+        if not self.weight_quant:
+            self.weight_quant = {"none": "off"}.get(self.quantize,
+                                                    self.quantize)
+        elif self.quantize == "none" \
+                and self.weight_quant in ("off", "int8", "int4"):
+            self.quantize = {"off": "none"}.get(self.weight_quant,
+                                                self.weight_quant)
         self._validate()
 
     def _validate(self) -> None:
@@ -775,8 +818,50 @@ class Config:
         if self.sampling not in ("fast", "exact"):
             errs.append(f"TPU_SAMPLING must be fast|exact, "
                         f"got {self.sampling!r}")
-        if self.quantize not in ("none", "int8"):
-            errs.append("quantize must be 'none' or 'int8'")
+        if self.quantize not in ("none", "int8", "int4"):
+            errs.append("quantize must be 'none', 'int8' or 'int4'")
+        # Int4 weight-tier knobs (docs/QUANTIZATION.md): explicit
+        # compatibility matrix, mirroring KV_QUANT=int8 below — every
+        # unsupported combination is a NAMED startup error, never a
+        # silent fall-back.
+        if self.weight_quant not in ("off", "int8", "int4"):
+            errs.append(f"WEIGHT_QUANT must be off|int8|int4, "
+                        f"got {self.weight_quant!r}")
+        elif self.quantize in ("none", "int8", "int4") \
+                and {"off": "none"}.get(self.weight_quant,
+                                        self.weight_quant) != self.quantize:
+            errs.append(
+                f"WEIGHT_QUANT={self.weight_quant} conflicts with "
+                f"legacy TPU_QUANTIZE={self.quantize}; set only "
+                f"WEIGHT_QUANT (TPU_QUANTIZE is its alias)")
+        if self.weight_quant_group < 2 or self.weight_quant_group % 2:
+            errs.append(
+                f"WEIGHT_QUANT_GROUP must be an even integer >= 2 (int4 "
+                f"packs adjacent rows into one byte, so a scale group "
+                f"must never split a nibble pair), got "
+                f"{self.weight_quant_group}")
+        if self.weight_quant_calib and self.weight_quant_calib != "corpus" \
+                and not os.path.isfile(self.weight_quant_calib):
+            errs.append(
+                f"WEIGHT_QUANT_CALIB must be '' (data-free), 'corpus', "
+                f"or a readable prompt file (one per line); no file at "
+                f"{self.weight_quant_calib!r}")
+        if self.use_pallas_int4 and self.weight_quant != "int4":
+            errs.append(
+                "TPU_USE_PALLAS_INT4=true requires WEIGHT_QUANT=int4 "
+                "(the kernel reads nibble-packed {'q4','s'} leaves)")
+        if self.weight_quant == "int4":
+            if self.tp_size > 1 or self.dp_size > 1 or self.sp_size > 1:
+                errs.append(
+                    "WEIGHT_QUANT=int4 is single-device only in v1 "
+                    "(partition rules for the q4/scale leaves exist — "
+                    "parallel/sharding.py — but the sharded load/init "
+                    "path is unvalidated); set TPU_TP_SIZE=TPU_DP_SIZE="
+                    "TPU_SP_SIZE=1")
+            if self.spmd_role != "off":
+                errs.append("WEIGHT_QUANT=int4 is incompatible with "
+                            "multi-host SPMD serving; set "
+                            "TPU_SPMD_ROLE=off")
         if self.sched_queue_bound <= 0:
             errs.append("sched_queue_bound must be > 0")
         if self.sched_default_deadline_s <= 0:

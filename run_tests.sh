@@ -96,6 +96,18 @@
 #                             SPMD follower-kill liveness test, and
 #                             the scripts/check_failpoints.py
 #                             coverage lint (docs/RESILIENCE.md).
+#   ./run_tests.sh --int4     int4 weight tier group (WEIGHT_QUANT=
+#                             int4, docs/QUANTIZATION.md): pack/unpack
+#                             roundtrip + group sweep, the fused XLA
+#                             and Pallas matmul paths, model logit
+#                             bounds, the AWQ calibration search,
+#                             engine serving (incl. the int4 x
+#                             int8-KV x paged composition and the
+#                             trained-tinychat factory acceptance),
+#                             sharding rules, perf-ledger weight
+#                             bytes, the compat matrix, and a
+#                             scripts/quantize_checkpoint.py
+#                             --data-free smoke into a temp cache.
 #   ./run_tests.sh --perf     perf-attribution/flight-recorder group:
 #                             the step ledger (wall-time decomposition,
 #                             padding waste, MFU, compile ledger),
@@ -298,6 +310,30 @@ if [[ "${1:-}" == "--chaos" ]]; then
     echo "    tests; docs/RESILIENCE.md) ---"
     "${PYENV[@]}" python scripts/check_failpoints.py
     "${PYENV[@]}" python -m pytest tests/test_chaos.py "$@"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--int4" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_int4_quant.py "$@"
+    if [[ -f fasttalk_tpu/assets/tinychat/model.safetensors ]]; then
+        echo "--- quantize_checkpoint.py smoke (data-free, temp cache) ---"
+        tmpdir="$(mktemp -d)"
+        trap 'rm -rf "$tmpdir"' EXIT
+        cp -r fasttalk_tpu/assets/tinychat "$tmpdir/tinychat"
+        "${PYENV[@]}" python scripts/quantize_checkpoint.py \
+            --model tinychat --model-path "$tmpdir" --data-free \
+            --group 128
+        manifest="$(find "$tmpdir/.prepared" -name quantize_manifest.json)"
+        [[ -n "$manifest" ]] \
+            || { echo "int4 smoke: no quantize_manifest.json" >&2; exit 1; }
+        grep -q '"mode": "data-free"' "$manifest" \
+            || { echo "int4 smoke: manifest mode wrong" >&2; exit 1; }
+        echo "manifest OK: $manifest"
+    else
+        echo "--- quantize_checkpoint.py smoke skipped (no tinychat" \
+             "checkpoint; run scripts/train_tinychat.py first) ---"
+    fi
     exit 0
 fi
 

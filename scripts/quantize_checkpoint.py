@@ -1,0 +1,136 @@
+"""Offline int4 checkpoint quantization (AWQ-calibrated or data-free).
+
+Quantizes a float checkpoint into the WEIGHT_QUANT=int4 format and
+writes it into the SAME prepared-weight cache the factory load path
+reads (models/prepared_cache.py) — a server started afterwards with
+WEIGHT_QUANT=int4 restores the calibrated leaves instead of re-doing
+the data-free quantization, with zero serving-path changes. A manifest
+JSON (chosen alpha/clip per layer, calibration provenance) lands next
+to the cache for auditability.
+
+Usage:
+  python scripts/quantize_checkpoint.py --model tinychat \
+      --model-path fasttalk_tpu/assets \
+      [--group 128] [--calib corpus|/path/to/texts.txt] \
+      [--calib-samples 16] [--seq-len 256] [--dtype bfloat16] \
+      [--data-free] [--seed 0]
+
+``--calib corpus`` (default) calibrates on rendered tinychat training
+conversations (training/corpus.py); a file path uses its non-empty
+lines. ``--data-free`` skips calibration entirely (int4.py fallback —
+same scales the factory computes inline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Quantize a checkpoint to the int4 tier "
+                    "(AWQ-calibrated scale search by default)")
+    ap.add_argument("--model", default="tinychat",
+                    help="model config name (models/configs.py)")
+    ap.add_argument("--model-path", default="fasttalk_tpu/assets",
+                    help="MODEL_PATH the server will use")
+    ap.add_argument("--group", type=int, default=128,
+                    help="WEIGHT_QUANT_GROUP the server will use")
+    ap.add_argument("--calib", default="corpus",
+                    help="'corpus' or a UTF-8 text file of documents")
+    ap.add_argument("--calib-samples", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=("bfloat16", "float32", "float16"),
+                    help="serving dtype the cache is keyed by")
+    ap.add_argument("--data-free", action="store_true",
+                    help="skip AWQ; plain group-wise maxabs scales")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax.numpy as jnp
+
+    from fasttalk_tpu.engine.tokenizer import load_tokenizer
+    from fasttalk_tpu.models.configs import get_model_config
+    from fasttalk_tpu.models.loader import find_checkpoint_dir, load_params
+    from fasttalk_tpu.models.prepared_cache import cache_meta, save_prepared
+    from fasttalk_tpu.quantization.int4 import (quantize_params_int4,
+                                                validate_group)
+
+    model_cfg = get_model_config(args.model, args.model_path)
+    validate_group(model_cfg, args.group)
+    ckpt = find_checkpoint_dir(args.model_path, model_cfg.name)
+    if not ckpt:
+        print(f"error: no checkpoint for {model_cfg.name!r} under "
+              f"{args.model_path!r}", file=sys.stderr)
+        return 2
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}[args.dtype]
+    # Float32 host-side load: the scale search wants full-precision
+    # stats; serving-dtype casting happens where it always does (the
+    # non-quantized leaves are cast by the put hook below).
+    import jax
+
+    params = load_params(
+        model_cfg, ckpt, dtype,
+        put=lambda arr, path: jax.device_put(jnp.asarray(arr, jnp.float32)))
+
+    manifest: dict = {"mode": "data-free", "group": int(args.group),
+                      "model": model_cfg.name}
+    if args.data_free:
+        qparams = quantize_params_int4(params, args.group)
+    else:
+        from fasttalk_tpu.quantization.awq import (calibration_tokens,
+                                                   quantize_params_awq)
+
+        tokenizer = load_tokenizer(args.model_path, args.model,
+                                   template=model_cfg.chat_template)
+        tokens = calibration_tokens(
+            tokenizer, n_samples=args.calib_samples,
+            seq_len=args.seq_len, seed=args.seed, source=args.calib)
+        print(f"calibrating on {tokens.shape[0]} x {tokens.shape[1]} "
+              f"tokens from {args.calib!r}")
+        qparams, awq_info = quantize_params_awq(params, model_cfg,
+                                                tokens, args.group)
+        manifest = {"mode": "awq", "model": model_cfg.name,
+                    "calib": args.calib,
+                    "calib_samples": int(tokens.shape[0]),
+                    "seq_len": int(tokens.shape[1]),
+                    "seed": args.seed, **awq_info}
+
+    # Non-quantized leaves (norms, biases) must land in the SERVING
+    # dtype or the cache's restore target (abstract_params) mismatches;
+    # the quantization scales ("s") stay float32 BY FORMAT.
+    def cast_plain(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if name != "s" and hasattr(leaf, "dtype") \
+                and leaf.dtype == jnp.float32:
+            return leaf.astype(dtype)
+        return leaf
+
+    qparams = jax.tree_util.tree_map_with_path(cast_plain, qparams)
+    meta = cache_meta(model_cfg, dtype, "int4", None, ckpt_dir=ckpt,
+                      group=args.group)
+    path = save_prepared(qparams, args.model_path, meta, block=True)
+    if path is None:
+        print("error: prepared-cache write failed", file=sys.stderr)
+        return 1
+    man_path = os.path.join(path, "quantize_manifest.json")
+    with open(man_path, "w") as f:
+        json.dump(manifest, f, indent=2)
+    print(f"int4 prepared cache written: {path}")
+    print(f"manifest: {man_path}")
+    print(f"serve with: WEIGHT_QUANT=int4 WEIGHT_QUANT_GROUP="
+          f"{args.group} MODEL_NAME={args.model} "
+          f"MODEL_PATH={args.model_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
