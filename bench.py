@@ -39,6 +39,15 @@ ratio), the same-slot-count short-context decode tok/s pair (the
 gather/scatter overhead bound, target within 10%), and aliased-prefix
 HBM savings.
 
+``BENCH_MODE=roofline`` runs the measured-vs-ceiling attribution sweep
+(docs/ROOFLINE.md): every decode configuration the compat matrix
+serves — (kv_quant x kv_layout x kernel) cells from
+``BENCH_RF_CONFIGS`` crossed with the ``BENCH_RF_STEPS``
+steps-per-call/fetch-cadence axis — each in its own subprocess at full
+slot occupancy, reporting tok/s NEXT TO the perf ledger's
+decomposition (device-busy/host-gap fractions, MFU, KV + weight read
+bandwidth, and the first-order HBM ceiling fraction).
+
 ``BENCH_MODE=int4`` runs the weight-tier capacity scenario
 (docs/QUANTIZATION.md): a FIXED device-HBM budget (default 1.5x the
 bf16 weight footprint, ``BENCH_I4_BUDGET_MB`` to override) priced per
@@ -129,6 +138,18 @@ def perf_attribution() -> dict | None:
 
     s = get_perf().summary()
     return s if s.get("device_busy_frac") is not None else None
+
+
+def _child_env(**overrides: str) -> dict:
+    """Environment for a bench subprocess phase. Children log at
+    WARNING unless the caller pinned LOG_LEVEL themselves: child
+    stderr lands in the captured bench tail, and per-connection INFO
+    lines from a warmed engine were drowning the summary lines the
+    tail exists for (BENCH_r05.json)."""
+    env = dict(os.environ)
+    env.setdefault("LOG_LEVEL", "WARNING")
+    env.update(overrides)
+    return env
 
 
 BASELINE_TOKS = 150.0  # reference llama3.2:1b on RTX 3090 (README.md:474)
@@ -379,9 +400,8 @@ def _mt_run_phase_subprocess(budget_mb: float) -> dict:
     leaked-state asymmetry between the phases)."""
     import subprocess
 
-    env = dict(os.environ)
-    env["BENCH_MT_PHASE"] = "1"
-    env["BENCH_KV_BUDGET_MB"] = str(budget_mb)
+    env = _child_env(BENCH_MT_PHASE="1",
+                     BENCH_KV_BUDGET_MB=str(budget_mb))
     proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                           env=env, stdout=subprocess.PIPE, text=True)
     if proc.returncode != 0:
@@ -570,8 +590,7 @@ def _lc_run_phase_subprocess(kv_quant: str) -> dict:
     teardown crash, and fresh processes keep the comparison fair)."""
     import subprocess
 
-    env = dict(os.environ)
-    env["BENCH_LC_PHASE"] = kv_quant
+    env = _child_env(BENCH_LC_PHASE=kv_quant)
     proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                           env=env, stdout=subprocess.PIPE, text=True)
     if proc.returncode != 0:
@@ -685,8 +704,7 @@ def _i4_run_phase_subprocess(tier: str) -> dict:
     XLA-CPU teardown crash, and fresh processes keep the tiers fair)."""
     import subprocess
 
-    env = dict(os.environ)
-    env["BENCH_I4_PHASE"] = tier
+    env = _child_env(BENCH_I4_PHASE=tier)
     proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                           env=env, stdout=subprocess.PIPE, text=True)
     if proc.returncode != 0:
@@ -879,9 +897,7 @@ def _pg_run_phase_subprocess(phase: str, layout: str) -> dict:
     compile caches and heap symmetric)."""
     import subprocess
 
-    env = dict(os.environ)
-    env["BENCH_PG_PHASE"] = phase
-    env["BENCH_PG_LAYOUT"] = layout
+    env = _child_env(BENCH_PG_PHASE=phase, BENCH_PG_LAYOUT=layout)
     proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                           env=env, stdout=subprocess.PIPE, text=True)
     if proc.returncode != 0:
@@ -945,6 +961,122 @@ def bench_paged() -> dict:
             "throughput": {"dense_tok_s": d_tp["tok_s"],
                            "paged_tok_s": p_tp["tok_s"],
                            "ratio": tok_ratio}}
+
+
+# ---------------- roofline mode (decode attribution sweep) -------------
+
+# The sweep grid: every decode configuration the compat matrix serves,
+# as kv_quant:kv_layout:kernel triples. Overridable so a TPU run can
+# focus (BENCH_RF_CONFIGS=int8:paged:pallas) and the CPU smoke can
+# stay short.
+_RF_ALL_CONFIGS = ("none:dense:xla,int8:dense:xla,"
+                   "none:dense:pallas,int8:dense:pallas,"
+                   "none:paged:xla,int8:paged:xla,"
+                   "none:paged:pallas,int8:paged:pallas")
+
+
+async def _rf_phase(cfg, max_tokens: int) -> dict:
+    """One roofline cell: decode at full slot occupancy under one
+    (kv_quant x kv_layout x kernel x steps_per_call) configuration,
+    then read the perf ledger's attribution over the measured window
+    so tok/s never travels without its decomposition
+    (docs/ROOFLINE.md)."""
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.observability.perf import get_perf
+
+    engine = build_engine(cfg)
+    engine.warmup(cfg.warmup)
+    engine.start()
+    try:
+        # Warmup wave compiles the shapes the measurement hits.
+        await asyncio.gather(*(
+            run_session_msgs(
+                engine, f"rfw-{i}", f"rfw-sess-{i}",
+                [{"role": "user", "content": f"[w{i}] hi"}], 8)
+            for i in range(cfg.decode_slots)))
+        t0 = time.monotonic()
+        results = await asyncio.gather(*(
+            run_session_msgs(
+                engine, f"rf-{i}", f"rf-sess-{i}",
+                [{"role": "user", "content": f"[d{i}] {PROMPT}"}],
+                max_tokens)
+            for i in range(cfg.decode_slots)))
+        wall = time.monotonic() - t0
+        perf = get_perf().summary()
+    finally:
+        engine.shutdown()
+    toks = sum(r["tokens"] for r in results)
+    return {"kv_quant": cfg.kv_quant,
+            "kv_layout": cfg.kv_layout,
+            "kernel": perf.get("attention_kernel"),
+            "steps_per_call": cfg.decode_steps_per_call,
+            "slots": cfg.decode_slots,
+            "tok_s": round(toks / wall, 2),
+            "perf": perf}
+
+
+def _rf_run_phase_subprocess(kv_quant: str, layout: str, kernel: str,
+                             steps: int) -> dict:
+    """One roofline cell per child process (same isolation rationale
+    as every other multi-engine bench mode: fresh XLA state per cell,
+    and a fresh perf-ledger window so cells never read each other's
+    step records)."""
+    import subprocess
+
+    env = _child_env(BENCH_RF_PHASE="1",
+                     BENCH_RF_KV=kv_quant,
+                     BENCH_RF_LAYOUT=layout,
+                     BENCH_RF_KERNEL=kernel,
+                     TPU_DECODE_STEPS=str(steps))
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"roofline cell ({kv_quant}/{layout}/{kernel}/steps="
+            f"{steps}) exited {proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_roofline() -> dict:
+    """BENCH_MODE=roofline (docs/ROOFLINE.md): the measured-vs-ceiling
+    attribution sweep. Each cell of (kv_quant x kv_layout x kernel) x
+    steps_per_call runs decode at full occupancy in its own process
+    and reports tok/s NEXT TO the perf ledger's decomposition —
+    device-busy/host-gap fractions, MFU, KV and weight read bandwidth,
+    and the first-order HBM ceiling (frac_of_ceiling == hbm_bw_util).
+    The steps_per_call axis is the fetch-cadence axis: one device call
+    covers `steps` tokens per slot between host token fetches."""
+    steps_list = [int(s) for s in os.environ.get(
+        "BENCH_RF_STEPS", "8,32").split(",") if s.strip()]
+    configs = [c.strip().split(":") for c in os.environ.get(
+        "BENCH_RF_CONFIGS", _RF_ALL_CONFIGS).split(",") if c.strip()]
+    rows = []
+    n = len(configs) * len(steps_list)
+    i = 0
+    for kv_quant, layout, kernel in configs:
+        for steps in steps_list:
+            i += 1
+            log(f"--- roofline cell {i}/{n}: kv={kv_quant} "
+                f"layout={layout} kernel={kernel} steps={steps} ---")
+            r = _rf_run_phase_subprocess(kv_quant, layout, kernel,
+                                         steps)
+            p = r["perf"]
+            ceil = p.get("frac_of_ceiling")
+            ceil_txt = ("n/a (no HBM peak for this device kind)"
+                        if ceil is None else str(ceil))
+            log(f"  {r['tok_s']} tok/s via {r['kernel']} | busy "
+                f"{p.get('device_busy_frac')} gap "
+                f"{p.get('host_gap_frac')} | mfu {p.get('mfu')} | "
+                f"kv {p.get('kv_read_gbps')} GB/s | ceiling frac "
+                f"{ceil_txt}")
+            rows.append(r)
+    best = max(rows, key=lambda r: r["tok_s"])
+    return {"rows": rows,
+            "best": {k: best[k] for k in
+                     ("kv_quant", "kv_layout", "kernel",
+                      "steps_per_call", "tok_s")},
+            "best_frac_of_ceiling": best["perf"].get(
+                "frac_of_ceiling")}
 
 
 # ---------------- fleet mode (router scale-out) ----------------
@@ -1092,8 +1224,7 @@ def _fleet_run_phase_subprocess(replicas: int) -> dict:
     no teardown-order hazards between phases)."""
     import subprocess
 
-    env = dict(os.environ)
-    env["BENCH_FLEET_PHASE"] = str(replicas)
+    env = _child_env(BENCH_FLEET_PHASE=str(replicas))
     # Two in-proc engines racing the shared persistent XLA compile
     # cache segfault the XLA-CPU client (observed deterministic);
     # disable it for BOTH phases so the comparison stays fair.
@@ -1343,8 +1474,7 @@ def _fleet_fabric_subprocess(env_key: str, env_val: str) -> dict:
     same isolation discipline as every other multi-engine bench)."""
     import subprocess
 
-    env = dict(os.environ)
-    env[env_key] = env_val
+    env = _child_env(**{env_key: env_val})
     env["TPU_COMPILE_CACHE"] = "off"
     proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                           env=env, stdout=subprocess.PIPE, text=True)
@@ -1840,7 +1970,7 @@ def _chaos_run_subprocess(phase: str) -> dict:
     N prior crash cycles."""
     import subprocess
 
-    env = dict(os.environ, BENCH_CHAOS_PHASE=phase)
+    env = _child_env(BENCH_CHAOS_PHASE=phase)
     last_err = ""
     for _attempt in range(2):  # native-runtime flakes get one retry
         try:
@@ -2110,6 +2240,52 @@ def main() -> None:
             # the budget can hold resident.
             "vs_baseline": r["envelope_ratio_int4_vs_bf16"],
             "int4": r,
+        }), flush=True)
+        return
+    if MODE == "roofline":
+        slots = int(os.environ.get("BENCH_RF_SLOTS", "8"))
+        max_tokens = int(os.environ.get("BENCH_RF_MAX_TOKENS", "24"))
+        if os.environ.get("BENCH_RF_PHASE"):
+            # Child process: one sweep cell. Weight quant off by
+            # default so the KV-tier and kernel axes are the only
+            # variables (the TPU driver can re-pin BENCH_QUANTIZE);
+            # spec off because the int8 cells reject it and every cell
+            # must measure the same decode family.
+            kv_quant = os.environ.get("BENCH_RF_KV", "none")
+            layout = os.environ.get("BENCH_RF_LAYOUT", "dense")
+            kernel = os.environ.get("BENCH_RF_KERNEL", "xla")
+            cfg = Config(llm_provider="tpu", model_name=MODEL,
+                         decode_slots=slots, max_model_len=1024,
+                         default_context_window=1024,
+                         prefill_chunk=512, dtype="bfloat16",
+                         port=PORT, monitoring_port=PORT + 1,
+                         enable_agent=False, spec_decode="off",
+                         quantize=os.environ.get("BENCH_QUANTIZE",
+                                                 "none"),
+                         kv_quant=kv_quant, kv_layout=layout,
+                         kv_block_size=int(os.environ.get(
+                             "KV_BLOCK_SIZE", "16")),
+                         kv_host_budget_mb=0.0,
+                         use_pallas_attention=(kernel == "pallas"))
+            phase = asyncio.run(_rf_phase(cfg, max_tokens))
+            print(json.dumps(phase), flush=True)
+            return
+        r = bench_roofline()
+        b = r["best"]
+        frac = r["best_frac_of_ceiling"]
+        print(json.dumps({
+            "metric": (f"roofline sweep best decode tok/s, {MODEL}: "
+                       f"{len(r['rows'])} cells (kv x layout x kernel "
+                       f"x steps_per_call) at {slots} slots; best = "
+                       f"kv={b['kv_quant']} {b['kv_layout']} "
+                       f"{b['kernel']} steps={b['steps_per_call']}"
+                       + (f", {frac:.0%} of first-order HBM ceiling"
+                          if frac is not None else
+                          " (no HBM peak for this device kind)")),
+            "value": b["tok_s"],
+            "unit": "tok/s",
+            "vs_baseline": round(b["tok_s"] / BASELINE_TOKS, 2),
+            "roofline": r,
         }), flush=True)
         return
     if MODE == "paged":

@@ -434,10 +434,11 @@ class TPUEngine(EngineBase):
         # combinations raise here (and at Config validation with the
         # same reasons) rather than silently degrading:
         # - mesh: the scale arrays do not shard with the kv axis yet;
-        # - Pallas decode attention: the kernel streams raw cache rows
-        #   (the quantized tier is the XLA dequant path first);
-        # - speculative decoding: verify-block quantize-on-write is
-        #   unvalidated.
+        # - speculative decoding: the spec carry does not thread the
+        #   scale arrays through the verify block.
+        # The Pallas decode kernel COMPOSES with this tier: int8 rows
+        # + scales DMA into VMEM and dequantize inside the kernel
+        # (ops/pallas_attention.py).
         if kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant must be 'none' or 'int8', "
                              f"got {kv_quant!r}")
@@ -449,18 +450,12 @@ class TPUEngine(EngineBase):
                 raise ValueError(
                     "KV_QUANT=int8 is single-device only: the per-row "
                     "scale arrays do not shard with the kv axis yet")
-            if self.use_pallas_attention:
-                raise ValueError(
-                    "KV_QUANT=int8 is incompatible with the Pallas "
-                    "decode-attention kernel (it streams raw cache "
-                    "rows; the quantized tier dequantizes in the XLA "
-                    "attention read) — set TPU_USE_PALLAS_ATTENTION="
-                    "false")
             if spec_decode in ("ngram", "auto"):
                 raise ValueError(
                     "KV_QUANT=int8 is incompatible with speculative "
-                    "decoding (the verify block's quantize-on-write "
-                    "is unvalidated) — set TPU_SPEC_DECODE=off")
+                    "decoding (the spec carry does not thread the "
+                    "scale arrays through the verify block) — set "
+                    "TPU_SPEC_DECODE=off")
             self.kv_scale_granule = granule_dim(kv_quant_granule,
                                                 model_cfg.num_kv_heads)
         else:
@@ -533,6 +528,17 @@ class TPUEngine(EngineBase):
         # The mesh path keeps forward(): its cache is "sp"-sharded and
         # per-layer dynamic slices would break GSPMD's even sharding.
         self._scatter_decode = mesh is None
+        # Which attention path decode steps actually run — perf
+        # attribution only (README perf table "kernel" column,
+        # BENCH_MODE=roofline): all four decode families (plain/
+        # history/fsm/spec) route through forward_decode's
+        # pallas_dense/pallas_paged flags on the scatter path.
+        if self.use_pallas_attention:
+            self.attention_kernel = ("pallas_paged" if self.paged
+                                     else "pallas_dense")
+        else:
+            self.attention_kernel = ("xla_gather" if self.paged
+                                     else "xla_dense")
         # Self-drafting speculative decoding (engine-owned, no second
         # model): drafts come from the slot's own token history via
         # on-device prompt-lookup, a verify block of draft+1 positions
@@ -553,12 +559,12 @@ class TPUEngine(EngineBase):
         # Auto never loses more than the probe overhead (~1 call in
         # 16) and wins whenever drafts are being accepted — VERDICT r4
         # #3's no-knob-guessing mode.
-        # Requires the scatter-decode path, and is disabled under the
-        # Pallas attention kernel: the verify block runs the XLA
-        # scatter forward regardless, and plain calls in spec modes use
-        # the history-maintaining scatter variant — mixing kernels per
-        # call is an untested matrix, so the explicit pallas knob wins.
-        spec_ok = self._scatter_decode and not self.use_pallas_attention
+        # Requires the scatter-decode path. Composes with the Pallas
+        # attention kernel: the verify block (T = draft+1 positions)
+        # runs through the multi-token q generalisation of the kernel
+        # (dense and paged variants), so spec no longer forces
+        # TPU_USE_PALLAS_ATTENTION off.
+        spec_ok = self._scatter_decode
         self.spec_mode = (spec_decode
                           if spec_ok
                           and spec_decode in ("ngram", "auto") else "off")
@@ -587,7 +593,8 @@ class TPUEngine(EngineBase):
         # - single-device only in v1 (the mesh decode path is the
         #   non-scatter forward; per-slot FSM state is not threaded
         #   through it);
-        # - no Pallas decode attention (same non-scatter path);
+        # - the Pallas decode kernel composes (it rides the scatter
+        #   path via pallas_dense/pallas_paged);
         # - speculative decoding pauses per CALL while any constrained
         #   slot is running (verify-block masking is unvalidated) and
         #   resumes when the last constrained slot finishes.
@@ -603,11 +610,6 @@ class TPUEngine(EngineBase):
             reason = ("structured decoding is single-device only in "
                       "v1 (no tp/dp/sp mesh — per-slot FSM state is "
                       "not threaded through the sharded decode path)")
-        elif self.use_pallas_attention:
-            reason = ("structured decoding is incompatible with the "
-                      "Pallas decode-attention kernel (it uses the "
-                      "non-scatter decode path) — set "
-                      "TPU_USE_PALLAS_ATTENTION=false")
         if structured == "on" and reason is not None:
             raise ValueError(f"STRUCTURED_MODE=on: {reason}")
         if structured == "off":
@@ -853,7 +855,8 @@ class TPUEngine(EngineBase):
                               kv_row_bytes=self._kv_row_bytes,
                               weight_quant=self.weight_quant,
                               weight_bytes_per_step=(
-                                  self._weight_bytes_per_step))
+                                  self._weight_bytes_per_step),
+                              attention_kernel=self.attention_kernel)
 
     def _make_cache(self) -> KVCache:
         if self.paged:
@@ -1736,12 +1739,17 @@ class TPUEngine(EngineBase):
                            **self._kvq_attrs,
                            **({"kv_layout": "paged"} if self.paged
                               else {}))
+        # BOTH kernel variants ride the scatter path now
+        # (forward_decode routes pallas_dense/pallas_paged), so the
+        # kernel composes with everything the scatter family carries:
+        # int8 KV, history/spec, structured. The dense kernel needs the
+        # bucket divisible by its 128 block — true for the
+        # power-of-two >= 512 buckets, false only for a short max_len
+        # fallback bucket, which keeps the XLA read.
         use_pallas = self.use_pallas_attention and kv_len % 128 == 0
-        # Paged tier: the Pallas kernel's block-walking variant rides
-        # the scatter path (forward_decode routes it), so paged never
-        # leaves the scatter decode family.
-        scatter = self._scatter_decode and (self.paged or not use_pallas)
+        scatter = self._scatter_decode
         pallas_paged = self.paged and self.use_pallas_attention
+        pallas_dense = use_pallas and not self.paged and scatter
         bsz = self.kv_block_size
         rows = jnp.arange(self.num_slots)
         max_len = self.max_len
@@ -1804,7 +1812,8 @@ class TPUEngine(EngineBase):
                         pallas_int8=self.use_pallas_int8,
                         pallas_int4=self.use_pallas_int4,
                         block_table=bt, block_size=bsz,
-                        pallas_paged=pallas_paged)
+                        pallas_paged=pallas_paged,
+                        pallas_dense=pallas_dense)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
                                          cnt, reps, press, freqs)
                     nxt = sample_tokens(lg, sub, temps, topks, topps,
@@ -1851,7 +1860,8 @@ class TPUEngine(EngineBase):
                         pallas_int8=self.use_pallas_int8,
                         pallas_int4=self.use_pallas_int4,
                         block_table=bt, block_size=bsz,
-                        pallas_paged=pallas_paged)
+                        pallas_paged=pallas_paged,
+                        pallas_dense=pallas_dense)
                     lg = apply_penalties(logits[:, :self.sample_vocab],
                                          cnt, reps, press, freqs)
                     nxt = sample_tokens(lg, sub, temps, topks, topps,
@@ -1926,6 +1936,8 @@ class TPUEngine(EngineBase):
         sv = self.sample_vocab
         bsz = self.kv_block_size
         pallas_paged = self.paged and self.use_pallas_attention
+        pallas_dense = (self.use_pallas_attention and not self.paged
+                        and kv_len % 128 == 0)
         powers = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
 
         def masked(lg, fst, masks):
@@ -1965,7 +1977,8 @@ class TPUEngine(EngineBase):
                         pallas_int8=self.use_pallas_int8,
                         pallas_int4=self.use_pallas_int4,
                         block_table=bt, block_size=bsz,
-                        pallas_paged=pallas_paged)
+                        pallas_paged=pallas_paged,
+                        pallas_dense=pallas_dense)
                     lg = apply_penalties(logits[:, :sv], cnt, reps,
                                          press, freqs)
                     lg = masked(lg, fst, masks)
@@ -2005,7 +2018,8 @@ class TPUEngine(EngineBase):
                     pallas_int8=self.use_pallas_int8,
                     pallas_int4=self.use_pallas_int4,
                     block_table=bt, block_size=bsz,
-                    pallas_paged=pallas_paged)
+                    pallas_paged=pallas_paged,
+                    pallas_dense=pallas_dense)
                 lg = apply_penalties(logits[:, :sv], cnt, reps,
                                      press, freqs)
                 lg = masked(lg, fst, masks)
@@ -2066,6 +2080,12 @@ class TPUEngine(EngineBase):
         sv = self.sample_vocab
 
         bsz = self.kv_block_size
+        # The verify block (T = G+1 positions) runs through the
+        # multi-token q generalisation of the Pallas kernels — the
+        # same gates as the plain decode families (_get_decode_fn).
+        pallas_paged = self.paged and self.use_pallas_attention
+        pallas_dense = (self.use_pallas_attention and not self.paged
+                        and kv_len % 128 == 0)
 
         @partial(jax.jit, donate_argnums=(1, 2, 3))
         def spec_call(params, cache: KVCache, history, counts, cur_tokens,
@@ -2101,7 +2121,9 @@ class TPUEngine(EngineBase):
                     act, attn_len=kv_len,
                     pallas_int8=self.use_pallas_int8,
                     pallas_int4=self.use_pallas_int4,
-                    block_table=bt, block_size=bsz)
+                    block_table=bt, block_size=bsz,
+                    pallas_paged=pallas_paged,
+                    pallas_dense=pallas_dense)
                 key, sub = jax.random.split(key)
                 # EXACT per-position penalty counts, without vocab-wide
                 # per-position intermediates: block position j is

@@ -249,15 +249,16 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     # nibbles inline, so pok4=False still never materialises f32.
     pok4 = pallas_int4 and t == 1
     # Int8 KV tier: quantize each fresh row at write time, dequantize
-    # on the attention read (fused into the operand load — XLA path;
-    # ops/kv_quant.py). The self-attention override regimes (ring
-    # prefill, training) bypass the cache read and are rejected at
-    # Config validation, as is the Pallas decode kernel (it streams
-    # raw cache rows).
+    # on the attention read — fused into the operand load on the XLA
+    # path (ops/kv_quant.py), or inside the Pallas kernel after the
+    # DMA (ops/pallas_attention.py: int8 bytes cross HBM either way).
+    # The self-attention override regimes (ring prefill, training)
+    # bypass the cache read and are rejected at Config validation.
     kvq = cache.quantized
     if kvq:
-        assert attn_override is None and not pallas_decode, \
-            "quantized KV cache is XLA scatter/slice paths only"
+        assert attn_override is None, \
+            "quantized KV cache: self-attention override regimes " \
+            "bypass the cache read"
         kvg = cache.k_scale.shape[-1]
 
     def layer(x, scanned):
@@ -285,22 +286,28 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                 cv = _write_kv(cv, qv, write_start, write_mask)
                 ks = _write_kv(ks, sk, write_start, write_mask)
                 vs = _write_kv(vs, sv, write_start, write_mask)
-                ak = kv_dequantize(ck, ks, x.dtype)
-                av = kv_dequantize(cv, vs, x.dtype)
             else:
                 ck = _write_kv(ck, k, write_start, write_mask)
                 cv = _write_kv(cv, v, write_start, write_mask)
-                ak, av = ck, cv
-            if cache_attn_override is not None:
-                o = cache_attn_override(q, ak, av, positions)
-            elif pallas_decode and t == 1:
+            if pallas_decode and t == 1 and cache_attn_override is None:
                 from fasttalk_tpu.ops.pallas_attention import decode_attend
 
-                o = decode_attend(q[:, 0], ak, av,
-                                  positions[:, 0] + 1)[:, None]
+                # Quantized tier: int8 rows + scales go straight into
+                # the kernel — no materialised bf16 dequant buffer.
+                o = decode_attend(q[:, 0], ck, cv, positions[:, 0] + 1,
+                                  k_scale=ks if kvq else None,
+                                  v_scale=vs if kvq else None)[:, None]
             else:
-                attn_fn = attend_blockwise if blockwise else attend
-                o = attn_fn(q, ak, av, positions)
+                if kvq:
+                    ak = kv_dequantize(ck, ks, x.dtype)
+                    av = kv_dequantize(cv, vs, x.dtype)
+                else:
+                    ak, av = ck, cv
+                if cache_attn_override is not None:
+                    o = cache_attn_override(q, ak, av, positions)
+                else:
+                    attn_fn = attend_blockwise if blockwise else attend
+                    o = attn_fn(q, ak, av, positions)
         x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok, pok4)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(
@@ -335,6 +342,7 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
                          block_table: jnp.ndarray | None = None,
                          block_size: int = 0,
                          pallas_paged: bool = False,
+                         pallas_dense: bool = False,
                          ) -> tuple[jnp.ndarray, KVCache]:
     """Scatter-write decode over a short block: tokens [B, T] ->
     logits [B, T, V], cache updated IN PLACE.
@@ -360,7 +368,11 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
     gathers the slot's blocks into position order
     (ops/attention.paged_gather_indices, the XLA gather fallback).
     ``pallas_paged`` replaces that gather+attend with the block-walking
-    Pallas kernel (T=1, full-precision rows only).
+    Pallas kernel; ``pallas_dense`` routes the dense slice read through
+    the length-pruning kernel instead of ``attend``. Both handle T>1
+    (spec-verify blocks) and the int8 tier (the kernels take the int8
+    rows + scale arrays and dequantize after the DMA — see
+    ops/pallas_attention.py).
     """
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta,
                                             cfg.rope_scaling))
@@ -446,9 +458,15 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
                 from fasttalk_tpu.ops.pallas_attention import \
                     decode_attend_paged
 
+                lks = lvs = None
+                if kvq:
+                    lks = jax.lax.dynamic_slice(
+                        ks_all, (li, 0, 0), (1, pool_rows, kvg))[0]
+                    lvs = jax.lax.dynamic_slice(
+                        vs_all, (li, 0, 0), (1, pool_rows, kvg))[0]
                 o = decode_attend_paged(
-                    q[:, 0], lk, lv, pos_mat[:, 0] + 1, block_table,
-                    block_size=block_size)[:, None]
+                    q, lk, lv, pos_mat[:, -1] + 1, block_table,
+                    block_size=block_size, k_scale=lks, v_scale=lvs)
             else:
                 ak = gather_paged_rows(lk, gather_idx)
                 av = gather_paged_rows(lv, gather_idx)
@@ -473,14 +491,26 @@ def forward_decode_multi(params: Params, cfg: ModelConfig,
             av = jax.lax.dynamic_slice(
                 cv_all, (li, 0, 0, 0, 0),
                 (1, b, attn_len, cfg.num_kv_heads, cfg.head_dim))[0]
+            aks = avs = None
             if kvq:
                 aks = jax.lax.dynamic_slice(
                     ks_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
                 avs = jax.lax.dynamic_slice(
                     vs_all, (li, 0, 0, 0), (1, b, attn_len, kvg))[0]
-                ak = kv_dequantize(ak, aks, x.dtype)
-                av = kv_dequantize(av, avs, x.dtype)
-            o = attend(q, ak, av, pos_mat)
+            if pallas_dense:
+                from fasttalk_tpu.ops.pallas_attention import \
+                    decode_attend
+
+                # Length-pruning kernel over the bounded slice; int8
+                # rows + scales dequantize inside the kernel, so the
+                # bf16 dequant buffer is never materialised.
+                o = decode_attend(q, ak, av, pos_mat[:, -1] + 1,
+                                  k_scale=aks, v_scale=avs)
+            else:
+                if kvq:
+                    ak = kv_dequantize(ak, aks, x.dtype)
+                    av = kv_dequantize(av, avs, x.dtype)
+                o = attend(q, ak, av, pos_mat)
         x = x + qmm(o.reshape(b, t, cfg.q_dim), lp["wo"], pok, pok4)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(
@@ -511,6 +541,7 @@ def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
                    pallas_int8: bool = False, pallas_int4: bool = False,
                    block_table: jnp.ndarray | None = None,
                    block_size: int = 0, pallas_paged: bool = False,
+                   pallas_dense: bool = False,
                    ) -> tuple[jnp.ndarray, KVCache]:
     """One decode step [B] -> logits [B, V], cache updated IN PLACE.
 
@@ -527,7 +558,7 @@ def forward_decode(params: Params, cfg: ModelConfig, cur: jnp.ndarray,
         attn_len=attn_len, pallas_int8=pallas_int8,
         pallas_int4=pallas_int4,
         block_table=block_table, block_size=block_size,
-        pallas_paged=pallas_paged)
+        pallas_paged=pallas_paged, pallas_dense=pallas_dense)
     return logits[:, 0], new_cache
 
 

@@ -160,6 +160,10 @@ class PerfLedger:
         # figures read this instead of assuming params x 2 bytes.
         self._weight_quant = "off"
         self._weight_bytes_per_step = 0
+        # Which decode attention path the engine routes steps through
+        # (bind_model): attribution for the README perf table's
+        # "kernel" column and docs/ROOFLINE.md rows.
+        self._attention_kernel = ""
         # Compile ledger: key -> {kind, count, serving, first/last ts}.
         self._compiles: dict[str, dict[str, Any]] = {}
         m = get_metrics()
@@ -229,7 +233,8 @@ class PerfLedger:
     def bind_model(self, model_cfg: Any, num_slots: int,
                    dtype: str = "", kv_quant: str = "none",
                    kv_row_bytes: int = 0, weight_quant: str = "off",
-                   weight_bytes_per_step: int = 0) -> None:
+                   weight_bytes_per_step: int = 0,
+                   attention_kernel: str = "") -> None:
         """Attach the served model's cost estimate (engine __init__).
         FLOPs/token = 2·params (every weight partakes in one multiply-
         accumulate) + 4·layers·q_dim·kv_len (QKᵀ and A·V per head).
@@ -238,7 +243,11 @@ class PerfLedger:
         element size — int8 rows + scales under KV_QUANT=int8, never
         an assumed bf16. ``weight_bytes_per_step``: what one decode
         step streams of the resident weights, at THEIR actual size
-        (WEIGHT_QUANT tier: bf16 / int8+scales / packed int4+scales)."""
+        (WEIGHT_QUANT tier: bf16 / int8+scales / packed int4+scales).
+        ``attention_kernel``: which decode attention path the engine
+        routes steps through (xla_dense / xla_gather / pallas_dense /
+        pallas_paged) — pure attribution, so the README perf table and
+        docs/ROOFLINE.md can name the kernel per measured row."""
         with self._lock:
             self._model_name = getattr(model_cfg, "name", "")
             self._num_slots = num_slots
@@ -247,6 +256,7 @@ class PerfLedger:
             self._kv_row_bytes = int(kv_row_bytes)
             self._weight_quant = weight_quant
             self._weight_bytes_per_step = int(weight_bytes_per_step)
+            self._attention_kernel = attention_kernel
             self._params = int(model_cfg.param_count())
             self._flops_base = 2.0 * self._params
             self._flops_per_ctx = 4.0 * model_cfg.num_layers \
@@ -320,7 +330,8 @@ class PerfLedger:
                       "kv_row_bytes": self._kv_row_bytes,
                       "weight_quant": self._weight_quant,
                       "weight_bytes_per_step":
-                          self._weight_bytes_per_step},
+                          self._weight_bytes_per_step,
+                      "attention_kernel": self._attention_kernel},
             "compiles": {
                 "total": sum(e["count"] for e in compiles),
                 "serving": sum(e["serving"] for e in compiles),
@@ -341,6 +352,10 @@ class PerfLedger:
             out["hbm"] = {"bytes_read": 0, "read_gbps": 0.0,
                           "peak_hbm_gbps": peak_hbm or None,
                           "bw_util": None, "flop_per_byte": None}
+            out["ceiling"] = {"hbm_bytes_per_token": None,
+                              "ceiling_tok_s": None,
+                              "measured_tok_s": None,
+                              "frac_of_ceiling": None}
             return out
 
         # Wall-time decomposition: union the (clipped) call intervals,
@@ -471,6 +486,22 @@ class PerfLedger:
             "flop_per_byte": round(flops / hbm_bytes, 4)
             if hbm_bytes > 0 else None,
         }
+        # First-order roofline ceiling (docs/ROOFLINE.md): the tok/s
+        # this window would have produced if HBM were saturated at the
+        # device peak with the SAME measured per-useful-token byte
+        # cost. frac_of_ceiling equals hbm.bw_util by construction —
+        # stated here so "measured X tok/s of Y ceiling" reads off one
+        # block without re-deriving the division.
+        bpt = hbm_bytes / useful if useful > 0 else 0.0
+        ceiling = peak_hbm * 1e9 / bpt if bpt > 0 and peak_hbm > 0 \
+            else 0.0
+        out["ceiling"] = {
+            "hbm_bytes_per_token": round(bpt, 2) if bpt > 0 else None,
+            "ceiling_tok_s": round(ceiling, 2) if ceiling > 0 else None,
+            "measured_tok_s": out["tokens"]["useful_tok_s"],
+            "frac_of_ceiling": round(hbm_gbps / peak_hbm, 6)
+            if peak_hbm > 0 else None,
+        }
         return out
 
     def summary(self, now: float | None = None) -> dict[str, Any]:
@@ -495,6 +526,12 @@ class PerfLedger:
                 "read_gbps"),
             "hbm_bw_util": (rep.get("hbm") or {}).get("bw_util"),
             "flop_per_byte": (rep.get("hbm") or {}).get("flop_per_byte"),
+            "attention_kernel": (rep.get("model") or {}).get(
+                "attention_kernel"),
+            "ceiling_tok_s": (rep.get("ceiling") or {}).get(
+                "ceiling_tok_s"),
+            "frac_of_ceiling": (rep.get("ceiling") or {}).get(
+                "frac_of_ceiling"),
             "serving_compiles": rep["compiles"]["serving"],
         }
 

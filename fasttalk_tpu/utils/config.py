@@ -250,13 +250,19 @@ class Config:
     spmd_hb_timeout_s: float = field(
         default_factory=lambda: _env_float("SPMD_HB_TIMEOUT_S", 8.0))
     hbm_util: float = field(default_factory=lambda: _env_float("TPU_HBM_UTILIZATION", 0.9))
-    # The length-pruning Pallas decode-attention kernel. Off by default:
-    # profiled on v5e-1 its per-grid-cell cost (8 statically unrolled
-    # tiny GQA matmuls) makes it ~2x SLOWER than the XLA attention over
-    # a bucketed view at chat-scale lengths — it was the hidden reason
-    # r2's int8 measured equal to bf16. Worth enabling only for very
-    # long contexts with short active lengths, where block-level pruning
-    # beats reading the whole bucket.
+    # The length-pruning Pallas decode-attention kernel (ops/
+    # pallas_attention.py). Rides the scatter decode path and composes
+    # with KV_QUANT=int8 (fused in-kernel dequant), KV_LAYOUT=paged
+    # (block-walking variant), speculative decoding (multi-token verify
+    # blocks) and structured decoding. Off by default: profiled on
+    # v5e-1 the original q_len=1 bf16 variant's per-grid-cell cost (8
+    # statically unrolled tiny GQA matmuls) made it ~2x SLOWER than the
+    # XLA attention over a bucketed view at chat-scale lengths — it was
+    # the hidden reason r2's int8 measured equal to bf16. Wins where
+    # block-level pruning beats reading the whole bucket (long buckets,
+    # short active lengths) and on the int8 tier, where it skips the
+    # materialised bf16 dequant buffer; see docs/ROOFLINE.md for the
+    # measured decision table per config.
     use_pallas_attention: bool = field(
         default_factory=lambda: _env_bool("TPU_USE_PALLAS_ATTENTION", False))
     # Int8 dequant-fused matmul kernel (single-device decode); gates
@@ -953,18 +959,16 @@ class Config:
                 errs.append("KV_QUANT=int8 is incompatible with "
                             "multi-host SPMD serving (sharded cache); "
                             "set TPU_SPMD_ROLE=off")
-            if self.use_pallas_attention:
-                errs.append(
-                    "KV_QUANT=int8 is incompatible with the Pallas "
-                    "decode-attention kernel (it streams raw bf16 "
-                    "cache rows; the quantized tier dequantizes inside "
-                    "the XLA attention read) — set "
-                    "TPU_USE_PALLAS_ATTENTION=false")
+            # The Pallas decode-attention kernel composes with this
+            # tier: int8 rows + scale arrays DMA into VMEM and
+            # dequantize inside the kernel (ops/pallas_attention.py) —
+            # no guard needed.
             if self.spec_decode != "off":
                 errs.append(
                     "KV_QUANT=int8 is incompatible with speculative "
-                    "decoding (the verify block's quantize-on-write "
-                    "is unvalidated) — set TPU_SPEC_DECODE=off")
+                    "decoding (the spec carry does not thread the "
+                    "scale arrays through the verify block) — set "
+                    "TPU_SPEC_DECODE=off")
         if self.kv_layout not in ("dense", "paged"):
             errs.append(f"kv_layout must be 'dense' or 'paged', "
                         f"got {self.kv_layout!r}")
@@ -1033,12 +1037,9 @@ class Config:
                 errs.append("STRUCTURED_MODE=on is incompatible with "
                             "multi-host SPMD serving; set "
                             "TPU_SPMD_ROLE=off")
-            if self.use_pallas_attention:
-                errs.append(
-                    "STRUCTURED_MODE=on is incompatible with the "
-                    "Pallas decode-attention kernel (non-scatter "
-                    "decode path) — set TPU_USE_PALLAS_ATTENTION="
-                    "false")
+            # The Pallas decode-attention kernel now rides the scatter
+            # decode path (pallas_dense/pallas_paged in forward_decode),
+            # so constrained decoding composes with it — no guard.
         if self.kv_host_budget_mb > 0:
             # Warn (don't fail) when the budget exceeds detectable host
             # RAM: the pool would page/OOM long before filling.

@@ -108,6 +108,18 @@
 #                             bytes, the compat matrix, and a
 #                             scripts/quantize_checkpoint.py
 #                             --data-free smoke into a temp cache.
+#   ./run_tests.sh --roofline roofline/decode-kernel group (docs/
+#                             ROOFLINE.md): the compat-matrix lint
+#                             (scripts/check_compat.py — doc tables vs
+#                             live Config rejections), interpret-mode
+#                             Pallas kernel parity (bf16 + fused int8
+#                             dequant, single- and multi-token q,
+#                             dense + paged), fused-dequant greedy
+#                             parity and kernel routing at the engine
+#                             seam, spec-verify and structured-FSM
+#                             composition through the kernels, and a
+#                             two-cell BENCH_MODE=roofline sweep smoke
+#                             on the byte-tokenizer test model.
 #   ./run_tests.sh --perf     perf-attribution/flight-recorder group:
 #                             the step ledger (wall-time decomposition,
 #                             padding waste, MFU, compile ledger),
@@ -334,6 +346,31 @@ if [[ "${1:-}" == "--int4" ]]; then
         echo "--- quantize_checkpoint.py smoke skipped (no tinychat" \
              "checkpoint; run scripts/train_tinychat.py first) ---"
     fi
+    exit 0
+fi
+
+if [[ "${1:-}" == "--roofline" ]]; then
+    shift
+    echo "--- check_compat lint (doc compat tables <-> live Config"
+    echo "    rejections; docs/ROOFLINE.md) ---"
+    "${PYENV[@]}" python scripts/check_compat.py
+    "${PYENV[@]}" python -m pytest tests/test_pallas_attention.py \
+        "tests/test_kv_quant.py::TestCompatMatrix" \
+        "tests/test_kv_quant.py::TestTrainedTinyAcceptance::test_greedy_parity_pallas_fused_dequant" \
+        "tests/test_spec_decode.py::test_pallas_attention_composes_with_spec" \
+        "tests/test_structured.py::TestStructuredWithPallas" \
+        "$@"
+    echo "--- BENCH_MODE=roofline sweep smoke (2 cells, XLA vs fused"
+    echo "    Pallas, test model; one JSON line on stdout) ---"
+    out="$("${PYENV[@]}" env BENCH_MODE=roofline BENCH_MODEL=test-tiny \
+        BENCH_RF_CONFIGS=none:dense:xla,int8:dense:pallas \
+        BENCH_RF_STEPS=8 BENCH_RF_SLOTS=2 BENCH_RF_MAX_TOKENS=8 \
+        python bench.py)"
+    echo "$out"
+    for want in xla_dense pallas_dense frac_of_ceiling; do
+        grep -q "$want" <<<"$out" \
+            || { echo "roofline smoke: missing '$want'" >&2; exit 1; }
+    done
     exit 0
 fi
 

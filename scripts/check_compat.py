@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""Compat-matrix lint (docs/KVCACHE.md, SPEC_DECODE.md, STRUCTURED.md,
+QUANTIZATION.md; run_tests.sh --roofline).
+
+The docs carry compat tables ("rejected — <reason>" / "supported")
+and Config/engine carry the actual guards. Each has drifted from the
+other before: a guard lifted without its doc row (stale "rejected"
+scares users off a working path) or a doc row flipped to "supported"
+without the guard actually lifting. This lint cross-checks both
+surfaces on every run:
+
+1. DYNAMIC — build a real `Config` per documented combination and
+   assert it is accepted or rejected exactly as the doc row claims,
+   with the doc's named reason a substring of the actual ValueError.
+2. STATIC — for rejections enforced at the engine seam too, assert
+   the reason phrase appears in engine.py source, so the two error
+   messages can't drift apart.
+3. DOC — assert each doc file still contains the row text this table
+   encodes, so editing a doc row without editing this table (or vice
+   versa) fails CI instead of shipping a contradiction.
+
+Exit 0 = clean; exit 1 = problems, each printed on its own line.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+ENGINE = REPO / "fasttalk_tpu" / "engine" / "engine.py"
+
+# The phrase both seams must agree on for the one remaining
+# kernel-adjacent rejection (Config._validate AND TPUEngine.__init__).
+SPEC_SCALE_REASON = ("the spec carry does not thread the "
+                     "scale arrays through the verify block")
+
+
+@dataclass
+class Case:
+    name: str
+    kwargs: dict
+    # None -> Config must construct; str -> Config must raise
+    # ValueError containing this substring (the documented reason).
+    reject_reason: str | None = None
+    # (doc path relative to repo, substring the doc must contain) —
+    # the doc row this combination's behaviour is documented by.
+    docs: list[tuple[str, str]] = field(default_factory=list)
+    # Reason must also appear verbatim in engine.py (seam mirror).
+    engine_mirror: bool = False
+
+
+CASES = [
+    # --- KV_QUANT=int8 tier (docs/KVCACHE.md quantized compat table)
+    Case("kv_int8 x pallas attention composes",
+         dict(kv_quant="int8", spec_decode="off",
+              use_pallas_attention=True),
+         docs=[("docs/KVCACHE.md",
+                "dequantizes after the DMA, so int8 bytes are what "
+                "cross HBM"),
+               ("docs/ROOFLINE.md",
+                "dequant happens in VMEM *after* the DMA")]),
+    Case("kv_int8 x spec decode rejected (scale carry)",
+         dict(kv_quant="int8", spec_decode="ngram"),
+         reject_reason=SPEC_SCALE_REASON,
+         docs=[("docs/KVCACHE.md", SPEC_SCALE_REASON),
+               ("docs/SPEC_DECODE.md", SPEC_SCALE_REASON)],
+         engine_mirror=True),
+    Case("kv_int8 x mesh rejected",
+         dict(kv_quant="int8", spec_decode="off", tp_size=2),
+         reject_reason="single-device only",
+         docs=[("docs/KVCACHE.md",
+                "rejected — the scale arrays do not shard")]),
+    Case("kv_int8 x SPMD rejected",
+         dict(kv_quant="int8", spec_decode="off", spmd_role="leader",
+              spmd_addr="h:1", spmd_followers=1),
+         reject_reason="multi-host SPMD"),
+
+    # --- KV_LAYOUT=paged tier (docs/KVCACHE.md paged compat table)
+    Case("paged x pallas attention composes",
+         dict(kv_layout="paged", use_pallas_attention=True),
+         docs=[("docs/KVCACHE.md",
+                "Pallas decode attention | supported")]),
+    Case("paged x spec decode composes",
+         dict(kv_layout="paged", spec_decode="ngram"),
+         docs=[("docs/KVCACHE.md",
+                "speculative decoding | supported")]),
+    Case("paged x mesh rejected",
+         dict(kv_layout="paged", tp_size=2),
+         reject_reason="single-device only",
+         docs=[("docs/KVCACHE.md",
+                "rejected — the pool and tables are host-orchestrated")]),
+    Case("paged x kv_int8 x pallas composes (fused paged kernel)",
+         dict(kv_layout="paged", kv_quant="int8", spec_decode="off",
+              use_pallas_attention=True)),
+
+    # --- spec decode (docs/SPEC_DECODE.md)
+    Case("spec x pallas attention composes (multi-token-q verify)",
+         dict(spec_decode="ngram", use_pallas_attention=True),
+         docs=[("docs/SPEC_DECODE.md",
+                "multi-token-q generalisation")]),
+
+    # --- structured decoding (docs/STRUCTURED.md compat matrix)
+    Case("structured=on x pallas attention composes",
+         dict(structured_mode="on", use_pallas_attention=True),
+         docs=[("docs/STRUCTURED.md",
+                "rides the scatter path since the multi-token-q "
+                "generalisation")]),
+    Case("structured=on x mesh rejected",
+         dict(structured_mode="on", tp_size=2),
+         reject_reason="single-device only"),
+
+    # --- int4 weight tier (docs/QUANTIZATION.md compat matrix)
+    Case("weight int4 x pallas attention composes",
+         dict(weight_quant="int4", use_pallas_attention=True),
+         docs=[("docs/QUANTIZATION.md",
+                "the decode-attention kernel is orthogonal to the "
+                "weight tier")]),
+    Case("weight int4 x mesh rejected",
+         dict(weight_quant="int4", tp_size=2),
+         reject_reason="sharded load/init path is unvalidated",
+         docs=[("docs/QUANTIZATION.md",
+                "sharded load/init unvalidated")]),
+]
+
+
+def _norm(s: str) -> str:
+    """Collapse whitespace so phrases wrapped across source/doc lines
+    still match their single-line form."""
+    return " ".join(s.split())
+
+
+def main() -> int:
+    from fasttalk_tpu.utils.config import Config
+
+    problems: list[str] = []
+    # Strip quotes so phrases split across adjacent string literals
+    # ("... the " "scale arrays ...") still match their joined form.
+    engine_src = _norm(ENGINE.read_text().replace('"', ' '))
+
+    for case in CASES:
+        try:
+            Config(**case.kwargs)
+            err = None
+        except ValueError as e:
+            err = _norm(str(e))
+
+        if case.reject_reason is None:
+            if err is not None:
+                problems.append(
+                    f"{case.name}: doc says supported but Config "
+                    f"rejects: {err}")
+        else:
+            if err is None:
+                problems.append(
+                    f"{case.name}: doc says rejected "
+                    f"({case.reject_reason!r}) but Config accepts — "
+                    "lifted guard without updating the doc table and "
+                    "this lint?")
+            elif _norm(case.reject_reason) not in err:
+                problems.append(
+                    f"{case.name}: Config rejects but without the "
+                    f"documented reason {case.reject_reason!r}; "
+                    f"actual: {err}")
+
+        for doc_rel, needle in case.docs:
+            doc = REPO / doc_rel
+            if not doc.exists():
+                problems.append(f"{case.name}: {doc_rel} missing")
+            elif _norm(needle) not in _norm(doc.read_text()):
+                problems.append(
+                    f"{case.name}: {doc_rel} no longer contains the "
+                    f"row text {needle!r} — doc and guard drifted")
+
+        if case.engine_mirror \
+                and _norm(case.reject_reason) not in engine_src:
+            problems.append(
+                f"{case.name}: reason {case.reject_reason!r} not "
+                "found in engine.py — the engine seam no longer "
+                "mirrors the Config rejection")
+
+    if problems:
+        for p in problems:
+            print(f"PROBLEM: {p}")
+        return 1
+    print(f"check_compat: {len(CASES)} documented combinations match "
+          "live Config behaviour (docs/KVCACHE.md, SPEC_DECODE.md, "
+          "STRUCTURED.md, QUANTIZATION.md)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

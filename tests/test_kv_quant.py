@@ -366,12 +366,14 @@ class TestCompatMatrix:
         with pytest.raises(ValueError, match="single-device"):
             Config(kv_quant="int8", spec_decode="off", sp_size=2)
 
-    def test_pallas_attention_rejected(self):
+    def test_pallas_attention_composes(self):
+        """KV_QUANT x Pallas is no longer rejected: the kernel
+        dequantizes int8 rows + scales inside VMEM (lifted guard)."""
         from fasttalk_tpu.utils.config import Config
 
-        with pytest.raises(ValueError, match="Pallas"):
-            Config(kv_quant="int8", spec_decode="off",
-                   use_pallas_attention=True)
+        cfg = Config(kv_quant="int8", spec_decode="off",
+                     use_pallas_attention=True)
+        assert cfg.kv_quant == "int8" and cfg.use_pallas_attention
 
     def test_spec_decode_rejected(self):
         from fasttalk_tpu.utils.config import Config
@@ -388,13 +390,15 @@ class TestCompatMatrix:
         with pytest.raises(ValueError, match="speculative"):
             TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
                       max_len=256, kv_quant="int8", spec_decode="auto")
-        with pytest.raises(ValueError, match="Pallas"):
-            TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
-                      max_len=256, kv_quant="int8",
-                      use_pallas_attention=True)
         with pytest.raises(ValueError, match="kv_quant"):
             TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
                       max_len=256, kv_quant="fp8")
+        # Pallas x int8 constructs (lifted guard) and routes decode
+        # through the fused-dequant kernel, not the XLA fallback.
+        eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                        max_len=256, kv_quant="int8",
+                        spec_decode="off", use_pallas_attention=True)
+        assert eng.attention_kernel == "pallas_dense"
 
 
 @pytest.mark.skipif(not HAVE_TINYCHAT,
@@ -404,7 +408,7 @@ class TestTrainedTinyAcceptance:
     decode under int8 KV matches the bf16 control token for token on
     short contexts."""
 
-    def _engine(self, kv_quant):
+    def _engine(self, kv_quant, **kw):
         from fasttalk_tpu.engine.factory import build_engine
         from fasttalk_tpu.utils.config import Config
 
@@ -412,7 +416,7 @@ class TestTrainedTinyAcceptance:
                      model_path=os.path.dirname(CKPT), port=18771,
                      monitoring_port=18772, enable_agent=False,
                      max_model_len=1024, default_context_window=1024,
-                     spec_decode="off", kv_quant=kv_quant)
+                     spec_decode="off", kv_quant=kv_quant, **kw)
         eng = build_engine(cfg)
         eng.start()
         return eng
@@ -450,3 +454,24 @@ class TestTrainedTinyAcceptance:
                     cfinal["finish_reason"]
         finally:
             q.shutdown()
+
+    def test_greedy_parity_pallas_fused_dequant(self):
+        """The ISSUE 15 acceptance bar on REAL trained weights: the
+        fused int8-dequant Pallas kernel (interpret mode on CPU) is
+        greedy token-identical to the XLA dequant control."""
+        msgs = [{"role": "user", "content": "what color is the sky?"}]
+        ctl = self._engine("int8")
+        try:
+            ctext, cfinal = self._chat(ctl, "x-sky", msgs,
+                                       max_tokens=16)
+        finally:
+            ctl.shutdown()
+        pal = self._engine("int8", use_pallas_attention=True)
+        try:
+            assert pal.attention_kernel == "pallas_dense"
+            text, final = self._chat(pal, "p-sky", msgs,
+                                     max_tokens=16)
+            assert text == ctext, (text, ctext)
+            assert final["finish_reason"] == cfinal["finish_reason"]
+        finally:
+            pal.shutdown()

@@ -156,7 +156,45 @@ class TestPerfLedger:
         s = _ledger(tr).summary(now=101.0)
         assert s["device_busy_frac"] == pytest.approx(1.0)
         assert set(s) >= {"padding_waste_frac", "useful_tok_s", "mfu",
-                          "occupancy_mean", "serving_compiles"}
+                          "occupancy_mean", "serving_compiles",
+                          "attention_kernel", "ceiling_tok_s",
+                          "frac_of_ceiling"}
+
+    def test_ceiling_section_and_kernel_binding(self, monkeypatch):
+        # docs/ROOFLINE.md: ceiling_tok_s = peak_hbm / bytes-per-token,
+        # and frac_of_ceiling must equal hbm.bw_util by construction.
+        monkeypatch.setenv("PERF_PEAK_HBM_GBPS", "100.0")
+        tr = Tracer(enabled=True)
+        for t0 in (100.0, 101.0):
+            tr.step("engine_step", t0, t0 + 1.0, steps=8, batch=2,
+                    slots=4, occupancy=1.0, kind="plain", tokens=16,
+                    rows=16, kv_len=512, flops=0.0,
+                    kv_bytes=20e9, weight_bytes=5e9)
+        led = _ledger(tr)
+        led.bind_model(TINY, num_slots=4, dtype="bfloat16",
+                       attention_kernel="pallas_dense")
+        rep = led.report(now=102.0)
+        assert rep["model"]["attention_kernel"] == "pallas_dense"
+        # 50 GB over 2 s against a 100 GB/s peak; 32 useful tokens.
+        assert rep["hbm"]["bw_util"] == pytest.approx(0.25)
+        ceil = rep["ceiling"]
+        assert ceil["hbm_bytes_per_token"] == pytest.approx(50e9 / 32)
+        assert ceil["ceiling_tok_s"] == pytest.approx(64.0)
+        assert ceil["measured_tok_s"] == pytest.approx(16.0)
+        assert ceil["frac_of_ceiling"] == pytest.approx(
+            rep["hbm"]["bw_util"])
+        s = led.summary(now=102.0)
+        assert s["attention_kernel"] == "pallas_dense"
+        assert s["ceiling_tok_s"] == pytest.approx(64.0)
+        assert s["frac_of_ceiling"] == pytest.approx(0.25)
+
+    def test_ceiling_null_without_peak(self):
+        # CPU / unknown device: nulls, never a made-up ceiling.
+        tr = Tracer(enabled=True)
+        _step(tr, 100.0, 101.0)
+        rep = _ledger(tr).report(now=101.0)
+        assert rep["ceiling"]["ceiling_tok_s"] is None
+        assert rep["ceiling"]["frac_of_ceiling"] is None
 
 
 class TestPerfSurfaces:

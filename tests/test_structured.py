@@ -851,6 +851,40 @@ class TestStructuredWithSpecDecode:
 
 
 # ---------------------------------------------------------------------
+# Pallas decode kernel: constrained decoding rides the multi-token-q
+# kernel instead of forcing TPU_USE_PALLAS_ATTENTION off
+# ---------------------------------------------------------------------
+
+class TestStructuredWithPallas:
+    def test_constrained_greedy_matches_xla_control(self):
+        """STRUCTURED x Pallas composition (lifted guard): the FSM
+        decode path routes through the Pallas kernel and the greedy
+        constrained stream is byte-identical to the XLA control."""
+        import jax
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        outs = {}
+        for use_pallas in (False, True):
+            eng = TPUEngine(TINY, params, ByteTokenizer(), num_slots=2,
+                            max_len=256, prefill_chunk=64,
+                            spec_decode="off", structured="on",
+                            use_pallas_attention=use_pallas)
+            # The guard is gone: structured stays available.
+            assert eng.structured_reason is None
+            eng.start()
+            try:
+                t, f = _collect(eng, "pl1", "spl1",
+                                [{"role": "user", "content": "json"}],
+                                _sp())
+                assert f["finish_reason"] == "stop"
+                assert _validates(json.loads(t), FINITE_SCHEMA)
+                outs[use_pallas] = t
+            finally:
+                eng.shutdown()
+        assert outs[True] == outs[False]
+
+
+# ---------------------------------------------------------------------
 # Config knobs
 # ---------------------------------------------------------------------
 
@@ -873,9 +907,14 @@ class TestStructuredConfig:
                    structured_state_budget=1024)
         with pytest.raises(ValueError, match="single-device"):
             Config(structured_mode="on", tp_size=2)
-        with pytest.raises(ValueError, match="Pallas"):
-            Config(structured_mode="on", use_pallas_attention=True)
-        # auto tolerates both (requests get per-engine rejection).
+        # The Pallas decode kernel composes with constrained decoding
+        # since the multi-token q generalisation (the FSM scatter path
+        # routes through forward_decode's pallas flags) — no longer a
+        # rejected combination.
+        cfg = Config(structured_mode="on", use_pallas_attention=True)
+        assert cfg.structured_mode == "on"
+        assert cfg.use_pallas_attention
+        # auto tolerates a mesh (requests get per-engine rejection).
         Config(structured_mode="auto", tp_size=2)
 
     def test_config_show_names_bad_value(self):
