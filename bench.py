@@ -1140,6 +1140,19 @@ async def _fleet_failover(http, router, handles, max_tokens) -> dict:
     await asyncio.get_running_loop().run_in_executor(
         None, target.engine.shutdown)
     await asyncio.gather(*tasks)
+    # A failed-over stream must leave ONE stitched cross-replica trace
+    # retrievable over the wire (docs/OBSERVABILITY.md "Fleet
+    # tracing"): router + both replicas' spans, exactly one terminal
+    # event however many replicas served the stream.
+    from fasttalk_tpu.observability.trace import get_tracer
+    stitched = None
+    for t in reversed(get_tracer().completed()):
+        if any(s.name == "resume" for s in t.spans):
+            async with http.get(f"http://127.0.0.1:{PORT}"
+                                f"/traces/{t.request_id}") as r:
+                if r.status == 200:
+                    stitched = (await r.json()).get("stitched")
+            break
     errors = [v["error"] for v in shared if v["error"]]
     resumed = sorted(v["resumed_ms"] for v in shared
                      if v["resumed_ms"] is not None)
@@ -1159,10 +1172,20 @@ async def _fleet_failover(http, router, handles, max_tokens) -> dict:
             "p50": round(statistics.median(next_tok), 1) if next_tok
             else None,
         },
+        "stitched_trace": {
+            "resumed": stitched["resumed"],
+            "terminal_events": stitched["terminal_events"],
+            "components": stitched["components"],
+            "n_spans": stitched["n_spans"],
+        } if stitched is not None else None,
     }
     log(f"  failover: {len(resumed)}/{affected} resumed, "
         f"{len(errors)} errors, resume p50 "
         f"{out['resume_latency_ms']['p50']} ms")
+    if stitched is not None:
+        log(f"  stitched trace: {stitched['resumed']} resumed / "
+            f"{stitched['terminal_events']} terminal across "
+            f"components {stitched['components']}")
     return out
 
 
@@ -1183,6 +1206,9 @@ async def _fleet_phase(cfg, replicas: int, sessions: int,
         t0 = time.monotonic()
         eng = build_engine(cfg)
         eng.warmup(cfg.warmup)
+        # Tag each replica's spans so the failover scenario's stitched
+        # trace attributes hops to the replica that served them.
+        eng.set_trace_component(f"inproc-{i}")
         handles.append(ReplicaHandle(f"inproc-{i}", eng))
         log(f"  replica {i} built+warmed in "
             f"{time.monotonic() - t0:.1f}s")

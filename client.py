@@ -16,9 +16,18 @@ survives a routed failover or an overload burst the way a production
 caller should. Mid-stream ``resumed`` frames (fleet failover moved the
 stream to a surviving replica) are informational: the stream continues.
 
+``--journey`` (docs/OBSERVABILITY.md "the token journey") opts the
+session into per-token attribution and prints BOTH waterfalls side by
+side: the server's hop decomposition (device retire → fetch →
+detokenize → loop dequeue → WS write, from response_complete stats)
+and the client's own receive timeline. Each token frame then carries
+a server wall-clock stamp ("st"); min(client_recv_wall - st) over the
+stream estimates the one-way network delay + clock offset, splitting
+measured server time from network RTT.
+
 Usage: python client.py [--url ws://localhost:8000/ws/llm]
                         [--prompt "..."] [--max-tokens N] [--quiet]
-                        [--retries N]
+                        [--retries N] [--journey]
 """
 
 from __future__ import annotations
@@ -28,6 +37,7 @@ import asyncio
 import json
 import random
 import sys
+import time
 
 import aiohttp
 
@@ -71,8 +81,77 @@ def _maybe_backoff(msg: dict) -> None:
                       f"{err.get('message', '')}")
 
 
+class ClientJourney:
+    """Client-side half of the token journey: per-token receive
+    timestamps (monotonic for inter-token gaps, wall for the network
+    split against the server's "st" stamps)."""
+
+    def __init__(self) -> None:
+        self.t0_mono = time.monotonic()
+        self.recv_mono: list[float] = []
+        # (client wall at receive) - (server wall at send), per frame.
+        # Network one-way delay + clock offset; min() over the stream
+        # is the tightest estimate of the constant part, so
+        # (delta - min_delta) is per-token network jitter.
+        self.deltas: list[float] = []
+
+    def on_token(self, msg: dict) -> None:
+        now_mono = time.monotonic()
+        self.recv_mono.append(now_mono)
+        st = msg.get("st")
+        if isinstance(st, (int, float)):
+            self.deltas.append(time.time() - float(st))
+
+    @staticmethod
+    def _pctl(vals: list[float], q: float) -> float:
+        if not vals:
+            return 0.0
+        s = sorted(vals)
+        return s[min(len(s) - 1, max(0, int(round(q * len(s) + 0.5)) - 1))]
+
+    def report(self, server_journey: dict | None) -> str:
+        lines = ["", "--- token journey (client view) ---"]
+        n = len(self.recv_mono)
+        if not n:
+            return "\n".join(lines + ["no tokens received"])
+        ttft_ms = (self.recv_mono[0] - self.t0_mono) * 1000
+        gaps = [(b - a) * 1000 for a, b in
+                zip(self.recv_mono, self.recv_mono[1:])]
+        lines.append(f"client TTFT            {ttft_ms:9.1f} ms "
+                     f"({n} tokens)")
+        if gaps:
+            lines.append(f"inter-token p50/p99    "
+                         f"{self._pctl(gaps, 0.50):9.1f} / "
+                         f"{self._pctl(gaps, 0.99):.1f} ms")
+        if self.deltas:
+            base = min(self.deltas)
+            jitter = [(d - base) * 1000 for d in self.deltas]
+            lines.append(f"network+offset (min)   {base * 1000:9.1f} ms "
+                         "(one-way delay + clock offset)")
+            lines.append(f"network jitter p50/p99 "
+                         f"{self._pctl(jitter, 0.50):9.1f} / "
+                         f"{self._pctl(jitter, 0.99):.1f} ms")
+        if server_journey:
+            lines.append("--- token journey (server hops) ---")
+            hops = server_journey.get("hops_ms", {})
+            for hop, ms in hops.items():
+                lines.append(f"{hop:<22} {float(ms):9.1f} ms total")
+            lines.append(
+                f"server wall {float(server_journey.get('wall_ms', 0)):.1f} "
+                f"ms, hop sum {float(server_journey.get('hops_sum_ms', 0)):.1f}"
+                f" ms, reconciliation "
+                f"{float(server_journey.get('reconciliation', 0)):.3f}")
+            sttft = server_journey.get("ttft_ms")
+            if sttft is not None:
+                lines.append(
+                    f"server TTFT {float(sttft):.1f} ms vs client "
+                    f"{ttft_ms:.1f} ms → network+client share "
+                    f"{ttft_ms - float(sttft):.1f} ms")
+        return "\n".join(lines)
+
+
 async def run_session(ws_url: str, prompt: str, max_tokens: int,
-                      quiet: bool) -> bool:
+                      quiet: bool, journey: bool = False) -> bool:
     async with aiohttp.ClientSession() as session:
         async with session.ws_connect(ws_url) as ws:
             first = await ws.receive()
@@ -92,16 +171,18 @@ async def run_session(ws_url: str, prompt: str, max_tokens: int,
                 print(f"session: {msg['session_id']} "
                       f"(provider={msg.get('provider')})")
 
-            await ws.send_json({
-                "type": "start_session",
-                "config": {
-                    "system_prompt": "You are a concise assistant.",
-                    "max_tokens": max_tokens,
-                },
-            })
+            config = {
+                "system_prompt": "You are a concise assistant.",
+                "max_tokens": max_tokens,
+            }
+            if journey:
+                config["journey"] = True
+            await ws.send_json({"type": "start_session",
+                                "config": config})
             msg = json.loads((await ws.receive()).data)
             assert msg["type"] == "session_configured", msg
 
+            jc = ClientJourney() if journey else None
             await ws.send_json({"type": "user_message", "text": prompt})
             tokens = 0
             stats = {}
@@ -116,6 +197,8 @@ async def run_session(ws_url: str, prompt: str, max_tokens: int,
                 msg = json.loads(raw.data)
                 if msg["type"] == "token":
                     tokens += 1
+                    if jc is not None:
+                        jc.on_token(msg)
                     if not quiet:
                         print(msg.get("data", ""), end="", flush=True)
                 elif msg["type"] == "resumed":
@@ -135,6 +218,8 @@ async def run_session(ws_url: str, prompt: str, max_tokens: int,
                 print(f"\nstats: {stats.get('tokens_generated')} tok, "
                       f"{stats.get('tokens_per_second', 0):.1f} tok/s, "
                       f"ttft {stats.get('ttft_ms', 0):.0f} ms")
+            if jc is not None:
+                print(jc.report(stats.get("journey")))
 
             await ws.send_json({"type": "end_session"})
             msg = json.loads((await ws.receive()).data)
@@ -143,12 +228,14 @@ async def run_session(ws_url: str, prompt: str, max_tokens: int,
 
 
 async def run_with_backoff(ws_url: str, prompt: str, max_tokens: int,
-                           quiet: bool, retries: int) -> bool:
+                           quiet: bool, retries: int,
+                           journey: bool = False) -> bool:
     """run_session, honouring server retry_after hints: sleep and
     reconnect up to ``retries`` times before giving up."""
     for attempt in range(retries + 1):
         try:
-            return await run_session(ws_url, prompt, max_tokens, quiet)
+            return await run_session(ws_url, prompt, max_tokens, quiet,
+                                     journey=journey)
         except Backoff as b:
             if attempt >= retries:
                 print(f"giving up after {retries} retries: {b.why}",
@@ -176,7 +263,8 @@ async def amain(args: argparse.Namespace) -> int:
     if not await check_health(base, args.quiet):
         return 1
     ok = await run_with_backoff(args.url, args.prompt, args.max_tokens,
-                                args.quiet, args.retries)
+                                args.quiet, args.retries,
+                                journey=args.journey)
     if ok and not args.quiet:
         print("E2E OK")
     return 0 if ok else 1
@@ -191,6 +279,10 @@ def main() -> int:
     p.add_argument("--retries", type=int, default=3,
                    help="reconnect-and-backoff attempts on capacity "
                         "rejections (retry_after / close 1013)")
+    p.add_argument("--journey", action="store_true",
+                   help="opt into per-token journey attribution and "
+                        "print client vs server waterfalls (network "
+                        "RTT split)")
     return asyncio.run(amain(p.parse_args()))
 
 

@@ -191,6 +191,12 @@ class GenerationParams:
     # zero-cost default). Validated here so a malformed spec surfaces
     # as a 400 / invalid_config, never a 500.
     structured: Any = None
+    # Per-token journey waterfall (observability/journey.py): when set,
+    # the engine stamps each token event with its device-fetch /
+    # detok-emit boundaries (the "j" dict) so the serving layer can cut
+    # TTFT and inter-token gaps into named hops. Off by default — two
+    # time.monotonic() calls per retirement are cheap but not free.
+    journey: bool = False
 
 
 def raw_prompt_text(messages: list[dict]) -> str:
@@ -305,6 +311,13 @@ class EngineBase:
     def pending_requests(self) -> int:
         """Requests still queued or running (drain-progress probe)."""
         return 0
+
+    def set_trace_component(self, component: str) -> None:
+        """Tag this engine's spans with a fleet component name (e.g.
+        ``inproc-0``) so in-proc replicas sharing one process tracer
+        stay distinguishable in stitched traces (observability/
+        stitch.py). No-op by default; engines that hold a tracer
+        override by rebinding it to ``get_tracer().scoped(name)``."""
 
     # ---- fleet fabric: cross-replica KV migration (docs/ROUTER.md).
     # Engines without a host pool answer None/False — the router then
@@ -824,6 +837,12 @@ class TPUEngine(EngineBase):
             buckets=(0.5, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000,
                      4000))
         self._tracer = get_tracer()
+        # Journey stamps (observability/journey.py): monotonic marks
+        # taken around the blocking device fetch of the CURRENT
+        # retirement, attached per-request in _flush_emit when the
+        # request opted in. One pair per retirement, not per request.
+        self._j_wait0: float = 0.0
+        self._j_fetched: float = 0.0
         # Attribution ledger (observability/perf.py): binds the served
         # model's FLOP cost estimate so step records can carry per-call
         # FLOPs and /perf can report achieved-vs-peak MFU. The KV
@@ -1514,6 +1533,13 @@ class TPUEngine(EngineBase):
         """Requests not yet terminal (queued + prefilling + running):
         the drain loop polls this toward zero."""
         return len(self._by_id)
+
+    def set_trace_component(self, component: str) -> None:
+        """Tag this engine's spans with a fleet component name: in-proc
+        replicas of a BENCH_MODE=fleet router share ONE process tracer,
+        so the component attr is what keeps replica A's prefill/decode
+        spans distinguishable from replica B's in a stitched trace."""
+        self._tracer = get_tracer().scoped(component)
 
     def scheduler_debug(self) -> dict:
         """Scheduler state + queued entries (position, priority,
@@ -4390,7 +4416,9 @@ class TPUEngine(EngineBase):
             if not block and not fut.done():
                 return
             self._pending_firsts.popleft()
+            self._j_wait0 = time.monotonic()
             arr = fut.result()
+            self._j_fetched = time.monotonic()
             for j, s, req in entries:
                 req.first_pending = False
                 if req.finished or self._running.get(s) is not req:
@@ -4680,7 +4708,9 @@ class TPUEngine(EngineBase):
             self._drain_firsts(block=True)
         t0 = time.monotonic()
         res = fut.result()  # sync point
-        self._m_step.observe((time.monotonic() - t0) * 1000)
+        self._j_wait0 = t0
+        self._j_fetched = time.monotonic()
+        self._m_step.observe((self._j_fetched - t0) * 1000)
         # The block above gave every pending firsts-copy >= one call's
         # wall time to land: emit whatever arrived NOW. Without this, a
         # request admitted after call N dispatched waits for call N+1's
@@ -5017,7 +5047,18 @@ class TPUEngine(EngineBase):
         measurable slice of aggregate throughput."""
         if req.emit_buf:
             text, req.emit_buf = req.emit_buf, ""
-            self._emit(req, {"type": "token", "text": text})
+            event: dict = {"type": "token", "text": text}
+            if req.params.journey:
+                # Journey stamps (observability/journey.py): the
+                # retirement's fetch-wait start / fetch-landed marks
+                # plus the enqueue instant. The serving loop adds its
+                # dequeue and ws-write boundaries; out-of-order stamps
+                # (a flush from a different retirement than the fetch
+                # the marks describe) are clamped forward there.
+                event["j"] = {"w": self._j_wait0,
+                              "f": self._j_fetched,
+                              "e": time.monotonic()}
+            self._emit(req, event)
 
     def _emit(self, req: _Request, event: dict) -> None:
         try:

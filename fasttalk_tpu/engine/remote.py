@@ -20,7 +20,8 @@ import aiohttp
 
 from fasttalk_tpu.engine.engine import (EngineBase, GenerationParams,
                                         raw_prompt_text)
-from fasttalk_tpu.observability.trace import get_tracer
+from fasttalk_tpu.observability.trace import (current_traceparent,
+                                              get_tracer)
 from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.utils.errors import (AdmissionRejected, ErrorCategory,
                                        LLMServiceError)
@@ -58,6 +59,7 @@ class _RemoteEngine(EngineBase):
         self._cancelled: set[str] = set()
         self._session: aiohttp.ClientSession | None = None
         self._started = False
+        self._tracer = get_tracer()
         m = get_metrics()
         self._m_shed = m.counter(
             "remote_shed_total",
@@ -198,10 +200,15 @@ class _RemoteEngine(EngineBase):
         """Register the request with the span tracer (phase: upstream).
         Returns whether this engine owns the trace's finish (False when
         the serving layer started it first)."""
-        tracer = get_tracer()
+        tracer = self._tracer
         owned = tracer.start(request_id, session_id)
         tracer.set_phase(request_id, "upstream", backend=backend)
         return owned
+
+    def set_trace_component(self, component: str) -> None:
+        """Tag this engine's spans with a fleet component name (see
+        EngineBase.set_trace_component)."""
+        self._tracer = get_tracer().scoped(component)
 
     def _trace_end(self, request_id: str, owned: bool, t0: float,
                    ttft_ms: float | None, chunks: int,
@@ -209,7 +216,7 @@ class _RemoteEngine(EngineBase):
         """Close the upstream_stream span (covers connect + the whole
         body read — a remote engine has no queue/prefill visibility, so
         this is the request's single engine-side phase)."""
-        tracer = get_tracer()
+        tracer = self._tracer
         tracer.add_span(request_id, "upstream_stream", t0,
                         time.monotonic(), summary=True, backend=backend,
                         chunks=chunks,
@@ -341,11 +348,18 @@ class VLLMRemoteEngine(_RemoteEngine):
                         await _fp.fire_async("remote.connect",
                                  exc=aiohttp.ClientConnectionError,
                                  request_id=request_id)
+                    # Trace-context propagation (docs/OBSERVABILITY.md
+                    # "Fleet tracing"): carry the fleet trace id on the
+                    # dispatch so a remote replica's serving edge joins
+                    # its spans to the router's trace instead of
+                    # minting a disjoint one.
+                    headers = {"Authorization": f"Bearer {self.api_key}"}
+                    tp = current_traceparent()
+                    if tp is not None:
+                        headers["traceparent"] = tp
                     for _attempt in range(3):
                         async with client.post(
-                                url, json=body,
-                                headers={"Authorization":
-                                         f"Bearer {self.api_key}"},
+                                url, json=body, headers=headers,
                                 ) as resp:
                             if resp.status != 200:
                                 text = await resp.text()
@@ -437,7 +451,7 @@ class VLLMRemoteEngine(_RemoteEngine):
                                     if ttft is None:
                                         ttft = (time.monotonic()
                                                 - started) * 1000
-                                        get_tracer().event(request_id,
+                                        self._tracer.event(request_id,
                                                            "first_chunk")
                                     yield {"type": "token",
                                            "text": content}
@@ -561,7 +575,11 @@ class OllamaRemoteEngine(_RemoteEngine):
                         await _fp.fire_async("remote.connect",
                                  exc=aiohttp.ClientConnectionError,
                                  request_id=request_id)
-                    async with client.post(url, json=body) as resp:
+                    tp = current_traceparent()
+                    async with client.post(
+                            url, json=body,
+                            headers={"traceparent": tp} if tp else None,
+                            ) as resp:
                         if resp.status != 200:
                             text = await resp.text()
                             raise LLMServiceError(
@@ -600,7 +618,7 @@ class OllamaRemoteEngine(_RemoteEngine):
                                 if ttft is None:
                                     ttft = (time.monotonic()
                                             - started) * 1000
-                                    get_tracer().event(request_id,
+                                    self._tracer.event(request_id,
                                                        "first_chunk")
                                 yield {"type": "token", "text": content}
                             if obj.get("done"):

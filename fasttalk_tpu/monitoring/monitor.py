@@ -61,7 +61,7 @@ def _device_memory() -> list[dict]:
 
 def build_monitoring_app(ready_check=None, sched_info=None,
                          supervisor_info=None, fault_http=False,
-                         ) -> web.Application:
+                         trace_lookup=None) -> web.Application:
     """``sched_info``: optional zero-arg callable returning the
     engine's scheduler view ({"stats": ..., "queued": [...]}, see
     engine.scheduler_debug) — surfaces the admission-control overload
@@ -77,7 +77,15 @@ def build_monitoring_app(ready_check=None, sched_info=None,
     control, resilience/failpoints.py). OFF by default — the
     monitoring port is unauthenticated, so the mutation endpoint must
     be an explicit opt-in (FAULT_HTTP=true) and never enabled in
-    production. GET /debug/fault (read-only view) is always served."""
+    production. GET /debug/fault (read-only view) is always served.
+
+    ``trace_lookup``: optional one-arg callable (request_id → stitched
+    cross-replica trace dict or None; the FleetRouter's
+    ``stitched_trace``). GET /traces/{request_id} falls back to it
+    when the local ring misses — on a router-fronted deployment the
+    request ran on a REPLICA, so the router process's own ring never
+    saw it and the old behavior was an unconditional 404
+    (docs/OBSERVABILITY.md "Fleet tracing")."""
     app = web.Application()
 
     def _sched_view() -> dict | None:
@@ -351,6 +359,24 @@ def build_monitoring_app(ready_check=None, sched_info=None,
         tracer = get_tracer()
         trace = tracer.get(rid)
         if trace is None:
+            if trace_lookup is not None:
+                # Router-fronted lookup fan-out: the request ran on a
+                # replica, not in this process. Off-loop — the lookup
+                # does HTTP fetches to every remote replica.
+                import asyncio
+                import json as _json
+
+                try:
+                    stitched = await asyncio.get_running_loop() \
+                        .run_in_executor(None, trace_lookup, rid)
+                except Exception as e:
+                    return web.json_response(
+                        {"error": f"fleet trace lookup failed: {e}"},
+                        status=502)
+                if stitched is not None and stitched.get("fragments"):
+                    return web.json_response(
+                        stitched,
+                        dumps=lambda o: _json.dumps(o, default=str))
             return web.json_response(
                 {"error": f"unknown request_id {rid!r}"}, status=404)
         if request.query.get("format") == "jsonl":

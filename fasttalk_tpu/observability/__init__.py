@@ -1,8 +1,18 @@
 from fasttalk_tpu.observability.trace import (RequestTrace, Span, Tracer,
-                                              bind_request, get_tracer,
+                                              bind_request,
+                                              current_trace_id,
+                                              current_traceparent,
+                                              get_tracer, make_traceparent,
+                                              mint_trace_id,
+                                              parse_traceparent,
+                                              propagate_enabled,
                                               reset_tracer)
 from fasttalk_tpu.observability.export import (chrome_trace, jsonl_dump,
-                                               load_jsonl)
+                                               load_jsonl, merge_prometheus)
+from fasttalk_tpu.observability.stitch import (RESUME_SPAN, TERMINAL_SPAN,
+                                               collect_fragments, stitch,
+                                               trace_fragment)
+from fasttalk_tpu.observability.journey import (HOPS, JourneyRecorder)
 from fasttalk_tpu.observability.events import (Event, EventLog, get_events,
                                                reset_events)
 from fasttalk_tpu.observability.slo import (ClassObjectives, SLOEngine,
@@ -14,13 +24,20 @@ from fasttalk_tpu.observability.perf import (PerfLedger, get_perf,
                                              reset_perf)
 from fasttalk_tpu.observability.flight import (FlightRecorder, get_flight,
                                                reset_flight)
+from fasttalk_tpu.observability.fleetflight import FleetFlightRecorder
 
 __all__ = [
     "Span", "RequestTrace", "Tracer", "get_tracer", "reset_tracer",
-    "bind_request", "chrome_trace", "jsonl_dump", "load_jsonl",
+    "bind_request", "current_trace_id", "current_traceparent",
+    "make_traceparent", "mint_trace_id", "parse_traceparent",
+    "propagate_enabled", "chrome_trace", "jsonl_dump", "load_jsonl",
+    "merge_prometheus", "RESUME_SPAN", "TERMINAL_SPAN",
+    "collect_fragments", "stitch", "trace_fragment",
+    "HOPS", "JourneyRecorder",
     "Event", "EventLog", "get_events", "reset_events",
     "ClassObjectives", "SLOEngine", "get_slo", "objectives_from_env",
     "reset_slo", "Watchdog", "get_watchdog", "reset_watchdog",
     "PerfLedger", "get_perf", "reset_perf",
     "FlightRecorder", "get_flight", "reset_flight",
+    "FleetFlightRecorder",
 ]

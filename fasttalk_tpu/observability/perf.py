@@ -166,6 +166,14 @@ class PerfLedger:
         self._attention_kernel = ""
         # Compile ledger: key -> {kind, count, serving, first/last ts}.
         self._compiles: dict[str, dict[str, Any]] = {}
+        # Per-connection token-journey attribution (observability/
+        # journey.py): the serving layer feeds each finished journeyed
+        # connection's hop totals here, so GET /perf shows where
+        # *connections* (not just the process window) spent their wall
+        # time — the per-connection form of the host-gap decomposition.
+        self._journey_hops: dict[str, float] = {}
+        self._journey_frames = 0
+        self._journey_conns = 0
         m = get_metrics()
         self._m_busy = m.gauge(
             "perf_device_busy_frac",
@@ -286,6 +294,17 @@ class PerfLedger:
         if serving:
             self._m_compiles.inc()
 
+    def note_journey(self, hops_ms: dict[str, float],
+                     frames: int) -> None:
+        """Accumulate one finished connection's per-hop wall-time
+        totals (serving/server.py, JOURNEY_ENABLED streams only)."""
+        with self._lock:
+            for name, ms in hops_ms.items():
+                self._journey_hops[name] = \
+                    self._journey_hops.get(name, 0.0) + float(ms)
+            self._journey_frames += int(frames)
+            self._journey_conns += 1
+
     # ---------------- the report ----------------
 
     def _peak_tflops(self) -> tuple[float, str]:
@@ -315,6 +334,12 @@ class PerfLedger:
         peak, device = self._peak_tflops()
         with self._lock:
             compiles = [dict(e) for e in self._compiles.values()]
+            journey = {
+                "connections": self._journey_conns,
+                "frames": self._journey_frames,
+                "hops_ms": {h: round(v, 3) for h, v
+                            in sorted(self._journey_hops.items())},
+            }
         compiles.sort(key=lambda e: -e["last_ts"])
         out: dict[str, Any] = {
             "enabled": tracer.enabled,
@@ -337,6 +362,7 @@ class PerfLedger:
                 "serving": sum(e["serving"] for e in compiles),
                 "by_key": compiles,
             },
+            "journey": journey,
         }
         peak_hbm, hbm_src = self._peak_hbm()
         if not records:
@@ -565,6 +591,9 @@ class PerfLedger:
         that engine's per-call FLOP feed for the rest of the process."""
         with self._lock:
             self._compiles.clear()
+            self._journey_hops.clear()
+            self._journey_frames = 0
+            self._journey_conns = 0
 
 
 _perf: PerfLedger | None = None

@@ -34,9 +34,12 @@ scripts/trace_report.py.
 
 from __future__ import annotations
 
+import contextvars
 import os
+import re
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -48,6 +51,70 @@ from fasttalk_tpu.utils.logger import request_id_var
 # decode calls) must not grow one trace without bound. Overflow is
 # counted on the trace so the export can say what was dropped.
 _MAX_SPANS_PER_TRACE = 2048
+
+# ---------------------------------------------------------------------
+# Trace-context propagation (docs/OBSERVABILITY.md "Fleet tracing").
+#
+# A trace id is minted once at the serving edge (WS accept / OpenAI
+# request) and threaded through every hop after that: the ContextVar
+# carries it across the asyncio task tree (and through
+# asyncio.to_thread, which copies the context), the W3C-style
+# ``traceparent`` header carries it across processes (the /v1 remote
+# client and the /kv/parked migration wire), and stitch.py reassembles
+# per-process fragments by it.
+# ---------------------------------------------------------------------
+
+trace_id_var: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "fasttalk_trace_id", default="")
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def propagate_enabled() -> bool:
+    """TRACE_PROPAGATE gate (default on): whether outbound hops attach
+    the traceparent header and inbound edges adopt it."""
+    return os.getenv("TRACE_PROPAGATE", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def mint_trace_id() -> str:
+    """A fresh 32-hex trace id (W3C trace-context format)."""
+    return uuid.uuid4().hex
+
+
+def current_trace_id() -> str:
+    """The trace id bound in this context ("" when unbound)."""
+    return trace_id_var.get()
+
+
+def make_traceparent(trace_id: str) -> str:
+    """Render a W3C ``traceparent`` header value for this hop. The
+    span-id segment is minted per call (each hop is its own parent);
+    we only consume the trace-id segment on the receiving side."""
+    return f"00-{trace_id}-{uuid.uuid4().hex[:16]}-01"
+
+
+def parse_traceparent(header: str | None) -> str | None:
+    """Extract the trace id from a ``traceparent`` header value; None
+    when absent or malformed (a bad header never fails the request —
+    the trace just starts fresh on this process)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    tid = m.group(1)
+    return None if tid == "0" * 32 else tid
+
+
+def current_traceparent() -> str | None:
+    """A ready-to-send traceparent header for the bound trace, or None
+    when no trace is bound or propagation is disabled."""
+    tid = trace_id_var.get()
+    if not tid or not propagate_enabled():
+        return None
+    return make_traceparent(tid)
 
 
 @dataclass
@@ -72,6 +139,10 @@ class RequestTrace:
     finished: bool = False
     dropped_spans: int = 0
     attrs: dict[str, Any] = field(default_factory=dict)
+    # Fleet-wide identity: the edge-minted trace id this request's
+    # spans belong to. Fragments of one logical request on different
+    # processes (failover, migration) share it; stitch.py joins on it.
+    trace_id: str = ""
 
     def age_s(self) -> float:
         return time.monotonic() - self.started_mono
@@ -108,17 +179,28 @@ class Tracer:
 
     # ---------------- request lifecycle ----------------
 
-    def start(self, request_id: str, session_id: str = "") -> bool:
+    def start(self, request_id: str, session_id: str = "",
+              trace_id: str | None = None) -> bool:
         """Register an in-flight request. Returns True if this call
         created the trace (the creator is responsible for finish());
-        False if it already existed or tracing is disabled."""
+        False if it already existed or tracing is disabled.
+
+        ``trace_id`` resolution: an explicit id wins (the serving edge
+        mints one), else the id bound in the current context (a replica
+        adopting a propagated traceparent), else a fresh mint — every
+        trace carries a fleet-unique id either way."""
         if not self.enabled:
             return False
+        tid = trace_id or trace_id_var.get() or mint_trace_id()
         with self._lock:
-            if request_id in self._inflight:
+            existing = self._inflight.get(request_id)
+            if existing is not None:
+                if not existing.trace_id:
+                    existing.trace_id = tid
                 return False
             self._inflight[request_id] = RequestTrace(
-                request_id=request_id, session_id=session_id)
+                request_id=request_id, session_id=session_id,
+                trace_id=tid)
             return True
 
     def finish(self, request_id: str) -> None:
@@ -220,6 +302,18 @@ class Tracer:
                     return t
         return None
 
+    def find_by_trace_id(self, trace_id: str) -> list[RequestTrace]:
+        """Every local trace (in-flight or completed) carrying this
+        fleet trace id — a failed-over request leaves one fragment per
+        re-dispatch on a remote replica; stitch.py merges them."""
+        if not trace_id:
+            return []
+        with self._lock:
+            out = [t for t in self._inflight.values()
+                   if t.trace_id == trace_id]
+            out.extend(t for t in self._ring if t.trace_id == trace_id)
+        return out
+
     def completed(self) -> list[RequestTrace]:
         with self._lock:
             return list(self._ring)
@@ -239,17 +333,80 @@ class Tracer:
             self._ring.clear()
             self._steps.clear()
 
+    def scoped(self, component: str) -> "ComponentTracer":
+        """A view of this tracer that stamps ``component=<name>`` on
+        every span/event/step it records — how router, serving and
+        each in-proc replica distinguish their rows inside the ONE
+        shared trace of a BENCH_MODE=fleet process."""
+        return ComponentTracer(self, component)
+
+
+class ComponentTracer:
+    """Thin delegating wrapper around a Tracer that injects a
+    ``component`` attr into recorded spans, events and step records
+    (explicit attrs win). Lifecycle methods (start/finish/set_phase/
+    read side) pass straight through — there is still exactly one
+    underlying tracer and one trace per request id."""
+
+    def __init__(self, inner: Tracer, component: str):
+        self._inner = inner
+        self.component = component
+
+    @property
+    def enabled(self) -> bool:
+        return self._inner.enabled
+
+    def add_span(self, request_id: str, name: str, t0: float, t1: float,
+                 summary: bool = False, **attrs: Any) -> None:
+        attrs.setdefault("component", self.component)
+        self._inner.add_span(request_id, name, t0, t1, summary=summary,
+                             **attrs)
+
+    def event(self, request_id: str, name: str, **attrs: Any) -> None:
+        attrs.setdefault("component", self.component)
+        self._inner.event(request_id, name, **attrs)
+
+    @contextmanager
+    def span(self, request_id: str, name: str,
+             **attrs: Any) -> Iterator[None]:
+        if not self._inner.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add_span(request_id, name, t0, time.monotonic(),
+                          **attrs)
+
+    def step(self, name: str, t0: float, t1: float,
+             **attrs: Any) -> None:
+        attrs.setdefault("component", self.component)
+        self._inner.step(name, t0, t1, **attrs)
+
+    def scoped(self, component: str) -> "ComponentTracer":
+        return ComponentTracer(self._inner, component)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
 
 @contextmanager
-def bind_request(request_id: str) -> Iterator[None]:
+def bind_request(request_id: str,
+                 trace_id: str | None = None) -> Iterator[None]:
     """Bind the request id into the logging/tracing ContextVar so every
     log line inside the block carries it (utils/logger formatters read
-    the same var)."""
+    the same var). When ``trace_id`` is given, bind it too — downstream
+    hops in this task tree (the /v1 remote client, to_thread migration
+    workers) read it via current_traceparent()."""
     token = request_id_var.set(request_id)
+    t_token = trace_id_var.set(trace_id) if trace_id else None
     try:
         yield
     finally:
         request_id_var.reset(token)
+        if t_token is not None:
+            trace_id_var.reset(t_token)
 
 
 _tracer: Tracer | None = None

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import struct
+import time
 from dataclasses import replace
 from typing import Any
 
@@ -141,7 +142,9 @@ def deserialize_parked(data: bytes) -> ParkedKV:
 
 # ---------------- the transfer itself ----------------
 
-def transfer(src, dst, session_id: str) -> tuple[bool, int, str, int]:
+def transfer(src, dst, session_id: str, traceparent: str | None = None,
+             tracer=None,
+             request_id: str = "") -> tuple[bool, int, str, int]:
     """Move one parked session's entry ``src`` → ``dst`` (replica
     handles). Returns ``(ok, nbytes, reason, kept)`` — ``kept`` is the
     moved entry's trusted-token count (0 on failure), the identity the
@@ -152,9 +155,21 @@ def transfer(src, dst, session_id: str) -> tuple[bool, int, str, int]:
 
     Runs on the router's disposable migrate worker thread — both the
     export (remote: an HTTP GET) and the import (remote: an HTTP POST)
-    may block; the router bounds the whole call with its timeout."""
+    may block; the router bounds the whole call with its timeout.
+    ``traceparent`` rides the /kv/parked wire on remote hops
+    (docs/OBSERVABILITY.md "Fleet tracing"); when ``tracer`` and
+    ``request_id`` are given, the two legs are recorded as
+    ``migrate_send``/``migrate_recv`` spans on the request's trace —
+    the caller's thread-unsafe ContextVar does not cross into this
+    worker thread, so the span plumbing is explicit."""
     from fasttalk_tpu.resilience import failpoints as _fp
 
+    def span(name: str, t0: float, **attrs) -> None:
+        if tracer is not None and request_id and tracer.enabled:
+            tracer.add_span(request_id, name, t0, time.monotonic(),
+                            session_id=session_id, **attrs)
+
+    t_send = time.monotonic()
     try:
         if _fp.enabled:
             # Chaos seam, source side: a dead/partitioned source looks
@@ -162,11 +177,16 @@ def transfer(src, dst, session_id: str) -> tuple[bool, int, str, int]:
             # re-prefill with both pools' accounting intact.
             _fp.fire("router.migrate_send", session_id=session_id,
                      replica=src.replica_id)
-        entry = src.export_parked(session_id)
+        entry = src.export_parked(session_id, traceparent=traceparent)
     except Exception as e:
+        span("migrate_send", t_send, replica=src.replica_id, ok=False)
         return False, 0, f"export failed: {e}", 0
     if entry is None:
+        span("migrate_send", t_send, replica=src.replica_id, ok=False)
         return False, 0, "no parked entry", 0
+    span("migrate_send", t_send, replica=src.replica_id, ok=True,
+         nbytes=entry.nbytes)
+    t_recv = time.monotonic()
     try:
         if _fp.enabled:
             corrupt = _fp.fire("router.migrate_recv",
@@ -180,10 +200,14 @@ def transfer(src, dst, session_id: str) -> tuple[bool, int, str, int]:
                 entry = replace(entry, tokens=entry.tokens[:-1])
         problem = entry_problem(entry)
         if problem is not None:
+            span("migrate_recv", t_recv, replica=dst.replica_id,
+                 ok=False)
             return False, 0, f"corrupt entry refused: {problem}", 0
-        ok = dst.import_parked(entry)
+        ok = dst.import_parked(entry, traceparent=traceparent)
     except Exception as e:
+        span("migrate_recv", t_recv, replica=dst.replica_id, ok=False)
         return False, 0, f"import failed: {e}", 0
+    span("migrate_recv", t_recv, replica=dst.replica_id, ok=bool(ok))
     if not ok:
         return False, 0, "target refused the entry", 0
     return True, entry.nbytes, "ok", entry.kept

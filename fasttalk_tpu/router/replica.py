@@ -217,14 +217,43 @@ class ReplicaHandle:
     def parked_info(self, session_id: str) -> tuple[int, int] | None:
         return self.engine.parked_kv_info(session_id)
 
-    def export_parked(self, session_id: str):
+    def export_parked(self, session_id: str,
+                      traceparent: str | None = None):
+        # traceparent is a wire concern: in-proc transfers already live
+        # inside the process tracer, so the kwarg is accepted (one
+        # transfer() call shape for both handle types) and ignored.
         return self.engine.export_parked_kv(session_id)
 
-    def import_parked(self, entry) -> bool:
+    def import_parked(self, entry, traceparent: str | None = None,
+                      ) -> bool:
         return bool(self.engine.import_parked_kv(entry))
 
     def drop_parked(self, session_id: str) -> bool:
         return bool(self.engine.drop_parked_kv(session_id))
+
+    # ---------------- fleet observability fan-out ----------------
+    # (router/router.py stitched_trace / fleet_metrics / fleet_slo,
+    # observability/fleetflight.py). In-proc replicas share the
+    # router-front process's tracer, metrics registry and SLO engine —
+    # their contribution is already in the local fragment/exposition,
+    # so fetching from them would double-count. RemoteReplicaHandle
+    # overrides with the serving-port HTTP surfaces.
+
+    def fetch_trace(self, request_id: str,
+                    trace_id: str = "") -> list[dict[str, Any]]:
+        """Trace fragments this replica holds for a request ([] for
+        in-proc: the local collect_fragments already saw them)."""
+        return []
+
+    def fetch_metrics(self) -> str | None:
+        """Prometheus exposition text (None for in-proc: the shared
+        registry is the local text)."""
+        return None
+
+    def fetch_slo(self) -> dict[str, Any] | None:
+        """SLO report (None for in-proc: the shared engine's snapshot
+        is the local report)."""
+        return None
 
     def to_dict(self) -> dict[str, Any]:
         with self._lock:
@@ -359,27 +388,34 @@ class RemoteReplicaHandle(ReplicaHandle):
         body = r.json()
         return int(body["kept"]), int(body["nbytes"])
 
-    def export_parked(self, session_id: str):
+    def export_parked(self, session_id: str,
+                      traceparent: str | None = None):
         import requests
 
         from fasttalk_tpu.router.migrate import deserialize_parked
 
         r = requests.get(f"{self.base_url}/kv/parked/{session_id}",
+                         headers={"traceparent": traceparent}
+                         if traceparent else None,
                          timeout=self.MIGRATE_HTTP_TIMEOUT_S)
         if r.status_code == 404:
             return None
         r.raise_for_status()
         return deserialize_parked(r.content)
 
-    def import_parked(self, entry) -> bool:
+    def import_parked(self, entry, traceparent: str | None = None,
+                      ) -> bool:
         import requests
 
         from fasttalk_tpu.router.migrate import serialize_parked
 
+        headers = {"Content-Type": "application/octet-stream"}
+        if traceparent:
+            headers["traceparent"] = traceparent
         r = requests.post(
             f"{self.base_url}/kv/parked/{entry.session_id}",
             data=serialize_parked(entry),
-            headers={"Content-Type": "application/octet-stream"},
+            headers=headers,
             timeout=self.MIGRATE_HTTP_TIMEOUT_S)
         return r.status_code == 200
 
@@ -389,3 +425,42 @@ class RemoteReplicaHandle(ReplicaHandle):
         r = requests.delete(f"{self.base_url}/kv/parked/{session_id}",
                             timeout=self.probe_timeout_s)
         return r.status_code == 200
+
+    # ---------------- fleet observability fan-out ----------------
+
+    def fetch_trace(self, request_id: str,
+                    trace_id: str = "") -> list[dict[str, Any]]:
+        """Fragments this replica's serving port holds for a request
+        (GET /traces/{request_id}, serving/server.py). Raises on
+        transport failure — the router classifies and keeps stitching
+        from the replicas that answered."""
+        import requests
+
+        r = requests.get(f"{self.base_url}/traces/{request_id}",
+                         params={"trace_id": trace_id}
+                         if trace_id else None,
+                         timeout=self.probe_timeout_s)
+        if r.status_code == 404:
+            return []
+        r.raise_for_status()
+        body = r.json()
+        frags = body.get("fragments", [])
+        for f in frags:
+            f.setdefault("source", self.replica_id)
+        return frags
+
+    def fetch_metrics(self) -> str | None:
+        import requests
+
+        r = requests.get(f"{self.base_url}/metrics",
+                         timeout=self.probe_timeout_s)
+        r.raise_for_status()
+        return r.text
+
+    def fetch_slo(self) -> dict[str, Any] | None:
+        import requests
+
+        r = requests.get(f"{self.base_url}/slo",
+                         timeout=self.probe_timeout_s)
+        r.raise_for_status()
+        return r.json()

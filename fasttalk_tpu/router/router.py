@@ -59,7 +59,8 @@ from typing import Any, AsyncGenerator
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.kvcache import RestorePolicy, kv_env_defaults
 from fasttalk_tpu.observability.events import get_events
-from fasttalk_tpu.observability.trace import get_tracer
+from fasttalk_tpu.observability.trace import (current_traceparent,
+                                              get_tracer)
 import fasttalk_tpu.router.migrate as _migrate
 from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.router.policy import AffinityMap, PlacementPolicy
@@ -120,7 +121,11 @@ class FleetRouter(EngineBase):
         self._probe_thread: threading.Thread | None = None
         self._probe_stop = threading.Event()
         self._events = get_events()
-        self._tracer = get_tracer()
+        # Router spans carry component="router" so a stitched trace
+        # (observability/stitch.py) keeps the fleet's hops apart from
+        # the replicas' queue_wait/prefill/decode_step spans even when
+        # everything shares one in-proc process tracer.
+        self._tracer = get_tracer().scoped("router")
         m = get_metrics()
         self._m_replicas = m.gauge(
             "router_replicas", "replicas registered with the router")
@@ -339,7 +344,8 @@ class FleetRouter(EngineBase):
                                      migratable=True) == "migrate"
 
     def _migrate_session(self, session_id: str, src: ReplicaHandle,
-                         dst: ReplicaHandle) -> str:
+                         dst: ReplicaHandle,
+                         request_id: str = "") -> str:
         """One bounded migration: run the transfer on a disposable
         worker thread so a hung channel (router.migrate_send=hang, a
         wedged NIC) can NEVER wedge the caller — drain and failover
@@ -356,10 +362,19 @@ class FleetRouter(EngineBase):
         abandoned = threading.Event()
         handoff = threading.Lock()
         box: dict[str, Any] = {}
+        # Captured HERE: the ContextVar carrying the fleet trace id is
+        # copied into asyncio.to_thread contexts but NOT into the plain
+        # worker thread below — the wire header (and the span plumbing)
+        # must travel explicitly.
+        traceparent = current_traceparent()
+        tracer = self._tracer if request_id else None
 
         def work() -> None:
             try:
-                result = _migrate.transfer(src, dst, session_id)
+                result = _migrate.transfer(src, dst, session_id,
+                                           traceparent=traceparent,
+                                           tracer=tracer,
+                                           request_id=request_id)
             except BaseException as e:  # disposable thread: report all
                 result = (False, 0, str(e), 0)
             with handoff:
@@ -490,7 +505,25 @@ class FleetRouter(EngineBase):
         deterministically without the probe thread."""
         for h in self.replicas:
             before = h.state
+            slo_before = h.last_probe.get("slo_alert", "ok")
+            t_probe = time.monotonic()
             h.probe_now()
+            if self._tracer.enabled:
+                # Process-level probe row: the fleet's health sampling
+                # is visible in the same trace dump as engine steps.
+                self._tracer.step(
+                    "probe", t_probe, time.monotonic(),
+                    replica=h.replica_id, state=h.state)
+            if h.last_probe.get("slo_alert", "ok") == "page" \
+                    and slo_before != "page":
+                # A remote replica's own SLO engine crossed into page
+                # (its /health body said so). One event per transition:
+                # the fleet flight recorder fans out evidence
+                # collection while the incident is still live.
+                self._events.emit(
+                    "replica_slo_page", severity="critical",
+                    replica=h.replica_id,
+                    slo=h.last_probe.get("slo_alert"))
             if h.state != before:
                 self._events.emit(
                     "router_replica_dead" if h.state == STATE_DEAD
@@ -570,14 +603,18 @@ class FleetRouter(EngineBase):
         return None
 
     def _failover_migrate(self, session_id: str, src: ReplicaHandle,
-                          dst: ReplicaHandle) -> bool:
+                          dst: ReplicaHandle,
+                          request_id: str = "") -> bool:
         """Best-effort parked-KV pull from the failed replica to the
         chosen survivor (migrate worker thread via to_thread). Never
-        raises."""
+        raises. Runs under the caller's copied context (to_thread), so
+        the fleet trace id is still bound here — it is captured into an
+        explicit traceparent before the plain worker thread loses it."""
         try:
             if not self._migration_priced(session_id, src):
                 return False
-            return self._migrate_session(session_id, src, dst) == "ok"
+            return self._migrate_session(
+                session_id, src, dst, request_id=request_id) == "ok"
         except Exception as e:
             log.debug(f"failover migration probe failed for "
                       f"{session_id}: {e}")
@@ -630,7 +667,13 @@ class FleetRouter(EngineBase):
                                             self.probe_interval_s
                                             or 1.0),
                             reason="no_replica") from e
+                t_place = time.monotonic()
                 handle = self._place(session_id, excluded, prefix_key)
+                if self._tracer.enabled:
+                    self._tracer.add_span(
+                        request_id, "place", t_place, time.monotonic(),
+                        replica=handle.replica_id, attempt=attempt,
+                        excluded=len(excluded))
                 if failed_handle is not None \
                         and failed_handle is not handle:
                     # Failover migration: the dead/failed replica may
@@ -646,11 +689,17 @@ class FleetRouter(EngineBase):
                     if self.migrate_enabled:
                         await asyncio.to_thread(
                             self._failover_migrate, session_id, src,
-                            handle)
+                            handle, request_id)
                 if pending_resume:
                     pending_resume = False
                     resumed_total += 1
                     self._m_resumes.inc()
+                    # The stitched-trace resume marker (stitch.py
+                    # RESUME_SPAN): exactly one per failover the client
+                    # survived, tagged with where the stream landed.
+                    self._tracer.event(request_id, "resume",
+                                       replica=handle.replica_id,
+                                       attempt=attempt)
                     yield {"type": "resumed",
                            "replica": handle.replica_id,
                            "attempt": attempt}
@@ -956,6 +1005,81 @@ class FleetRouter(EngineBase):
             },
         }
 
+    # ---------------- fleet observability (docs/OBSERVABILITY.md
+    # "Fleet tracing and the token journey") ----------------
+    # All three fan out over synchronous HTTP to remote replicas —
+    # callers on an event loop must run them off-loop (the serving and
+    # monitoring routes do).
+
+    def stitched_trace(self, request_id: str) -> dict[str, Any] | None:
+        """ONE cross-replica timeline for a request: local fragments
+        (router + serving + any in-proc replica, all in this process's
+        tracer) joined with every remote replica's fragments fetched
+        over its serving port. None when nobody remembers the id."""
+        from fasttalk_tpu.observability.stitch import (collect_fragments,
+                                                       stitch)
+
+        frags = collect_fragments(get_tracer(), request_id,
+                                  source="router")
+        trace_id = frags[0].get("trace_id", "") if frags else ""
+        for h in self.replicas:
+            try:
+                frags.extend(h.fetch_trace(request_id, trace_id))
+            except Exception as e:
+                log.debug(f"trace fetch from {h.replica_id} failed "
+                          f"for {request_id}: {e}")
+        return stitch(frags)
+
+    def fleet_metrics(self) -> str:
+        """Label-merged Prometheus exposition across the fleet (export
+        merge_prometheus): the local registry — router + serving + any
+        in-proc replicas, which share it — as ``replica="router"``,
+        each remote replica's /metrics under its own label, histograms
+        summed. Unreachable replicas become free comments, never a
+        broken scrape."""
+        from fasttalk_tpu.observability.export import merge_prometheus
+
+        remotes: dict[str, str | None] = {}
+        for h in self.replicas:
+            if not hasattr(h, "base_url"):
+                continue  # in-proc: already in the local registry
+            try:
+                remotes[h.replica_id] = h.fetch_metrics()
+            except Exception:
+                remotes[h.replica_id] = None
+        return merge_prometheus(get_metrics().prometheus(), "router",
+                                remotes)
+
+    def fleet_slo(self) -> dict[str, Any]:
+        """Fleet SLO rollup: the local engine's report (shared by the
+        router front and in-proc replicas) plus each remote replica's
+        /slo, with the worst alert across the fleet on top."""
+        from fasttalk_tpu.observability.slo import get_slo
+
+        rank = ("ok", "warn", "page").index
+        engine = get_slo()
+        local = engine.snapshot()
+        worst = max(list(engine.alert_summary().values()) or ["ok"],
+                    key=lambda s: rank(s) if s in ("ok", "warn",
+                                                   "page") else 0)
+        replicas: dict[str, Any] = {}
+        for h in self.replicas:
+            if hasattr(h, "base_url"):
+                try:
+                    report = h.fetch_slo()
+                except Exception:
+                    report = None
+                alert = h.last_probe.get("slo_alert", "ok")
+                replicas[h.replica_id] = {"alert": alert,
+                                          "report": report}
+                if alert in ("warn", "page") \
+                        and rank(alert) > rank(worst):
+                    worst = alert
+            else:
+                replicas[h.replica_id] = {"shared_process": True}
+        return {"worst_alert": worst, "local": local,
+                "replicas": replicas}
+
     @staticmethod
     def _safe(h: ReplicaHandle, method: str, default):
         try:
@@ -974,18 +1098,25 @@ def build_fleet(cfg) -> FleetRouter:
 
     handles: list[ReplicaHandle] = []
     for i in range(cfg.fleet_replicas):
+        engine = build_engine(cfg)
+        # Component tagging: in-proc replicas share the process tracer,
+        # so the replica id on each span is what keeps a stitched
+        # trace's fragments attributable (observability/stitch.py).
+        engine.set_trace_component(f"inproc-{i}")
         handles.append(ReplicaHandle(
-            f"inproc-{i}", build_engine(cfg),
+            f"inproc-{i}", engine,
             dead_probes=cfg.router_dead_probes))
     for i, url in enumerate(u.strip() for u in
                             cfg.router_backends.split(",") if u.strip()):
-        handles.append(RemoteReplicaHandle(
+        handle = RemoteReplicaHandle(
             f"remote-{i}", url, cfg.model_name,
             dead_probes=cfg.router_dead_probes,
             timeout_s=cfg.vllm_timeout,
             max_inflight=cfg.remote_max_inflight,
             admission_timeout_s=cfg.sched_default_deadline_s,
-            connect_retries=cfg.remote_connect_retries))
+            connect_retries=cfg.remote_connect_retries)
+        handle.engine.set_trace_component(f"remote-{i}")
+        handles.append(handle)
     return FleetRouter(
         handles,
         probe_interval_s=cfg.router_probe_interval_s,

@@ -311,7 +311,11 @@ class ServerLauncher:
             ready_check=self._ready,
             sched_info=getattr(self.engine, "scheduler_debug", None),
             supervisor_info=self.supervisor_info,
-            fault_http=self.config.fault_http_enabled)
+            fault_http=self.config.fault_http_enabled,
+            # Router-fronted /traces/{rid} fan-out: requests run on
+            # replicas, so the monitoring port's local ring would 404
+            # on every fleet request without this.
+            trace_lookup=getattr(self.engine, "stitched_trace", None))
         mon_runner = web.AppRunner(mon_app)
         await mon_runner.setup()
         await web.TCPSite(mon_runner, self.config.monitoring_host,

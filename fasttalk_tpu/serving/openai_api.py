@@ -16,6 +16,7 @@ GET /v1/models. Authentication mirrors vLLM's "not needed but accepted".
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 import uuid
@@ -33,6 +34,10 @@ from fasttalk_tpu.agents.hermes import (
 )
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.engine.remote import _RemoteEngine
+from fasttalk_tpu.observability.trace import (bind_request, get_tracer,
+                                              mint_trace_id,
+                                              parse_traceparent,
+                                              propagate_enabled)
 from fasttalk_tpu.structured.compiler import validate_structured_spec
 from fasttalk_tpu.utils.errors import (ENGINE_SHED_CODES,
                                        AdmissionRejected, CircuitBreaker,
@@ -240,6 +245,31 @@ def _unwrap_agent(engine):
     from fasttalk_tpu.agents.voice_agent import VoiceAgent
 
     return engine.engine if isinstance(engine, VoiceAgent) else engine
+
+
+@contextlib.contextmanager
+def _trace_scope(request: web.Request, completion_id: str,
+                 session_id: str):
+    """Trace-context scope for one /v1 completion (docs/OBSERVABILITY
+    .md "Fleet tracing"). An incoming ``traceparent`` header (the
+    router's RemoteReplicaHandle dispatch sends one) joins that trace;
+    otherwise this edge is the root and mints a fresh trace id. The
+    ``request_complete`` terminal event is emitted ONLY at the root —
+    a router-dispatched inner hop must not duplicate the one-terminal
+    marker stitch() counts."""
+    tracer = get_tracer().scoped("serving")
+    parsed = parse_traceparent(request.headers.get("traceparent", "")) \
+        if propagate_enabled() else None
+    inner_hop = parsed is not None
+    tid = parsed if parsed else mint_trace_id()
+    tracer.start(completion_id, session_id, trace_id=tid)
+    with bind_request(completion_id, trace_id=tid):
+        try:
+            yield
+        finally:
+            if not inner_hop:
+                tracer.event(completion_id, "request_complete")
+            tracer.finish(completion_id)
 
 
 def _oai_tool_call(call, index: int) -> dict:
@@ -621,9 +651,11 @@ def register_openai_routes(app: web.Application,
             async def write_finish(finish_reason: str) -> None:
                 await resp.write(chunk({}, finish=finish_reason))
 
-            await _stream_events(resp, engine, completion_id, session_id,
-                                 messages, params, handle_token, finalize,
-                                 write_finish)
+            with _trace_scope(request, completion_id, session_id):
+                await _stream_events(resp, engine, completion_id,
+                                     session_id, messages, params,
+                                     handle_token, finalize,
+                                     write_finish)
             return resp
 
         # Non-streaming
@@ -641,9 +673,10 @@ def register_openai_routes(app: web.Application,
                               for c in calls if c.name)
 
         try:
-            stats, finish_reason, err = await _collect_events(
-                engine, completion_id, session_id, messages, params,
-                on_token)
+            with _trace_scope(request, completion_id, session_id):
+                stats, finish_reason, err = await _collect_events(
+                    engine, completion_id, session_id, messages, params,
+                    on_token)
         except AdmissionRejected as e:
             return _reject_429(e)
         except LLMServiceError as e:
@@ -753,9 +786,11 @@ def register_openai_routes(app: web.Application,
             async def write_finish(finish_reason: str) -> None:
                 await resp.write(chunk("", finish=finish_reason))
 
-            await _stream_events(resp, engine, completion_id, session_id,
-                                 messages, params, handle_token, finalize,
-                                 write_finish)
+            with _trace_scope(request, completion_id, session_id):
+                await _stream_events(resp, engine, completion_id,
+                                     session_id, messages, params,
+                                     handle_token, finalize,
+                                     write_finish)
             return resp
 
         text = ""
@@ -765,9 +800,10 @@ def register_openai_routes(app: web.Application,
             text += t
 
         try:
-            stats, finish_reason, err = await _collect_events(
-                engine, completion_id, session_id, messages, params,
-                on_token)
+            with _trace_scope(request, completion_id, session_id):
+                stats, finish_reason, err = await _collect_events(
+                    engine, completion_id, session_id, messages, params,
+                    on_token)
         except AdmissionRejected as e:
             return _reject_429(e)
         except LLMServiceError as e:

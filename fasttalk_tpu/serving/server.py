@@ -35,8 +35,13 @@ from aiohttp import WSCloseCode, WSMsgType, web
 
 from fasttalk_tpu import __version__
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.observability.journey import JourneyRecorder
+from fasttalk_tpu.observability.perf import get_perf
 from fasttalk_tpu.observability.slo import get_slo
-from fasttalk_tpu.observability.trace import bind_request, get_tracer
+from fasttalk_tpu.observability.trace import (bind_request,
+                                              current_trace_id,
+                                              get_tracer, mint_trace_id,
+                                              parse_traceparent)
 from fasttalk_tpu.observability.watchdog import get_watchdog
 from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.serving.connection import ConnectionManager, ConnectionState
@@ -106,7 +111,10 @@ class WebSocketLLMServer:
             "correlated frames only)",
             buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250,
                      1000))
-        self._tracer = get_tracer()
+        # Serving spans carry component="serving" so stitched fleet
+        # traces (observability/stitch.py) keep the edge's ws_send /
+        # token_journey rows apart from router and replica spans.
+        self._tracer = get_tracer().scoped("serving")
 
         # client_max_size: the KV migration import (/kv/parked POST)
         # carries a whole parked session's rows — tens of MB for long
@@ -120,14 +128,49 @@ class WebSocketLLMServer:
         self.app.router.add_get("/health", self._http_health)
         self.app.router.add_get("/stats", self._http_stats)
         self.app.router.add_get("/models", self._http_models)
+        # Serving-port observability surfaces (docs/OBSERVABILITY.md
+        # "Fleet tracing"): the router reaches a REMOTE replica only
+        # through this port, so the registry exposition, the SLO
+        # report and the replica's trace fragments must be served here
+        # too (the monitoring port may not be routable fleet-wide).
+        self.app.router.add_get("/metrics", self._http_metrics)
+        self.app.router.add_get("/slo", self._http_slo)
+        self.app.router.add_get("/traces/{request_id}",
+                                self._http_trace)
         self.app.router.add_get("/ws/llm", self.handle_websocket)
         # Router-backed mode (docs/ROUTER.md): when the engine is a
         # FleetRouter, expose the fleet registry and the coordinated
         # single-replica drain used for rolling restarts.
+        self.fleet_flight = None
         if hasattr(engine, "fleet_stats"):
             self.app.router.add_get("/fleet", self._http_fleet)
             self.app.router.add_post("/fleet/drain/{replica_id}",
                                      self._http_fleet_drain)
+        if hasattr(engine, "fleet_metrics"):
+            self.app.router.add_get("/fleet/metrics",
+                                    self._http_fleet_metrics)
+            self.app.router.add_get("/fleet/slo", self._http_fleet_slo)
+            # Fleet flight recorder (observability/fleetflight.py):
+            # router-side incident triggers fan evidence collection out
+            # to every live replica into one bundle directory.
+            from fasttalk_tpu.observability.fleetflight import \
+                FleetFlightRecorder
+
+            self.fleet_flight = FleetFlightRecorder(
+                engine,
+                enabled=getattr(config, "fleet_flight_enabled", True),
+                base_dir=getattr(config, "fleet_flight_dir", None),
+                max_bundles=getattr(config, "fleet_flight_max_bundles",
+                                    None),
+                min_interval_s=getattr(config,
+                                       "fleet_flight_min_interval_s",
+                                       None),
+                failover_burst=getattr(config,
+                                       "fleet_flight_failover_burst",
+                                       None),
+                window_s=getattr(config, "fleet_flight_window_s",
+                                 None))
+            self.fleet_flight.install()
         # Cross-replica KV migration channel (docs/ROUTER.md,
         # router/migrate.py): a remote router moves parked session KV
         # in and out of THIS replica's host pool through these. Engines
@@ -174,6 +217,8 @@ class WebSocketLLMServer:
             self._housekeeping.cancel()
         if self._watchdog_task:
             self._watchdog_task.cancel()
+        if self.fleet_flight is not None:
+            self.fleet_flight.uninstall()
         # Graceful drain (docs/SCHEDULING.md): new submissions are
         # rejected with retry_after from here on, while generations
         # already streaming (or queued) get up to the drain timeout to
@@ -328,11 +373,85 @@ class WebSocketLLMServer:
                 {"error": f"unknown replica {replica_id!r}"}, status=404)
         return web.json_response(summary)
 
+    # -------- serving-port observability (docs/OBSERVABILITY.md) ----
+
+    async def _http_metrics(self, request: web.Request) -> web.Response:
+        text = await asyncio.to_thread(get_metrics().prometheus)
+        return web.Response(text=text,
+                            content_type="text/plain; version=0.0.4",
+                            charset="utf-8")
+
+    async def _http_slo(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(get_slo().snapshot))
+
+    async def _http_trace(self, request: web.Request) -> web.Response:
+        """This process's trace fragments for a request — and, when
+        the engine is a FleetRouter, the stitched cross-replica
+        timeline. Remote replicas answer the router's fan-out through
+        this same route (router/replica.py fetch_trace reads
+        ``fragments``)."""
+        from fasttalk_tpu.observability.stitch import (collect_fragments,
+                                                       stitch)
+
+        request_id = request.match_info["request_id"]
+        trace_id = request.query.get("trace_id", "")
+
+        def build() -> dict[str, Any]:
+            frags = collect_fragments(get_tracer(), request_id,
+                                      trace_id)
+            body: dict[str, Any] = {"request_id": request_id,
+                                    "fragments": frags}
+            if hasattr(self.engine, "stitched_trace"):
+                stitched = self.engine.stitched_trace(request_id)
+                if stitched is not None:
+                    body["stitched"] = stitched
+            elif frags:
+                body["stitched"] = stitch(frags)
+            return body
+
+        body = await asyncio.to_thread(build)
+        if not body.get("fragments") and not body.get("stitched"):
+            return web.json_response(
+                {"error": f"no trace for {request_id}"}, status=404)
+        return web.json_response(
+            body, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _http_fleet_metrics(self, request: web.Request,
+                                  ) -> web.Response:
+        text = await asyncio.to_thread(self.engine.fleet_metrics)
+        return web.Response(text=text,
+                            content_type="text/plain; version=0.0.4",
+                            charset="utf-8")
+
+    async def _http_fleet_slo(self, request: web.Request,
+                              ) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.engine.fleet_slo),
+            dumps=lambda o: json.dumps(o, default=str))
+
     # ---------------- KV migration channel ----------------
+
+    def _kv_wire_step(self, name: str, session_id: str,
+                      request: web.Request) -> None:
+        """Record a migration wire hop against the originating trace.
+        The router sends ``traceparent`` on /kv/parked requests
+        (router/migrate.py transfer); there is no local request trace
+        for the session here, so the hop lands as a step record
+        carrying the trace id — scripts/trace_report.py and the
+        stitched timeline pick it up by trace id."""
+        parsed = parse_traceparent(
+            request.headers.get("traceparent", ""))
+        if parsed is None:
+            return
+        t = time.monotonic()
+        self._tracer.step(name, t, t, session_id=session_id,
+                          trace_id=parsed)
 
     async def _http_kv_export(self, request: web.Request,
                               ) -> web.Response:
         session_id = request.match_info["session_id"]
+        self._kv_wire_step("kv_export", session_id, request)
         if request.query.get("meta"):
             info = await asyncio.to_thread(self.engine.parked_kv_info,
                                            session_id)
@@ -358,6 +477,7 @@ class WebSocketLLMServer:
         from fasttalk_tpu.router.migrate import deserialize_parked
 
         session_id = request.match_info["session_id"]
+        self._kv_wire_step("kv_import", session_id, request)
         data = await request.read()
         try:
             entry = await asyncio.to_thread(deserialize_parked, data)
@@ -519,7 +639,7 @@ class WebSocketLLMServer:
     _GEN_KEYS = ("temperature", "top_p", "top_k", "max_tokens", "stop",
                  "tts_chunking", "repeat_penalty", "presence_penalty",
                  "frequency_penalty", "ignore_eos", "priority",
-                 "deadline_s", "structured")
+                 "deadline_s", "structured", "journey")
 
     @classmethod
     def _gen_overrides(cls, cfg: dict) -> dict:
@@ -578,6 +698,14 @@ class WebSocketLLMServer:
             # not silently decode every reply to the full budget.
             raise ValueError(
                 f"ignore_eos must be a boolean, got {ignore_eos!r}")
+        journey = over.get("journey", False)
+        if not isinstance(journey, bool):
+            raise ValueError(
+                f"journey must be a boolean, got {journey!r}")
+        # Server-side kill switch: JOURNEY_ENABLED=false ignores the
+        # per-session opt-in without erroring the client.
+        journey = journey and getattr(self.config, "journey_enabled",
+                                      True)
         return GenerationParams(
             temperature=float(over.get("temperature",
                                        self.config.default_temperature)),
@@ -607,6 +735,10 @@ class WebSocketLLMServer:
             # "json_schema" | "regex" | "tool_call", ...}); shape
             # errors surface as invalid_config via GenerationParams.
             structured=over.get("structured"),
+            # Per-token journey attribution (docs/OBSERVABILITY.md
+            # "the token journey"): the engine stamps device-retire /
+            # fetch / detokenize monotonics on each token event.
+            journey=journey,
         )
 
     async def _generate(self, session_id: str, user_text: str,
@@ -615,13 +747,24 @@ class WebSocketLLMServer:
         self._cur_request[session_id] = request_id
         # The serving layer owns the request trace (the engine only adds
         # spans to it) and binds the id into the logging ContextVar so
-        # every log line of this generation carries it.
-        self._tracer.start(request_id, session_id)
-        with bind_request(request_id):
+        # every log line of this generation carries it. The WS edge is
+        # the trace ROOT: it mints the fleet-wide trace id that rides
+        # every downstream hop (router placement, /kv/parked migration,
+        # remote-replica dispatch) so GET /traces/{request_id} can
+        # stitch one cross-replica timeline (docs/OBSERVABILITY.md).
+        tid = current_trace_id() or mint_trace_id()
+        self._tracer.start(request_id, session_id, trace_id=tid)
+        with bind_request(request_id, trace_id=tid):
             try:
                 await self._generate_traced(session_id, user_text, ws,
                                             request_id)
             finally:
+                # Terminal marker: exactly ONE per stitched trace — the
+                # edge that owns the client stream emits it, inner hops
+                # (router-dispatched /v1 legs) never do. stitch()
+                # counts these to prove a failed-over request finished
+                # exactly once.
+                self._tracer.event(request_id, "request_complete")
                 self._tracer.finish(request_id)
 
     async def _generate_traced(self, session_id: str, user_text: str,
@@ -633,6 +776,7 @@ class WebSocketLLMServer:
         state = self.conversation_manager.get(session_id)
         tts = bool(state.gen_config.get("tts_chunking")) if state else False
         tts_buffer = ""
+        jr: JourneyRecorder | None = None
         try:
             # Params validation BEFORE touching the breaker: a client
             # that stored an invalid generation config (e.g.
@@ -660,6 +804,13 @@ class WebSocketLLMServer:
                         f"structured output unavailable: {reason}")
                     return
             self.breaker.check()
+            if params.journey:
+                # Per-token journey waterfall: the engine stamps
+                # device-retire/fetch/detokenize monotonics on each
+                # token event ("j"); the loop below adds event-loop
+                # dequeue and WS-write times so every hop from device
+                # step to socket is named (docs/OBSERVABILITY.md).
+                jr = JourneyRecorder(start)
             messages = self.conversation_manager.get_messages_for_generation(
                 session_id)
             if self.agent is not None:
@@ -673,22 +824,36 @@ class WebSocketLLMServer:
             async for event in stream:
                 etype = event["type"]
                 if etype == "token":
+                    t_dq = time.monotonic()  # event-loop dequeue mark
                     full_text += event["text"]
                     if tts:
                         tts_buffer += event["text"]
                         chunk, tts_buffer = extract_speakable_chunk(tts_buffer)
                         if chunk:
-                            await self._send(session_id, ws, {
-                                "type": "token", "data": chunk,
-                                "speakable": True},
-                                request_id=request_id)
+                            frame = {"type": "token", "data": chunk,
+                                     "speakable": True}
+                            if jr is not None:
+                                # Server wall clock on the frame lets
+                                # the client estimate network RTT /
+                                # clock offset (client.py --journey).
+                                frame["st"] = time.time()
+                            await self._send(session_id, ws, frame,
+                                             request_id=request_id)
                             self._m_ws_tokens.inc()
+                            if jr is not None:
+                                jr.frame(event.get("j"), t_dq,
+                                         time.monotonic())
                     else:
-                        await self._send(session_id, ws,
-                                         {"type": "token",
-                                          "data": event["text"]},
+                        frame = {"type": "token",
+                                 "data": event["text"]}
+                        if jr is not None:
+                            frame["st"] = time.time()
+                        await self._send(session_id, ws, frame,
                                          request_id=request_id)
                         self._m_ws_tokens.inc()
+                        if jr is not None:
+                            jr.frame(event.get("j"), t_dq,
+                                     time.monotonic())
                 elif etype in ("done", "cancelled"):
                     stats = event.get("stats", {})
                     cancelled = etype == "cancelled"
@@ -749,6 +914,16 @@ class WebSocketLLMServer:
             duration = time.monotonic() - start
             log.log_generation(session_id, tokens, duration,
                                ttft_ms=stats.get("ttft_ms"))
+            journey_summary = None
+            if jr is not None and jr.frames:
+                journey_summary = jr.summary()
+                # One summary span per request: trace_report.py
+                # --journey reads the per-hop frame arrays off it.
+                self._tracer.add_span(request_id, "token_journey",
+                                      start, time.monotonic(),
+                                      **jr.span_attrs())
+                get_perf().note_journey(journey_summary["hops_ms"],
+                                        jr.frames)
             await self._send(session_id, ws, {
                 "type": "response_complete",
                 "stats": {
@@ -774,6 +949,8 @@ class WebSocketLLMServer:
                     "finish_reason": "cancelled" if cancelled
                     else finish_reason,
                     "provider": self.config.llm_provider,
+                    **({"journey": journey_summary}
+                       if journey_summary is not None else {}),
                 },
             }, request_id=request_id)
         except asyncio.CancelledError:

@@ -703,6 +703,43 @@ class Config:
     # How many newest-first events each bundle's events.json carries.
     flight_events_tail: int = field(
         default_factory=lambda: _env_int("FLIGHT_EVENTS_TAIL", 256))
+    # ---- Fleet tracing + token journey (docs/OBSERVABILITY.md
+    # "Fleet tracing and the token journey") ----
+    # Thread the trace id across hops: traceparent headers on router →
+    # replica dispatch and /kv/parked migration, adopted by the /v1
+    # edge. Off = every process minds its own traces (stitching still
+    # works per-process, cross-replica timelines don't).
+    trace_propagate: bool = field(
+        default_factory=lambda: _env_bool("TRACE_PROPAGATE", True))
+    # Server-side kill switch for per-token journey attribution; the
+    # per-session journey:true opt-in is ignored when false.
+    journey_enabled: bool = field(
+        default_factory=lambda: _env_bool("JOURNEY_ENABLED", True))
+    # Reconciliation tolerance for derived checks (trace_report.py
+    # --journey): |1 - hop_sum/wall| must stay within this fraction.
+    journey_tol: float = field(
+        default_factory=lambda: _env_float("JOURNEY_TOL", 0.10))
+    # ---- Fleet flight recorder (observability/fleetflight.py):
+    # router-side incident triggers fan bundle collection out to every
+    # live replica (router-fronted processes only) ----
+    fleet_flight_enabled: bool = field(
+        default_factory=lambda: _env_bool("FLEET_FLIGHT_ENABLED", True))
+    fleet_flight_dir: str = field(
+        default_factory=lambda: _env_str(
+            "FLEET_FLIGHT_DIR", "/tmp/fasttalk-tpu-fleet-flight"))
+    fleet_flight_max_bundles: int = field(
+        default_factory=lambda: _env_int("FLEET_FLIGHT_MAX_BUNDLES", 4))
+    fleet_flight_min_interval_s: float = field(
+        default_factory=lambda: _env_float("FLEET_FLIGHT_MIN_INTERVAL_S",
+                                           120.0))
+    # This many failovers within fleet_flight_window_s counts as a
+    # failover burst and triggers a fleet bundle.
+    fleet_flight_failover_burst: int = field(
+        default_factory=lambda: _env_int("FLEET_FLIGHT_FAILOVER_BURST",
+                                         3))
+    fleet_flight_window_s: float = field(
+        default_factory=lambda: _env_float("FLEET_FLIGHT_WINDOW_S",
+                                           60.0))
     # Pre-compile hot shapes at startup: "off" | "fast" | "full" — the
     # in-tree replacement for the reference's 300s engine-container
     # health start_period (docker-compose.vllm.yml:62-67). Empty means
@@ -1092,6 +1129,20 @@ class Config:
             errs.append("flight_recompile_window_s must be > 0")
         if self.flight_events_tail < 1:
             errs.append("flight_events_tail must be >= 1")
+        if not (0 < self.journey_tol < 1):
+            errs.append("journey_tol must be in (0, 1) — a fraction "
+                        "of wall clock the hop sum may miss by")
+        if not self.fleet_flight_dir.strip():
+            errs.append("fleet_flight_dir must be a non-empty path")
+        if self.fleet_flight_max_bundles < 1:
+            errs.append("fleet_flight_max_bundles must be >= 1")
+        if self.fleet_flight_min_interval_s < 0:
+            errs.append("fleet_flight_min_interval_s must be >= 0")
+        if self.fleet_flight_failover_burst < 2:
+            errs.append("fleet_flight_failover_burst must be >= 2 "
+                        "(one failover is an event, not an incident)")
+        if self.fleet_flight_window_s <= 0:
+            errs.append("fleet_flight_window_s must be > 0")
         if self.watchdog_cancel_stall_s < self.watchdog_token_stall_s:
             # Cancellation cannot precede detection; a smaller value
             # would silently mean max(token, cancel) (watchdog.py).

@@ -120,6 +120,20 @@
 #                             composition through the kernels, and a
 #                             two-cell BENCH_MODE=roofline sweep smoke
 #                             on the byte-tokenizer test model.
+#   ./run_tests.sh --journey  fleet-tracing/token-journey group
+#                             (docs/OBSERVABILITY.md "Fleet tracing
+#                             and the token journey"): the router-span
+#                             coverage lint (scripts/
+#                             check_router_spans.py), traceparent
+#                             propagation + cross-replica trace
+#                             stitching (mid-stream failover, /kv/
+#                             parked migration), the JourneyRecorder
+#                             telescoping-hop unit tests, the WS
+#                             journey opt-in surface, /fleet/metrics
+#                             label-merged exposition through the
+#                             strict Prometheus validator, the fleet
+#                             flight recorder, plus a trace_report
+#                             --journey reconciliation-gate smoke.
 #   ./run_tests.sh --perf     perf-attribution/flight-recorder group:
 #                             the step ledger (wall-time decomposition,
 #                             padding waste, MFU, compile ledger),
@@ -371,6 +385,38 @@ if [[ "${1:-}" == "--roofline" ]]; then
         grep -q "$want" <<<"$out" \
             || { echo "roofline smoke: missing '$want'" >&2; exit 1; }
     done
+    exit 0
+fi
+
+if [[ "${1:-}" == "--journey" ]]; then
+    shift
+    echo "--- check_router_spans lint (failpoint seams <-> router"
+    echo "    spans <-> fleet-trace tests; docs/OBSERVABILITY.md) ---"
+    "${PYENV[@]}" python scripts/check_router_spans.py
+    "${PYENV[@]}" python -m pytest tests/test_fleet_trace.py "$@"
+    echo "--- trace_report --journey reconciliation gate smoke ---"
+    tmp="$(mktemp)"
+    trap 'rm -f "$tmp"' EXIT
+    cat > "$tmp" <<'EOF'
+{"request_id": "s1:aa", "session_id": "s1", "span": "token_journey", "ts": 10.0, "dur_ms": 120.0, "attrs": {"frames": 3, "wall_ms": 120.0, "hops_sum_ms": 119.0, "reconciliation": 0.9917, "hops_ms": {"engine": 80.0, "device_fetch": 10.0, "detok_emit": 9.0, "loop_dequeue": 10.0, "ws_write": 10.0}, "frames_ms": {"engine": [60.0, 10.0, 10.0], "device_fetch": [4.0, 3.0, 3.0], "detok_emit": [3.0, 3.0, 3.0], "loop_dequeue": [4.0, 3.0, 3.0], "ws_write": [4.0, 3.0, 3.0]}}}
+EOF
+    out="$("${PYENV[@]}" python scripts/trace_report.py --journey "$tmp")"
+    echo "$out"
+    for want in engine ws_write "all journeys reconcile"; do
+        grep -q "$want" <<<"$out" \
+            || { echo "trace_report --journey smoke: missing '$want'" >&2; exit 1; }
+    done
+    # ...and the gate must actually FAIL on a hop sum that does not
+    # telescope to the wall clock.
+    sed 's/"hops_sum_ms": 119.0/"hops_sum_ms": 60.0/' "$tmp" > "$tmp.bad"
+    if "${PYENV[@]}" python scripts/trace_report.py --journey \
+            "$tmp.bad" >/dev/null 2>&1; then
+        echo "trace_report --journey smoke: gate passed a broken sum" >&2
+        rm -f "$tmp.bad"
+        exit 1
+    fi
+    rm -f "$tmp.bad"
+    echo "reconciliation gate rejects broken decomposition OK"
     exit 0
 fi
 

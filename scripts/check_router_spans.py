@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Router span coverage lint (docs/OBSERVABILITY.md "Fleet tracing",
+run_tests.sh --journey).
+
+The fleet trace promise is that every router decision the chaos suite
+can break is also VISIBLE in the stitched timeline: each router
+failpoint seam has a span of the same name, and the full router span
+vocabulary is exercised by the fleet-trace test suite. Statically
+cross-checks three surfaces — no imports, pure AST/text, same
+discipline as scripts/check_failpoints.py:
+
+1. Every ``router.*`` failpoint in the CATALOG
+   (fasttalk_tpu/resilience/failpoints.py) maps to a router span name
+   in SEAM_SPANS below — a new chaos seam without a matching span
+   would be a router decision the trace cannot see.
+2. Every router span name (the SEAM_SPANS values plus the
+   dispatch-lifecycle spans ``failover`` and ``resume``) is emitted by
+   an AST-visible ``add_span``/``event``/``step`` call with that
+   string literal somewhere under fasttalk_tpu/router/.
+3. Every router span name is referenced by tests/test_fleet_trace.py
+   — an unasserted span regresses silently.
+
+Exit 0 = clean; exit 1 = problems, each printed on its own line.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FAILPOINTS = REPO / "fasttalk_tpu" / "resilience" / "failpoints.py"
+ROUTER_DIR = REPO / "fasttalk_tpu" / "router"
+TRACE_TESTS = REPO / "tests" / "test_fleet_trace.py"
+
+# router failpoint seam -> span name recorded at that seam. Check 1
+# fails when the CATALOG grows a router.* point with no entry here;
+# adding the entry forces adding the span (check 2) and its test
+# (check 3).
+SEAM_SPANS = {
+    "router.place": "place",
+    "router.probe": "probe",
+    "router.migrate_send": "migrate_send",
+    "router.migrate_recv": "migrate_recv",
+}
+
+# Spans with no failpoint seam of their own but part of the router's
+# trace vocabulary: failover is observable via the router_failover
+# event + the re-dispatched place span; resume marks the stream
+# continuing on the survivor.
+LIFECYCLE_SPANS = ("failover", "resume")
+
+# Emitter methods whose first string-literal argument after request_id
+# is a span/step/event name (observability/trace.py Tracer API), plus
+# "span" — router/migrate.py wraps tracer.add_span in a local span()
+# helper so both transfer legs share the guard logic.
+EMITTERS = ("add_span", "event", "step", "span")
+
+
+def router_catalog_points() -> set[str]:
+    """router.* CATALOG keys, read from the AST (no import — same
+    rationale as check_failpoints.catalog_names)."""
+    tree = ast.parse(FAILPOINTS.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.AnnAssign, ast.Assign)):
+            targets = ([node.target] if isinstance(node, ast.AnnAssign)
+                       else node.targets)
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if "CATALOG" in names and isinstance(node.value, ast.Dict):
+                return {k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and k.value.startswith("router.")}
+    raise SystemExit(f"{FAILPOINTS}: CATALOG dict literal not found")
+
+
+def emitted_span_names() -> dict[str, list[str]]:
+    """span name -> router files that emit it via an AST-visible
+    ``.add_span(...)``/``.event(...)``/``.step(...)`` call whose name
+    argument is a string literal. ``step`` takes the name first;
+    ``add_span``/``event`` take it second (after request_id) — accept
+    a literal in either of the first two positions so the lint does
+    not depend on call-shape details."""
+    sites: dict[str, list[str]] = {}
+    for path in sorted(ROUTER_DIR.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as e:  # pragma: no cover
+            print(f"PROBLEM: {path}: unparseable ({e})")
+            sys.exit(1)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) \
+                else func.id if isinstance(func, ast.Name) else None
+            if name not in EMITTERS:
+                continue
+            for arg in node.args[:2]:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    sites.setdefault(arg.value, []).append(
+                        str(path.relative_to(REPO)))
+    return sites
+
+
+def main() -> int:
+    problems: list[str] = []
+
+    # 1. every router.* failpoint seam has a span mapping
+    points = router_catalog_points()
+    for point in sorted(points - set(SEAM_SPANS)):
+        problems.append(
+            f"router failpoint {point!r} has no span mapping in "
+            "scripts/check_router_spans.py SEAM_SPANS — a chaos seam "
+            "the stitched trace cannot see")
+    for point in sorted(set(SEAM_SPANS) - points):
+        problems.append(
+            f"SEAM_SPANS maps {point!r} which is not in the "
+            "failpoints CATALOG (stale lint entry)")
+
+    # 2. every span name is emitted somewhere under fasttalk_tpu/router/
+    required = sorted(set(SEAM_SPANS.values()) | set(LIFECYCLE_SPANS))
+    emitted = emitted_span_names()
+    for span in required:
+        if span not in emitted:
+            problems.append(
+                f"router span {span!r} is not emitted by any "
+                "add_span/event/step string-literal call under "
+                "fasttalk_tpu/router/")
+
+    # 3. every span name is asserted by the fleet-trace suite
+    if not TRACE_TESTS.exists():
+        problems.append(f"{TRACE_TESTS} does not exist")
+    else:
+        text = TRACE_TESTS.read_text()
+        for span in required:
+            if f'"{span}"' not in text and f"'{span}'" not in text:
+                problems.append(
+                    f"router span {span!r} is not referenced by "
+                    f"{TRACE_TESTS.relative_to(REPO)} (unasserted "
+                    "span regresses silently)")
+
+    if problems:
+        for p in problems:
+            print(f"PROBLEM: {p}")
+        return 1
+    print(f"check_router_spans: {len(points)} router failpoint seams "
+          f"mapped, {len(required)} router spans all emitted in-tree "
+          "and all asserted by tests/test_fleet_trace.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

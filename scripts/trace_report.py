@@ -11,7 +11,17 @@ Usage:
     python scripts/trace_report.py dump.jsonl
     curl -s localhost:9092/traces?format=jsonl | \
         python scripts/trace_report.py -
-    python scripts/trace_report.py --slo dump.jsonl   # CI gate
+    python scripts/trace_report.py --slo dump.jsonl      # CI gate
+    python scripts/trace_report.py --journey dump.jsonl  # CI gate
+
+``--journey`` aggregates the per-token hop waterfall from
+``token_journey`` summary spans (serving/server.py emits one per
+opted-in request; observability/journey.py defines the hops): per-hop
+count / total / p50 / p95 / p99 across every recorded frame, plus a
+reconciliation gate — each request's hop-sum must match its wall
+clock within ``JOURNEY_TOL`` (default 0.10, i.e. |1 - sum/wall| ≤
+10%) — and exits non-zero on violation, so a bench run can prove the
+decomposition is honest, not just pretty.
 
 ``--slo`` evaluates the dump against the configured SLO targets
 (``SLO_TTFT_P95_MS`` etc. — same knobs and defaults as
@@ -253,6 +263,97 @@ def format_perf(p: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# Mirrors observability/journey.py HOPS (stdlib-only: no package
+# import); tests/test_fleet_trace.py pins the two tuples equal.
+JOURNEY_HOPS = ("engine", "device_fetch", "detok_emit", "loop_dequeue",
+                "ws_write")
+
+
+def _journey_tol() -> float:
+    raw = os.environ.get("JOURNEY_TOL", "").strip()
+    try:
+        tol = float(raw) if raw else 0.10
+    except ValueError:
+        tol = 0.10
+    return tol
+
+
+def journey_report(records: Iterable[dict[str, Any]],
+                   tol: float | None = None,
+                   ) -> tuple[list[dict[str, Any]],
+                              list[dict[str, Any]], bool]:
+    """Aggregate ``token_journey`` spans: (hop_rows, recon_rows, ok).
+
+    hop_rows: per-hop percentile table pooled over every request's
+    (capped) per-frame arrays. recon_rows: one row per request with
+    its hop-sum vs wall-clock ratio, checked against ``tol`` —
+    requests whose span carries no reconciliation (zero wall) pass
+    vacuously. ok is False when any request's decomposition fails to
+    reconcile."""
+    if tol is None:
+        tol = _journey_tol()
+    by_hop: dict[str, list[float]] = defaultdict(list)
+    recon_rows: list[dict[str, Any]] = []
+    for rec in records:
+        if rec.get("span") != "token_journey":
+            continue
+        attrs = rec.get("attrs") or {}
+        frames_ms = attrs.get("frames_ms") or {}
+        for hop, vals in frames_ms.items():
+            if isinstance(vals, list):
+                by_hop[str(hop)].extend(float(v) for v in vals)
+        wall = float(attrs.get("wall_ms") or 0.0)
+        hops_sum = float(attrs.get("hops_sum_ms") or 0.0)
+        ratio = hops_sum / wall if wall > 0 else None
+        recon_rows.append({
+            "request_id": rec.get("request_id", "?"),
+            "frames": attrs.get("frames"),
+            "wall_ms": wall,
+            "hops_sum_ms": hops_sum,
+            "ratio": ratio,
+            "ok": ratio is None or abs(1.0 - ratio) <= tol,
+        })
+    hop_rows: list[dict[str, Any]] = []
+    for hop in JOURNEY_HOPS:
+        vals = sorted(by_hop.pop(hop, []))
+        hop_rows.append({
+            "phase": hop, "count": len(vals), "total_ms": sum(vals),
+            "p50_ms": percentile(vals, 50),
+            "p95_ms": percentile(vals, 95),
+            "p99_ms": percentile(vals, 99),
+        })
+    for hop, vals in sorted(by_hop.items()):  # unknown hops: show, last
+        vals.sort()
+        hop_rows.append({
+            "phase": hop, "count": len(vals), "total_ms": sum(vals),
+            "p50_ms": percentile(vals, 50),
+            "p95_ms": percentile(vals, 95),
+            "p99_ms": percentile(vals, 99),
+        })
+    ok = all(r["ok"] for r in recon_rows)
+    return hop_rows, recon_rows, ok
+
+
+def format_journey(hop_rows: list[dict[str, Any]],
+                   recon_rows: list[dict[str, Any]],
+                   tol: float) -> str:
+    lines = ["token journey (per-frame hop decomposition)",
+             format_table(hop_rows), ""]
+    header = (f"{'request_id':<34}{'frames':>8}{'wall_ms':>12}"
+              f"{'hop_sum':>12}{'ratio':>8}  result")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for r in recon_rows:
+        ratio = "-" if r["ratio"] is None else f"{r['ratio']:.3f}"
+        frames = "-" if r["frames"] is None else str(r["frames"])
+        lines.append(
+            f"{str(r['request_id'])[:33]:<34}{frames:>8}"
+            f"{r['wall_ms']:>12.1f}{r['hops_sum_ms']:>12.1f}"
+            f"{ratio:>8}  " + ("PASS" if r["ok"] else "FAIL"))
+    lines.append(f"(reconciliation tolerance ±{tol:.0%}, JOURNEY_TOL)")
+    return "\n".join(lines)
+
+
 def _slo_target(name: str) -> float:
     raw = os.environ.get(name, "").strip()
     if raw:
@@ -364,6 +465,10 @@ def main(argv: list[str] | None = None) -> int:
                     "(wall-time decomposition, padding waste, "
                     "occupancy, MFU) computed from the dump's "
                     "engine_step/engine_prefill rows")
+    ap.add_argument("--journey", action="store_true",
+                    help="per-token hop waterfall from token_journey "
+                    "spans + hop-sum/wall-clock reconciliation gate "
+                    "(JOURNEY_TOL, default 10%%); exit 1 on violation")
     args = ap.parse_args(argv)
     try:
         if args.dump == "-":
@@ -383,6 +488,20 @@ def main(argv: list[str] | None = None) -> int:
     print()
     kv_note = kv_phase_note(records)
     perf = perf_attribution(records) if args.perf else None
+    if args.journey:
+        tol = _journey_tol()
+        hop_rows, recon_rows, ok = journey_report(records, tol)
+        if not recon_rows:
+            print("error: no token_journey spans in dump (opt in with "
+                  "journey:true in the session config, or "
+                  "client.py --journey)", file=sys.stderr)
+            return 1
+        print(format_journey(hop_rows, recon_rows, tol))
+        if not ok:
+            print("\nJOURNEY RECONCILIATION VIOLATION", file=sys.stderr)
+            return 1
+        print("\nall journeys reconcile with wall clock")
+        return 0
     if args.slo:
         rows, ok = slo_evaluate(records)
         print(format_slo_table(rows))

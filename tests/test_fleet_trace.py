@@ -1,0 +1,1005 @@
+"""Fleet-wide distributed tracing and per-token journey attribution
+(docs/OBSERVABILITY.md "Fleet tracing and the token journey").
+
+The tentpole invariants under test:
+
+- trace-context propagation: one trace id minted at the serving edge
+  survives every hop — router placement ("place"), health sampling
+  ("probe"), mid-stream "failover" + "resume", and the
+  "migrate_send"/"migrate_recv" legs of a KV transfer (the
+  ``traceparent`` header on the /kv/parked wire) — so
+  ``FleetRouter.stitched_trace`` returns ONE cross-replica timeline
+  with exactly one terminal event however many replicas served;
+- the per-token journey waterfall telescopes: named hop sums reconcile
+  with wall clock BY CONSTRUCTION, and the WS ``response_complete``
+  stats carry the decomposition when the session opted in;
+- fleet aggregation: ``fleet_metrics`` label-merges every replica's
+  exposition into one strictly valid scrape (two replicas up, one
+  dead), ``fleet_slo`` rolls up the worst alert, and the fleet flight
+  recorder fans incident bundles out across the fleet.
+
+scripts/check_router_spans.py statically asserts this file references
+every router span name: "place", "probe", "failover", "migrate_send",
+"migrate_recv", "resume".
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.engine.engine import GenerationParams
+from fasttalk_tpu.engine.fake import FakeEngine
+from fasttalk_tpu.kvcache.hostpool import (HostKVPool, ParkedKV,
+                                           strip_device)
+from fasttalk_tpu.kvcache.offload import kv_bucket
+from fasttalk_tpu.observability.events import Event
+from fasttalk_tpu.observability.fleetflight import FleetFlightRecorder
+from fasttalk_tpu.observability.journey import HOPS, JourneyRecorder
+from fasttalk_tpu.observability.stitch import collect_fragments, stitch
+from fasttalk_tpu.observability.trace import (Tracer, bind_request,
+                                              current_traceparent,
+                                              get_tracer,
+                                              make_traceparent,
+                                              mint_trace_id,
+                                              parse_traceparent,
+                                              propagate_enabled)
+from fasttalk_tpu.router import FleetRouter, ReplicaHandle
+from fasttalk_tpu.router import migrate as migrate_mod
+from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from fasttalk_tpu.utils.metrics import get_metrics
+
+GREEDY = dict(temperature=0.0, top_k=1)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------
+# Fakes (test_fleet_fabric.py idiom: FakeEngine + real HostKVPool, can
+# die mid-stream like a partitioned replica)
+# ---------------------------------------------------------------------
+
+class MortalEngine(FakeEngine):
+    def __init__(self, reply="alpha beta gamma delta epsilon zeta "
+                 "eta theta", delay_s=0.0):
+        super().__init__(reply=reply, n_repeats=1, delay_s=delay_s)
+        self.pool = HostKVPool(budget_mb=16.0)
+        self.dead = False
+        self.die_after_tokens = None
+
+    def kill(self):
+        self.dead = True
+        self._started = False
+
+    def check_connection(self):
+        return not self.dead and super().check_connection()
+
+    # migration seam (mirrors TPUEngine's pool-only contract)
+    def export_parked_kv(self, session_id):
+        entry = self.pool.get(session_id)
+        return None if entry is None else strip_device(entry)
+
+    def parked_kv_info(self, session_id):
+        entry = self.pool.get(session_id)
+        return None if entry is None else (entry.kept, entry.nbytes)
+
+    def import_parked_kv(self, entry):
+        self.pool.revive(entry.session_id)
+        return self.pool.put(strip_device(entry))
+
+    def drop_parked_kv(self, session_id):
+        return self.pool.purge(session_id)
+
+    async def generate(self, request_id, session_id, messages, params):
+        self.requests_seen.append({
+            "request_id": request_id, "session_id": session_id,
+            "messages": messages, "params": params,
+        })
+        if self.dead:
+            raise LLMServiceError("replica down",
+                                  category=ErrorCategory.CONNECTION)
+        words = self.reply.split(" ")
+        n = 0
+        self._active.add(request_id)
+        try:
+            for i, w in enumerate(words):
+                if self.dead or (self.die_after_tokens is not None
+                                 and n >= self.die_after_tokens):
+                    self.kill()
+                    raise LLMServiceError(
+                        "replica died mid-stream",
+                        category=ErrorCategory.CONNECTION)
+                if request_id in self._cancelled:
+                    yield {"type": "cancelled",
+                           "finish_reason": "cancelled", "stats": {}}
+                    return
+                if n >= params.max_tokens:
+                    break
+                await asyncio.sleep(self.delay_s)
+                n += 1
+                yield {"type": "token",
+                       "text": w + (" " if i < len(words) - 1 else "")}
+            yield {"type": "done", "finish_reason": "stop",
+                   "stats": {"tokens_generated": n,
+                             "processing_time_ms": 1.0,
+                             "tokens_per_second": 100.0,
+                             "ttft_ms": 1.0, "prompt_tokens": 5}}
+        finally:
+            self._active.discard(request_id)
+            self._cancelled.discard(request_id)
+
+
+def make_entry(sid, n_tokens=32):
+    bucket = kv_bucket(n_tokens, 256)
+    rng = np.random.default_rng(hash(sid) % (2**32))
+    shape = (2, bucket, 2, 4)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    return ParkedKV(session_id=sid, tokens=list(range(n_tokens)),
+                    kept=n_tokens, bucket=bucket, k=k, v=v,
+                    k_scale=None, v_scale=None,
+                    nbytes=int(k.nbytes) + int(v.nbytes))
+
+
+def make_fleet(n=2, **router_kw):
+    engines = [MortalEngine() for _ in range(n)]
+    handles = [ReplicaHandle(f"r{i}", e, dead_probes=2)
+               for i, e in enumerate(engines)]
+    kw = dict(probe_interval_s=0, failover_retries=2,
+              migrate_timeout_s=2.0)
+    kw.update(router_kw)
+    router = FleetRouter(handles, **kw)
+    router.start()
+    return router, engines, handles
+
+
+def make_config(**env):
+    from fasttalk_tpu.utils.config import Config
+    old = {}
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    try:
+        return Config()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+async def recv_json(ws):
+    msg = await asyncio.wait_for(ws.receive(), timeout=10)
+    return json.loads(msg.data)
+
+
+async def make_ws_server(engine, **env):
+    from fasttalk_tpu.serving.server import WebSocketLLMServer
+
+    config = make_config(LLM_PROVIDER="fake",
+                         ENABLE_PYDANTIC_AI="false", **env)
+    server = WebSocketLLMServer(config, engine)
+    client = TestClient(TestServer(server.app))
+    await client.start_server()
+    return server, client
+
+
+async def open_session(client, config=None):
+    ws = await client.ws_connect("/ws/llm")
+    started = await recv_json(ws)
+    assert started["type"] == "session_started"
+    await ws.send_json({"type": "start_session",
+                        "config": config or {}})
+    configured = await recv_json(ws)
+    assert configured["type"] == "session_configured", configured
+    return ws, started["session_id"]
+
+
+async def run_turn(ws, text="hi"):
+    await ws.send_json({"type": "user_message", "text": text})
+    frames = []
+    while True:
+        msg = await recv_json(ws)
+        frames.append(msg)
+        if msg["type"] in ("response_complete", "error"):
+            return frames
+
+
+def _completed_request_id(session_id):
+    """The serving edge mints request ids as <session>:<hex8>; recover
+    the one the WS turn just finished from the completed-trace ring."""
+    for t in get_tracer().completed():
+        if t.session_id == session_id:
+            return t.request_id
+    raise AssertionError(f"no completed trace for {session_id}")
+
+
+# ---------------------------------------------------------------------
+# Trace-context plumbing
+# ---------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_traceparent_roundtrip(self):
+        tid = mint_trace_id()
+        header = make_traceparent(tid)
+        assert header.startswith(f"00-{tid}-")
+        assert parse_traceparent(header) == tid
+
+    def test_parse_rejects_malformed(self):
+        assert parse_traceparent(None) is None
+        assert parse_traceparent("") is None
+        assert parse_traceparent("not a header") is None
+        assert parse_traceparent("00-zz-11-01") is None
+        # All-zero trace id is explicitly invalid in W3C trace-context.
+        assert parse_traceparent(
+            f"00-{'0' * 32}-{'1' * 16}-01") is None
+
+    def test_current_traceparent_binding_and_gate(self, monkeypatch):
+        assert current_traceparent() is None  # unbound
+        tid = mint_trace_id()
+        with bind_request("req-b", trace_id=tid):
+            header = current_traceparent()
+            assert header is not None
+            assert parse_traceparent(header) == tid
+            monkeypatch.setenv("TRACE_PROPAGATE", "0")
+            assert not propagate_enabled()
+            assert current_traceparent() is None
+        monkeypatch.delenv("TRACE_PROPAGATE", raising=False)
+        assert current_traceparent() is None
+
+    def test_tracer_start_trace_id_resolution(self):
+        tr = Tracer(enabled=True)
+        explicit = mint_trace_id()
+        assert tr.start("r1", "s", trace_id=explicit)
+        assert tr.get("r1").trace_id == explicit
+        # Context-bound id adopted when no explicit one is given (a
+        # replica picking up a propagated traceparent).
+        ctx = mint_trace_id()
+        with bind_request("r2", trace_id=ctx):
+            tr.start("r2", "s")
+        assert tr.get("r2").trace_id == ctx
+        # Fresh mint otherwise — every trace is fleet-addressable.
+        tr.start("r3", "s")
+        assert len(tr.get("r3").trace_id) == 32
+        # Second start is a no-op that keeps the original id.
+        assert not tr.start("r1", "s", trace_id=mint_trace_id())
+        assert tr.get("r1").trace_id == explicit
+
+    def test_find_by_trace_id_spans_inflight_and_ring(self):
+        tr = Tracer(enabled=True)
+        tid = mint_trace_id()
+        tr.start("a", "s", trace_id=tid)
+        tr.start("b", "s", trace_id=tid)
+        tr.finish("a")
+        got = {t.request_id for t in tr.find_by_trace_id(tid)}
+        assert got == {"a", "b"}
+        assert tr.find_by_trace_id("") == []
+
+
+class TestStitch:
+    def test_stitch_empty_is_none(self):
+        assert stitch([]) is None
+        assert stitch([{}]) is None
+
+    def test_stitch_merges_orders_and_counts(self):
+        tr = Tracer(enabled=True)
+        tid = mint_trace_id()
+        tr.start("req-1", "sess", trace_id=tid)
+        tr.add_span("req-1", "place", 10.0, 10.1, replica="r0")
+        tr.add_span("req-1", "failover", 10.5, 10.6)
+        tr.event("req-1", "resume", replica="r1")
+        frags = collect_fragments(tr, "req-1", source="router")
+        assert len(frags) == 1
+        # A remote replica's fragment, already in wall time, with the
+        # terminal event the serving edge over there emitted.
+        wall = tr.to_wall(10.2)
+        frags.append({
+            "request_id": "req-1b", "session_id": "sess",
+            "trace_id": tid, "finished": True, "source": "r1",
+            "spans": [
+                {"name": "decode", "t0": wall, "t1": wall + 0.1,
+                 "attrs": {}},
+                {"name": "request_complete", "t0": wall + 0.2,
+                 "t1": wall + 0.2, "attrs": {}},
+            ],
+        })
+        out = stitch(frags)
+        assert out["trace_id"] == tid
+        assert out["fragments"] == 2
+        assert out["request_ids"] == ["req-1", "req-1b"]
+        assert out["sources"] == ["router", "r1"]
+        assert out["resumed"] == 1
+        assert out["terminal_events"] == 1
+        assert out["finished"] is True
+        # Wall-clock order across fragments; spans without their own
+        # component attr inherit the fragment source.
+        t0s = [s["t0"] for s in out["spans"]]
+        assert t0s == sorted(t0s)
+        decode = next(s for s in out["spans"] if s["name"] == "decode")
+        assert decode["attrs"]["component"] == "r1"
+
+
+# ---------------------------------------------------------------------
+# Per-token journey waterfall
+# ---------------------------------------------------------------------
+
+class TestJourneyRecorder:
+    def test_hops_telescope_and_reconcile_exactly(self):
+        jr = JourneyRecorder(start_mono=100.0)
+        jr.frame({"w": 100.010, "f": 100.030, "e": 100.031},
+                 100.040, 100.041)
+        jr.frame({"w": 100.050, "f": 100.060, "e": 100.061},
+                 100.070, 100.072)
+        s = jr.summary()
+        assert s["frames"] == 2
+        assert tuple(s["hops_ms"]) == HOPS
+        assert s["wall_ms"] == pytest.approx((100.072 - 100.0) * 1000,
+                                             abs=1e-6)
+        assert s["hops_sum_ms"] == pytest.approx(s["wall_ms"], abs=1e-6)
+        assert s["reconciliation"] == pytest.approx(1.0, abs=1e-3)
+        assert s["ttft_ms"] == pytest.approx(41.0, abs=1e-6)
+        # Hop values are the boundary deltas.
+        assert s["ttft_hops_ms"]["engine"] == pytest.approx(10.0,
+                                                            abs=1e-6)
+        assert s["ttft_hops_ms"]["device_fetch"] == pytest.approx(
+            20.0, abs=1e-6)
+
+    def test_out_of_order_stamps_clamp_forward(self):
+        jr = JourneyRecorder(start_mono=100.0)
+        # A batched retirement can stamp w before this frame's prev
+        # boundary — clamping keeps every hop >= 0 and the sum intact.
+        jr.frame({"w": 100.010, "f": 100.020, "e": 100.021},
+                 100.030, 100.031)
+        jr.frame({"w": 100.005, "f": 100.028, "e": 100.040},
+                 100.035, 100.050)
+        s = jr.summary()
+        for hop, ms in s["hops_ms"].items():
+            assert ms >= 0.0, (hop, ms)
+        assert s["reconciliation"] == pytest.approx(1.0, abs=1e-3)
+
+    def test_missing_engine_stamps_degrade(self):
+        jr = JourneyRecorder(start_mono=100.0)
+        jr.frame(None, 100.020, 100.025)
+        s = jr.summary()
+        assert s["hops_ms"]["device_fetch"] == 0.0
+        assert s["hops_ms"]["detok_emit"] == 0.0
+        assert s["reconciliation"] == pytest.approx(1.0, abs=1e-3)
+
+    def test_frame_cap_bounds_arrays_not_totals(self):
+        jr = JourneyRecorder(start_mono=100.0, max_frames=2)
+        t = 100.0
+        for _ in range(5):
+            jr.frame(None, t + 0.010, t + 0.020)
+            t += 0.020
+        s = jr.summary()
+        assert s["frames"] == 5
+        assert s["frames_uncounted_in_percentiles"] == 3
+        attrs = jr.span_attrs()
+        assert all(len(v) == 2 for v in attrs["frames_ms"].values())
+        # Totals keep counting past the cap — the reconciliation check
+        # must hold for the WHOLE stream.
+        assert s["reconciliation"] == pytest.approx(1.0, abs=1e-3)
+
+    def test_hops_pin_matches_offline_report(self):
+        # scripts/trace_report.py --journey orders its table by the
+        # same hop vocabulary; a drift would silently mis-pool.
+        report = _load_script("trace_report")
+        assert tuple(report.JOURNEY_HOPS) == HOPS
+
+    def test_offline_journey_report_reconciliation_gate(self):
+        report = _load_script("trace_report")
+        good = {"span": "token_journey", "request_id": "r-ok",
+                "attrs": {"wall_ms": 100.0, "hops_sum_ms": 99.0,
+                          "frames": 3,
+                          "frames_ms": {"engine": [30.0, 30.0, 30.0],
+                                        "ws_write": [3.0, 3.0, 3.0]}}}
+        bad = dict(good, request_id="r-bad",
+                   attrs=dict(good["attrs"], hops_sum_ms=60.0))
+        hop_rows, recon, ok = report.journey_report([good], tol=0.10)
+        assert ok and recon[0]["ok"]
+        engine_row = next(r for r in hop_rows
+                          if r["phase"] == "engine")
+        assert engine_row["count"] == 3
+        _, recon, ok = report.journey_report([good, bad], tol=0.10)
+        assert not ok
+        assert [r["ok"] for r in recon] == [True, False]
+
+
+# ---------------------------------------------------------------------
+# Router spans in the stitched timeline
+# ---------------------------------------------------------------------
+
+class TestRouterSpans:
+    async def test_place_span_and_probe_step(self):
+        router, engines, handles = make_fleet()
+        try:
+            tr = get_tracer()
+            tr.start("rid-p", "sess-p", trace_id=mint_trace_id())
+            events = []
+            async for ev in router.generate(
+                    "rid-p", "sess-p",
+                    [{"role": "user", "content": "hi"}],
+                    GenerationParams(max_tokens=4, **GREEDY)):
+                events.append(ev)
+            assert events[-1]["type"] == "done"
+            trace = tr.get("rid-p")
+            place = [s for s in trace.spans if s.name == "place"]
+            assert len(place) == 1
+            assert place[0].attrs["component"] == "router"
+            assert place[0].attrs["replica"] in ("r0", "r1")
+            router.probe_once()
+            probes = [s for s in tr.steps() if s.name == "probe"]
+            assert {p.attrs["replica"] for p in probes} == {"r0", "r1"}
+            assert all(p.attrs["component"] == "router"
+                       for p in probes)
+        finally:
+            router.shutdown()
+
+    async def test_failover_emits_failover_and_resume_spans(self):
+        router, engines, handles = make_fleet()
+        for e in engines:
+            e.delay_s = 0.005
+        try:
+            tr = get_tracer()
+            tid = mint_trace_id()
+            tr.start("rid-f", "sess-f", trace_id=tid)
+            events, killed = [], False
+            async for ev in router.generate(
+                    "rid-f", "sess-f",
+                    [{"role": "user", "content": "hi"}],
+                    GenerationParams(max_tokens=8, **GREEDY)):
+                events.append(ev)
+                if ev["type"] == "token" and not killed:
+                    killed = True
+                    placed = next(e for e in engines
+                                  if e.requests_seen)
+                    placed.die_after_tokens = 0  # dies on next token
+            types = [e["type"] for e in events]
+            assert types.count("resumed") == 1
+            assert types[-1] == "done"
+            names = [s.name for s in tr.get("rid-f").spans]
+            assert names.count("place") == 2  # original + re-dispatch
+            assert "failover" in names
+            assert "resume" in names
+            # The stitched view (router front, in-proc fleet: one
+            # process tracer) joins on the edge-minted trace id.
+            stitched = router.stitched_trace("rid-f")
+            assert stitched is not None
+            assert stitched["trace_id"] == tid
+            assert stitched["resumed"] == 1
+            assert "router" in stitched["components"]
+        finally:
+            router.shutdown()
+
+    async def test_migrate_transfer_records_send_recv_spans(self):
+        router, engines, handles = make_fleet()
+        try:
+            engines[0].pool.put(make_entry("s-mig"))
+            tr = get_tracer()
+            tr.start("rid-m", "s-mig", trace_id=mint_trace_id())
+            ok, nbytes, reason, kept = migrate_mod.transfer(
+                handles[0], handles[1], "s-mig",
+                tracer=tr.scoped("router"), request_id="rid-m")
+            assert ok, reason
+            names = {s.name: s for s in tr.get("rid-m").spans}
+            assert "migrate_send" in names
+            assert "migrate_recv" in names
+            assert names["migrate_send"].attrs["session_id"] == "s-mig"
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# The acceptance integration: WS stream fails over mid-decode, the
+# stitched trace has router + serving spans, ONE terminal event, and
+# the journey block reconciles.
+# ---------------------------------------------------------------------
+
+class TestStitchedFailoverOverWS:
+    async def test_ws_failover_one_stitched_trace(self):
+        router, engines, handles = make_fleet()
+        for e in engines:
+            e.delay_s = 0.01
+        server, client = await make_ws_server(router)
+        try:
+            ws, sid = await open_session(client,
+                                         config={"journey": True})
+            await ws.send_json({"type": "user_message", "text": "go"})
+            frames, killed = [], False
+            while True:
+                msg = await recv_json(ws)
+                frames.append(msg)
+                if msg["type"] == "token" and not killed:
+                    killed = True
+                    placed = next(e for e in engines
+                                  if e.requests_seen)
+                    placed.die_after_tokens = 0
+                if msg["type"] in ("response_complete", "error"):
+                    break
+            types = [m["type"] for m in frames]
+            assert "error" not in types, frames[-1]
+            assert types.count("resumed") == 1
+            assert types[-1] == "response_complete"
+
+            rid = _completed_request_id(sid)
+            stitched = router.stitched_trace(rid)
+            assert stitched is not None
+            assert stitched["resumed"] == 1
+            assert stitched["terminal_events"] == 1
+            assert {"router", "serving"} <= set(stitched["components"])
+            names = [s["name"] for s in stitched["spans"]]
+            for span in ("place", "failover", "resume",
+                         "request_complete", "token_journey"):
+                assert span in names, (span, names)
+
+            # Journey block: every token frame is stamped for the
+            # client-side network split, and the hop decomposition
+            # reconciles with wall clock (acceptance: within 10%).
+            tokens = [m for m in frames if m["type"] == "token"]
+            assert tokens and all(
+                isinstance(m.get("st"), float) for m in tokens)
+            journey = frames[-1]["stats"]["journey"]
+            assert journey["frames"] == len(tokens)
+            assert tuple(journey["hops_ms"]) == HOPS
+            assert abs(journey["reconciliation"] - 1.0) <= 0.10
+        finally:
+            await client.close()
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Serving surfaces: journey opt-in, /traces, /kv wire steps
+# ---------------------------------------------------------------------
+
+class TestServingJourney:
+    async def test_journey_block_present_when_opted_in(self):
+        server, client = await make_ws_server(MortalEngine())
+        try:
+            ws, sid = await open_session(client,
+                                         config={"journey": True})
+            frames = await run_turn(ws)
+            assert frames[-1]["type"] == "response_complete"
+            journey = frames[-1]["stats"]["journey"]
+            tokens = [m for m in frames if m["type"] == "token"]
+            assert journey["frames"] == len(tokens)
+            assert abs(journey["reconciliation"] - 1.0) <= 0.10
+            # The once-per-request summary span feeds the offline
+            # report: per-hop frame arrays ride its attrs.
+            trace = get_tracer().get(_completed_request_id(sid))
+            tj = next(s for s in trace.spans
+                      if s.name == "token_journey")
+            assert set(tj.attrs["frames_ms"]) == set(HOPS)
+        finally:
+            await client.close()
+
+    async def test_journey_off_by_default(self):
+        server, client = await make_ws_server(MortalEngine())
+        try:
+            ws, _sid = await open_session(client)
+            frames = await run_turn(ws)
+            assert "journey" not in frames[-1]["stats"]
+            assert all("st" not in m for m in frames
+                       if m["type"] == "token")
+        finally:
+            await client.close()
+
+    async def test_journey_requires_bool(self):
+        server, client = await make_ws_server(MortalEngine())
+        try:
+            ws, _sid = await open_session(client,
+                                          config={"journey": "yes"})
+            frames = await run_turn(ws)
+            assert frames[-1]["type"] == "error"
+            err = frames[-1]["error"]
+            assert err["code"] == "invalid_config"
+            assert "journey" in err["message"]
+        finally:
+            await client.close()
+
+    async def test_journey_env_gate_overrides_opt_in(self):
+        server, client = await make_ws_server(MortalEngine(),
+                                              JOURNEY_ENABLED="false")
+        try:
+            ws, _sid = await open_session(client,
+                                          config={"journey": True})
+            frames = await run_turn(ws)
+            assert frames[-1]["type"] == "response_complete"
+            assert "journey" not in frames[-1]["stats"]
+        finally:
+            await client.close()
+
+
+class TestTracesEndpoint:
+    async def test_serving_trace_route(self):
+        server, client = await make_ws_server(MortalEngine())
+        try:
+            ws, sid = await open_session(client)
+            await run_turn(ws)
+            rid = _completed_request_id(sid)
+            resp = await client.get(f"/traces/{rid}")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["request_id"] == rid
+            assert body["fragments"]
+            assert body["stitched"]["terminal_events"] == 1
+            assert (await client.get("/traces/nope")).status == 404
+        finally:
+            await client.close()
+
+    async def test_router_fronted_trace_route_stitches(self):
+        """Satellite (a): /traces on a router-fronted server answers
+        from the fleet-wide stitched view, not just the local ring."""
+        router, engines, handles = make_fleet()
+        server, client = await make_ws_server(router)
+        try:
+            ws, sid = await open_session(client)
+            await run_turn(ws)
+            rid = _completed_request_id(sid)
+            resp = await client.get(f"/traces/{rid}")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["stitched"]["terminal_events"] == 1
+            assert "router" in body["stitched"]["sources"]
+        finally:
+            await client.close()
+            router.shutdown()
+
+    async def test_monitoring_trace_fallback_uses_fleet_lookup(self):
+        from fasttalk_tpu.monitoring.monitor import build_monitoring_app
+
+        canned = {"trace_id": "t" * 32, "fragments": 2,
+                  "terminal_events": 1, "spans": []}
+        calls = []
+
+        def lookup(rid):
+            calls.append(rid)
+            return canned if rid == "known" else None
+
+        app = build_monitoring_app(trace_lookup=lookup)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/traces/known")
+            assert resp.status == 200
+            assert (await resp.json())["fragments"] == 2
+            assert (await client.get("/traces/lost")).status == 404
+            assert calls == ["known", "lost"]
+        finally:
+            await client.close()
+
+
+class TestKVWireTraceSteps:
+    async def test_kv_routes_record_wire_steps_with_trace_id(self):
+        server, client = await make_ws_server(MortalEngine(),
+                                              KV_MIGRATE_HTTP="true")
+        try:
+            tid = mint_trace_id()
+            hdr = {"traceparent": make_traceparent(tid)}
+            await client.get("/kv/parked/s-wire", headers=hdr)
+            await client.post("/kv/parked/s-wire", data=b"x",
+                              headers=hdr)
+            steps = {s.name: s for s in get_tracer().steps()}
+            assert "kv_export" in steps
+            assert "kv_import" in steps
+            assert steps["kv_export"].attrs["trace_id"] == tid
+            assert steps["kv_import"].attrs["session_id"] == "s-wire"
+        finally:
+            await client.close()
+
+    async def test_malformed_traceparent_records_nothing(self):
+        server, client = await make_ws_server(MortalEngine(),
+                                              KV_MIGRATE_HTTP="true")
+        try:
+            await client.get("/kv/parked/s-bad",
+                             headers={"traceparent": "garbage"})
+            assert not [s for s in get_tracer().steps()
+                        if s.name == "kv_export"]
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------
+# /v1 edge: traceparent adoption and terminal-event ownership
+# ---------------------------------------------------------------------
+
+class TestOpenAIEdgeTracing:
+    BODY = {"model": "fake", "stream": False,
+            "messages": [{"role": "user", "content": "hi"}]}
+
+    async def test_fresh_request_owns_terminal_event(self):
+        server, client = await make_ws_server(MortalEngine())
+        try:
+            resp = await client.post("/v1/chat/completions",
+                                     json=self.BODY)
+            assert resp.status == 200
+            traces = get_tracer().completed()
+            assert traces
+            names = [s.name for s in traces[-1].spans]
+            assert names.count("request_complete") == 1
+        finally:
+            await client.close()
+
+    async def test_inner_hop_adopts_id_and_defers_terminal(self):
+        """A router-dispatched /v1 leg adopts the incoming trace id and
+        must NOT emit its own request_complete — the WS edge that owns
+        the client stream emits the one terminal marker stitch()
+        counts."""
+        server, client = await make_ws_server(MortalEngine())
+        try:
+            tid = mint_trace_id()
+            resp = await client.post(
+                "/v1/chat/completions", json=self.BODY,
+                headers={"traceparent": make_traceparent(tid)})
+            assert resp.status == 200
+            frags = get_tracer().find_by_trace_id(tid)
+            assert len(frags) == 1  # adopted, not re-minted
+            names = [s.name for s in frags[0].spans]
+            assert "request_complete" not in names
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------
+# Fleet aggregation: /fleet/metrics, /fleet/slo
+# ---------------------------------------------------------------------
+
+REMOTE_PROM = """\
+# HELP ft_remote_tokens_total tokens
+# TYPE ft_remote_tokens_total counter
+ft_remote_tokens_total 5
+# HELP ft_remote_latency_ms latency
+# TYPE ft_remote_latency_ms histogram
+ft_remote_latency_ms_bucket{le="1"} 1
+ft_remote_latency_ms_bucket{le="+Inf"} 2
+ft_remote_latency_ms_sum 3.0
+ft_remote_latency_ms_count 2
+"""
+
+
+class StubRemoteHandle(ReplicaHandle):
+    """In-proc handle dressed as a remote (base_url present) so the
+    fleet fan-out paths exercise their HTTP branch without sockets."""
+
+    def __init__(self, rid, text, slo_alert="ok"):
+        super().__init__(rid, MortalEngine(), dead_probes=2)
+        self.base_url = f"http://stub/{rid}"
+        self._text = text
+        self.last_probe["slo_alert"] = slo_alert
+
+    def fetch_metrics(self):
+        if self._text is None:
+            raise RuntimeError("replica unreachable")
+        return self._text
+
+    def fetch_slo(self):
+        if self._text is None:
+            raise RuntimeError("replica unreachable")
+        return {"alert": self.last_probe.get("slo_alert", "ok")}
+
+
+class TestFleetMetrics:
+    def test_merge_prometheus_labels_sums_and_validates(self):
+        check = _load_script("check_prometheus")
+        m = get_metrics()
+        m.counter("ft_local_smoke_total", "smoke").inc()
+        m.histogram("ft_local_smoke_ms", "smoke").observe(3.0)
+        from fasttalk_tpu.observability.export import merge_prometheus
+
+        merged = merge_prometheus(
+            m.prometheus(), "router",
+            {"r1": REMOTE_PROM, "r2": REMOTE_PROM, "r3": None})
+        assert not check.validate(merged), check.validate(merged)
+        assert 'replica="router"' in merged
+        assert 'ft_remote_tokens_total{replica="r1"} 5' in merged
+        assert 'ft_remote_tokens_total{replica="r2"} 5' in merged
+        # Histograms sum by bucket across replicas — one monotone
+        # ladder per family, as the strict validator requires.
+        assert 'ft_remote_latency_ms_bucket{le="+Inf"} 4' in merged
+        assert "ft_remote_latency_ms_count 4" in merged
+        assert "# replica r3 unreachable" in merged
+
+    def test_fleet_metrics_mid_incident_two_up_one_dead(self):
+        """Satellite (d): /fleet/metrics stays a valid scrape while a
+        replica is dead — the gap becomes a comment, not a 500."""
+        check = _load_script("check_prometheus")
+        router, engines, handles = make_fleet()
+        try:
+            router.replicas.append(StubRemoteHandle("rem-up",
+                                                    REMOTE_PROM))
+            router.replicas.append(StubRemoteHandle("rem-dead", None))
+            get_metrics().counter("ft_router_smoke_total", "s").inc()
+            out = router.fleet_metrics()
+            assert not check.validate(out), check.validate(out)
+            assert 'replica="router"' in out
+            assert 'replica="rem-up"' in out
+            assert "# replica rem-dead unreachable" in out
+        finally:
+            router.shutdown()
+
+    async def test_fleet_endpoints_served(self):
+        router, engines, handles = make_fleet()
+        server, client = await make_ws_server(router)
+        try:
+            resp = await client.get("/fleet/metrics")
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            resp = await client.get("/fleet/slo")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["worst_alert"] in ("ok", "warn", "page")
+            # Plain (non-fleet) servers must not grow the routes.
+            server2, client2 = await make_ws_server(MortalEngine())
+            assert (await client2.get("/fleet/metrics")).status == 404
+            await client2.close()
+        finally:
+            await client.close()
+            router.shutdown()
+
+    def test_fleet_slo_rolls_up_worst_alert(self):
+        router, engines, handles = make_fleet()
+        try:
+            router.replicas.append(
+                StubRemoteHandle("rem-pg", REMOTE_PROM,
+                                 slo_alert="page"))
+            out = router.fleet_slo()
+            assert out["worst_alert"] == "page"
+            assert out["replicas"]["rem-pg"]["alert"] == "page"
+            assert out["replicas"]["r0"] == {"shared_process": True}
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Fleet flight recorder
+# ---------------------------------------------------------------------
+
+class TestFleetFlightRecorder:
+    def _recorder(self, router, tmp_path, **kw):
+        opts = dict(enabled=True, base_dir=str(tmp_path), inline=True,
+                    min_interval_s=100.0, failover_burst=3,
+                    window_s=60.0)
+        opts.update(kw)
+        return FleetFlightRecorder(router, **opts)
+
+    def _event(self, kind, **attrs):
+        return Event(seq=1, kind=kind, severity="warning", ts=0.0,
+                     last_ts=0.0, attrs=attrs)
+
+    def test_bundle_contents_and_rate_limit(self, tmp_path):
+        router, engines, handles = make_fleet()
+        try:
+            clock = [1000.0]
+            rec = self._recorder(router, tmp_path,
+                                 clock=lambda: clock[0])
+            get_tracer().start("req-live", "s-live")
+            bundle = rec.trigger("unit-test")
+            assert bundle is not None
+            names = os.listdir(bundle)
+            for f in ("manifest.json", "router.json", "events.json",
+                      "slo.json", "fleet_metrics.prom"):
+                assert f in names, names
+            manifest = json.load(
+                open(os.path.join(bundle, "manifest.json")))
+            assert manifest["reason"] == "unit-test"
+            assert set(manifest["replicas"]) == {"r0", "r1"}
+            assert "req-live" in manifest["stitched_traces"]
+            assert os.path.exists(os.path.join(
+                bundle, "replicas", "r0", "health.json"))
+            assert os.path.exists(os.path.join(
+                bundle, "traces", "req-live.json"))
+            # Inside the window: suppressed. force bypasses it.
+            clock[0] += 10.0
+            assert rec.trigger("too-soon") is None
+            assert rec.triggers_suppressed == 1
+            assert rec.trigger("forced", force=True) is not None
+            assert rec.bundles_written == 2
+        finally:
+            router.shutdown()
+
+    def test_partition_and_slo_page_trigger_immediately(self, tmp_path):
+        router, engines, handles = make_fleet()
+        try:
+            rec = self._recorder(router, tmp_path, min_interval_s=0.0)
+            rec.on_event(self._event("router_partition", replica="r0"))
+            assert rec.bundles_written == 1
+            rec.on_event(self._event("replica_slo_page", replica="r1"))
+            assert rec.bundles_written == 2
+            # slo_burn_start only at page severity.
+            rec.on_event(self._event("slo_burn_start", state="warn"))
+            assert rec.bundles_written == 2
+            rec.on_event(self._event("slo_burn_start", state="page"))
+            assert rec.bundles_written == 3
+        finally:
+            router.shutdown()
+
+    def test_failover_burst_window(self, tmp_path):
+        router, engines, handles = make_fleet()
+        try:
+            clock = [0.0]
+            rec = self._recorder(router, tmp_path, min_interval_s=0.0,
+                                 clock=lambda: clock[0])
+            ev = self._event("router_failover", replica="r0")
+            rec.on_event(ev)       # 1 within window: routine
+            clock[0] = 100.0       # first failover ages out
+            rec.on_event(ev)
+            assert rec.bundles_written == 0
+            clock[0] = 101.0
+            rec.on_event(ev)
+            clock[0] = 102.0
+            rec.on_event(ev)       # 3 within 60s: a dying fleet
+            assert rec.bundles_written == 1
+        finally:
+            router.shutdown()
+
+    def test_prune_keeps_newest_bundles(self, tmp_path):
+        router, engines, handles = make_fleet()
+        try:
+            rec = self._recorder(router, tmp_path, min_interval_s=0.0,
+                                 max_bundles=2)
+            for i in range(4):
+                assert rec.trigger(f"b{i}") is not None
+            assert len(rec.list_bundles()) == 2
+        finally:
+            router.shutdown()
+
+    def test_disabled_recorder_is_inert(self, tmp_path):
+        router, engines, handles = make_fleet()
+        try:
+            rec = self._recorder(router, tmp_path, enabled=False)
+            assert rec.trigger("nope") is None
+            rec.on_event(self._event("router_partition", replica="r0"))
+            assert rec.bundles_written == 0
+            assert rec.list_bundles() == []
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------
+
+class TestConfigKnobs:
+    def test_defaults(self):
+        cfg = make_config()
+        assert cfg.trace_propagate is True
+        assert cfg.journey_enabled is True
+        assert cfg.journey_tol == pytest.approx(0.10)
+        assert cfg.fleet_flight_enabled is True
+        assert cfg.fleet_flight_max_bundles == 4
+        assert cfg.fleet_flight_failover_burst == 3
+        # Every knob is introspectable via `config --show`.
+        shown = cfg.to_dict()
+        for key in ("trace_propagate", "journey_enabled",
+                    "journey_tol", "fleet_flight_enabled",
+                    "fleet_flight_dir", "fleet_flight_max_bundles",
+                    "fleet_flight_min_interval_s",
+                    "fleet_flight_failover_burst",
+                    "fleet_flight_window_s"):
+            assert key in shown, key
+
+    @pytest.mark.parametrize("env,needle", [
+        ({"JOURNEY_TOL": "1.5"}, "journey_tol"),
+        ({"JOURNEY_TOL": "0"}, "journey_tol"),
+        ({"FLEET_FLIGHT_DIR": " "}, "fleet_flight_dir"),
+        ({"FLEET_FLIGHT_MAX_BUNDLES": "0"}, "fleet_flight_max_bundles"),
+        ({"FLEET_FLIGHT_MIN_INTERVAL_S": "-1"},
+         "fleet_flight_min_interval_s"),
+        ({"FLEET_FLIGHT_FAILOVER_BURST": "1"},
+         "fleet_flight_failover_burst"),
+        ({"FLEET_FLIGHT_WINDOW_S": "0"}, "fleet_flight_window_s"),
+    ])
+    def test_named_validation_errors(self, env, needle):
+        with pytest.raises(ValueError, match=needle):
+            make_config(**env)
