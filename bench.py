@@ -39,6 +39,15 @@ ratio), the same-slot-count short-context decode tok/s pair (the
 gather/scatter overhead bound, target within 10%), and aliased-prefix
 HBM savings.
 
+``BENCH_MODE=radix`` runs the automatic-prefix-cache scenario
+(docs/KVCACHE.md "Automatic prefix cache"): a multi-turn agent
+workload that re-submits its growing transcript every turn under a
+FRESH session id (the stateless-proxy pattern — same-session resident
+reuse can never serve it), radix on (``KV_RADIX_ENABLED=true``) vs
+off in subprocess-isolated phases — reports follow-up-turn TTFT both
+ways (headline: the speedup, acceptance >= 2x), the tree's hit rate
+and bytes saved.
+
 ``BENCH_MODE=roofline`` runs the measured-vs-ceiling attribution sweep
 (docs/ROOFLINE.md): every decode configuration the compat matrix
 serves — (kv_quant x kv_layout x kernel) cells from
@@ -961,6 +970,139 @@ def bench_paged() -> dict:
             "throughput": {"dense_tok_s": d_tp["tok_s"],
                            "paged_tok_s": p_tp["tok_s"],
                            "ratio": tok_ratio}}
+
+
+# ---------------- radix mode (automatic prefix cache) ----------------
+
+async def _rx_turn(engine, sid: str, messages: list[dict],
+                   max_tokens: int) -> tuple[str, float]:
+    """One agent turn under a FRESH session id, released as soon as it
+    finishes — the stateless-proxy agent pattern: no session affinity,
+    so nothing resident can serve the transcript prefix next turn.
+    Returns (reply text, TTFT ms)."""
+    from fasttalk_tpu.engine.engine import GenerationParams
+
+    t0 = time.monotonic()
+    ttft = None
+    text = ""
+    params = GenerationParams(temperature=0.7, top_k=40, top_p=0.9,
+                              max_tokens=max_tokens)
+    async for ev in engine.generate(f"req-{sid}", sid, messages,
+                                    params):
+        if ev["type"] == "token":
+            if ttft is None:
+                ttft = (time.monotonic() - t0) * 1000.0
+            text += ev["text"]
+        elif ev["type"] == "error":
+            raise RuntimeError(f"generation failed: {ev}")
+    engine.release_session(sid)
+    return text, ttft or 0.0
+
+
+async def _rx_phase(cfg, agents: int, turns: int,
+                    max_tokens: int) -> dict:
+    """One radix phase: ``agents`` concurrent agent transcripts, each
+    re-submitted in full every turn. With the tree on, turn N should
+    alias everything up to turn N-1 and prefill only the delta; off,
+    every turn re-prefills the whole transcript. Reports follow-up
+    (turn >= 2) TTFT and the tree's counters."""
+    from fasttalk_tpu.engine.factory import build_engine
+
+    engine = build_engine(cfg)
+    engine.warmup(cfg.warmup)
+    engine.start()
+    followup_ttfts: list[float] = []
+    try:
+        histories: list[list[dict]] = [
+            [{"role": "user", "content": f"[agent {i}] {PROMPT}"}]
+            for i in range(agents)]
+        # Warmup wave compiles the prefill/decode shapes the
+        # measurement hits, on session ids outside the measured set.
+        await asyncio.gather(*(
+            _rx_turn(engine, f"rxw-{i}",
+                     [{"role": "user", "content": f"[warm {i}] hi"}], 8)
+            for i in range(agents)))
+        reset_slo_after_warmup()
+        for turn in range(turns):
+            results = await asyncio.gather(*(
+                _rx_turn(engine, f"rx-{i}-t{turn}", histories[i],
+                         max_tokens)
+                for i in range(agents)))
+            for i, (text, ttft) in enumerate(results):
+                if turn >= 1:
+                    followup_ttfts.append(ttft)
+                histories[i].append(
+                    {"role": "assistant", "content": text})
+                histories[i].append(
+                    {"role": "user",
+                     "content": f"Next step, please (turn "
+                                f"{turn + 2})."})
+        radix = engine.get_stats().get("kv_radix", {})
+    finally:
+        engine.shutdown()
+    followup_ttfts.sort()
+    n = len(followup_ttfts)
+    return {
+        "followup_turns": n,
+        "followup_ttft_ms": {
+            "p50": round(statistics.median(followup_ttfts), 1)
+            if n else None,
+            "p95": round(followup_ttfts[min(n - 1, int(0.95 * n))], 1)
+            if n else None,
+        },
+        "radix": radix,
+    }
+
+
+def _rx_run_phase_subprocess(phase: str) -> dict:
+    """One radix phase per child process (same isolation rationale as
+    multiturn/longctx: two warmed engines in one process trip the
+    XLA-CPU teardown crash, and fresh processes keep the phases'
+    compile caches and heap symmetric)."""
+    import subprocess
+
+    env = _child_env(BENCH_RX_PHASE=phase)
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"radix phase ({phase}) exited "
+                           f"{proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_radix() -> dict:
+    """The automatic-prefix-cache scenario (docs/KVCACHE.md "Automatic
+    prefix cache"): a growing agent transcript re-submitted every turn
+    under fresh session ids, measured radix off (every turn re-
+    prefills the whole history) and on (turn N aliases the cached
+    chain and prefills only the delta). Each phase runs in its own
+    subprocess."""
+    agents = int(os.environ.get("BENCH_RX_AGENTS", "4"))
+    turns = int(os.environ.get("BENCH_RX_TURNS", "4"))
+
+    log(f"radix: {agents} agents x {turns} turns, fresh session id "
+        f"per turn, KV_RADIX_ENABLED off vs on...")
+    log("--- phase 1/2: radix OFF (re-prefill path) ---")
+    off = _rx_run_phase_subprocess("off")
+    log(f"  off: follow-up TTFT p50/p95 "
+        f"{off['followup_ttft_ms']['p50']}/"
+        f"{off['followup_ttft_ms']['p95']} ms")
+    log("--- phase 2/2: radix ON (alias + delta-prefill path) ---")
+    on = _rx_run_phase_subprocess("on")
+    rx = on.get("radix", {})
+    log(f"  on:  follow-up TTFT p50/p95 "
+        f"{on['followup_ttft_ms']['p50']}/"
+        f"{on['followup_ttft_ms']['p95']} ms, hit rate "
+        f"{rx.get('hit_rate')}, bytes saved {rx.get('bytes_saved')}")
+    speedup = None
+    if off["followup_ttft_ms"]["p50"] and on["followup_ttft_ms"]["p50"]:
+        speedup = round(off["followup_ttft_ms"]["p50"]
+                        / on["followup_ttft_ms"]["p50"], 2)
+    return {"agents": agents, "turns": turns, "off": off, "on": on,
+            "followup_ttft_p50_speedup": speedup,
+            "hit_rate": rx.get("hit_rate"),
+            "hit_tokens": rx.get("hit_tokens"),
+            "bytes_saved": rx.get("bytes_saved")}
 
 
 # ---------------- roofline mode (decode attribution sweep) -------------
@@ -2312,6 +2454,48 @@ def main() -> None:
             "unit": "tok/s",
             "vs_baseline": round(b["tok_s"] / BASELINE_TOKS, 2),
             "roofline": r,
+        }), flush=True)
+        return
+    if MODE == "radix":
+        agents = int(os.environ.get("BENCH_RX_AGENTS", "4"))
+        turns = int(os.environ.get("BENCH_RX_TURNS", "4"))
+        max_tokens = int(os.environ.get("BENCH_RX_MAX_TOKENS", "32"))
+        if os.environ.get("BENCH_RX_PHASE"):
+            # Child process: one phase. Paged layout in BOTH phases
+            # (the tree requires it, and the off control must differ
+            # by exactly one knob); host pool off so park/restore
+            # can't serve the prefix either way.
+            on = os.environ["BENCH_RX_PHASE"] == "on"
+            cfg = Config(llm_provider="tpu", model_name=MODEL,
+                         decode_slots=agents, max_model_len=2048,
+                         default_context_window=2048,
+                         prefill_chunk=512, dtype="bfloat16",
+                         port=PORT, monitoring_port=PORT + 1,
+                         enable_agent=False, spec_decode="off",
+                         kv_host_budget_mb=0.0, kv_layout="paged",
+                         kv_radix_enabled=on,
+                         quantize=os.environ.get("BENCH_QUANTIZE",
+                                                 "int8"))
+            out = asyncio.run(_rx_phase(cfg, agents, turns,
+                                        max_tokens))
+            print(json.dumps(out), flush=True)
+            return
+        r = bench_radix()
+        on_p50 = (r["on"]["followup_ttft_ms"] or {}).get("p50")
+        print(json.dumps({
+            "metric": (f"radix follow-up-turn TTFT p50 ms, {MODEL}: "
+                       f"{r['agents']} agents x {r['turns']} turns, "
+                       f"fresh session per turn (off p50 "
+                       f"{r['off']['followup_ttft_ms']['p50']} ms, "
+                       f"hit rate {r['hit_rate']}, bytes saved "
+                       f"{r['bytes_saved']}, p50 speedup "
+                       f"{r['followup_ttft_p50_speedup']}x)"),
+            "value": on_p50,
+            "unit": "ms",
+            # Baseline is the engine's own full-re-prefill path:
+            # >1 means the tree is winning; acceptance wants >= 2.
+            "vs_baseline": r["followup_ttft_p50_speedup"],
+            "radix": r,
         }), flush=True)
         return
     if MODE == "paged":

@@ -72,6 +72,7 @@ from fasttalk_tpu.kvcache import (HostKVPool, KVOffloader, RestorePolicy,
                                   entry_problem, kv_env_defaults,
                                   strip_device)
 from fasttalk_tpu.kvcache.blocks import BlockAllocator, blocks_for
+from fasttalk_tpu.kvcache.radix import RadixTree
 from fasttalk_tpu.kvcache.offload import (kv_bucket, make_kv_restore_fn,
                                           make_kv_slice_fn,
                                           make_paged_kv_restore_fn,
@@ -381,6 +382,9 @@ class TPUEngine(EngineBase):
                  kv_pool_blocks: int = 0,
                  kv_reserve_policy: str = "fixed",
                  kv_reserve_tokens: int = 128,
+                 kv_radix: bool = False,
+                 kv_radix_min_blocks: int = 0,
+                 kv_radix_evict_policy: str = "lru",
                  structured: str = "auto",
                  structured_max_states: int = 8192,
                  structured_state_budget: int = 16384,
@@ -524,6 +528,32 @@ class TPUEngine(EngineBase):
                 or num_slots * self.max_len // bs
             self._kv_blocks = BlockAllocator(self.kv_pool_blocks, bs,
                                              num_slots)
+        # Radix-tree automatic prefix cache (kvcache/radix.py,
+        # docs/KVCACHE.md "Automatic prefix cache"): retired/parked
+        # sessions donate their clean prefix blocks to a radix tree
+        # keyed by chained block hashes; every admission aliases the
+        # longest cached chain and prefills only the delta. Requires
+        # the paged layout (the tree owns pool blocks) — Config
+        # enforces the same cross-check with a named startup error.
+        if kv_radix and not self.paged:
+            raise ValueError(
+                "KV_RADIX_ENABLED=true requires KV_LAYOUT=paged (the "
+                "radix prefix cache holds device pool blocks; the "
+                "dense layout has no block pool)")
+        self.kv_radix = bool(kv_radix)
+        self._kv_radix: RadixTree | None = None
+        if self.kv_radix:
+            token_row_bytes = (2 * model_cfg.num_layers
+                               * model_cfg.num_kv_heads
+                               * model_cfg.head_dim
+                               * (1 if kv_quant == "int8"
+                                  else jnp.dtype(dtype).itemsize))
+            self._kv_radix = RadixTree(
+                self._kv_blocks,
+                min_free_blocks=max(0, int(kv_radix_min_blocks)),
+                evict_policy=kv_radix_evict_policy,
+                token_bytes=token_row_bytes)
+            self._kv_blocks.set_pressure(self._kv_radix.evict)
         # Worst-case decode-position advances of in-flight calls
         # (paged only): the dispatcher must pre-allocate blocks for
         # where the DEVICE can be, which leads the host mirrors by
@@ -1094,6 +1124,16 @@ class TPUEngine(EngineBase):
                 self._kv_blocks = BlockAllocator(
                     self.kv_pool_blocks, self.kv_block_size,
                     self.num_slots)
+                if self._kv_radix is not None:
+                    # Cached prefix rows died with the cache: rebuild
+                    # the tree empty over the fresh pool (holds in the
+                    # old tree point at the discarded allocator).
+                    self._kv_radix = RadixTree(
+                        self._kv_blocks,
+                        min_free_blocks=self._kv_radix.min_free_blocks,
+                        evict_policy=self._kv_radix.evict_policy,
+                        token_bytes=self._kv_radix.token_bytes)
+                    self._kv_blocks.set_pressure(self._kv_radix.evict)
             self._paged_leads.clear()
             # Quiesce the fetch workers FIRST: the crashed thread's
             # in-flight device calls may still be executing on the
@@ -1375,7 +1415,8 @@ class TPUEngine(EngineBase):
             else:
                 for plen in {g for g in (64, 256) if g <= self.max_len}:
                     self.cache = self._get_prefix_copy_fn(plen)(
-                        self.cache, np.int32(0), np.int32(0))
+                        self.cache, np.int32(0), np.int32(0),
+                        np.int32(0))
             jax.block_until_ready(self.cache.k)
         jax.block_until_ready(self.cache.k)
         # Warm every fetch worker's first device→host copy: on relayed
@@ -1673,6 +1714,8 @@ class TPUEngine(EngineBase):
             used = sum(min(s.kv_written, len(s.tokens))
                        for s in self.slots.slots)
             out["kv_blocks"] = self._kv_blocks.stats(used_tokens=used)
+        if self._kv_radix is not None:
+            out["kv_radix"] = self._kv_radix.stats()
         return out
 
     # ---------------- jitted steps ----------------
@@ -2233,26 +2276,53 @@ class TPUEngine(EngineBase):
         self._spec_fns[key] = spec_call
         return spec_call
 
-    @staticmethod
-    def _share_granule(share: int) -> int:
-        """Round a shared-prefix length down to a power of two (min 16).
+    # Dense-stamp alignment: shares round down to this granule (the
+    # same minimum the slot scan uses), not to a power of two — the r4
+    # pow2 bucketing (_share_granule) wasted up to HALF of a matched
+    # prefix on the stamp path. The executable family stays bounded at
+    # one per pow2 chunk length because _stamp_prefix decomposes the
+    # share into descending pow2 chunks over an offset-parameterized
+    # copy (the offset is a traced operand, not part of the jit key).
+    _STAMP_GRANULE = 16
 
-        The copy executable set is keyed on length; a 16-token granule
-        compiled one executable per distinct share length — an
-        unpredictable synchronous compile stall on the TTFT-critical
-        admission path for heterogeneous system prompts, and up to
-        max_len/16 executables (ADVICE r4). Powers of two bound the set
-        at log2(max_len) ≈ 11 while keeping at least half of any share.
-        """
-        if share < 16:
-            return 0
-        return 1 << (share.bit_length() - 1)
+    @classmethod
+    def _stamp_chunks(cls, share: int) -> list[tuple[int, int]]:
+        """(offset, length) power-of-two chunks exactly covering
+        ``share`` rounded down to the stamp granule. At most
+        log2(max_len) chunks, each >= the granule."""
+        share -= share % cls._STAMP_GRANULE
+        out: list[tuple[int, int]] = []
+        off = 0
+        while off < share:
+            rem = share - off
+            chunk = 1 << (rem.bit_length() - 1)
+            out.append((off, chunk))
+            off += chunk
+        return out
+
+    def _stamp_prefix(self, src: int, dst: int, share: int) -> int:
+        """Dense shared-prefix stamp: copy the source slot's leading
+        rows onto ``dst`` in pow2 chunks (granule-aligned, so at most
+        granule-1 matched tokens are wasted instead of up to half
+        under the old pow2 round-down). Returns rows stamped."""
+        done = 0
+        for off, ln in self._stamp_chunks(share):
+            self._sink("prefix_copy", share=ln, off=off, src=src,
+                       dst=dst)
+            self.cache = self._get_prefix_copy_fn(ln)(
+                self.cache, np.int32(src), np.int32(dst),
+                np.int32(off))
+            done = off + ln
+        return done
 
     def _get_prefix_copy_fn(self, plen: int):
-        """Copy one slot's leading ``plen`` KV rows onto another slot —
-        the shared-prefix stamp. Pure HBM traffic (2·L·plen·Kv·H
-        elements), ordered against prefills and decode calls by the
-        donated-cache chain like every other cache op."""
+        """Copy one slot's KV rows [off, off+plen) onto another slot —
+        one chunk of the shared-prefix stamp. Pure HBM traffic
+        (2·L·plen·Kv·H elements), ordered against prefills and decode
+        calls by the donated-cache chain like every other cache op.
+        The row offset is a traced operand: one executable serves
+        every chunk position, keeping the family at one entry per
+        pow2 chunk length."""
         key = ("pcopy", plen)
         fn = self._prefill_fns.get(key)
         if fn is not None:
@@ -2266,25 +2336,27 @@ class TPUEngine(EngineBase):
         sshape = (self.cfg.num_layers, 1, plen, self.kv_scale_granule)
 
         @partial(jax.jit, donate_argnums=(0,))
-        def prefix_copy(cache: KVCache, src, dst):
-            rk = jax.lax.dynamic_slice(cache.k, (0, src, 0, 0, 0), shape)
-            rv = jax.lax.dynamic_slice(cache.v, (0, src, 0, 0, 0), shape)
+        def prefix_copy(cache: KVCache, src, dst, off):
+            rk = jax.lax.dynamic_slice(cache.k, (0, src, off, 0, 0),
+                                       shape)
+            rv = jax.lax.dynamic_slice(cache.v, (0, src, off, 0, 0),
+                                       shape)
             new_k = jax.lax.dynamic_update_slice(cache.k, rk,
-                                                 (0, dst, 0, 0, 0))
+                                                 (0, dst, off, 0, 0))
             new_v = jax.lax.dynamic_update_slice(cache.v, rv,
-                                                 (0, dst, 0, 0, 0))
+                                                 (0, dst, off, 0, 0))
             if not kvq:
                 return KVCache(new_k, new_v)
-            rks = jax.lax.dynamic_slice(cache.k_scale, (0, src, 0, 0),
-                                        sshape)
-            rvs = jax.lax.dynamic_slice(cache.v_scale, (0, src, 0, 0),
-                                        sshape)
+            rks = jax.lax.dynamic_slice(cache.k_scale,
+                                        (0, src, off, 0), sshape)
+            rvs = jax.lax.dynamic_slice(cache.v_scale,
+                                        (0, src, off, 0), sshape)
             return KVCache(
                 new_k, new_v,
                 jax.lax.dynamic_update_slice(cache.k_scale, rks,
-                                             (0, dst, 0, 0)),
+                                             (0, dst, off, 0)),
                 jax.lax.dynamic_update_slice(cache.v_scale, rvs,
-                                             (0, dst, 0, 0)))
+                                             (0, dst, off, 0)))
 
         self._prefill_fns[key] = prefix_copy
         return prefix_copy
@@ -2650,8 +2722,13 @@ class TPUEngine(EngineBase):
     def _on_slot_unpin(self, slot: Slot) -> None:
         """SlotManager unpin hook: a session leaving its slot (evict
         or release) drops its whole block table — aliased blocks
-        survive through their other referents' refcounts."""
+        survive through their other referents' refcounts. With the
+        radix cache on, the departing session's clean prefix blocks
+        are donated to the tree FIRST (holds taken before the table
+        refs drop), so the next request inherits them instead of
+        re-prefilling."""
         if self.paged:
+            self._radix_insert_slot(slot)
             self._kv_blocks.release(slot.index)
 
     def _paged_table_np(self, nb: int) -> np.ndarray:
@@ -2805,6 +2882,75 @@ class TPUEngine(EngineBase):
                 reused += tail
         return reused
 
+    # ---------------- radix prefix cache ----------------
+    # (kvcache/radix.py; docs/KVCACHE.md "Automatic prefix cache".)
+
+    def _radix_insert_slot(self, slot: Slot) -> int:
+        """Donate a slot's clean (fully written) prefix blocks to the
+        radix tree. The tree takes allocator holds on blocks it did
+        not already cache, so they survive the slot's release. Engine
+        thread only; no device work."""
+        tree = self._kv_radix
+        if tree is None or slot.session_id is None:
+            return 0
+        kept = min(slot.kv_written, len(slot.tokens))
+        if kept < self.kv_block_size:
+            return 0
+        return tree.insert(slot.tokens,
+                           self._kv_blocks.table(slot.index),
+                           written=kept)
+
+    def _radix_admit(self, req: _Request, slot: Slot,
+                     prompt: list[int]) -> int:
+        """Alias the longest radix-cached block chain into this fresh
+        slot (zero device copies; the delta prefills from a block
+        boundary, so no COW is needed at match time). On a tree miss,
+        the legacy cross-slot scan SEEDS the tree — the explicit stamp
+        path is now a thin shim over radix insert — and the match
+        retries. Returns leading prompt tokens now resident (0 = no
+        usable chain, or a longer parked host entry should restore
+        instead)."""
+        tree = self._kv_radix
+        if tree is None:
+            return 0
+        bs = self.kv_block_size
+        # At least one prompt token must run through the model (same
+        # trust rule as every other reuse path).
+        max_blocks = (len(prompt) - 1) // bs
+        if max_blocks <= 0:
+            return 0
+        blocks, _digest = tree.match(prompt, max_blocks=max_blocks)
+        matched = len(blocks) * bs
+        if self.shared_prefix and matched < max_blocks * bs:
+            # Tree shorter than another slot's resident prefix: donate
+            # that slot's clean blocks, then match again.
+            src, share = self.slots.best_shared_prefix(slot, prompt)
+            if src is not None \
+                    and min(share, src.kv_written) // bs * bs > matched:
+                self._radix_insert_slot(src)
+                blocks, _digest = tree.match(
+                    prompt, max_blocks=max_blocks, count=False)
+                matched = len(blocks) * bs
+        if matched < bs:
+            return 0
+        if self._kv_pool.enabled:
+            # Host-offload interplay: a LONGER parked entry for this
+            # session wins — one H2D copy beats prefilling the extra
+            # delta; _try_restore runs next in the caller.
+            entry = self._kv_pool.get(req.session_id)
+            if entry is not None:
+                hm = _lcp(entry.tokens, prompt,
+                          min(entry.kept, len(prompt) - 1))
+                if hm > matched and self._kv_policy.should_restore(
+                        hm, entry.nbytes):
+                    return 0
+        self._kv_blocks.alias_blocks(slot.index, blocks)
+        slot.tokens = list(prompt[:matched])
+        slot.kv_written = matched
+        tree.note_hit(matched)
+        self._m_shared.inc(matched)
+        return matched
+
     def _paged_reserve_tokens(self, req: _Request) -> int:
         """Decode-growth reserve the admission check must see free
         (KV_RESERVE_POLICY): 'fixed' covers the next
@@ -2839,7 +2985,13 @@ class TPUEngine(EngineBase):
                           + self._paged_reserve_tokens(req))
         need = blocks_for(need_tokens, bs) \
             - self._kv_blocks.slot_blocks(slot.index)
-        if need <= self._kv_blocks.available():
+        avail = self._kv_blocks.available()
+        if self._kv_radix is not None:
+            # Unreferenced radix-held blocks are reclaimable on demand
+            # (the allocator's pressure callback evicts them inside
+            # _take), so admission counts them as free.
+            avail += self._kv_radix.evictable_blocks()
+        if need <= avail:
             return True
         self._paged_exhausted_finish(
             req, f"KV block pool exhausted: prompt needs {need} more "
@@ -2874,7 +3026,10 @@ class TPUEngine(EngineBase):
         if not self.paged:
             return 0.0
         need = blocks_for(prompt_len, self.kv_block_size)
-        if need <= self._kv_blocks.available():
+        avail = self._kv_blocks.available()
+        if self._kv_radix is not None:
+            avail += self._kv_radix.evictable_blocks()
+        if need <= avail:
             return 0.0
         return self._paged_retry_after()
 
@@ -3881,20 +4036,30 @@ class TPUEngine(EngineBase):
                 reused = min(reused, slot.kv_written)
             if reused:
                 self._m_prefix.inc(reused)
-            elif (restored := self._try_restore(req, slot, prompt)):
+            if not reused:
+                # Radix prefix cache (kvcache/radix.py): alias the
+                # longest cached block chain — zero device copies,
+                # zero explicit registration. Defers internally to a
+                # LONGER parked host entry (restore beats prefilling
+                # the extra delta).
+                reused = self._radix_admit(req, slot, prompt)
+            if not reused and (restored := self._try_restore(req, slot,
+                                                             prompt)):
                 # Host-offload tier: the session's kept prefix came
                 # back from host RAM — only the token delta prefills
                 # below, composing with the delta path exactly like
                 # slot-resident reuse.
                 reused = restored
-            elif self.shared_prefix:
+            if not reused and self.shared_prefix:
                 # Fresh slot: stamp the longest prefix resident in any
                 # OTHER slot (common system prompt across sessions)
-                # instead of re-prefilling it. Rounded down to a
-                # power-of-two granule so the copy executable set stays
-                # bounded (_share_granule). The source's rows [0:share)
-                # are stable: its own writes only ever target positions
-                # >= its kept length.
+                # instead of re-prefilling it, aligned to the 16-token
+                # stamp granule (_stamp_prefix decomposes the share
+                # into pow2 chunks, so the copy executable family
+                # stays bounded without the old pow2 round-down that
+                # wasted up to half the match). The source's rows
+                # [0:share) are stable: its own writes only ever
+                # target positions >= its kept length.
                 src, share = self.slots.best_shared_prefix(slot, prompt)
                 if self.paged:
                     # Paged tier: block ALIASING, not row copies — the
@@ -3909,17 +4074,15 @@ class TPUEngine(EngineBase):
                         reused = aliased
                         self._m_shared.inc(aliased)
                     src = None  # the dense stamp below must not run
-                share = self._share_granule(share)
-                if src is not None and share >= 16:
-                    self._sink("prefix_copy", share=share,
-                               src=src.index, dst=slot.index)
-                    self.cache = self._get_prefix_copy_fn(share)(
-                        self.cache, np.int32(src.index),
-                        np.int32(slot.index))
-                    slot.tokens = list(prompt[:share])
-                    slot.kv_written = share
-                    reused = share
-                    self._m_shared.inc(share)
+                if src is not None \
+                        and share >= self._STAMP_GRANULE:
+                    stamped = self._stamp_prefix(src.index, slot.index,
+                                                 share)
+                    if stamped:
+                        slot.tokens = list(prompt[:stamped])
+                        slot.kv_written = stamped
+                        reused = stamped
+                        self._m_shared.inc(stamped)
             todo = prompt[reused:]
             req.prefill_tokens = len(todo)  # restore-policy cost feed
             if reused + len(todo) > self.usable_len:
@@ -4141,7 +4304,7 @@ class TPUEngine(EngineBase):
                     continue
                 pt = item[0].prompt_tokens
                 share = _lcp(lp, pt, min(len(lp), len(pt) - 1))
-                share = self._share_granule(share)
+                share -= share % self._STAMP_GRANULE
                 if share < self._INTRA_SHARE_MIN:
                     continue
                 # Sharing must actually shrink the member's prefill
@@ -4172,7 +4335,8 @@ class TPUEngine(EngineBase):
             # re-check the delta-bucket fit, since a SMALLER share
             # means a LARGER delta whose bucket may no longer fit at
             # the new start.
-            share = self._share_granule(min(share, lslot.kv_written))
+            share = min(share, lslot.kv_written)
+            share -= share % self._STAMP_GRANULE
             delta_b = next(
                 (b for b in _PREFILL_BUCKETS
                  if b >= max(1, len(req.prompt_tokens) - share)), None)
@@ -4181,10 +4345,7 @@ class TPUEngine(EngineBase):
                     or share + delta_b > self.max_len:
                 second.append((req, slot, 0, req.prompt_tokens))
                 continue
-            self._sink("prefix_copy", share=share, src=lslot.index,
-                       dst=slot.index)
-            self.cache = self._get_prefix_copy_fn(share)(
-                self.cache, np.int32(lslot.index), np.int32(slot.index))
+            share = self._stamp_prefix(lslot.index, slot.index, share)
             slot.tokens = list(req.prompt_tokens[:share])
             slot.kv_written = share
             self._m_shared.inc(share)
@@ -4956,6 +5117,13 @@ class TPUEngine(EngineBase):
                 # before any reallocation's writes (in-order dispatch
                 # stream, old table captured at dispatch).
                 self._kv_blocks.truncate(slot.index, slot.kv_written)
+                # Donate the finished turn's clean prefix to the radix
+                # tree NOW (kv_written just settled): the next request
+                # — any session sharing this prefix, not just this one
+                # — inherits the blocks with zero registration. Runs
+                # before the deferred release below so the holds land
+                # while the table refs still pin the blocks.
+                self._radix_insert_slot(slot)
             sid = slot.session_id
             if sid is not None and sid in self._release_after:
                 self._release_after.discard(sid)

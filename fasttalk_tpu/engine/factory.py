@@ -411,6 +411,9 @@ def build_engine(cfg: Config) -> EngineBase:
         kv_pool_blocks=acct["kv_pool_blocks"],
         kv_reserve_policy=cfg.kv_reserve_policy,
         kv_reserve_tokens=cfg.kv_reserve_tokens,
+        kv_radix=cfg.kv_radix_enabled,
+        kv_radix_min_blocks=cfg.kv_radix_min_blocks,
+        kv_radix_evict_policy=cfg.kv_radix_evict_policy,
         structured=cfg.structured_mode,
         structured_max_states=cfg.structured_max_states,
         structured_state_budget=cfg.structured_state_budget,

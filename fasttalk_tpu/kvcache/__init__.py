@@ -12,12 +12,16 @@ O(host→device copy + delta prefill).
 - offload.py  — the dedicated copy thread + length-bucketed jitted
   device↔host copy programs
 - policy.py   — the park/restore decision (copy cost vs prefill cost)
+- blocks.py   — paged-tier block allocator (KV_LAYOUT=paged)
+- radix.py    — radix-tree automatic prefix cache over the block pool
 """
 
 from fasttalk_tpu.kvcache.hostpool import (HostKVPool, ParkedKV,
                                            entry_problem, strip_device)
 from fasttalk_tpu.kvcache.offload import KVOffloader
 from fasttalk_tpu.kvcache.policy import RestorePolicy, kv_env_defaults
+from fasttalk_tpu.kvcache.radix import RadixTree, chain_digest
 
 __all__ = ["HostKVPool", "ParkedKV", "KVOffloader", "RestorePolicy",
-           "kv_env_defaults", "entry_problem", "strip_device"]
+           "kv_env_defaults", "entry_problem", "strip_device",
+           "RadixTree", "chain_digest"]

@@ -21,11 +21,20 @@ copy (copy-on-write, driven by the engine). A block returns to the free
 list when its last referent drops it — eviction, truncation on history
 divergence, or session release.
 
+Beyond slot tables, a block may carry *holds* — references owned by a
+non-slot structure (the radix prefix tree, kvcache/radix.py). A hold is
+one refcount like any table entry; ``hold``/``unhold`` maintain them,
+and the pressure callback installed with ``set_pressure`` lets the
+holder shed refcount-free holds when ``_take`` would otherwise raise,
+so cached-but-unreferenced prefix blocks are reclaimed before a live
+admission is shed.
+
 Invariant (asserted by ``check_leaks``): every block is either on the
-free list with refcount 0, or appears in tables with multiplicity equal
-to its refcount. ``kv.block_alloc`` is a chaos failpoint at the single
-place blocks are taken from the free list, so pool exhaustion mid-
-prefill is a rehearsed incident, not a novel one (docs/RESILIENCE.md).
+free list with refcount 0, or its refcount equals its table
+multiplicity plus its hold multiplicity. ``kv.block_alloc`` is a chaos
+failpoint at the single place blocks are taken from the free list, so
+pool exhaustion mid-prefill is a rehearsed incident, not a novel one
+(docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
@@ -81,6 +90,15 @@ class BlockAllocator:
             "token rows (allocation granularity waste)")
         self._m_total.set(num_blocks)
         self._aliased = 0
+        # Non-slot references (block id -> hold multiplicity), owned by
+        # the radix prefix cache. Counted inside _ref like table
+        # entries; kept separately so check_leaks can prove the split.
+        self._held: dict[int, int] = {}
+        # Invoked by _take when the free list cannot cover a request:
+        # cb(shortfall_blocks) should release holds (via unhold) and
+        # may return the number of blocks it freed. Installed by the
+        # engine when the radix cache is on.
+        self._pressure = None
         self._update_gauges()
 
     # ---------------- queries ----------------
@@ -108,6 +126,13 @@ class BlockAllocator:
     def block_shared(self, slot: int, index: int) -> bool:
         return self._ref[self._tables[slot][index]] > 1
 
+    def ref(self, blk: int) -> int:
+        return self._ref[blk]
+
+    def held(self) -> int:
+        """Distinct blocks currently carrying at least one hold."""
+        return len(self._held)
+
     # ---------------- allocation ----------------
 
     def _take(self, n: int) -> list[int]:
@@ -118,6 +143,10 @@ class BlockAllocator:
             return []
         if _fp.enabled:
             _fp.fire("kv.block_alloc", exc=BlockExhausted, need=str(n))
+        if n > len(self._free) and self._pressure is not None:
+            # Reclaim radix-held blocks before declaring exhaustion —
+            # the callback unholds LRU cached prefixes, growing _free.
+            self._pressure(n - len(self._free))
         if n > len(self._free):
             raise BlockExhausted(
                 f"KV block pool exhausted: need {n} blocks, "
@@ -182,6 +211,43 @@ class BlockAllocator:
         """Drop the slot's whole table (unpin/eviction/release)."""
         self.truncate(slot, 0)
 
+    # ---------------- holds (radix prefix cache) ----------------
+
+    def set_pressure(self, cb) -> None:
+        """Install the reclaim-under-pressure callback (or None).
+        ``cb(shortfall)`` runs inside ``_take`` when the free list is
+        short, after the chaos failpoint and before the exhaustion
+        raise; it should ``unhold`` cached blocks to grow the pool."""
+        self._pressure = cb
+
+    def hold(self, blocks: list[int]) -> None:
+        """Take one non-slot reference on each (live) block. The
+        holder keeps the rows alive after every slot table drops
+        them."""
+        for blk in blocks:
+            ref = self._ref[blk]
+            assert ref > 0, f"hold on free KV block {blk}"
+            if ref == 1:
+                self._aliased += 1
+            self._ref[blk] = ref + 1
+            self._held[blk] = self._held.get(blk, 0) + 1
+        if blocks:
+            self._update_gauges()
+
+    def unhold(self, blocks: list[int]) -> None:
+        """Release one hold per block; blocks whose last reference
+        this was return to the free list."""
+        for blk in blocks:
+            h = self._held.get(blk, 0)
+            assert h > 0, f"unhold without hold on KV block {blk}"
+            if h == 1:
+                del self._held[blk]
+            else:
+                self._held[blk] = h - 1
+            self._drop(blk)
+        if blocks:
+            self._update_gauges()
+
     # ---------------- aliasing (shared prefix) ----------------
 
     def alias(self, src_slot: int, dst_slot: int, n_blocks: int) -> int:
@@ -201,6 +267,24 @@ class BlockAllocator:
             self.alias_events += 1
             self._update_gauges()
         return n
+
+    def alias_blocks(self, dst_slot: int, blocks: list[int]) -> int:
+        """Share an explicit block chain (radix-tree match) into the
+        (empty) destination table, bumping refcounts. Returns blocks
+        aliased."""
+        dst = self._tables[dst_slot]
+        assert not dst, "alias target must be a fresh (empty) table"
+        for blk in blocks:
+            ref = self._ref[blk]
+            assert ref > 0, f"alias of free KV block {blk}"
+            if ref == 1:
+                self._aliased += 1
+            self._ref[blk] = ref + 1
+            dst.append(blk)
+        if blocks:
+            self.alias_events += 1
+            self._update_gauges()
+        return len(blocks)
 
     def cow_tail(self, slot: int) -> tuple[int, int] | None:
         """Copy-on-write the slot's tail block: swap the (shared) last
@@ -244,6 +328,7 @@ class BlockAllocator:
             "aliased": self._aliased,
             "alias_events": self.alias_events,
             "cow_copies": self.cow_copies,
+            "held": len(self._held),
             "tables": [len(t) for t in self._tables],
         }
         if used_tokens is not None:
@@ -256,18 +341,22 @@ class BlockAllocator:
 
     def check_leaks(self) -> None:
         """Assert the pool invariant: refcounts equal table
-        multiplicity and free+referenced covers every block exactly.
-        Test/debug surface — O(blocks + table entries)."""
+        multiplicity plus hold multiplicity, and free+referenced
+        covers every block exactly. Test/debug surface —
+        O(blocks + table entries)."""
         mult: dict[int, int] = {}
         for t in self._tables:
             for blk in t:
                 mult[blk] = mult.get(blk, 0) + 1
+        for blk, h in self._held.items():
+            assert h > 0, f"block {blk}: zero-multiplicity hold entry"
+            mult[blk] = mult.get(blk, 0) + h
         free = set(self._free)
         assert len(free) == len(self._free), "free-list duplicates"
         for blk in range(self.num_blocks):
             ref = self._ref[blk]
             assert mult.get(blk, 0) == ref, \
-                f"block {blk}: refcount {ref} != table multiplicity " \
-                f"{mult.get(blk, 0)}"
+                f"block {blk}: refcount {ref} != table+hold " \
+                f"multiplicity {mult.get(blk, 0)}"
             assert (blk in free) == (ref == 0), \
                 f"block {blk}: ref {ref} but free={blk in free}"

@@ -401,7 +401,8 @@ def follower_loop(engine, host: str, port: int,
                     e._arg(p["cfg_row"]))
         elif kind == "prefix_copy":
             e.cache = e._get_prefix_copy_fn(p["share"])(
-                e.cache, np.int32(p["src"]), np.int32(p["dst"]))
+                e.cache, np.int32(p["src"]), np.int32(p["dst"]),
+                np.int32(p.get("off", 0)))
         elif kind == "patch":
             (e._counts_dev, e._positions_dev, e._active_dev,
              e._temps_dev, e._topks_dev, e._topps_dev, e._reps_dev,

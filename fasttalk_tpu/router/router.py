@@ -51,13 +51,13 @@ is by character count of delivered text.
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import threading
 import time
 from typing import Any, AsyncGenerator
 
 from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
 from fasttalk_tpu.kvcache import RestorePolicy, kv_env_defaults
+from fasttalk_tpu.kvcache.radix import chain_digest
 from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.observability.trace import (current_traceparent,
                                               get_tracer)
@@ -587,20 +587,42 @@ class FleetRouter(EngineBase):
             self._m_affinity_hits.inc()
         return handle
 
-    @staticmethod
-    def _prefix_key(messages: list[dict]) -> str | None:
-        """Shared-prefix identity of a request: the system prompt's
-        hash (tenants sharing one co-locate to hit the prefix stamp).
-        None when there is no system message — generic traffic spreads
-        least-loaded as before."""
-        for m in messages:
-            if m.get("role") == "system":
-                content = m.get("content") or ""
-                if content:
-                    return hashlib.sha1(
-                        content.encode("utf-8", "replace")).hexdigest()[:16]
-                return None
-        return None
+    # Chained-digest parameters mirroring the engine-side radix
+    # prefix cache (kvcache/radix.py): fixed char blocks, each link
+    # committing to everything before it, capped at a small depth.
+    # ~1 KB of leading content covers the system prompt + few-shot
+    # header in practice and is STABLE as a transcript grows, so
+    # every turn of an agent loop maps to the same key.
+    _PREFIX_CHAIN_CHARS = 256
+    _PREFIX_CHAIN_DEPTH = 4
+
+    @classmethod
+    def _prefix_key(cls, messages: list[dict]) -> str | None:
+        """Radix chain-hash prefix of the request's leading history
+        (every message before the final turn, system prompt included):
+        chained sha1 over fixed char blocks, the same chaining scheme
+        the engine's radix tree uses over token blocks, so requests
+        sharing it co-locate onto the replica most likely to already
+        hold their cached prefix blocks. Upgrades the old system-
+        prompt-only sha1: multi-turn transcripts without a system
+        message now co-locate too. None when there is no leading
+        content — bare single-turn traffic spreads least-loaded as
+        before."""
+        head = messages[:-1] if messages else []
+        text = "".join(
+            f"{m.get('role', '')}\x1f{m.get('content') or ''}\x1e"
+            for m in head)
+        if not text.strip("\x1f\x1e"):
+            return None
+        digest = ""
+        for i in range(cls._PREFIX_CHAIN_DEPTH):
+            chunk = text[i * cls._PREFIX_CHAIN_CHARS:
+                         (i + 1) * cls._PREFIX_CHAIN_CHARS]
+            if not chunk:
+                break
+            digest = chain_digest(digest,
+                                  chunk.encode("utf-8", "replace"))
+        return digest[:16]
 
     def _failover_migrate(self, session_id: str, src: ReplicaHandle,
                           dst: ReplicaHandle,

@@ -946,6 +946,10 @@ class WebSocketLLMServer:
                         stats.get("tokens_per_second") or 0.0,
                     "ttft_ms": stats.get("ttft_ms"),
                     "prompt_tokens": stats.get("prompt_tokens"),
+                    # Tokens actually prefilled after prefix-cache /
+                    # restore reuse; == prompt_tokens when nothing was
+                    # reused, None on remote backends.
+                    "prefill_tokens": stats.get("prefill_tokens"),
                     "finish_reason": "cancelled" if cancelled
                     else finish_reason,
                     "provider": self.config.llm_provider,

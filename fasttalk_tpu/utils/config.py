@@ -566,6 +566,29 @@ class Config:
         default_factory=lambda: _env_str("KV_RESERVE_POLICY", "fixed"))
     kv_reserve_tokens: int = field(
         default_factory=lambda: _env_int("KV_RESERVE_TOKENS", 128))
+    # ---- Radix automatic prefix cache (kvcache/radix.py,
+    # docs/KVCACHE.md "Automatic prefix cache") ----
+    # Retired/parked sessions donate their clean prefix blocks to a
+    # radix tree keyed by chained block hashes; every admission
+    # silently aliases the longest cached chain and prefills only the
+    # delta — zero explicit registration. Requires KV_LAYOUT=paged
+    # (the tree holds device pool blocks; validated below with a
+    # named error). Cached blocks are reclaimed LRU-first under pool
+    # pressure before any live admission is shed.
+    kv_radix_enabled: bool = field(
+        default_factory=lambda: _env_bool("KV_RADIX_ENABLED", False))
+    # Free-block headroom the cache must leave after an insert: the
+    # tree evicts itself down to this floor so cached prefixes never
+    # crowd out the next admission. 0 = rely on pressure eviction
+    # alone. Must be < KV_POOL_BLOCKS when that is set.
+    kv_radix_min_blocks: int = field(
+        default_factory=lambda: _env_int("KV_RADIX_MIN_BLOCKS", 0))
+    # "lru" (default): evict least-recently-matched leaves first;
+    # "fifo": oldest-inserted first (cheap scans, agent workloads
+    # where recency ≈ insertion order anyway).
+    kv_radix_evict_policy: str = field(
+        default_factory=lambda: _env_str("KV_RADIX_EVICT_POLICY",
+                                         "lru"))
     # ---- Structured decoding (fasttalk_tpu/structured/,
     # docs/STRUCTURED.md) ----
     # "auto" (default): constrained requests are served whenever the
@@ -1038,6 +1061,25 @@ class Config:
                 errs.append(
                     f"kv_block_size ({self.kv_block_size}) must not "
                     f"exceed max_model_len ({self.max_model_len})")
+        # Radix prefix-cache compat matrix (docs/KVCACHE.md "Automatic
+        # prefix cache"): named startup errors, mirrored in the engine.
+        if self.kv_radix_enabled and self.kv_layout != "paged":
+            errs.append(
+                "KV_RADIX_ENABLED=true requires KV_LAYOUT=paged (the "
+                "radix prefix cache holds device pool blocks; the "
+                "dense layout has no block pool to cache into)")
+        if self.kv_radix_min_blocks < 0:
+            errs.append("kv_radix_min_blocks must be >= 0")
+        elif self.kv_radix_enabled and self.kv_pool_blocks \
+                and self.kv_radix_min_blocks >= self.kv_pool_blocks:
+            errs.append(
+                f"kv_radix_min_blocks ({self.kv_radix_min_blocks}) "
+                f"must be < kv_pool_blocks ({self.kv_pool_blocks}) — "
+                "a headroom floor covering the whole pool leaves the "
+                "cache nothing to hold")
+        if self.kv_radix_evict_policy not in ("lru", "fifo"):
+            errs.append(f"kv_radix_evict_policy must be lru|fifo, "
+                        f"got {self.kv_radix_evict_policy!r}")
         if self.structured_mode not in ("auto", "on", "off"):
             errs.append(f"structured_mode must be auto|on|off, "
                         f"got {self.structured_mode!r}")

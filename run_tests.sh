@@ -42,6 +42,17 @@
 #                             kv.block_alloc chaos drill, and the
 #                             failpoint lint (docs/KVCACHE.md "Paged
 #                             tier").
+#   ./run_tests.sh --radix    radix prefix-cache group
+#                             (KV_RADIX_ENABLED=true): chain-digest /
+#                             insert / match / split units, refcount-
+#                             aware LRU+FIFO eviction with exact
+#                             accounting, the allocator pressure seam,
+#                             cross-session automatic admission with
+#                             greedy parity (incl. the trained
+#                             tinychat multi-turn O(delta) prefill),
+#                             crash-restart tree rebuild, and the two
+#                             radix chaos drills (docs/KVCACHE.md
+#                             "Automatic prefix cache").
 #   ./run_tests.sh --slo      SLO/watchdog group: burn-rate windows,
 #                             goodput, the fake-clock stall watchdog,
 #                             /slo + /events endpoints, the strict
@@ -219,6 +230,33 @@ if [[ "${1:-}" == "--paged" ]]; then
     "${PYENV[@]}" python -m pytest tests/test_paged_kv.py \
         "tests/test_chaos.py::TestKVChaos::test_block_alloc_exhaustion_sheds_with_exact_accounting" \
         "$@"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--radix" ]]; then
+    shift
+    # Radix automatic prefix cache over the block pool (ISSUE 17,
+    # docs/KVCACHE.md "Automatic prefix cache"): tree units + the
+    # slow engine suites (cross-session hits with zero registration,
+    # O(delta) multi-turn prefill on trained weights, pressure
+    # eviction) + the chaos drills proving the failpoint fires before
+    # eviction and refcounted blocks are never reclaimed. Failpoint
+    # lint first, same bar as --paged.
+    "${PYENV[@]}" python scripts/check_failpoints.py
+    "${PYENV[@]}" python -m pytest tests/test_radix_kv.py \
+        "tests/test_chaos.py::TestKVChaos::test_block_alloc_failpoint_fires_before_radix_eviction" \
+        "tests/test_chaos.py::TestKVChaos::test_radix_pressure_never_evicts_refcounted_blocks" \
+        "$@"
+    echo "--- BENCH_MODE=radix smoke (2 agents x 3 turns, test model,"
+    echo "    radix off vs on; one JSON line on stdout) ---"
+    out="$("${PYENV[@]}" env BENCH_MODE=radix BENCH_MODEL=test-tiny \
+        BENCH_RX_AGENTS=2 BENCH_RX_TURNS=3 BENCH_RX_MAX_TOKENS=8 \
+        BENCH_QUANTIZE=none python bench.py)"
+    echo "$out"
+    for want in followup_ttft_p50_speedup hit_rate bytes_saved; do
+        grep -q "$want" <<<"$out" \
+            || { echo "radix bench smoke: missing '$want'" >&2; exit 1; }
+    done
     exit 0
 fi
 

@@ -644,6 +644,96 @@ class TestKVChaos:
             fp.clear()
             e.shutdown()
 
+    def test_block_alloc_failpoint_fires_before_radix_eviction(self):
+        """Radix prefix cache (kvcache/radix.py): the kv.block_alloc
+        failpoint fires BEFORE the allocator's pressure callback, so an
+        injected exhaustion must shed WITHOUT evicting a single cached
+        block — tree holds and refcounts exactly as it found them.
+        With the fault cleared, the same admission reclaims cached
+        blocks through the pressure seam instead of shedding."""
+        e = _make_engine(kv_layout="paged", kv_block_size=16,
+                         kv_pool_blocks=12, kv_radix=True,
+                         kv_reserve_policy="none",
+                         kv_host_budget_mb=0.0)
+        try:
+            alloc = e._kv_blocks
+            tree = e._kv_radix
+            done = _collect(e, "rx1", "RX", MSG_A)
+            _assert_one_terminal(done, "done")
+            e.release_session("RX")
+            assert _wait(lambda: e.slots.lookup("RX") is None)
+            assert _wait(lambda: tree.stats()["blocks"] > 0)
+            held0 = alloc.held()
+            fp.activate("kv.block_alloc=error;count=1")
+            msg_b = [{"role": "user", "content": "z" * 120}]
+            events = _collect(e, "rx2", "RY", msg_b)
+            _assert_one_terminal(events, "error",
+                                 code="kv_blocks_exhausted")
+            assert events[-1]["retry_after"] > 0
+            # The injected failure never reached the pressure seam:
+            # zero evictions, every hold still in place.
+            assert tree.stats()["evicted_blocks"] == 0
+            assert alloc.held() == held0
+            tree.check_integrity()
+            alloc.check_leaks()
+            fp.clear()
+            # Real pressure now: the pool is mostly tree-held, the
+            # prompt shares no prefix — admission must evict LRU
+            # cached blocks rather than shed.
+            events = _collect(e, "rx3", "RY", msg_b)
+            _assert_one_terminal(events, "done")
+            st = tree.stats()
+            assert st["evicted_blocks"] > 0
+            # Exact hold accounting (the finished request donated its
+            # own blocks at retirement, so balance the full ledger):
+            # every hold ever taken came from an insert, every one
+            # released from an eviction.
+            assert alloc.held() == \
+                st["inserted_blocks"] - st["evicted_blocks"]
+            tree.check_integrity()
+            alloc.check_leaks()
+        finally:
+            fp.clear()
+            e.shutdown()
+
+    def test_radix_pressure_never_evicts_refcounted_blocks(self):
+        """Mid-admission exhaustion with the whole tree slot-aliased:
+        blocks at refcount >= 2 (a live slot still reads them) are
+        untouchable, so the admission sheds rather than corrupt a
+        resident session — which keeps decoding correctly after."""
+        e = _make_engine(kv_layout="paged", kv_block_size=16,
+                         kv_pool_blocks=12, kv_radix=True,
+                         kv_reserve_policy="none",
+                         kv_host_budget_mb=0.0)
+        try:
+            alloc = e._kv_blocks
+            tree = e._kv_radix
+            msg_a = [{"role": "user", "content": "a" * 100}]
+            r1 = _text(_collect(e, "rp1", "RA", msg_a))
+            # RA stays RESIDENT: its donated blocks are ref 2
+            # (slot table + tree hold) — nothing is evictable.
+            assert _wait(lambda: tree.stats()["blocks"] > 0)
+            assert tree.evictable_blocks() == 0
+            held0 = alloc.held()
+            events = _collect(e, "rp2", "RB",
+                              [{"role": "user", "content": "b" * 100}])
+            _assert_one_terminal(events, "error",
+                                 code="kv_blocks_exhausted")
+            assert tree.stats()["evicted_blocks"] == 0
+            assert alloc.held() == held0
+            alloc.check_leaks()
+            # The pinned session was not corrupted: its next turn
+            # decodes from the still-held blocks.
+            msg2 = msg_a + [{"role": "assistant", "content": r1},
+                            {"role": "user", "content": "go on"}]
+            events = _collect(e, "rp3", "RA", msg2, max_tokens=4)
+            _assert_one_terminal(events, "done")
+            tree.check_integrity()
+            alloc.check_leaks()
+        finally:
+            fp.clear()
+            e.shutdown()
+
 
 # ---------------------------------------------------------------------
 # Remote backend chaos
