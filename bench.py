@@ -82,6 +82,14 @@ engine-restart MTTR p50 over injected crash_thread drills
 (3) router failover resume-latency p50 (kill a replica mid-decode
 under a FakeEngine fleet — the routing layer's recovery deadline).
 
+``BENCH_MODE=profiler`` runs the continuous-profiler overhead control
+(docs/OBSERVABILITY.md "Continuous profiler and program attribution"):
+decode tok/s with the host stack sampler off vs on at ``PROF_HZ``,
+pairwise-interleaved like the chaos failpoints control — the headline
+is the median on/off delta (target |delta| < 1%), reported next to the
+host-gap cause decomposition and per-program attribution the ON
+phases produced.
+
 ``BENCH_MODE=overload`` runs the admission-control scenario
 (docs/SCHEDULING.md): an OPEN-LOOP arrival process (one request every
 ``BENCH_ARRIVAL_MS`` ms for ``BENCH_OVERLOAD_S`` s, regardless of
@@ -2194,6 +2202,110 @@ def bench_chaos_main() -> dict:
     }
 
 
+# ---------------- profiler mode (sampler overhead control) -------------
+
+async def bench_profiler(engine) -> dict:
+    """The continuous-profiler zero-overhead control
+    (docs/OBSERVABILITY.md "Continuous profiler and program
+    attribution"): decode throughput with the host stack sampler OFF
+    vs ON at PROF_HZ must agree within 1% — the contract that lets the
+    sampler ship enabled in production. Same pairwise-interleaved
+    design as the failpoints control (bench_chaos): warm until two
+    consecutive phases agree, then take the median of back-to-back
+    on/off ratios with alternating order (drift within a pair is
+    small; alternation cancels its direction; the median rejects
+    outlier pairs). The ON phases feed the host-gap cause
+    decomposition, so the result also carries host_gap_causes and the
+    per-program attribution next to the delta."""
+    from fasttalk_tpu.observability import profiler as profmod
+    from fasttalk_tpu.observability.perf import get_perf
+
+    log("warmup (compiling prefill + decode buckets)...")
+    t0 = time.monotonic()
+    await run_session(engine, 999, max_tokens=8)
+    engine.release_session("bench-sess-999")
+    await asyncio.gather(
+        *(run_session(engine, 900 + i, max_tokens=8)
+          for i in range(NUM_SESSIONS)))
+    for i in range(NUM_SESSIONS):
+        engine.release_session(f"bench-sess-{900 + i}")
+    log(f"warmup done in {time.monotonic() - t0:.1f}s")
+    reset_slo_after_warmup()
+
+    # This mode exists to measure the sampler, so it runs enabled
+    # regardless of the ambient PROF_ENABLED — through the singleton,
+    # so the perf ledger's host_gap_causes block sees its samples.
+    os.environ["PROF_ENABLED"] = "true"
+    profmod.reset_profiler()
+    prof = profmod.get_profiler()
+
+    async def tps_phase() -> float:
+        # Several waves per phase (see bench_chaos.tps_phase for why).
+        waves = int(os.environ.get("BENCH_PROF_WAVES", "3"))
+        t0 = time.monotonic()
+        tokens = 0
+        for _ in range(waves):
+            results = await asyncio.gather(
+                *(run_session(engine, i, MAX_TOKENS)
+                  for i in range(NUM_SESSIONS)))
+            tokens += sum(r["tokens"] for r in results)
+        wall = time.monotonic() - t0
+        for i in range(NUM_SESSIONS):
+            engine.release_session(f"bench-sess-{i}")
+        return tokens / wall
+
+    async def on_phase() -> float:
+        prof.start()
+        try:
+            return await tps_phase()
+        finally:
+            prof.stop()
+
+    log(f"control phases: sampler off vs on ({prof.hz:g} Hz)...")
+    prev = await tps_phase()
+    for _ in range(8):  # warm until stable
+        cur = await tps_phase()
+        if abs(cur - prev) / prev < 0.05:
+            break
+        prev = cur
+
+    off_tps: list[float] = []
+    on_tps: list[float] = []
+    ratios: list[float] = []
+    for k in range(6):
+        if k % 2 == 0:
+            o = await tps_phase()
+            a = await on_phase()
+        else:
+            a = await on_phase()
+            o = await tps_phase()
+        off_tps.append(o)
+        on_tps.append(a)
+        ratios.append(a / o)
+    tps_off = statistics.median(off_tps)
+    tps_on = statistics.median(on_tps)
+    delta = statistics.median(ratios) - 1.0
+    log(f"  off {tps_off:.1f} tok/s vs sampling {tps_on:.1f} tok/s: "
+        f"delta {delta:+.2%} (target |delta| < 1%)")
+
+    rep = prof.report(top=5)
+    perf = get_perf().summary()
+    return {
+        "control": {
+            "off_tps": round(tps_off, 2),
+            "on_tps": round(tps_on, 2),
+            "delta_frac": round(delta, 4),
+            "off_runs": [round(x, 2) for x in off_tps],
+            "on_runs": [round(x, 2) for x in on_tps],
+        },
+        "sampler": {"hz": prof.hz, "samples": rep["samples"],
+                    "errors": rep["errors"],
+                    "dropped_stacks": rep["dropped_stacks"]},
+        "host_gap_causes": perf.get("host_gap_causes"),
+        "programs_top": perf.get("programs_top"),
+    }
+
+
 async def bench_engine(engine) -> dict:
     log("warmup (compiling prefill + decode buckets)...")
     t0 = time.monotonic()
@@ -2727,6 +2839,33 @@ def main() -> None:
             # ~1.0 IS the result (armed-inert costs nothing).
             "vs_baseline": round(ctl["armed_tps"] / ctl["off_tps"], 3),
             "chaos": r,
+        }), flush=True)
+        return
+    if MODE == "profiler":
+        from fasttalk_tpu.engine.factory import build_engine
+
+        t0 = time.monotonic()
+        engine = build_engine(cfg)
+        engine.start()
+        log(f"engine up in {time.monotonic() - t0:.1f}s")
+        try:
+            r = asyncio.run(bench_profiler(engine))
+        finally:
+            engine.shutdown()
+        ctl = r["control"]
+        print(json.dumps({
+            "metric": (f"continuous-profiler overhead delta frac, "
+                       f"{MODEL}: sampler off {ctl['off_tps']} vs on "
+                       f"{ctl['on_tps']} tok/s at "
+                       f"{r['sampler']['hz']:g} Hz "
+                       f"({r['sampler']['samples']} samples; target "
+                       f"|delta| < 0.01)"),
+            "value": ctl["delta_frac"],
+            "unit": "frac",
+            # For this mode the baseline is the sampler-off phase:
+            # ~1.0 IS the result (sampling-on costs nothing).
+            "vs_baseline": round(ctl["on_tps"] / ctl["off_tps"], 3),
+            "profiler": r,
         }), flush=True)
         return
     if MODE == "ws":

@@ -82,7 +82,7 @@ from fasttalk_tpu.models.configs import ModelConfig
 from fasttalk_tpu.models.llama import (KVCache, forward, forward_decode,
                                        init_cache, init_paged_cache)
 from fasttalk_tpu.observability.events import get_events
-from fasttalk_tpu.observability.perf import get_perf
+from fasttalk_tpu.observability.perf import get_perf, program_key
 from fasttalk_tpu.resilience import failpoints as _fp
 from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
@@ -989,7 +989,7 @@ class TPUEngine(EngineBase):
         # an older call is still in flight.
         self._inflight: deque[
             tuple[Future, float, int, list[tuple[int, _Request]],
-                  float, int]] = deque()
+                  float, int, str]] = deque()
         # First sampled tokens whose device→host copy is still in
         # flight: (host-copy Future, [(row, slot_index, request), ...]).
         # Admission emits the first token only when the fetch lands, so
@@ -1738,6 +1738,31 @@ class TPUEngine(EngineBase):
             self._events.emit("recompile", severity="warning",
                               what=kind, **attrs)
 
+    # Program keys for the perf ledger's per-program device-time
+    # attribution: every step record carries the SAME executable key
+    # its dispatch's _note_compile would build, so /perf's programs
+    # block and compile table join exactly (perf.program_key docs).
+
+    def _decode_program(self, kv_len: int, steps: int,
+                        st_on: bool) -> str:
+        return program_key(
+            "decode", kv_len=kv_len, steps=steps,
+            **({"structured": True} if st_on else {}),
+            **self._kvq_attrs,
+            **({"kv_layout": "paged"} if self.paged else {}))
+
+    def _prefill_program(self, start: int, bucket: int) -> str:
+        """The executable key _run_chunk_prefill(start, bucket) routes
+        to — the paged ctx computation is duplicated deliberately so
+        callers can stamp BEFORE dispatch mutates their state."""
+        if self.paged:
+            ctx = next((b for b in _KV_BUCKETS
+                        if b >= start + bucket and b <= self.max_len),
+                       self.max_len)
+            return program_key("prefill", chunk=bucket, ctx=ctx,
+                               kv_layout="paged", **self._kvq_attrs)
+        return program_key("prefill", chunk=bucket, **self._kvq_attrs)
+
     def _fetch(self, arr) -> Future:
         """Submit a device→host copy on the fetch pool, tracked so
         restart() can wait for every outstanding copy to land before
@@ -2448,6 +2473,15 @@ class TPUEngine(EngineBase):
         else:
             out = self._get_kv_slice_fn(bucket)(
                 self.cache, np.int32(slot.index))
+        if self._tracer.enabled:
+            # Device-time attribution row for the park slice (no token
+            # stats — engine_op records feed only the busy union and
+            # the per-program ledger).
+            self._tracer.step(
+                "engine_op", t0, time.monotonic(), kind="kv_offload",
+                program=program_key(
+                    "kv_offload", bucket=bucket, **self._kvq_attrs,
+                    **({"kv_layout": "paged"} if self.paged else {})))
         # Quantized tier: the slice carries int8 rows + scale rows;
         # the pool entry's nbytes (and therefore the budget, the
         # kv_host_bytes gauge and the copy-bandwidth EMA) see the
@@ -2573,6 +2607,12 @@ class TPUEngine(EngineBase):
                                   time.monotonic(), tokens=match,
                                   bytes=entry.nbytes,
                                   prestaged=prestaged)
+            self._tracer.step(
+                "engine_op", t0, time.monotonic(), kind="kv_restore",
+                program=program_key(
+                    "kv_restore", bucket=entry.bucket,
+                    **self._kvq_attrs,
+                    **({"kv_layout": "paged"} if paged else {})))
         return match
 
     def _kv_wait_discount(self, session_id: str,
@@ -2834,8 +2874,15 @@ class TPUEngine(EngineBase):
 
     def _paged_copy_block(self, src_blk: int, dst_blk: int) -> None:
         bs = self.kv_block_size
+        t0 = time.monotonic()
         self.cache = self._get_block_copy_fn()(
             self.cache, np.int32(src_blk * bs), np.int32(dst_blk * bs))
+        if self._tracer.enabled:
+            self._tracer.step(
+                "engine_op", t0, time.monotonic(),
+                kind="kv_block_copy",
+                program=program_key("kv_block_copy", block_size=bs,
+                                    **self._kvq_attrs))
 
     def _paged_sync_resident(self, slot: Slot) -> None:
         """Reconcile the slot's block table with its (possibly just
@@ -3240,12 +3287,17 @@ class TPUEngine(EngineBase):
         cfg_row = np.array([slot.index, req.params.temperature,
                             req.params.top_k, req.params.top_p,
                             gstate, entry.sel], np.float32)
+        t0 = time.monotonic()
         first, self._cur_tokens, self._st_state_dev, self._rng_dev = \
             self._get_st_sample_fn()(
                 last_logits, self._cur_tokens, self._st_state_dev,
                 self._rng_dev, self._arg(cfg_row),
                 self._arg(mask_row), self._st_cls_dev,
                 self._st_nexts_dev)
+        if self._tracer.enabled:
+            self._tracer.step("engine_op", t0, time.monotonic(),
+                              kind="st_sample",
+                              program=program_key("st_sample"))
         # The program just wrote this slot's authoritative state
         # (post-first-token). A pending host-side patch for the slot —
         # the previous occupant's finish→FREE reset, queued before
@@ -3335,6 +3387,7 @@ class TPUEngine(EngineBase):
                     "engine_prefill", t0, time.monotonic(),
                     bucket=bucket, tokens=len(feed), rows=bucket,
                     kind="jump_forward",
+                    program=self._prefill_program(start, bucket),
                     flops=self._perf.call_flops(len(feed), start + n))
                 self._tracer.add_span(
                     req.request_id, "jump_forward", t0,
@@ -3514,6 +3567,7 @@ class TPUEngine(EngineBase):
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
+        self._note_compile("ring_prefill", bucket=bucket)
         from fasttalk_tpu.parallel.train import ring_override
 
         ring = ring_override(self.mesh)
@@ -4183,7 +4237,10 @@ class TPUEngine(EngineBase):
                 self._tracer.step(
                     "engine_prefill", t0p, time.monotonic(),
                     bucket=ring_bucket, tokens=n, rows=ring_bucket,
-                    kind="ring", flops=self._perf.call_flops(n, n))
+                    kind="ring",
+                    program=program_key("ring_prefill",
+                                        bucket=ring_bucket),
+                    flops=self._perf.call_flops(n, n))
             else:
                 take = min(len(st.todo), self.prefill_chunk)
                 bucket = next(b for b in _PREFILL_BUCKETS if b >= take)
@@ -4217,6 +4274,7 @@ class TPUEngine(EngineBase):
                            last=take - 1)
                 # numpy scalars, not jnp ones: each eager jnp scalar is
                 # its own device round trip on relayed backends.
+                prog = self._prefill_program(st.start, bucket)
                 st.last_logits = self._run_chunk_prefill(
                     slot, padded, st.start, take - 1, bucket)
                 slot.tokens.extend(chunk)
@@ -4230,7 +4288,7 @@ class TPUEngine(EngineBase):
                 self._tracer.step(
                     "engine_prefill", t0p, time.monotonic(),
                     bucket=bucket, tokens=take, rows=bucket,
-                    kind="chunk",
+                    kind="chunk", program=prog,
                     flops=self._perf.call_flops(take, st.start))
             # Each completed chunk is forward progress — for EVERY
             # request in the prefill FIFO, not just the head: the ones
@@ -4474,6 +4532,10 @@ class TPUEngine(EngineBase):
         self._tracer.step(
             "engine_prefill", t0p, time.monotonic(), bucket=bucket,
             tokens=real, rows=gp * bucket, kind="batched", group=g,
+            program=program_key(
+                "batched_prefill", chunk=bucket, group=gp, ctx=ctx,
+                **self._kvq_attrs,
+                **({"kv_layout": "paged"} if self.paged else {})),
             flops=self._perf.call_flops(real, ctx))
         entries = []
         for j, (req, slot, start, todo) in enumerate(sub):
@@ -4505,7 +4567,7 @@ class TPUEngine(EngineBase):
             # past its first token makes this condition false.
             return False
         promised: dict[int, int] = {}
-        for _, min_toks, _, snap, _, _ in self._inflight:
+        for _, min_toks, _, snap, _, _, _ in self._inflight:
             for _, req in snap:
                 promised[id(req)] = promised.get(id(req), 0) + min_toks
         # A first token whose fetch hasn't landed is not yet counted in
@@ -4720,7 +4782,7 @@ class TPUEngine(EngineBase):
         # maximum advances; size the KV bucket for where the device can
         # be at the END of this call.
         base = int(self._positions[active].max()) \
-            + sum(adv for _, _, adv, _, _, _ in self._inflight)
+            + sum(adv for _, _, adv, _, _, _, _ in self._inflight)
         # Constrained slot running → the per-call compat matrix
         # (docs/STRUCTURED.md): speculative calls pause (verify-block
         # masking is unvalidated in v1) and the fsm decode variants
@@ -4774,7 +4836,9 @@ class TPUEngine(EngineBase):
                                       max(1.0, self._spec_ema))
                 self._inflight.append(
                     (self._fetch(toks), promise,
-                     exp_adv, snapshot, t_disp, kv_len))
+                     exp_adv, snapshot, t_disp, kv_len,
+                     program_key("spec_decode", kv_len=kv_len,
+                                 steps=steps)))
                 return
         max_pos = base + steps
         kv_len = next((b for b in _KV_BUCKETS
@@ -4815,7 +4879,8 @@ class TPUEngine(EngineBase):
                 self._paged_leads.append(worst_adv)
             self._inflight.append(
                 (self._fetch(toks), steps, steps,
-                 snapshot, t_disp, kv_len))
+                 snapshot, t_disp, kv_len,
+                 self._decode_program(kv_len, steps, st_on)))
             return
         fn = self._get_decode_fn(kv_len, steps, with_fsm=st_on)
         self._sink("decode", kv_len=kv_len, steps=steps,
@@ -4847,11 +4912,13 @@ class TPUEngine(EngineBase):
         # _fetch_pool note in __init__).
         self._inflight.append(
             (self._fetch(toks), steps, steps,
-             snapshot, t_disp, kv_len))
+             snapshot, t_disp, kv_len,
+             self._decode_program(kv_len, steps, st_on)))
 
     def _retire_oldest(self) -> None:
         """Block on the oldest in-flight call and consume its tokens."""
-        fut, _, _, snapshot, t_disp, kv_len = self._inflight.popleft()
+        (fut, _, _, snapshot, t_disp, kv_len,
+         program) = self._inflight.popleft()
         if self.paged and self._paged_leads:
             self._paged_leads.popleft()
         if _fp.enabled:
@@ -4945,6 +5012,7 @@ class TPUEngine(EngineBase):
                 "engine_step", t_disp, t1, steps=int(res.shape[0]),
                 batch=len(snapshot), slots=self.num_slots,
                 occupancy=occupancy, kind="spec" if spec else "plain",
+                program=program,
                 tokens=consumed, rows=rows, kv_len=kv_len,
                 flops=self._perf.call_flops(consumed, kv_len),
                 kv_bytes=int(res.shape[0]) * self._kv_read_rows(

@@ -30,6 +30,7 @@ from fasttalk_tpu.observability.events import get_events
 from fasttalk_tpu.observability.export import chrome_trace, jsonl_dump
 from fasttalk_tpu.observability.flight import get_flight
 from fasttalk_tpu.observability.perf import get_perf
+from fasttalk_tpu.observability.profiler import get_profiler
 from fasttalk_tpu.observability.slo import get_slo
 from fasttalk_tpu.observability.trace import get_tracer
 from fasttalk_tpu.observability.watchdog import get_watchdog
@@ -398,6 +399,27 @@ def build_monitoring_app(ready_check=None, sched_info=None,
         compile ledger (observability/perf.py)."""
         return web.json_response(get_perf().report())
 
+    async def debug_profile(request: web.Request) -> web.Response:
+        """Continuous host profiler (observability/profiler.py):
+        flamegraph-collapsed text by default (pipe straight into
+        flamegraph.pl / speedscope), ?format=json for the structured
+        report (per-role hot stacks, engine-thread cause timeline, GC
+        pauses, sampler health)."""
+        prof = get_profiler()
+        if request.query.get("format") == "json":
+            return web.json_response(prof.report())
+        if not prof.enabled:
+            return web.Response(
+                text="# continuous profiler disabled "
+                     "(PROF_ENABLED=false)\n",
+                content_type="text/plain", status=200)
+        # Rendering walks the whole aggregated stack table — keep it
+        # off the event loop like the trace exports above.
+        import asyncio
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, prof.collapsed)
+        return web.Response(text=text, content_type="text/plain")
+
     async def debug_bundle(request: web.Request) -> web.Response:
         """Manually capture a flight-recorder debug bundle (same
         contents as the automatic incident captures; bypasses the rate
@@ -484,6 +506,7 @@ def build_monitoring_app(ready_check=None, sched_info=None,
     app.router.add_get("/slo", slo)
     app.router.add_get("/perf", perf)
     app.router.add_post("/debug/bundle", debug_bundle)
+    app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/fault", fault_get)
     app.router.add_post("/debug/fault", fault_post)
     app.router.add_get("/events", events)

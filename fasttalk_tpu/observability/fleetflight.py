@@ -225,11 +225,26 @@ class FleetFlightRecorder:
 
             return get_slo().snapshot()
 
+        def profile_collapsed():
+            from fasttalk_tpu.observability.profiler import get_profiler
+
+            return get_profiler().collapsed()
+
+        def profile_report():
+            from fasttalk_tpu.observability.profiler import get_profiler
+
+            return get_profiler().report()
+
         router = self.router
         section("router.json", router.fleet_stats)
         section("events.json", events_tail)
         section("slo.json", slo_report)
         section("fleet_metrics.prom", router.fleet_metrics)
+        # The router process's own continuous-profiler aggregate: a
+        # fleet incident's routing-side half (probe loops, failover
+        # bursts) happens on THIS process's threads.
+        section("profile.txt", profile_collapsed)
+        section("profile.json", profile_report)
 
         replica_status: dict[str, dict[str, Any]] = {}
         for h in list(getattr(router, "replicas", ())):

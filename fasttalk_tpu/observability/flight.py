@@ -314,6 +314,16 @@ class FlightRecorder:
                 raw = get_config().to_dict()
             return redact_config(dict(raw))
 
+        def profile_collapsed():
+            from fasttalk_tpu.observability.profiler import get_profiler
+
+            return get_profiler().collapsed()
+
+        def profile_report():
+            from fasttalk_tpu.observability.profiler import get_profiler
+
+            return get_profiler().report()
+
         try:
             section("events.json", events_tail)
             section("slo.json", slo_report)
@@ -323,6 +333,12 @@ class FlightRecorder:
             section("trace.json", trace_chrome)
             section("trace.jsonl", trace_jsonl)
             section("config.json", config_redacted)
+            # What every thread was DOING when the incident fired —
+            # the continuous sampler's aggregate (collapsed text for
+            # flamegraph tooling + the structured report). Disabled
+            # profiler still writes honest (empty) sections.
+            section("profile.txt", profile_collapsed)
+            section("profile.json", profile_report)
             autoprof = None
             if self.autoprof_s > 0:
                 autoprof = self._autoprof(bundle_dir, errors)

@@ -47,6 +47,7 @@ place for tests, fake-clock drivable (``report(now=...)``).
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -83,9 +84,26 @@ PEAK_HBM_GBPS = (
 )
 
 # Step-ring record names this ledger aggregates (engine/engine.py):
-# decode calls (dispatch → retirement) and prefill calls (dispatch).
+# decode calls (dispatch → retirement), prefill calls (dispatch), and
+# auxiliary device programs (park/restore slices, block copies,
+# structured sample-and-place) — the _OP records carry no token
+# statistics, only device-busy intervals and a program key.
 _STEP = "engine_step"
 _PREFILL = "engine_prefill"
+_OP = "engine_op"
+
+# Host-gap cause taxonomy — mirrors profiler.CAUSE_NAMES (kept literal
+# here so the ledger stays importable without the profiler module).
+GAP_CAUSES = ("detok", "ws_send", "scheduler", "radix", "gc", "other")
+
+
+def program_key(kind: str, **attrs: Any) -> str:
+    """The canonical executable key: identical to the one
+    ``note_compile`` builds from engine._note_compile's kind + attrs,
+    so a step record's ``program`` attr and the compile ledger's
+    ``by_key`` entries join exactly — /perf can say "this executable
+    compiled at 14:03 AND has consumed 41% of device time since"."""
+    return kind + "".join(f" {k}={attrs[k]}" for k in sorted(attrs))
 
 
 def _detect_peak(table) -> tuple[float, str]:
@@ -124,6 +142,7 @@ class PerfLedger:
                  window_s: float | None = None,
                  idle_gap_ms: float | None = None,
                  peak_tflops: float | None = None,
+                 profiler: Any = None,
                  clock=time.monotonic):
         self.window_s = window_s if window_s is not None \
             else max(1.0, env_float("PERF_WINDOW_S", DEFAULT_WINDOW_S))
@@ -137,6 +156,11 @@ class PerfLedger:
         self._hbm_override = env_float("PERF_PEAK_HBM_GBPS", 0.0)
         self._hbm_detected: tuple[float, str] | None = None
         self._tracer = tracer
+        # The continuous stack sampler (observability/profiler.py):
+        # supplies engine-thread cause observations and GC pause
+        # intervals for the host-gap decomposition. Injectable in
+        # tests; None = the process singleton, resolved lazily.
+        self._profiler = profiler
         self._clock = clock
         self._lock = threading.Lock()
         # Model cost estimate (bind_model): FLOPs/token = _flops_base +
@@ -228,6 +252,25 @@ class PerfLedger:
         self._m_compiles = m.counter(
             "perf_serving_compiles_total",
             "jitted-executable compiles observed while serving traffic")
+        self._m_prog_busy = m.labeled_gauge(
+            "perf_program_busy_seconds",
+            "device-busy seconds attributed to each jitted program "
+            "over the attribution window (overlap split evenly; the "
+            "family sums to device_busy_s)", label="program")
+        self._m_prog_calls = m.labeled_gauge(
+            "perf_program_calls",
+            "device calls per jitted program over the attribution "
+            "window", label="program")
+        self._m_gap_cause_s = m.labeled_gauge(
+            "perf_host_gap_cause_seconds",
+            "host-gap seconds by sampled cause over the attribution "
+            "window (gc from gc.callbacks pauses; the residual is "
+            "'other', so the family sums to host_gap_s)",
+            label="cause")
+        self._m_gap_cause_frac = m.labeled_gauge(
+            "perf_host_gap_cause_frac",
+            "host-gap fraction of the attribution window by sampled "
+            "cause (the family sums to host_gap_frac)", label="cause")
 
     # ---------------- wiring ----------------
 
@@ -237,6 +280,13 @@ class PerfLedger:
 
             self._tracer = get_tracer()
         return self._tracer
+
+    def _get_profiler(self):
+        if self._profiler is None:
+            from fasttalk_tpu.observability.profiler import get_profiler
+
+            self._profiler = get_profiler()
+        return self._profiler
 
     def bind_model(self, model_cfg: Any, num_slots: int,
                    dtype: str = "", kv_quant: str = "none",
@@ -279,7 +329,7 @@ class PerfLedger:
                      **attrs: Any) -> None:
         """Count one jitted-executable cache miss under its signature
         (the same kind+attrs key engine._note_compile events carry)."""
-        key = kind + "".join(f" {k}={attrs[k]}" for k in sorted(attrs))
+        key = program_key(kind, **attrs)
         now = time.time()
         with self._lock:
             entry = self._compiles.get(key)
@@ -327,7 +377,7 @@ class PerfLedger:
         tracer = self._get_tracer()
         now = self._clock() if now is None else now
         records = [r for r in tracer.steps()
-                   if r.name in (_STEP, _PREFILL)]
+                   if r.name in (_STEP, _PREFILL, _OP)]
         horizon = now - self.window_s
         records = [r for r in records if r.t1 > horizon]
         records.sort(key=lambda r: r.t0)
@@ -349,6 +399,7 @@ class PerfLedger:
                                   if r.name == _STEP),
             "n_prefill_calls": sum(1 for r in records
                                    if r.name == _PREFILL),
+            "n_op_calls": sum(1 for r in records if r.name == _OP),
             "model": {"name": self._model_name, "params": self._params,
                       "slots": self._num_slots, "dtype": self._dtype,
                       "kv_quant": self._kv_quant,
@@ -367,6 +418,8 @@ class PerfLedger:
         peak_hbm, hbm_src = self._peak_hbm()
         if not records:
             out["wall"] = None
+            out["programs"] = {"total_busy_s": 0.0, "by_program": []}
+            out["host_gap_causes"] = None
             out["tokens"] = None
             out["mfu"] = {"peak_tflops": peak or None,
                           "device": device, "mfu": None}
@@ -390,18 +443,57 @@ class PerfLedger:
         # is later) so a freshly started process is not reported as
         # mostly idle.
         start = max(horizon, records[0].t0)
-        intervals = [(max(r.t0, start), min(r.t1, now)) for r in records]
-        intervals = [(a, b) for a, b in intervals if b > a]
+        clipped: list[tuple[float, float, str]] = []
+        for r in records:
+            a, b = max(r.t0, start), min(r.t1, now)
+            if b > a:
+                clipped.append(
+                    (a, b, str(r.attrs.get("program",
+                                           "(unattributed)"))))
         merged: list[tuple[float, float]] = []
-        for a, b in intervals:
+        for a, b, _ in clipped:
             if merged and a <= merged[-1][1]:
                 if b > merged[-1][1]:
                     merged[-1] = (merged[-1][0], b)
             else:
                 merged.append((a, b))
-        busy = sum(b - a for a, b in merged)
+
+        # Per-program attribution: a boundary sweep over the clipped
+        # intervals splits every elementary covered segment evenly
+        # among the programs running through it (pipelined decode
+        # calls overlap on the in-order device queue — neither owns
+        # the wall exclusively). device_busy_s is then DEFINED as the
+        # fsum of the per-program totals, so the programs block
+        # reconciles with it by construction, not by coincidence:
+        # math.fsum over the reported busy_s values reproduces
+        # total_busy_s bitwise (fsum is exact in any order).
+        starts_at: dict[float, list[str]] = {}
+        ends_at: dict[float, list[str]] = {}
+        for a, b, prog in clipped:
+            starts_at.setdefault(a, []).append(prog)
+            ends_at.setdefault(b, []).append(prog)
+        prog_parts: dict[str, list[float]] = {}
+        active: dict[str, int] = {}
+        prev: float | None = None
+        for p in sorted(set(starts_at) | set(ends_at)):
+            if prev is not None and active and p > prev:
+                share = (p - prev) / sum(active.values())
+                for prog, n in active.items():
+                    prog_parts.setdefault(prog, []).append(share * n)
+            for prog in ends_at.get(p, ()):
+                active[prog] -= 1
+                if not active[prog]:
+                    del active[prog]
+            for prog in starts_at.get(p, ()):
+                active[prog] = active.get(prog, 0) + 1
+            prev = p
+        prog_busy = {prog: math.fsum(parts)
+                     for prog, parts in prog_parts.items()}
+        busy = math.fsum(prog_busy.values())
+
         gap_thresh = self.idle_gap_ms / 1000.0
         host_gap = idle = 0.0
+        hg_intervals: list[tuple[float, float]] = []
         cursor = start
         for a, b in merged:
             g = a - cursor
@@ -410,6 +502,7 @@ class PerfLedger:
                     idle += g
                 else:
                     host_gap += g
+                    hg_intervals.append((cursor, a))
             cursor = max(cursor, b)
         tail = now - cursor
         if tail > 0:
@@ -417,6 +510,7 @@ class PerfLedger:
                 idle += tail
             else:
                 host_gap += tail
+                hg_intervals.append((cursor, now))
         window = now - start
         frac = (lambda x: round(x / window, 4)) if window > 0 \
             else (lambda x: 0.0)
@@ -428,6 +522,87 @@ class PerfLedger:
             "device_busy_frac": frac(busy),
             "host_gap_frac": frac(host_gap),
             "idle_frac": frac(idle),
+        }
+
+        # Program stats (calls, tokens) ride the same records.
+        prog_calls: dict[str, int] = {}
+        prog_tokens: dict[str, int] = {}
+        for r in records:
+            prog = str(r.attrs.get("program", "(unattributed)"))
+            prog_calls[prog] = prog_calls.get(prog, 0) + 1
+            prog_tokens[prog] = prog_tokens.get(prog, 0) \
+                + int(r.attrs.get("tokens", 0))
+        by_program = [
+            {"program": prog,
+             # busy_s deliberately unrounded: the reconciliation
+             # contract (fsum(busy_s) == total_busy_s) survives JSON
+             # round-tripping only at full precision.
+             "busy_s": prog_busy.get(prog, 0.0),
+             "busy_frac_of_window": frac(prog_busy.get(prog, 0.0)),
+             "frac_of_busy": round(prog_busy.get(prog, 0.0) / busy, 4)
+             if busy > 0 else None,
+             "calls": prog_calls.get(prog, 0),
+             "tokens": prog_tokens.get(prog, 0)}
+            for prog in prog_busy
+        ]
+        by_program.sort(key=lambda e: (-e["busy_s"], e["program"]))
+        out["programs"] = {"total_busy_s": busy,
+                           "by_program": by_program}
+
+        # Host-gap cause decomposition: GC pauses are exact
+        # (gc.callbacks intervals, clipped to the gap); the remainder
+        # of each gap distributes proportionally to what the sampler
+        # saw the engine thread doing inside it; whatever no evidence
+        # claims — including every gap sampled as "other" and every
+        # gap shorter than a sampler tick — lands in the residual
+        # "other" bucket, which CLOSES the sum: by-cause seconds (and
+        # fractions) total host_gap_s (host_gap_frac) by construction.
+        try:
+            prof = self._get_profiler()
+        except Exception:
+            prof = None
+        named_parts: dict[str, list[float]] = {}
+        for g0, g1 in hg_intervals:
+            glen = g1 - g0
+            gc_s = 0.0
+            counts: dict[str, int] = {}
+            if prof is not None:
+                # A torn sampler (thread died mid-walk) costs this
+                # gap's evidence, never the /perf report.
+                try:
+                    gc_s = min(glen,
+                               max(0.0, prof.gc_overlap_s(g0, g1)))
+                    counts = prof.causes_between(g0, g1)
+                except Exception:
+                    gc_s, counts = 0.0, {}
+            if gc_s > 0:
+                named_parts.setdefault("gc", []).append(gc_s)
+            rest = glen - gc_s
+            seen = sum(counts.values())
+            if rest > 0 and seen > 0:
+                for c in GAP_CAUSES:
+                    if c in ("gc", "other"):
+                        continue
+                    n = counts.get(c, 0)
+                    if n:
+                        named_parts.setdefault(c, []).append(
+                            rest * n / seen)
+        named_s = {c: math.fsum(v) for c, v in named_parts.items()}
+        other_s = max(0.0, host_gap - math.fsum(named_s.values()))
+        cause_s = {c: named_s.get(c, 0.0) for c in GAP_CAUSES}
+        cause_s["other"] = other_s
+        out["host_gap_causes"] = {
+            "host_gap_s": host_gap,
+            "host_gap_frac": host_gap / window if window > 0 else 0.0,
+            "sampler": {
+                "enabled": bool(getattr(prof, "enabled", False)),
+                "samples": int(getattr(prof, "samples", 0)),
+            },
+            "by_cause": {
+                c: {"s": cause_s[c],
+                    "frac": cause_s[c] / window if window > 0 else 0.0}
+                for c in GAP_CAUSES
+            },
         }
 
         # Useful tokens vs computed rows, occupancy, FLOPs, KV bytes.
@@ -448,7 +623,7 @@ class PerfLedger:
                 dur = max(0.0, r.t1 - r.t0)
                 occ_weight += dur
                 occ_sum += dur * float(a.get("occupancy", 0.0))
-            else:
+            elif r.name == _PREFILL:
                 prefill_tokens += int(a.get("tokens", 0))
                 computed_rows += int(a.get("rows", a.get("tokens", 0)))
         useful = decode_tokens + prefill_tokens
@@ -559,6 +734,18 @@ class PerfLedger:
             "frac_of_ceiling": (rep.get("ceiling") or {}).get(
                 "frac_of_ceiling"),
             "serving_compiles": rep["compiles"]["serving"],
+            "host_gap_causes": {
+                c: round(v["frac"], 4) for c, v in
+                ((rep.get("host_gap_causes") or {}).get("by_cause")
+                 or {}).items()
+            } or None,
+            "programs_top": [
+                {"program": e["program"],
+                 "busy_s": round(e["busy_s"], 4),
+                 "frac_of_busy": e["frac_of_busy"]}
+                for e in (rep.get("programs") or {}).get(
+                    "by_program", [])[:5]
+            ],
         }
 
     def sample(self, now: float | None = None) -> None:
@@ -583,6 +770,17 @@ class PerfLedger:
         self._m_w_gbps.set((rep.get("weights") or {}).get("read_gbps")
                            or 0.0)
         self._m_hbm_bw.set((rep.get("hbm") or {}).get("bw_util") or 0.0)
+        progs = (rep.get("programs") or {}).get("by_program", [])
+        self._m_prog_busy.set_all(
+            {e["program"]: round(e["busy_s"], 6) for e in progs})
+        self._m_prog_calls.set_all(
+            {e["program"]: e["calls"] for e in progs})
+        causes = ((rep.get("host_gap_causes") or {}).get("by_cause")
+                  or {})
+        self._m_gap_cause_s.set_all(
+            {c: round(v["s"], 6) for c, v in causes.items()})
+        self._m_gap_cause_frac.set_all(
+            {c: round(v["frac"], 6) for c, v in causes.items()})
 
     def clear(self) -> None:
         """Test hook: drop the compile ledger IN PLACE. The model

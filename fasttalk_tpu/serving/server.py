@@ -103,6 +103,13 @@ class WebSocketLLMServer:
         from fasttalk_tpu.observability.flight import get_flight
 
         get_flight().install()
+        # Continuous host profiler (observability/profiler.py): samples
+        # host thread stacks so /debug/profile and the host_gap_causes
+        # block on /perf can name where non-device time goes. start()
+        # is a no-op (no thread) when PROF_ENABLED=false.
+        from fasttalk_tpu.observability.profiler import get_profiler
+
+        get_profiler().start()
         m = get_metrics()
         self._m_ws_tokens = m.counter("ws_tokens_streamed_total",
                                       "token frames streamed to clients")

@@ -696,6 +696,20 @@ class Config:
     # unknown kinds report kv bw_util: null.
     perf_peak_hbm_gbps: float = field(
         default_factory=lambda: _env_float("PERF_PEAK_HBM_GBPS", 0.0))
+    # ---- Continuous host profiler (observability/profiler.py,
+    # GET /debug/profile + host_gap_causes on /perf) ----
+    # Master switch: off spawns no sampler thread and hot paths never
+    # touch the profiler (pull-based), so off truly costs nothing.
+    prof_enabled: bool = field(
+        default_factory=lambda: _env_bool("PROF_ENABLED", True))
+    # Sampling rate of the host stack sampler (Hz). 67 deliberately
+    # avoids beating against 10/100 Hz periodic work.
+    prof_hz: float = field(
+        default_factory=lambda: _env_float("PROF_HZ", 67.0))
+    # Bound on distinct collapsed stacks kept per thread role; further
+    # novel stacks are counted as dropped, not stored.
+    prof_max_stacks: int = field(
+        default_factory=lambda: _env_int("PROF_MAX_STACKS", 2000))
     # ---- Incident flight recorder (observability/flight.py,
     # POST /debug/bundle) ----
     flight_enabled: bool = field(
@@ -1155,6 +1169,12 @@ class Config:
         if self.perf_peak_hbm_gbps < 0:
             errs.append("perf_peak_hbm_gbps must be >= 0 (0 = detect "
                         "from the device kind)")
+        if self.prof_hz <= 0 or self.prof_hz > 1000:
+            errs.append("prof_hz must be in (0, 1000] — the host "
+                        "stack sampler rate in Hz")
+        if self.prof_max_stacks < 16:
+            errs.append("prof_max_stacks must be >= 16 (the bound on "
+                        "distinct stacks kept per thread role)")
         if not self.flight_dir.strip():
             errs.append("flight_dir must be a non-empty path")
         if self.flight_max_bundles < 1:

@@ -80,6 +80,51 @@ class Gauge:
             return self._value
 
 
+class LabeledGauge:
+    """A gauge family keyed by ONE label (e.g. per-program busy
+    seconds). The flat Gauge stays the default — labels multiply
+    cardinality and most of this registry is deliberately scalar —
+    but per-program / per-cause attribution is exactly the case
+    labels exist for, and flattening the label into the metric name
+    would break every PromQL aggregation over the family.
+
+    ``set_all`` replaces the whole family atomically: attribution
+    samples are recomputed per scrape, and stale members (a program
+    that left the rolling window) must disappear rather than freeze
+    at their last value."""
+
+    def __init__(self, name: str, help_: str, label: str):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self._values: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: float) -> None:
+        with self._lock:
+            self._values[key] = value
+
+    def set_all(self, values: dict[str, float]) -> None:
+        with self._lock:
+            self._values = dict(values)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    @property
+    def value(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    @staticmethod
+    def _escape_label(value: str) -> str:
+        """Label-value escaping per the exposition format: backslash,
+        double quote and newline."""
+        return (value.replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+
 class Histogram:
     """Fixed-bucket histogram; also keeps a bounded sample window so the
     /stats endpoint can report true percentiles (p50/p95 TTFT etc.).
@@ -173,7 +218,8 @@ class Histogram:
 
 class MetricsRegistry:
     def __init__(self) -> None:
-        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._metrics: dict[str, Counter | Gauge | Histogram
+                            | LabeledGauge] = {}
         self._lock = threading.Lock()
         self.started_at = time.time()
 
@@ -182,6 +228,11 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get_or_create(name, lambda: Gauge(name, help_), Gauge)
+
+    def labeled_gauge(self, name: str, help_: str = "",
+                      label: str = "key") -> LabeledGauge:
+        return self._get_or_create(
+            name, lambda: LabeledGauge(name, help_, label), LabeledGauge)
 
     def histogram(self, name: str, help_: str = "",
                   buckets: Iterable[float] = (
@@ -210,7 +261,7 @@ class MetricsRegistry:
             if isinstance(m, Histogram):
                 out[name] = m.summary()
             else:
-                out[name] = m.value
+                out[name] = m.value  # LabeledGauge: {label_value: v}
         return out
 
     @staticmethod
@@ -241,6 +292,13 @@ class MetricsRegistry:
             elif isinstance(m, Gauge):
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {m.value}")
+            elif isinstance(m, LabeledGauge):
+                lines.append(f"# TYPE {name} gauge")
+                vals = m.value
+                for key in sorted(vals):
+                    esc = m._escape_label(key)
+                    lines.append(
+                        f'{name}{{{m.label}="{esc}"}} {vals[key]}')
             else:
                 lines.append(f"# TYPE {name} histogram")
                 acc = 0

@@ -152,6 +152,24 @@
 #                             fake-clock flight-bundle triggers, the
 #                             profiler endpoints, and a trace_report
 #                             --perf smoke (docs/OBSERVABILITY.md).
+#   ./run_tests.sh --profiler continuous-profiler/program-attribution
+#                             group (docs/OBSERVABILITY.md "Continuous
+#                             profiler and program attribution"): the
+#                             host stack sampler (role/cause
+#                             classification, bounded stack table,
+#                             gc.callbacks pauses, crash_thread-while-
+#                             sampling no-deadlock), the per-program
+#                             device-time ledger reconciliation
+#                             property (sum == device_busy_s, bitwise),
+#                             host_gap_causes closure, /debug/profile,
+#                             flight-bundle profile sections with
+#                             per-section fault isolation, strict
+#                             Prometheus validity of perf_program_* /
+#                             perf_host_gap_* mid-profile, PROF_*
+#                             config validation, plus smoke runs of
+#                             scripts/bench_compare.py (the
+#                             BENCH_r*.json regression gate) and the
+#                             trace_report --perf program table.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -474,6 +492,32 @@ EOF
     for want in "perf attribution" "padding waste" "device busy"; do
         grep -q "$want" <<<"$out" \
             || { echo "trace_report --perf smoke: missing '$want'" >&2; exit 1; }
+    done
+    exit 0
+fi
+
+if [[ "${1:-}" == "--profiler" ]]; then
+    shift
+    "${PYENV[@]}" python -m pytest tests/test_profiler.py \
+        tests/test_perf.py "$@"
+    echo "--- bench_compare regression-gate smoke (committed"
+    echo "    BENCH_r*.json trajectory; exit non-zero on regression) ---"
+    "${PYENV[@]}" python scripts/bench_compare.py --smoke
+    echo "--- trace_report --perf program-attribution smoke ---"
+    tmp="$(mktemp)"
+    trap 'rm -f "$tmp"' EXIT
+    cat > "$tmp" <<'EOF'
+{"request_id": null, "session_id": "", "span": "engine_step", "ts": 100.0, "dur_ms": 10.0, "attrs": {"occupancy": 0.5, "tokens": 16, "rows": 32, "program": "decode kv_len=512 steps=8"}}
+{"request_id": "r1", "session_id": "s1", "span": "detok_emit", "ts": 100.011, "dur_ms": 3.0, "attrs": {}}
+{"request_id": null, "session_id": "", "span": "engine_prefill", "ts": 100.016, "dur_ms": 20.0, "attrs": {"tokens": 40, "rows": 64, "program": "prefill chunk=512"}}
+{"request_id": null, "session_id": "", "span": "engine_op", "ts": 100.04, "dur_ms": 5.0, "attrs": {"kind": "kv_restore", "program": "kv_restore bucket=1024"}}
+EOF
+    out="$("${PYENV[@]}" python scripts/trace_report.py --perf "$tmp")"
+    echo "$out"
+    for want in "per-program device time" "host-gap causes" \
+            "decode kv_len=512 steps=8" "kv_restore bucket=1024" detok; do
+        grep -q "$want" <<<"$out" \
+            || { echo "trace_report program smoke: missing '$want'" >&2; exit 1; }
     done
     exit 0
 fi

@@ -24,6 +24,8 @@ import numpy as np
 from fasttalk_tpu.models.configs import get_model_config
 from fasttalk_tpu.models.llama import KVCache, forward, init_cache
 from fasttalk_tpu.models.loader import init_params_device
+from fasttalk_tpu.observability.perf import PerfLedger, program_key
+from fasttalk_tpu.observability.trace import Tracer
 from fasttalk_tpu.ops.quant import quantize_params
 from fasttalk_tpu.ops.sampling import sample_tokens
 from fasttalk_tpu.utils.compile_cache import enable_compilation_cache
@@ -32,6 +34,39 @@ SLOTS = 16
 KV_LEN = 512
 REPS = 10
 RT = 0.0  # measured relay round-trip latency, set in main()
+
+# Standalone step ledger: every timed loop below is also fed in as
+# device intervals stamped with its program key, so the script ends
+# with the same per-program attribution table GET /perf serves live —
+# one vocabulary for offline profiles and production telemetry.
+_TRACER = Tracer(enabled=True)
+_LEDGER = PerfLedger(tracer=_TRACER, window_s=3600.0)
+
+
+def record_loop(kind: str, reps: int, dt: float, tokens: int = 0,
+                **attrs) -> None:
+    """Feed a measured loop (reps back-to-back calls of dt seconds,
+    ending now) into the ledger as token-stat-free engine_op rows."""
+    prog = program_key(kind, **attrs)
+    end = time.monotonic()
+    for i in range(reps):
+        t1 = end - (reps - 1 - i) * dt
+        _TRACER.step("engine_op", t1 - dt, t1, kind=kind, program=prog,
+                     **({"tokens": tokens} if tokens else {}))
+
+
+def print_programs() -> None:
+    progs = (_LEDGER.report().get("programs") or {})
+    rows = progs.get("by_program") or []
+    if not rows:
+        return
+    print("== per-program device time (observability/perf.py "
+          "ledger) ==", flush=True)
+    for e in rows:
+        print(f"  {e['busy_s']:8.3f}s {e['frac_of_busy']:7.1%} "
+              f"x{e['calls']:<4d} {e['program']}")
+    print(f"  {progs['total_busy_s']:8.3f}s total device busy "
+          f"(per-program seconds sum to this by construction)")
 
 
 def measure_rt():
@@ -107,6 +142,7 @@ def bench_weight_stream(cfg, params, label):
     gb = nbytes(layers) / 1e9
     print(f"  mlp-stream {label:12s}: {dt * 1e3:7.2f} ms "
           f"({gb:.2f} GB -> {gb / dt:.0f} GB/s)")
+    record_loop("mlp_stream", REPS, dt, weights=label)
     return dt
 
 
@@ -171,6 +207,8 @@ def profile_variant(cfg, params, label, pallas_int8):
         print(f"  {label:14s} steps={steps:3d}: {dt * 1e3:7.2f} ms/call "
               f"= {dt / steps * 1e3:6.2f} ms/step "
               f"({SLOTS * steps / dt:6.0f} agg tok/s)")
+        record_loop("profile_decode", REPS, dt,
+                    tokens=SLOTS * steps, weights=label, steps=steps)
     # fixed-cost estimate from the 8->32 line
     per_step = (results[32] - results[8]) / 24
     fixed = results[8] - 8 * per_step
@@ -260,4 +298,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    finally:
+        print_programs()

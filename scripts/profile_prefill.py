@@ -20,20 +20,50 @@ import jax
 import jax.numpy as jnp
 
 from fasttalk_tpu.engine.factory import build_engine
+from fasttalk_tpu.observability.perf import PerfLedger, program_key
+from fasttalk_tpu.observability.trace import Tracer
 from fasttalk_tpu.utils.config import Config
 
 REPS = 10
 
+# Standalone step ledger (same fold as profile_decode.py): timed loops
+# stamped with a program key land in a PerfLedger, so the script ends
+# with the per-program attribution table GET /perf serves live.
+_TRACER = Tracer(enabled=True)
+_LEDGER = PerfLedger(tracer=_TRACER, window_s=3600.0)
 
-def timed(label, fn, reps=REPS):
+
+def timed(label, fn, reps=REPS, program=None, **pattrs):
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
         fn()
         ts.append((time.perf_counter() - t0) * 1000)
+    if program is not None:
+        prog = program_key(program, **pattrs)
+        end = time.monotonic()
+        dt = float(np.median(ts)) / 1e3
+        for i in range(reps):
+            t1 = end - (reps - 1 - i) * dt
+            _TRACER.step("engine_op", t1 - dt, t1, kind=program,
+                         program=prog)
     print(f"  {label:44s} p50 {float(np.median(ts)):8.2f} ms  "
           f"min {min(ts):8.2f}  max {max(ts):8.2f}")
     return float(np.median(ts))
+
+
+def print_programs() -> None:
+    progs = (_LEDGER.report().get("programs") or {})
+    rows = progs.get("by_program") or []
+    if not rows:
+        return
+    print("== per-program device time (observability/perf.py "
+          "ledger) ==", flush=True)
+    for e in rows:
+        print(f"  {e['busy_s']:8.3f}s {e['frac_of_busy']:7.1%} "
+              f"x{e['calls']:<4d} {e['program']}")
+    print(f"  {progs['total_busy_s']:8.3f}s total device busy "
+          f"(per-program seconds sum to this by construction)")
 
 
 def main() -> None:
@@ -83,11 +113,13 @@ def main() -> None:
     jax.block_until_ready(decode_call(8))
 
     timed("prefill b=64 g=1, DISPATCH only",
-          lambda: prefill_call(64, 1, False))
+          lambda: prefill_call(64, 1, False),
+          program="batched_prefill_dispatch", chunk=64, group=1)
     for gp in (1, 2, 4, 8, S):
         np.asarray(prefill_call(64, gp, False))  # warm shape
         timed(f"prefill b=64 g={gp} + firsts fetch",
-              lambda gp=gp: prefill_call(64, gp, True))
+              lambda gp=gp: prefill_call(64, gp, True),
+              program="batched_prefill", chunk=64, group=gp, ctx=512)
 
     def settled_fetch(gp):
         firsts = prefill_call(64, gp, False)
@@ -102,9 +134,11 @@ def main() -> None:
               f"{'':14s} p50 {float(np.median(vals)):8.2f} ms  "
               f"min {min(vals):.2f} max {max(vals):.2f}")
     timed("decode call 8 steps + token fetch",
-          lambda: np.asarray(decode_call(8)))
+          lambda: np.asarray(decode_call(8)),
+          program="decode", kv_len=512, steps=8)
     timed("decode dispatch only",
-          lambda: decode_call(8))
+          lambda: decode_call(8),
+          program="decode_dispatch", kv_len=512, steps=8)
     # Pipelined decode: dispatch N, then fetch the first — models the
     # engine's steady state where fetch overlaps the next call.
     t0 = time.perf_counter()
@@ -126,4 +160,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    finally:
+        print_programs()

@@ -148,19 +148,46 @@ def kv_phase_note(records: Iterable[dict[str, Any]]) -> str | None:
             + "; ".join(parts))
 
 
+# Offline mirror of observability/perf.py GAP_CAUSES; classification
+# here comes from the dump's OWN evidence spans overlapping each gap
+# (detok/ws/queue/radix-named spans), not the live host sampler.
+GAP_CAUSES = ("detok", "ws_send", "scheduler", "radix", "gc", "other")
+
+
+def _span_cause(name: str) -> str | None:
+    """Which host-gap cause a non-engine span is evidence for."""
+    n = name.lower()
+    if "detok" in n:
+        return "detok"
+    if n.startswith("ws_") or "ws_send" in n or "ws_write" in n:
+        return "ws_send"
+    if "queue" in n or "sched" in n:
+        return "scheduler"
+    if "radix" in n:
+        return "radix"
+    if n == "gc" or n.startswith("gc_"):
+        return "gc"
+    return None
+
+
 def perf_attribution(records: Iterable[dict[str, Any]],
                      idle_gap_ms: float | None = None,
                      peak_tflops: float | None = None,
                      ) -> dict[str, Any] | None:
     """Offline step-ledger attribution over a dump's process-level
-    rows (``engine_step`` dispatch→retirement intervals and
-    ``engine_prefill`` dispatch rows) — the stdlib mirror of
+    rows (``engine_step`` dispatch→retirement intervals,
+    ``engine_prefill`` dispatch rows, and token-stat-free
+    ``engine_op`` device calls) — the stdlib mirror of
     observability/perf.py's report, covering the dump's whole span:
     wall-time decomposition (device busy / host gap / idle via the
     PERF_IDLE_GAP_MS threshold), padding waste, occupancy, useful
-    tok/s, and MFU when the rows carry FLOP estimates and a roofline
-    is configured (PERF_PEAK_TFLOPS). None when the dump has no
-    engine rows."""
+    tok/s, MFU when the rows carry FLOP estimates and a roofline is
+    configured (PERF_PEAK_TFLOPS), the per-program device-time table
+    (rows stamped with their executable's ``program`` key), and the
+    host-gap cause decomposition (gap overlap with the dump's own
+    detok/ws/scheduler/radix evidence spans; the live /perf endpoint
+    classifies the same gaps with the host stack sampler instead).
+    None when the dump has no engine rows."""
     if idle_gap_ms is None:
         raw = os.environ.get("PERF_IDLE_GAP_MS", "").strip()
         try:
@@ -173,8 +200,10 @@ def perf_attribution(records: Iterable[dict[str, Any]],
             peak_tflops = float(raw) if raw else 0.0
         except ValueError:
             peak_tflops = 0.0
+    records = list(records)
     rows = [r for r in records
-            if r.get("span") in ("engine_step", "engine_prefill")]
+            if r.get("span") in ("engine_step", "engine_prefill",
+                                 "engine_op")]
     if not rows:
         return None
     ivals = sorted((float(r["ts"]),
@@ -190,13 +219,78 @@ def perf_attribution(records: Iterable[dict[str, Any]],
     busy = sum(b - a for a, b in merged)
     thresh = idle_gap_ms / 1e3
     host_gap = idle = 0.0
+    gap_ivals: list[tuple[float, float]] = []
     cursor = start
     for a, b in merged:
         g = a - cursor
         if g > 0:
-            idle, host_gap = (idle + g, host_gap) if g > thresh \
-                else (idle, host_gap + g)
+            if g > thresh:
+                idle += g
+            else:
+                host_gap += g
+                gap_ivals.append((cursor, a))
         cursor = max(cursor, b)
+
+    # Per-program device time: the same boundary-sweep the live ledger
+    # uses — elementary segments split evenly among the programs in
+    # flight, so concurrent dispatches never double-count and the
+    # per-program seconds sum back to the busy union.
+    events: list[tuple[float, int, str]] = []
+    prog_calls: dict[str, int] = defaultdict(int)
+    prog_tokens: dict[str, int] = defaultdict(int)
+    for r in rows:
+        a0 = float(r["ts"])
+        b0 = a0 + float(r.get("dur_ms", 0.0)) / 1e3
+        attrs0 = r.get("attrs") or {}
+        prog = str(attrs0.get("program") or r["span"])
+        prog_calls[prog] += 1
+        prog_tokens[prog] += int(attrs0.get("tokens", 0) or 0)
+        if b0 > a0:
+            events.append((a0, 1, prog))
+            events.append((b0, -1, prog))
+    prog_busy: dict[str, float] = defaultdict(float)
+    active: dict[str, int] = defaultdict(int)
+    pts = sorted(events)
+    prev_t: float | None = None
+    i = 0
+    while i < len(pts):
+        t = pts[i][0]
+        if prev_t is not None and active and t > prev_t:
+            share = (t - prev_t) / sum(active.values())
+            for p, n in active.items():
+                prog_busy[p] += share * n
+        while i < len(pts) and pts[i][0] == t:
+            _, d, p = pts[i]
+            active[p] += d
+            if active[p] <= 0:
+                del active[p]
+            i += 1
+        prev_t = t
+    if prog_busy:
+        busy = math.fsum(prog_busy.values())
+
+    # Host-gap causes from overlap with the dump's evidence spans;
+    # over-covering (overlapping evidence) is scaled back so the named
+    # causes never exceed the gap they explain.
+    causes = {c: 0.0 for c in GAP_CAUSES if c != "other"}
+    cspans = []
+    for r in records:
+        c = _span_cause(str(r.get("span", "")))
+        if c is not None:
+            a0 = float(r.get("ts", 0.0))
+            cspans.append((a0, a0 + float(r.get("dur_ms", 0.0)) / 1e3,
+                           c))
+    for ga, gb in gap_ivals:
+        for a0, b0, c in cspans:
+            ov = min(gb, b0) - max(ga, a0)
+            if ov > 0:
+                causes[c] += ov
+    named = sum(causes.values())
+    if named > host_gap > 0:
+        scale = host_gap / named
+        causes = {c: v * scale for c, v in causes.items()}
+        named = host_gap
+    causes["other"] = max(0.0, host_gap - named)
     window = end - start
     decode_toks = prefill_toks = computed = 0
     occ_w = occ_s = flops = kv_bytes = 0.0
@@ -210,7 +304,7 @@ def perf_attribution(records: Iterable[dict[str, Any]],
             dur = float(r.get("dur_ms", 0.0))
             occ_w += dur
             occ_s += dur * float(a.get("occupancy", 0.0))
-        else:
+        elif r["span"] == "engine_prefill":
             prefill_toks += int(a.get("tokens", 0))
             computed += int(a.get("rows", a.get("tokens", 0)))
     useful = decode_toks + prefill_toks
@@ -233,6 +327,22 @@ def perf_attribution(records: Iterable[dict[str, Any]],
         # kv_bytes: int8+scales under KV_QUANT=int8, bf16 otherwise).
         "kv_read_gbps": kv_bytes / window / 1e9 if window > 0
         and kv_bytes else None,
+        "programs": {
+            "total_busy_s": busy,
+            "by_program": sorted(
+                ({"program": p, "busy_s": s,
+                  "frac_of_busy": s / busy if busy > 0 else None,
+                  "calls": prog_calls[p], "tokens": prog_tokens[p]}
+                 for p, s in prog_busy.items()),
+                key=lambda e: -e["busy_s"]),
+        },
+        "host_gap_causes": {
+            "host_gap_s": host_gap,
+            "by_cause": {c: {"s": v,
+                             "frac": v / host_gap if host_gap > 0
+                             else None}
+                         for c, v in causes.items()},
+        },
     }
 
 
@@ -260,6 +370,22 @@ def format_perf(p: dict[str, Any]) -> str:
         + ("" if p.get("kv_read_gbps") is None
            else f"; KV read {p['kv_read_gbps']:.3f} GB/s"),
     ]
+    progs = (p.get("programs") or {}).get("by_program") or []
+    if progs:
+        lines.append(f"  per-program device time "
+                     f"({p['programs']['total_busy_s']:.3f}s busy):")
+        for e in progs[:12]:
+            lines.append(
+                f"    {e['busy_s']:8.3f}s {pct(e['frac_of_busy']):>6} "
+                f"x{e['calls']:<5d} {e['program']}")
+        if len(progs) > 12:
+            lines.append(f"    ... and {len(progs) - 12} more")
+    hg = p.get("host_gap_causes")
+    if hg and hg.get("host_gap_s", 0.0) > 0:
+        parts = [f"{c} {d['s'] * 1e3:.0f}ms ({pct(d['frac'])})"
+                 for c, d in hg["by_cause"].items() if d["s"] > 0]
+        lines.append(f"  host-gap causes ({hg['host_gap_s'] * 1e3:.0f}"
+                     f"ms between device calls): " + "  ".join(parts))
     return "\n".join(lines)
 
 

@@ -47,6 +47,7 @@ def _fresh_metrics():
     from fasttalk_tpu.observability.events import reset_events
     from fasttalk_tpu.observability.flight import reset_flight
     from fasttalk_tpu.observability.perf import reset_perf
+    from fasttalk_tpu.observability.profiler import reset_profiler
     from fasttalk_tpu.observability.slo import reset_slo
     from fasttalk_tpu.observability.trace import reset_tracer
     from fasttalk_tpu.observability.watchdog import reset_watchdog
@@ -59,6 +60,7 @@ def _fresh_metrics():
     reset_watchdog()
     reset_perf()
     reset_flight()
+    reset_profiler()
     yield
     reset_metrics()
     reset_events()
@@ -66,3 +68,4 @@ def _fresh_metrics():
     reset_watchdog()
     reset_perf()
     reset_flight()
+    reset_profiler()

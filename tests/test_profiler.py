@@ -1,0 +1,605 @@
+"""Continuous profiler + per-program attribution (the host-gap
+tentpole): the stack sampler's lifecycle and overhead contract, the
+per-program device-time ledger's bitwise reconciliation, host-gap
+cause decomposition closure, the /debug/profile endpoint, flight-
+bundle profile sections with per-section fault isolation, and the
+PROF_* config knobs. Fake clocks everywhere the math is asserted."""
+
+import gc
+import importlib.util
+import json
+import math
+import os
+import threading
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from fasttalk_tpu.observability.events import EventLog
+from fasttalk_tpu.observability.flight import FlightRecorder
+from fasttalk_tpu.observability.perf import PerfLedger, program_key
+from fasttalk_tpu.observability.profiler import (CAUSE_NAMES,
+                                                 ContinuousProfiler,
+                                                 get_profiler,
+                                                 reset_profiler)
+from fasttalk_tpu.observability.trace import Tracer
+from fasttalk_tpu.utils.metrics import get_metrics
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_prometheus",
+    os.path.join(os.path.dirname(__file__), "..", "scripts",
+                 "check_prometheus.py"))
+check_prometheus = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(check_prometheus)
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeSampler:
+    """The exact surface PerfLedger reads from the profiler: engine-
+    thread cause observations and GC pause overlap."""
+
+    def __init__(self, causes=None, gc_ivals=()):
+        self.enabled = True
+        self.samples = 100
+        self._causes = dict(causes or {})
+        self._gc = list(gc_ivals)
+
+    def causes_between(self, t0, t1):
+        return dict(self._causes)
+
+    def gc_overlap_s(self, t0, t1):
+        total = 0.0
+        for g0, g1 in self._gc:
+            lo, hi = max(t0, g0), min(t1, g1)
+            if hi > lo:
+                total += hi - lo
+        return total
+
+
+def _ledger(tracer, **kw):
+    kw.setdefault("window_s", 60.0)
+    kw.setdefault("idle_gap_ms", 250.0)
+    kw.setdefault("peak_tflops", 0.0)
+    return PerfLedger(tracer=tracer, **kw)
+
+
+def _pstep(tr, t0, t1, prog, *, name="engine_step", tokens=16):
+    tr.step(name, t0, t1, steps=8, batch=2, slots=4, occupancy=0.5,
+            kind="plain", tokens=tokens, rows=32, kv_len=512,
+            program=prog)
+
+
+class TestProgramAttribution:
+    def test_busy_sums_to_device_busy_bitwise(self):
+        """The reconciliation property: math.fsum over the reported
+        per-program busy_s reproduces total_busy_s EXACTLY (==, not
+        approx), and wall.device_busy_s is its rounding — many
+        overlapping records with awkward float boundaries."""
+        tr = Tracer(enabled=True)
+        progs = [program_key("decode", kv_len=512, steps=8),
+                 program_key("prefill", chunk=512),
+                 program_key("kv_restore", bucket=1024)]
+        for i in range(40):
+            t0 = 100.0 + i * 0.0371
+            _pstep(tr, t0, t0 + 0.05 + (i % 3) * 0.013, progs[i % 3])
+        rep = _ledger(tr).report(now=103.0)
+        blk = rep["programs"]
+        assert len(blk["by_program"]) == 3
+        assert math.fsum(e["busy_s"] for e in blk["by_program"]) \
+            == blk["total_busy_s"]
+        assert rep["wall"]["device_busy_s"] \
+            == round(blk["total_busy_s"], 4)
+
+    def test_reconciliation_survives_json_round_trip(self):
+        tr = Tracer(enabled=True)
+        for i in range(17):
+            t0 = 100.0 + i * 0.101
+            _pstep(tr, t0, t0 + 0.07, f"p{i % 4}")
+        rep = json.loads(json.dumps(_ledger(tr).report(now=102.0)))
+        blk = rep["programs"]
+        assert math.fsum(e["busy_s"] for e in blk["by_program"]) \
+            == blk["total_busy_s"]
+
+    def test_overlap_split_evenly(self):
+        """Pipelined calls share the overlapped wall evenly — neither
+        program owns [100.5, 101] alone."""
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "a")
+        _pstep(tr, 100.5, 101.5, "b")
+        rep = _ledger(tr).report(now=101.5)
+        by = {e["program"]: e for e in rep["programs"]["by_program"]}
+        assert by["a"]["busy_s"] == pytest.approx(0.75)
+        assert by["b"]["busy_s"] == pytest.approx(0.75)
+        assert rep["programs"]["total_busy_s"] == pytest.approx(1.5)
+        assert rep["wall"]["device_busy_s"] == pytest.approx(1.5)
+
+    def test_calls_tokens_and_sort_order(self):
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 100.2, "small", tokens=4)
+        _pstep(tr, 100.3, 101.3, "big", tokens=64)
+        _pstep(tr, 101.4, 102.4, "big", tokens=64)
+        rep = _ledger(tr).report(now=102.4)
+        rows = rep["programs"]["by_program"]
+        assert [e["program"] for e in rows] == ["big", "small"]
+        assert rows[0]["calls"] == 2 and rows[0]["tokens"] == 128
+        assert rows[1]["calls"] == 1 and rows[1]["tokens"] == 4
+        assert rows[0]["frac_of_busy"] == pytest.approx(2.0 / 2.2,
+                                                        abs=1e-3)
+
+    def test_unstamped_records_get_unattributed_bucket(self):
+        tr = Tracer(enabled=True)
+        tr.step("engine_step", 100.0, 101.0, steps=8, batch=1,
+                slots=4, occupancy=0.5, tokens=8, rows=32, kv_len=512)
+        rep = _ledger(tr).report(now=101.0)
+        rows = rep["programs"]["by_program"]
+        assert [e["program"] for e in rows] == ["(unattributed)"]
+
+    def test_empty_report_programs_shape(self):
+        rep = _ledger(Tracer(enabled=True)).report(now=100.0)
+        assert rep["programs"] == {"total_busy_s": 0.0,
+                                   "by_program": []}
+        assert rep["host_gap_causes"] is None
+
+    def test_engine_op_records_attributed(self):
+        """KV restore/park ops (engine_op records) land in the same
+        programs table as decode/prefill."""
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "decode kv_len=512 steps=8")
+        tr.step("engine_op", 101.02, 101.08, kind="kv_restore",
+                program=program_key("kv_restore", bucket=1024))
+        rep = _ledger(tr).report(now=101.08)
+        by = {e["program"] for e in rep["programs"]["by_program"]}
+        assert "kv_restore bucket=1024" in by
+        assert rep["n_op_calls"] == 1
+
+
+class TestHostGapCauses:
+    def test_causes_close_to_host_gap(self):
+        """gc exact from the pause intervals; the rest of the gap
+        distributed by sampler counts; by-cause seconds fsum to
+        host_gap_s and fractions to host_gap_frac."""
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "a")
+        _pstep(tr, 101.05, 102.05, "a")
+        prof = _FakeSampler(causes={"detok": 3, "ws_send": 1},
+                            gc_ivals=[(101.0, 101.01)])
+        rep = _ledger(tr, profiler=prof).report(now=102.05)
+        hg = rep["host_gap_causes"]
+        assert hg["host_gap_s"] == pytest.approx(0.05)
+        by = hg["by_cause"]
+        assert set(by) == set(CAUSE_NAMES)
+        assert by["gc"]["s"] == pytest.approx(0.01)
+        assert by["detok"]["s"] == pytest.approx(0.03)
+        assert by["ws_send"]["s"] == pytest.approx(0.01)
+        assert by["other"]["s"] == pytest.approx(0.0)
+        assert math.fsum(v["s"] for v in by.values()) \
+            == pytest.approx(hg["host_gap_s"])
+        assert math.fsum(v["frac"] for v in by.values()) \
+            == pytest.approx(rep["wall"]["host_gap_frac"], abs=1e-3)
+        assert hg["sampler"] == {"enabled": True, "samples": 100}
+
+    def test_no_sampler_evidence_is_all_other(self):
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "a")
+        _pstep(tr, 101.1, 102.1, "a")
+        rep = _ledger(tr, profiler=_FakeSampler()).report(now=102.1)
+        by = rep["host_gap_causes"]["by_cause"]
+        assert by["other"]["s"] == pytest.approx(0.1)
+        assert all(by[c]["s"] == 0.0 for c in CAUSE_NAMES
+                   if c != "other")
+
+    def test_gc_overlap_clipped_to_gap(self):
+        """A GC pause longer than the gap never credits more seconds
+        than the gap holds."""
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "a")
+        _pstep(tr, 101.05, 102.05, "a")
+        prof = _FakeSampler(gc_ivals=[(100.5, 101.5)])
+        rep = _ledger(tr, profiler=prof).report(now=102.05)
+        by = rep["host_gap_causes"]["by_cause"]
+        assert by["gc"]["s"] == pytest.approx(0.05)
+        assert by["other"]["s"] == pytest.approx(0.0)
+
+    def test_trailing_gap_included(self):
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "a")
+        prof = _FakeSampler(causes={"scheduler": 2})
+        rep = _ledger(tr, profiler=prof).report(now=101.1)
+        hg = rep["host_gap_causes"]
+        assert hg["host_gap_s"] == pytest.approx(0.1)
+        assert hg["by_cause"]["scheduler"]["s"] == pytest.approx(0.1)
+
+    def test_broken_profiler_never_breaks_report(self):
+        class _Boom:
+            def causes_between(self, t0, t1):
+                raise RuntimeError("torn")
+
+            def gc_overlap_s(self, t0, t1):
+                raise RuntimeError("torn")
+
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "a")
+        _pstep(tr, 101.1, 102.1, "a")
+        rep = _ledger(tr, profiler=_Boom()).report(now=102.1)
+        assert rep["wall"]["host_gap_s"] == pytest.approx(0.1)
+
+
+class TestContinuousProfiler:
+    def test_disabled_owns_no_resources(self):
+        p = ContinuousProfiler(enabled=False)
+        before = set(gc.callbacks)
+        p.start()
+        assert p._thread is None
+        assert not any(t.name == "prof-sampler"
+                       for t in threading.enumerate())
+        assert set(gc.callbacks) == before
+        rep = p.report()
+        assert rep["enabled"] is False and rep["running"] is False
+        p.stop()  # safe no-op
+
+    def test_start_stop_lifecycle(self):
+        p = ContinuousProfiler(enabled=True, hz=200.0)
+        p.start()
+        try:
+            t = p._thread
+            assert t is not None and t.daemon \
+                and t.name == "prof-sampler"
+            p.start()  # idempotent
+            assert p._thread is t
+            assert p._on_gc in gc.callbacks
+            deadline = time.monotonic() + 5.0
+            while p.samples == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert p.samples > 0
+        finally:
+            p.stop()
+        assert p._thread is None
+        assert p._on_gc not in gc.callbacks
+        assert p.report()["running"] is False
+
+    def test_hz_clamped(self):
+        assert ContinuousProfiler(enabled=False, hz=0.0).hz == 0.1
+        assert ContinuousProfiler(enabled=False, hz=5000.0).hz \
+            == 1000.0
+
+    def test_role_mapping(self):
+        r = ContinuousProfiler._role
+        assert r("tpu-engine") == "engine_loop"
+        assert r("kv-offload") == "kv_copy"
+        assert r("MainThread") == "event_loop"
+        assert r("spmd-bcast-3") == "spmd"
+        assert r("some-other-thread") == "some-other-thread"
+
+    def test_leaf_first_cause_classification(self):
+        """A detok leaf inside a scheduler-named parent frame names
+        the cause 'detok' — the deepest match wins (the regression
+        that motivated the cause is None guard)."""
+        clk = _FakeClock(1000.0)
+        p = ContinuousProfiler(enabled=True, clock=clk)
+        stop = threading.Event()
+
+        def _consume_token():  # detok needle (leaf)
+            stop.wait(10.0)
+
+        def _schedule_outer():  # scheduler needle (parent)
+            _consume_token()
+
+        t = threading.Thread(target=_schedule_outer,
+                             name="tpu-engine", daemon=True)
+        t.start()
+        try:
+            time.sleep(0.05)
+            p.sample_once()
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+        rep = p.report()
+        assert "engine_loop" in rep["threads"]
+        assert rep["engine_causes"].get("detok", 0) >= 1
+        assert "scheduler" not in rep["engine_causes"]
+        assert p.causes_between(999.0, 1001.0).get("detok", 0) >= 1
+        assert p.causes_between(1001.0, 1002.0) == {}
+
+    def test_distinct_stacks_bounded_with_dropped_counter(self):
+        p = ContinuousProfiler(enabled=True, max_stacks=1)
+
+        def from_a():
+            p.sample_once()
+
+        def from_b():
+            p.sample_once()
+
+        from_a()
+        from_b()
+        from_b()
+        assert sum(len(d) for d in p._stacks.values()) <= 1
+        assert p.dropped_stacks >= 1
+        assert p.report()["dropped_stacks"] == p.dropped_stacks
+
+    def test_collapsed_format(self):
+        p = ContinuousProfiler(enabled=True)
+        p.sample_once()
+        lines = [ln for ln in p.collapsed().splitlines() if ln]
+        assert lines
+        for ln in lines:
+            stack, n = ln.rsplit(" ", 1)
+            assert int(n) >= 1
+            assert ";" in stack  # role;frame;frame...
+        counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+        assert counts == sorted(counts, reverse=True)
+
+    def test_gc_pause_capture(self):
+        clk = _FakeClock(1000.0)
+        p = ContinuousProfiler(enabled=True, clock=clk)
+        p._on_gc("start", {})
+        clk.t = 1000.25
+        p._on_gc("stop", {})
+        assert p.gc_overlap_s(1000.0, 1001.0) == pytest.approx(0.25)
+        assert p.gc_overlap_s(1000.1, 1000.2) == pytest.approx(0.1)
+        assert p.gc_overlap_s(1001.0, 1002.0) == 0.0
+        rep = p.report()
+        assert rep["gc"]["pauses"] == 1
+        assert rep["gc"]["pause_s"] == pytest.approx(0.25)
+
+    def test_clear(self):
+        p = ContinuousProfiler(enabled=True)
+        p.sample_once()
+        assert p.samples == 1
+        p.clear()
+        assert p.samples == 0
+        assert p.report()["threads"] == {}
+        assert p.collapsed() == "\n"
+
+    def test_thread_death_while_sampling_never_deadlocks(self):
+        """Threads dying under the sampler (the crash_thread chaos
+        situation: the engine loop killed mid-iteration while the
+        sampler walks live frames) cost at most a tick — the sampler
+        keeps running and stop() always joins."""
+        p = ContinuousProfiler(enabled=True, hz=500.0, max_stacks=512)
+        p.start()
+        try:
+            def short_lived():
+                time.sleep(0.001)
+
+            for _ in range(25):
+                ts = [threading.Thread(target=short_lived,
+                                       name="tpu-engine", daemon=True)
+                      for _ in range(4)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=5.0)
+            deadline = time.monotonic() + 5.0
+            while p.samples == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            p.stop()
+        assert p._thread is None
+        assert p.samples > 0
+        assert not any(t.name == "prof-sampler"
+                       for t in threading.enumerate())
+
+    def test_singleton_reset_rereads_env(self, monkeypatch):
+        reset_profiler()
+        try:
+            assert get_profiler() is get_profiler()
+            monkeypatch.setenv("PROF_ENABLED", "false")
+            monkeypatch.setenv("PROF_HZ", "97")
+            monkeypatch.setenv("PROF_MAX_STACKS", "64")
+            reset_profiler()
+            p = get_profiler()
+            assert p.enabled is False
+            assert p.hz == 97.0
+            assert p.max_stacks == 64
+        finally:
+            reset_profiler()
+
+
+class TestProgramGauges:
+    def test_labeled_gauges_render_strict_exposition(self):
+        """perf_program_* / perf_host_gap_cause_* must be scrapeable
+        mid-profile: strict check_prometheus over the rendered text."""
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "decode kv_len=512 steps=8")
+        _pstep(tr, 101.05, 102.05, "prefill chunk=512")
+        led = _ledger(tr, profiler=_FakeSampler({"detok": 1}))
+        led.sample(now=102.05)
+        text = get_metrics().prometheus()
+        problems = check_prometheus.validate(text)
+        assert not problems, problems
+        for fam in ("perf_program_busy_seconds", "perf_program_calls",
+                    "perf_host_gap_cause_seconds",
+                    "perf_host_gap_cause_frac"):
+            assert f"# TYPE {fam} gauge" in text, fam
+        assert 'perf_program_busy_seconds{program=' \
+            '"decode kv_len=512 steps=8"}' in text
+        assert 'perf_host_gap_cause_seconds{cause="detok"}' in text
+
+    def test_gauge_families_replaced_not_accumulated(self):
+        """A program that ages out of the window disappears from the
+        family on the next sample (set_all replaces atomically)."""
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "old_prog")
+        led = _ledger(tr, window_s=60.0)
+        led.sample(now=101.0)
+        assert 'program="old_prog"' in get_metrics().prometheus()
+        led.sample(now=100000.0)  # horizon far past the record
+        assert 'program="old_prog"' \
+            not in get_metrics().prometheus()
+
+    def test_summary_carries_causes_and_top_programs(self):
+        tr = Tracer(enabled=True)
+        _pstep(tr, 100.0, 101.0, "decode kv_len=512 steps=8")
+        _pstep(tr, 101.05, 102.05, "prefill chunk=512")
+        led = _ledger(tr, profiler=_FakeSampler({"ws_send": 2}))
+        s = led.summary(now=102.05)
+        assert set(s["host_gap_causes"]) == set(CAUSE_NAMES)
+        assert s["host_gap_causes"]["ws_send"] > 0
+        progs = [e["program"] for e in s["programs_top"]]
+        assert "decode kv_len=512 steps=8" in progs
+
+
+class TestDebugProfileEndpoint:
+    async def _client(self):
+        from fasttalk_tpu.monitoring.monitor import \
+            build_monitoring_app
+
+        client = TestClient(TestServer(build_monitoring_app()))
+        await client.start_server()
+        return client
+
+    async def test_collapsed_text(self, monkeypatch):
+        import fasttalk_tpu.observability.profiler as prof_mod
+
+        p = ContinuousProfiler(enabled=True)
+        p.sample_once()
+        monkeypatch.setattr(prof_mod, "_profiler", p)
+        client = await self._client()
+        try:
+            r = await client.get("/debug/profile")
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            text = await r.text()
+            stack, n = text.strip().splitlines()[0].rsplit(" ", 1)
+            assert int(n) >= 1 and ";" in stack
+        finally:
+            await client.close()
+
+    async def test_json_report(self, monkeypatch):
+        import fasttalk_tpu.observability.profiler as prof_mod
+
+        p = ContinuousProfiler(enabled=True)
+        p.sample_once()
+        monkeypatch.setattr(prof_mod, "_profiler", p)
+        client = await self._client()
+        try:
+            r = await client.get("/debug/profile?format=json")
+            assert r.status == 200
+            body = await r.json()
+            assert body["enabled"] is True
+            assert body["samples"] >= 1
+            assert "threads" in body and "gc" in body
+        finally:
+            await client.close()
+
+    async def test_disabled_banner(self, monkeypatch):
+        import fasttalk_tpu.observability.profiler as prof_mod
+
+        monkeypatch.setattr(prof_mod, "_profiler",
+                            ContinuousProfiler(enabled=False))
+        client = await self._client()
+        try:
+            r = await client.get("/debug/profile")
+            assert r.status == 200
+            assert (await r.text()).startswith(
+                "# continuous profiler disabled")
+        finally:
+            await client.close()
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("max_bundles", 8)
+    kw.setdefault("min_interval_s", 120.0)
+    kw.setdefault("autoprof_s", 0.0)
+    kw.setdefault("recompile_burst", 3)
+    kw.setdefault("recompile_window_s", 60.0)
+    kw.setdefault("events_tail", 64)
+    kw.setdefault("config_provider", lambda: {"model_name": "tiny"})
+    return FlightRecorder(base_dir=str(tmp_path / "flight"),
+                          clock=_FakeClock(), inline=True, **kw)
+
+
+class TestFlightProfileSections:
+    def test_bundle_carries_profile_sections(self, tmp_path,
+                                             monkeypatch):
+        import fasttalk_tpu.observability.profiler as prof_mod
+
+        p = ContinuousProfiler(enabled=True)
+        p.sample_once()
+        monkeypatch.setattr(prof_mod, "_profiler", p)
+        path = _recorder(tmp_path).trigger("manual", force=True)
+        assert path is not None
+        with open(os.path.join(path, "profile.json")) as fp:
+            rep = json.load(fp)
+        assert rep["samples"] >= 1 and "threads" in rep
+        with open(os.path.join(path, "profile.txt")) as fp:
+            assert ";" in fp.read()
+
+    def test_disabled_profiler_writes_honest_empty_sections(
+            self, tmp_path, monkeypatch):
+        import fasttalk_tpu.observability.profiler as prof_mod
+
+        monkeypatch.setattr(prof_mod, "_profiler",
+                            ContinuousProfiler(enabled=False))
+        path = _recorder(tmp_path).trigger("manual", force=True)
+        with open(os.path.join(path, "profile.json")) as fp:
+            rep = json.load(fp)
+        assert rep["enabled"] is False and rep["samples"] == 0
+
+    def test_broken_profiler_never_truncates_the_bundle(
+            self, tmp_path, monkeypatch):
+        """Per-section fault isolation (the flight recorder's one-
+        broken-exporter-costs-one-file contract): a profiler that
+        raises loses profile.* and NOTHING else, and the manifest
+        names the failures."""
+        import fasttalk_tpu.observability.profiler as prof_mod
+
+        def boom():
+            raise RuntimeError("sampler exploded")
+
+        monkeypatch.setattr(prof_mod, "get_profiler", boom)
+        path = _recorder(tmp_path).trigger("manual", force=True)
+        assert path is not None
+        for name in ("manifest.json", "events.json", "perf.json",
+                     "metrics.prom", "metrics.json", "trace.json",
+                     "trace.jsonl", "slo.json", "config.json"):
+            assert os.path.isfile(os.path.join(path, name)), name
+        assert not os.path.isfile(os.path.join(path, "profile.txt"))
+        assert not os.path.isfile(os.path.join(path, "profile.json"))
+        with open(os.path.join(path, "manifest.json")) as fp:
+            manifest = json.load(fp)
+        assert "profile.txt" in manifest["errors"]
+        assert "profile.json" in manifest["errors"]
+
+
+class TestProfConfig:
+    def _config(self, **kw):
+        from fasttalk_tpu.utils.config import Config
+
+        return Config(llm_provider="fake", compute_device="cpu", **kw)
+
+    def test_defaults_valid_and_surfaced(self):
+        d = self._config().to_dict()
+        for key in ("prof_enabled", "prof_hz", "prof_max_stacks"):
+            assert key in d, key  # `main.py config --show` surface
+        assert d["prof_enabled"] is True
+        assert d["prof_hz"] == 67.0
+        assert d["prof_max_stacks"] == 2000
+
+    @pytest.mark.parametrize("kw,named", [
+        ({"prof_hz": 0.0}, "prof_hz"),
+        ({"prof_hz": -5.0}, "prof_hz"),
+        ({"prof_hz": 2000.0}, "prof_hz"),
+        ({"prof_max_stacks": 4}, "prof_max_stacks"),
+    ])
+    def test_invalid_knobs_rejected_by_name(self, kw, named):
+        with pytest.raises(ValueError, match=named):
+            self._config(**kw)
+
+    def test_env_knobs_resolve(self, monkeypatch):
+        monkeypatch.setenv("PROF_ENABLED", "false")
+        monkeypatch.setenv("PROF_HZ", "97")
+        monkeypatch.setenv("PROF_MAX_STACKS", "128")
+        cfg = self._config()
+        assert cfg.prof_enabled is False
+        assert cfg.prof_hz == 97.0
+        assert cfg.prof_max_stacks == 128
