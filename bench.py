@@ -21,7 +21,15 @@ It then runs the session-fabric pair: (1) drain-migrate vs
 drain-release follow-up TTFT on long parked sessions (cross-replica KV
 migration must beat re-prefill), and (2) a rolling restart of N
 replicas under live streams (drain → kill → restart each in turn) with
-zero client-visible error frames — only ``resumed`` events.
+zero client-visible error frames — only ``resumed`` events. Finally the
+disaggregation pair (docs/ROUTER.md "Disaggregated prefill/decode"): a
+mid-decode long-prompt burst against a role-split fleet (prefill tier
+hands finished KV to the decode tier over the migration wire) vs a
+mixed control — role-split must protect decode inter-token p99 with
+TTFT inside the priced-migration budget and zero error frames.
+
+``BENCH_MODE=disagg`` runs only that disaggregation pair and prints
+the decode ITL p99 gain (role-split over mixed) as its headline.
 
 ``BENCH_MODE=longctx`` runs the quantized-KV capacity scenario
 (docs/KVCACHE.md "Quantized tier"): long-context sessions parked into
@@ -1645,6 +1653,160 @@ async def _fleet_rolling_phase(cfg, n_replicas: int,
     }
 
 
+async def _fleet_disagg_phase(cfg, role_split: bool,
+                              sessions: int) -> dict:
+    """One side of the disaggregation comparison, in THIS process:
+    decode streams hold their slots and stream tokens while
+    ``sessions`` long-prompt requests arrive mid-decode. Role-split
+    runs replica 0 as the prefill tier (deep queue, zero decode slots)
+    and replica 1 as the decode tier — long prompts prefill on 0, hand
+    their KV over the migration wire, and decode on 1 — so a decode
+    step never sits behind a long prefill chunk in its own scheduler.
+    The mixed control runs the SAME engines with no roles, so long
+    prefills time-share with decoding slots. Decode inter-token p99 is
+    the headline — the number disaggregation exists to protect
+    (docs/ROUTER.md "Disaggregated prefill/decode")."""
+    from dataclasses import replace as dc_replace
+
+    from fasttalk_tpu.engine.engine import GenerationParams
+    from fasttalk_tpu.engine.factory import build_engine
+    from fasttalk_tpu.router import FleetRouter, ReplicaHandle
+
+    roles = ("prefill", "decode") if role_split else ("mixed", "mixed")
+    engines = []
+    for i, role in enumerate(roles):
+        # Mirror build_fleet: the prefill tier absorbs burst arrivals
+        # in queue depth instead of slot pressure.
+        ecfg = (dc_replace(cfg,
+                           sched_queue_bound=4 * cfg.sched_queue_bound)
+                if role == "prefill" else cfg)
+        t0 = time.monotonic()
+        eng = build_engine(ecfg)
+        eng.warmup(ecfg.warmup)
+        engines.append(eng)
+        log(f"  replica {i} ({role}) built+warmed in "
+            f"{time.monotonic() - t0:.1f}s")
+    handles = [ReplicaHandle(f"inproc-{i}", e, role=r)
+               for i, (e, r) in enumerate(zip(engines, roles))]
+    router = FleetRouter(handles, probe_interval_s=1.0, migrate=True,
+                         migrate_timeout_s=60.0,
+                         disagg_prefill_min_tokens=128)
+    router.start()
+    # Long enough to clear the 128-token threshold under BOTH the
+    # byte tokenizer (~1 token/char) and a BPE one (~4 chars/token).
+    long_prompt = " ".join(f"[{i}] {PROMPT}" for i in range(9))
+    greedy = dict(temperature=0.0, top_k=1)
+    # Leave decode headroom for the handed-off long sessions so both
+    # sides queue comparably; the decode streams are the ITL probes.
+    # Their prompt must stay WELL below the handoff threshold in any
+    # tokenization, or the probes would take the handoff themselves.
+    n_decode = max(1, cfg.decode_slots // 2)
+    stamps = [[] for _ in range(n_decode)]
+    errors = []
+
+    async def decode_stream(i):
+        async for ev in router.generate(
+                f"dec-{i}", f"dec-s{i}",
+                [{"role": "user", "content": f"[{i}] Say more."}],
+                GenerationParams(max_tokens=512, ignore_eos=IGNORE_EOS,
+                                 **greedy)):
+            if ev["type"] == "token":
+                stamps[i].append(time.monotonic())
+            elif ev["type"] == "error":
+                errors.append(ev)
+
+    async def long_turn(i):
+        t0 = time.monotonic()
+        ttft = None
+        async for ev in router.generate(
+                f"long-{i}", f"long-s{i}",
+                [{"role": "user", "content": f"[{i}] {long_prompt}"}],
+                GenerationParams(max_tokens=16, ignore_eos=IGNORE_EOS,
+                                 **greedy)):
+            if ev["type"] == "token" and ttft is None:
+                ttft = (time.monotonic() - t0) * 1000.0
+            elif ev["type"] == "error":
+                errors.append(ev)
+        return ttft
+
+    dec_tasks = [asyncio.create_task(decode_stream(i))
+                 for i in range(n_decode)]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if all(s for s in stamps):
+            break  # every ITL probe is decoding before the burst
+        await asyncio.sleep(0.02)
+    burst0 = time.monotonic()
+    ttfts = await asyncio.gather(*[long_turn(i)
+                                   for i in range(sessions)])
+    burst1 = time.monotonic()
+    for i in range(n_decode):  # probes outlived the burst — done
+        router.cancel(f"dec-{i}")
+    await asyncio.gather(*dec_tasks)
+
+    # ITL only while the burst was in flight — that is the window the
+    # split protects; before/after it both fleets decode undisturbed.
+    gaps = sorted(g for s in stamps
+                  for a, b in zip(s, s[1:])
+                  if b >= burst0 and a <= burst1
+                  for g in ((b - a) * 1000.0,))
+    if not gaps:  # probes finished early: fall back to the full run
+        gaps = sorted((b - a) * 1000.0 for s in stamps
+                      for a, b in zip(s, s[1:]))
+    ttfts = sorted(t for t in ttfts if t is not None)
+
+    def pct(xs, q):
+        return (round(xs[min(len(xs) - 1, int(q * len(xs)))], 1)
+                if xs else None)
+
+    ds = router.fleet_stats()["disagg"]
+    return {
+        "role_split": role_split,
+        "decode_streams": n_decode,
+        "long_sessions": sessions,
+        "decode_itl_ms": {"p50": pct(gaps, 0.50),
+                          "p99": pct(gaps, 0.99),
+                          "max": round(gaps[-1], 1) if gaps else None},
+        "long_ttft_ms": {"p50": pct(ttfts, 0.50),
+                         "max": round(ttfts[-1], 1) if ttfts else None},
+        "error_frames": len(errors),
+        "handoffs": ds["handoffs"],
+        "fallbacks": ds["fallbacks"],
+        "bytes_per_token": ds["bytes_per_token"],
+        "tiers": ds["tiers"],
+    }
+    # Deliberately no engine shutdown (see _fleet_phase note); the
+    # child prints its JSON and hard-exits.
+
+
+def bench_fleet_disagg() -> dict:
+    """The disaggregation acceptance pair (docs/ROUTER.md): the same
+    mid-decode long-prompt burst against a role-split fleet (prefill
+    tier hands KV to the decode tier over the migration wire) and a
+    mixed control — role-split must protect decode inter-token p99,
+    with long-prompt TTFT inside the priced-migration budget and zero
+    client-visible error frames on both sides."""
+    log("--- disagg 1/2: role-split (prefill|decode tiers) ---")
+    split = _fleet_fabric_subprocess("BENCH_FLEET_DISAGG", "split")
+    log("--- disagg 2/2: mixed control (same engines, no roles) ---")
+    mixed = _fleet_fabric_subprocess("BENCH_FLEET_DISAGG", "mixed")
+    gain = None
+    if split["decode_itl_ms"]["p99"] and mixed["decode_itl_ms"]["p99"]:
+        gain = round(mixed["decode_itl_ms"]["p99"]
+                     / split["decode_itl_ms"]["p99"], 2)
+    log(f"  decode ITL p99: split {split['decode_itl_ms']['p99']} ms "
+        f"vs mixed {mixed['decode_itl_ms']['p99']} ms ({gain}x); "
+        f"handoffs={split['handoffs']} "
+        f"fallbacks={split['fallbacks']}; TTFT p50 split "
+        f"{split['long_ttft_ms']['p50']} vs mixed "
+        f"{mixed['long_ttft_ms']['p50']} ms; error frames "
+        f"{split['error_frames']}+{mixed['error_frames']}")
+    return {"split": split, "mixed": mixed,
+            "decode_itl_p99_gain": gain,
+            "error_frames": split["error_frames"]
+            + mixed["error_frames"]}
+
+
 def _fleet_fabric_subprocess(env_key: str, env_val: str) -> dict:
     """Run one fabric phase in a child process (fresh XLA state — the
     same isolation discipline as every other multi-engine bench)."""
@@ -1652,6 +1814,9 @@ def _fleet_fabric_subprocess(env_key: str, env_val: str) -> dict:
 
     env = _child_env(**{env_key: env_val})
     env["TPU_COMPILE_CACHE"] = "off"
+    # Fabric children always dispatch through the fleet branch, even
+    # when the parent is the standalone BENCH_MODE=disagg headline.
+    env["BENCH_MODE"] = "fleet"
     proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                           env=env, stdout=subprocess.PIPE, text=True)
     if proc.returncode != 0:
@@ -2673,6 +2838,29 @@ def main() -> None:
             "paged": r,
         }), flush=True)
         return
+    if MODE == "disagg":
+        # The role-split-vs-mixed pair standalone (the same phases the
+        # fleet headline tail carries), with the decode ITL p99 gain
+        # as the gated value.
+        d = bench_fleet_disagg()
+        print(json.dumps({
+            "metric": (f"disagg decode ITL p99 gain, {MODEL}: "
+                       f"role-split (prefill|decode tiers) vs mixed "
+                       f"on 2 replicas (split p99 "
+                       f"{d['split']['decode_itl_ms']['p99']} ms vs "
+                       f"mixed {d['mixed']['decode_itl_ms']['p99']} "
+                       f"ms; {d['split']['handoffs']} handoffs, "
+                       f"{d['split']['fallbacks']} fallbacks; long "
+                       f"TTFT p50 {d['split']['long_ttft_ms']['p50']} "
+                       f"vs {d['mixed']['long_ttft_ms']['p50']} ms; "
+                       f"{d['error_frames']} error frames)"),
+            "value": d["decode_itl_p99_gain"],
+            "unit": "x",
+            # >1 means the split protected the decode tail.
+            "vs_baseline": d["decode_itl_p99_gain"],
+            "disagg": d,
+        }), flush=True)
+        return
     if MODE == "fleet":
         replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
         sessions = int(os.environ.get("BENCH_FLEET_SESSIONS", "8"))
@@ -2685,6 +2873,16 @@ def main() -> None:
             phase = asyncio.run(_fleet_migration_phase(
                 _fleet_fabric_cfg(slots), on,
                 int(os.environ.get("BENCH_FLEET_MIG_SESSIONS", "4"))))
+            print(json.dumps(phase), flush=True)
+            sys.stdout.flush()
+            os._exit(0)
+        if os.environ.get("BENCH_FLEET_DISAGG"):
+            # Child: one side of the role-split-vs-mixed pair.
+            split = os.environ["BENCH_FLEET_DISAGG"] == "split"
+            phase = asyncio.run(_fleet_disagg_phase(
+                _fleet_fabric_cfg(slots), split,
+                int(os.environ.get("BENCH_FLEET_DISAGG_SESSIONS",
+                                   "2"))))
             print(json.dumps(phase), flush=True)
             sys.stdout.flush()
             os._exit(0)
@@ -2716,12 +2914,18 @@ def main() -> None:
         r = bench_fleet(replicas, sessions, slots)
         fabric = bench_fleet_fabric(replicas, sessions)
         r["fabric"] = fabric
+        disagg = bench_fleet_disagg()
+        r["disagg"] = disagg
         fo = (r["fleet"].get("failover") or {})
         roll = fabric["rolling_restart"]
         log(f"fabric headline: migration follow-up TTFT "
             f"{fabric['followup_ttft_speedup']}x vs re-prefill; "
             f"rolling restart {roll['error_frames']} error frames / "
             f"{roll['resumed_events']} resumed")
+        log(f"disagg headline: decode ITL p99 gain "
+            f"{disagg['decode_itl_p99_gain']}x (role-split vs mixed), "
+            f"{disagg['split']['handoffs']} handoffs, "
+            f"{disagg['error_frames']} error frames")
         print(json.dumps({
             "metric": (f"fleet aggregate WS tok/s, {MODEL}: "
                        f"{r['sessions']} sessions on "
@@ -2739,13 +2943,19 @@ def main() -> None:
                        f"{fabric['followup_ttft_speedup']}x vs "
                        f"re-prefill, rolling restart "
                        f"{roll['error_frames']} error frames / "
-                       f"{roll['resumed_events']} resumed)"),
+                       f"{roll['resumed_events']} resumed; disagg "
+                       f"decode ITL p99 gain "
+                       f"{disagg['decode_itl_p99_gain']}x role-split "
+                       f"vs mixed, {disagg['split']['handoffs']} "
+                       f"handoffs, {disagg['error_frames']} error "
+                       f"frames)"),
             "value": r["fleet"]["agg_tps"],
             "unit": "tok/s",
             # For this mode the baseline is the single-replica run:
             # >1 means scaling out is buying capacity.
             "vs_baseline": r["agg_tps_speedup"],
             "fleet": r,
+            "disagg": disagg,
         }), flush=True)
         return
     if MODE == "overload":

@@ -126,6 +126,13 @@ class GenerationParams:
     # token budget instead of stopping on EOS — fixed-length benching
     # and forced continuation.
     ignore_eos: bool = False
+    # Disaggregated prefill tier (router/disagg.py): run ONLY the
+    # prompt's chunked prefill, park the finished KV rows to the host
+    # pool, and finish with reason "prefill_parked" — no first-token
+    # sample, no decode-slot occupancy. The router then migrates the
+    # parked entry to a decode replica over /kv/parked. Internal to
+    # the router handoff; not client-settable through serving.
+    prefill_only: bool = False
 
     def __post_init__(self) -> None:
         # Client-reachable values: apply_penalties DIVIDES by
@@ -158,6 +165,11 @@ class GenerationParams:
                 raise ValueError(
                     f"deadline_s must be a positive number, "
                     f"got {self.deadline_s!r}")
+        if self.prefill_only and self.structured is not None:
+            raise ValueError(
+                "prefill_only is incompatible with structured output "
+                "(the FSM samples the first token under its start-state "
+                "mask; a prefill-tier request never samples)")
         if self.structured is not None:
             # Shape errors surface here (400 / invalid_config);
             # compile errors surface at the engine seam the same way.
@@ -271,6 +283,14 @@ class EngineBase:
     of the reference's backend handlers (generate stream + connection
     check + model info + cancel, vllm_handler.py:117-326) as one async
     interface; tests substitute a FakeEngine."""
+
+    # Disaggregated-serving replica role (router/disagg.py): "mixed"
+    # serves prefill + decode (today's behaviour); "prefill" admits
+    # ONLY prefill_only handoff requests (zero decode-slot occupancy);
+    # "decode" is a placement hint — the engine itself admits
+    # everything. Set by the fleet builder, read by the role gate in
+    # TPUEngine.generate.
+    role: str = "mixed"
 
     async def generate(self, request_id: str, session_id: str,
                        messages: list[dict], params: GenerationParams,
@@ -1440,6 +1460,23 @@ class TPUEngine(EngineBase):
             raise LLMServiceError("Engine is not running (call start())",
                                   category=ErrorCategory.CONNECTION,
                                   recoverable=True)
+        if self.role == "prefill" and not params.prefill_only:
+            # Disaggregated prefill tier: this replica exists to run
+            # long prefills with zero decode-slot occupancy — a decode
+            # stream admitted here would recreate exactly the
+            # interference the role split removes. The router never
+            # places normal streams here; this is the engine-side
+            # guarantee behind that.
+            raise LLMServiceError(
+                "replica role is 'prefill': decode streams are "
+                "rejected (only prefill_only handoff requests admit)",
+                category=ErrorCategory.VALIDATION, recoverable=False)
+        if params.prefill_only and not self._kv_pool.enabled:
+            raise LLMServiceError(
+                "prefill_only requires the host KV pool "
+                "(KV_HOST_BUDGET_MB > 0): the finished prefill is "
+                "parked there for the decode-tier handoff",
+                category=ErrorCategory.VALIDATION, recoverable=False)
         if params.raw_prompt:
             # Raw text-completion path (/v1/completions): BOS + verbatim
             # tokens, no chat template (matching vLLM's completions
@@ -2491,6 +2528,21 @@ class TPUEngine(EngineBase):
                               kept, bucket, out[0], out[1], t0,
                               scales=scales, trim_rows=trim)
 
+    def _prefill_park_finish(self, req: _Request, slot: Slot) -> None:
+        """Terminal step of a ``prefill_only`` request (disaggregated
+        prefill tier, router/disagg.py): snapshot the freshly written
+        prompt KV to the host pool and finish with reason
+        ``prefill_parked`` — no first-token sample, no activation, the
+        slot frees immediately. The park's D2H fetch runs on the
+        offload copy thread; the router polls ``parked_kv_info`` until
+        the entry lands before migrating it out. Engine thread only."""
+        kept = min(slot.kv_written, len(slot.tokens))
+        if kept >= 1 and self._kv_pool.enabled \
+                and self._kv_pool.parked_len(req.session_id) < kept \
+                and not self._kv_offload.parking(req.session_id):
+            self._park_slot(slot, kept)
+        self._finish(req, "prefill_parked")
+
     def _try_restore(self, req: _Request, slot: Slot,
                      prompt: list[int]) -> int:
         """Restore a returning session's kept prefix from the host pool
@@ -2597,6 +2649,15 @@ class TPUEngine(EngineBase):
         dt = time.monotonic() - t0
         slot.tokens = list(entry.tokens[:match])
         slot.kv_written = match
+        if entry.imported:
+            # Migrated-in prefix (disagg handoff / fleet migration):
+            # donate the restored blocks to the radix tree NOW, while
+            # this slot's table pins them — the decode tier's prefix
+            # cache learns handed-off prefills at first use instead of
+            # waiting for this stream to finish. Holds are exact: the
+            # tree takes allocator holds through the same insert path
+            # as every other donation.
+            self._radix_insert_slot(slot)
         # Consumed: the KV is device-resident again; a later eviction
         # re-parks the (longer) history.
         self._kv_pool.take(req.session_id)
@@ -2748,12 +2809,19 @@ class TPUEngine(EngineBase):
                         v_scale=vs, bucket=bucket, nbytes=nbytes,
                         tokens=list(entry.tokens),
                         parked_at=time.monotonic(),
-                        last_used=time.monotonic())
+                        last_used=time.monotonic(), imported=True)
         # The session may have been released here before (tombstoned):
         # it is coming BACK via migration, so it may return — but the
         # tombstone falls only with a successful insert (a refused
         # import must keep guarding against stale in-flight parks).
-        return self._kv_pool.put(entry, revive=True)
+        ok = self._kv_pool.put(entry, revive=True)
+        if ok:
+            # The imported session's next request is typically already
+            # on the wire (disagg handoff: the decode stream admits
+            # right behind the transfer) — stage the rows to the
+            # device now so its restore dispatches H2D-free.
+            self._kv_offload.prestage(entry.session_id)
+        return ok
 
     # ---------------- paged KV tier ----------------
     # (KV_LAYOUT=paged — kvcache/blocks.py; docs/KVCACHE.md "Paged
@@ -4168,6 +4236,7 @@ class TPUEngine(EngineBase):
                           None)
             if bucket is not None and len(todo) <= allowed \
                     and reused + bucket <= self.max_len \
+                    and not req.params.prefill_only \
                     and not self._ring_prefill_eligible(reused,
                                                         len(todo)):
                 batch.append((req, slot, reused, todo))
@@ -4303,6 +4372,14 @@ class TPUEngine(EngineBase):
                 return  # next chunk on a later iteration
             self._prefilling.pop(0)
             self._m_prefill.observe((time.monotonic() - st.t0) * 1000)
+            if req.params.prefill_only:
+                # Disaggregated prefill tier: the prompt's KV is
+                # written — park it to the host pool and finish
+                # WITHOUT sampling or activating (zero decode-slot
+                # occupancy; the router migrates the parked entry to
+                # a decode replica, router/disagg.py).
+                self._prefill_park_finish(req, slot)
+                return
             if req.fsm is not None:
                 # Masked first-token sample from the FSM start state;
                 # also activates — _st_sample_place defers the fetch

@@ -71,6 +71,12 @@ class ParkedKV:
     v_scale: Any = None
     k_scale_dev: Any = None
     v_scale_dev: Any = None
+    # True when the entry arrived over the fleet migration wire
+    # (import_parked_kv) rather than from this replica's own park
+    # path. The restore path donates IMPORTED prefixes into the radix
+    # tree at admission — the decode tier builds its prefix cache from
+    # handed-off prefills, not only its own traffic (router/disagg.py).
+    imported: bool = False
 
 
 def strip_device(entry: ParkedKV) -> ParkedKV:

@@ -132,6 +132,11 @@ CATALOG: dict[str, str] = {
     "router.migrate_recv":
         "cross-replica KV migration, target-side import (corrupt = "
         "the transferred entry fails validation and is refused)",
+    "router.handoff":
+        "disaggregated prefill->decode handoff, between the prefill "
+        "leg finishing and the KV landing on the decode replica "
+        "(error/hang = the settle fails or wedges: the stream must "
+        "fall back to mixed placement with no client-visible error)",
     "serving.ws.send":
         "WebSocket frame send to a client",
     "spmd.send":

@@ -1,3 +1,5 @@
+from fasttalk_tpu.router.disagg import (DisaggController, parse_roles,
+                                        tier_stats)
 from fasttalk_tpu.router.elastic import ElasticScaler
 from fasttalk_tpu.router.migrate import (deserialize_parked,
                                          serialize_parked, transfer)
@@ -10,5 +12,5 @@ __all__ = [
     "AffinityMap", "PlacementPolicy", "ReplicaHandle",
     "RemoteReplicaHandle", "FleetRouter", "build_fleet",
     "ElasticScaler", "serialize_parked", "deserialize_parked",
-    "transfer",
+    "transfer", "DisaggController", "parse_roles", "tier_stats",
 ]

@@ -26,11 +26,15 @@ loop is clock-injectable so tests drive it deterministically.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from typing import Any, Callable
 
 from fasttalk_tpu.observability.events import get_events
+from fasttalk_tpu.router.disagg import (ROLE_DECODE, ROLE_MIXED,
+                                        ROLE_PREFILL, role_of,
+                                        tier_stats)
 from fasttalk_tpu.router.replica import ReplicaHandle
 from fasttalk_tpu.router.router import FleetRouter
 from fasttalk_tpu.utils.logger import get_logger
@@ -40,7 +44,23 @@ log = get_logger("router.elastic")
 
 
 class ElasticScaler:
-    """Queue-depth + SLO-burn driven fleet sizing over a FleetRouter."""
+    """Queue-depth + SLO-burn driven fleet sizing over a FleetRouter.
+
+    In a role-split fleet (router/disagg.py) the two tiers scale
+    INDEPENDENTLY off their own saturation signals: the prefill tier
+    off its aggregate queue depth (its work WAITS by design — depth is
+    the whole signal), the decode tier off queue depth, SLO page-burn
+    or slot occupancy crossing ``DECODE_OCCUPANCY_UP`` (decode
+    saturates by filling slots long before it queues). Scale-up
+    preserves the starved tier's role on the new replica; scale-down
+    never retires the last replica of a tier. All-mixed fleets take
+    the original single-signal path unchanged."""
+
+    # Decode-tier scale-up trigger: fraction of the tier's decode
+    # slots running. Queue depth alone under-fires for decode — slots
+    # fill and streams slow down (inter-token latency) before the
+    # scheduler queue grows.
+    DECODE_OCCUPANCY_UP = 0.9
 
     def __init__(self, router: FleetRouter,
                  build_replica: Callable[[str], ReplicaHandle], *,
@@ -137,10 +157,14 @@ class ElasticScaler:
             pass
         elif n < self.min_replicas:
             decision = self._scale_up("below_min")
-        elif (waiting >= self.up_queue_depth or paging) \
+        elif (waiting >= self.up_queue_depth or paging
+              or self._decode_saturated(live)) \
                 and n < self.max_replicas:
             decision = self._scale_up(
-                "slo_burn" if paging else "queue_depth",
+                "slo_burn" if paging else (
+                    "queue_depth" if waiting >= self.up_queue_depth
+                    else "decode_occupancy"),
+                role=self._starved_role(live, waiting, paging),
                 waiting=waiting)
         elif waiting == 0 and running == 0:
             if self._idle_since is None:
@@ -156,13 +180,69 @@ class ElasticScaler:
                 "waiting": waiting, "running": running,
                 "paging": paging, "pending_down": self._pending_down}
 
+    # ---------------- role-split tier signals (router/disagg.py) ----
+
+    def _decode_saturated(self, live: list[ReplicaHandle]) -> bool:
+        """Decode-tier slot occupancy at/over ``DECODE_OCCUPANCY_UP``
+        — the decode tier's own saturation signal in a role-split
+        fleet (occupancy comes from the replicas' last probe; an
+        unprobed fleet reads as not saturated)."""
+        if all(role_of(h) == ROLE_MIXED for h in live):
+            return False
+        return any(t.get("occupancy") is not None
+                   and t["occupancy"] >= self.DECODE_OCCUPANCY_UP
+                   for role, t in tier_stats(live).items()
+                   if role != ROLE_PREFILL)
+
+    def _starved_role(self, live: list[ReplicaHandle], waiting: int,
+                      paging: bool) -> str:
+        """Which tier the new replica should join. Mixed fleets grow
+        mixed (unchanged behaviour). In a role-split fleet the prefill
+        tier wins only when its OWN queue crossed the threshold and
+        the decode tier is not in distress — decode latency is the
+        user-facing signal, so ties go to decode."""
+        if all(role_of(h) == ROLE_MIXED for h in live):
+            return ROLE_MIXED
+        tiers = tier_stats(live)
+        pf_waiting = tiers.get(ROLE_PREFILL, {}).get("waiting", 0)
+        if pf_waiting >= self.up_queue_depth and not paging \
+                and not self._decode_saturated(live):
+            return ROLE_PREFILL
+        return ROLE_DECODE
+
+    def _build(self, replica_id: str, role: str) -> ReplicaHandle:
+        """Invoke the launcher's builder, passing the role through
+        when it accepts one (older builders — and the test suite's
+        1-arg lambdas — predate roles; their handles get the role
+        stamped on after the fact, engine mirror included, so
+        scale-up preserves the starved tier either way)."""
+        try:
+            wants_role = len(inspect.signature(
+                self.build_replica).parameters) >= 2
+        except (TypeError, ValueError):
+            wants_role = False
+        if wants_role:
+            handle = self.build_replica(replica_id, role)
+        else:
+            handle = self.build_replica(replica_id)
+        if role_of(handle) != role:
+            handle.role = role
+            try:
+                handle.engine.role = role
+            except Exception:
+                pass
+        return handle
+
     # ---------------- scale up ----------------
 
-    def _scale_up(self, reason: str, **attrs: Any) -> str:
+    def _scale_up(self, reason: str, role: str = ROLE_MIXED,
+                  **attrs: Any) -> str:
         self._seq += 1
         replica_id = f"elastic-{self._seq}"
+        if role != ROLE_MIXED:
+            attrs["role"] = role
         try:
-            handle = self.build_replica(replica_id)
+            handle = self._build(replica_id, role)
             handle.engine.start()
             handle.probe_now()
             self.router.add_replica(handle)
@@ -198,6 +278,18 @@ class ElasticScaler:
         candidates = [h for h in self.router.replicas
                       if h.available()
                       and not isinstance(h, RemoteReplicaHandle)]
+        if any(role_of(h) != ROLE_MIXED for h in self.router.replicas):
+            # Role-split fleet: never retire the last available
+            # replica of a tier — an empty prefill tier silently turns
+            # every long prompt into a fallback, an empty decode tier
+            # cannot serve at all.
+            tier_avail: dict[str, int] = {}
+            for h in self.router.replicas:
+                if h.available():
+                    tier_avail[role_of(h)] = \
+                        tier_avail.get(role_of(h), 0) + 1
+            candidates = [h for h in candidates
+                          if tier_avail.get(role_of(h), 0) > 1]
         if not candidates \
                 or len([h for h in self.router.replicas
                         if h.available()]) <= self.min_replicas:

@@ -136,24 +136,51 @@ class PlacementPolicy:
                         if rid == replica_id]:
                 del self._prefix[key]
 
+    @staticmethod
+    def pick_tier(replicas: list[ReplicaHandle],
+                  roles: tuple[str, ...],
+                  exclude: frozenset[str] | set[str] = frozenset(),
+                  ) -> ReplicaHandle | None:
+        """Least-loaded available replica within a role tier, with NO
+        affinity or prefix side effects — the prefill side of a
+        disagg handoff (router/disagg.py) is transient by design: the
+        session must end up pinned to its DECODE replica, where the
+        migrated KV lives, never to the prefill replica that computed
+        it."""
+        candidates = [h for h in replicas
+                      if h.available() and h.replica_id not in exclude
+                      and getattr(h, "role", "mixed") in roles]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda h: h.load_score())
+
     def place(self, session_id: str, replicas: list[ReplicaHandle],
               exclude: frozenset[str] | set[str] = frozenset(),
               prefix_key: str | None = None,
+              roles: tuple[str, ...] | None = None,
               ) -> tuple[ReplicaHandle | None, bool]:
         """Pick a replica for one request. Returns (handle, affine) —
         ``affine`` True when the session's pinned replica served (KV
         reuse preserved); None when no replica is placeable.
         ``prefix_key`` identifies the request's shared prefix (system
-        prompt hash) for co-location."""
+        prompt hash) for co-location. ``roles`` restricts candidates
+        to replicas of those roles (disaggregated serving,
+        router/disagg.py: decode streams place on the decode/mixed
+        tier — a pin pointing at a prefill-role replica is ignored,
+        never followed); None = role-blind (today's behaviour)."""
+        def _role_ok(h: ReplicaHandle) -> bool:
+            return roles is None or getattr(h, "role", "mixed") in roles
+
         by_id = {h.replica_id: h for h in replicas}
         pinned = self.affinity.get(session_id)
         if pinned is not None and pinned not in exclude:
             h = by_id.get(pinned)
-            if h is not None and h.available():
+            if h is not None and h.available() and _role_ok(h):
                 self.affinity.touch(session_id)
                 return h, True
         candidates = [h for h in replicas
-                      if h.available() and h.replica_id not in exclude]
+                      if h.available() and h.replica_id not in exclude
+                      and _role_ok(h)]
         if not candidates:
             return None, False
         scored = [(h.load_score(), h) for h in candidates]

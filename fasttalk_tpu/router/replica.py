@@ -56,9 +56,17 @@ class ReplicaHandle:
     """One in-process engine replica, as the router tracks it."""
 
     def __init__(self, replica_id: str, engine: EngineBase, *,
-                 dead_probes: int = 2, clock=time.monotonic):
+                 role: str = "mixed", dead_probes: int = 2,
+                 clock=time.monotonic):
         self.replica_id = replica_id
         self.engine = engine
+        # Disaggregated-serving role (router/disagg.py): placement
+        # filters by it, /fleet surfaces it. Mirrored onto the engine
+        # so an in-proc prefill replica enforces its zero-decode-slot
+        # guarantee itself (remote engines are client stubs — the
+        # remote server enforces its own configured role).
+        self.role = role
+        engine.role = role
         self.dead_probes = max(1, dead_probes)
         self._clock = clock
         self._lock = threading.Lock()
@@ -259,6 +267,7 @@ class ReplicaHandle:
         with self._lock:
             return {
                 "replica_id": self.replica_id,
+                "role": self.role,
                 "state": self.state,
                 "dead_reason": self.dead_reason,
                 "draining": self.draining,
@@ -281,7 +290,8 @@ class RemoteReplicaHandle(ReplicaHandle):
     """
 
     def __init__(self, replica_id: str, base_url: str, model: str, *,
-                 dead_probes: int = 2, probe_timeout_s: float = 3.0,
+                 role: str = "mixed", dead_probes: int = 2,
+                 probe_timeout_s: float = 3.0,
                  timeout_s: float = 600.0, max_inflight: int = 32,
                  admission_timeout_s: float = 30.0,
                  connect_retries: int = 2, clock=time.monotonic):
@@ -294,8 +304,8 @@ class RemoteReplicaHandle(ReplicaHandle):
             max_inflight=max_inflight,
             admission_timeout_s=admission_timeout_s,
             connect_retries=connect_retries)
-        super().__init__(replica_id, engine, dead_probes=dead_probes,
-                         clock=clock)
+        super().__init__(replica_id, engine, role=role,
+                         dead_probes=dead_probes, clock=clock)
 
     def probe_now(self) -> dict[str, Any]:
         import requests

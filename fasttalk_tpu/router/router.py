@@ -53,9 +53,11 @@ from __future__ import annotations
 import asyncio
 import threading
 import time
+from dataclasses import replace as _dc_replace
 from typing import Any, AsyncGenerator
 
-from fasttalk_tpu.engine.engine import EngineBase, GenerationParams
+from fasttalk_tpu.engine.engine import (EngineBase, GenerationParams,
+                                        raw_prompt_text)
 from fasttalk_tpu.kvcache import RestorePolicy, kv_env_defaults
 from fasttalk_tpu.kvcache.radix import chain_digest
 from fasttalk_tpu.observability.events import get_events
@@ -63,6 +65,10 @@ from fasttalk_tpu.observability.trace import (current_traceparent,
                                               get_tracer)
 import fasttalk_tpu.router.migrate as _migrate
 from fasttalk_tpu.resilience import failpoints as _fp
+from fasttalk_tpu.router.disagg import (DECODE_ROLES, ROLE_MIXED,
+                                        ROLE_PREFILL, DisaggController,
+                                        parse_roles, role_of,
+                                        tier_stats)
 from fasttalk_tpu.router.policy import AffinityMap, PlacementPolicy
 from fasttalk_tpu.router.replica import (STATE_DEAD, ReplicaHandle,
                                          RemoteReplicaHandle)
@@ -91,6 +97,7 @@ class FleetRouter(EngineBase):
                  migrate: bool = True,
                  migrate_timeout_s: float = 10.0,
                  prefix_affinity: bool = True,
+                 disagg_prefill_min_tokens: int = 512,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
@@ -110,6 +117,22 @@ class FleetRouter(EngineBase):
         # fleet's done-event stats (prompt_tokens / ttft).
         self.kv_policy = RestorePolicy(
             min_tokens=int(kv_env_defaults()["min_tokens"]))
+        # Disaggregated prefill/decode (router/disagg.py): the handoff
+        # decision + its learned wire-cost model, sharing the same
+        # pricing EMAs as drain/failover migration. Dormant (and
+        # byte-identical to the pre-disagg router) until a replica
+        # carries a non-mixed role.
+        self.disagg = DisaggController(
+            self.kv_policy,
+            prefill_min_tokens=disagg_prefill_min_tokens)
+        # request_id -> (prefill handle, sub-request id) while a
+        # handoff's prefill leg is in flight — cancel() forwards there.
+        self._handoff_streams: dict[str, tuple[ReplicaHandle, str]] = {}
+        # First in-proc replica's tokenizer, resolved lazily: the
+        # router has no model of its own, but the threshold routing
+        # needs a prompt-length estimate (falls back to chars/4 for
+        # all-remote fleets).
+        self._tok: Any = False  # False = unresolved, None = none found
         self.affinity = AffinityMap(ttl_s=affinity_ttl_s, clock=clock)
         self.policy = PlacementPolicy(
             self.affinity, prefix_affinity=prefix_affinity,
@@ -176,6 +199,23 @@ class FleetRouter(EngineBase):
             "router_prefix_colocations_total",
             "placements co-located with their shared-prefix tenant "
             "replica (prefix-stamp reuse)")
+        self._m_handoffs = m.counter(
+            "router_disagg_handoffs_total",
+            "disaggregated prefill->decode handoffs completed (prefill "
+            "tier computed the KV, the decode tier restored it)")
+        self._m_handoff_ms = m.histogram(
+            "router_disagg_handoff_ms",
+            "disagg handoff settle latency (park wait + KV transfer "
+            "to the decode replica; the prefill itself is not in "
+            "here — TTFT = prefill + this)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000,
+                     4000, 10000))
+        self._m_handoff_fallbacks = m.counter(
+            "router_disagg_fallback_total",
+            "streams that fell back to mixed placement (pricing said "
+            "re-prefill, no prefill replica, or the handoff "
+            "failed/hung — zero client-visible error frames either "
+            "way)")
         self._m_replicas.set(len(self.replicas))
 
     # ---------------- lifecycle ----------------
@@ -321,8 +361,13 @@ class FleetRouter(EngineBase):
             return None
         if not self._migration_priced(session_id, src):
             return None
+        # Never migrate a session's KV onto a prefill-role replica:
+        # its next decode turn could not be served there (the engine's
+        # role gate rejects decode streams), so the entry would just
+        # age out unreachable.
         candidates = [h for h in self.replicas
-                      if h is not src and h.available()]
+                      if h is not src and h.available()
+                      and role_of(h) != ROLE_PREFILL]
         if not candidates:
             return None
         return min(candidates, key=lambda h: h.load_score())
@@ -571,10 +616,12 @@ class FleetRouter(EngineBase):
         raise KeyError(f"unknown replica {replica_id!r}")
 
     def _place(self, session_id: str, exclude: set[str],
-               prefix_key: str | None = None) -> ReplicaHandle:
+               prefix_key: str | None = None,
+               roles: tuple[str, ...] | None = None) -> ReplicaHandle:
         handle, affine = self.policy.place(session_id, self.replicas,
                                            exclude,
-                                           prefix_key=prefix_key)
+                                           prefix_key=prefix_key,
+                                           roles=roles)
         if handle is None:
             self._m_sheds.inc()
             raise AdmissionRejected(
@@ -642,6 +689,205 @@ class FleetRouter(EngineBase):
                       f"{session_id}: {e}")
             return False
 
+    # ---------------- disaggregated prefill/decode (router/disagg.py,
+    # docs/ROUTER.md "Disaggregated prefill/decode") ----------------
+
+    def _decode_roles(self) -> tuple[str, ...] | None:
+        """Role filter for normal (decode) stream placement: None in
+        an all-mixed fleet (today's behaviour, zero role checks on the
+        hot path), the decode/mixed tier once any replica carries a
+        role — a decode stream must never land on a prefill replica,
+        whose engine rejects it."""
+        if all(role_of(h) == ROLE_MIXED for h in self.replicas):
+            return None
+        return DECODE_ROLES
+
+    def _estimate_prompt_tokens(self, messages: list[dict],
+                                params: GenerationParams) -> int:
+        """Prompt length for the threshold routing decision. Exact
+        when an in-proc replica lends its tokenizer; chars/4 for
+        all-remote fleets — the threshold gates a heuristic either
+        way, and the engine re-counts authoritatively at admission."""
+        if self._tok is False:
+            self._tok = next(
+                (t for h in self.replicas
+                 if (t := getattr(h.engine, "tokenizer", None))
+                 is not None), None)
+        if self._tok is not None:
+            try:
+                if params.raw_prompt:
+                    return len(self._tok.encode_prompt(
+                        raw_prompt_text(messages)))
+                return len(self._tok.apply_chat_template(messages))
+            except Exception:
+                pass
+        chars = sum(len(str(m.get("content") or ""))
+                    for m in messages)
+        return max(1, chars // 4)
+
+    @staticmethod
+    def _safe_parked_info(src: ReplicaHandle,
+                          session_id: str) -> tuple[int, int] | None:
+        try:
+            return src.parked_info(session_id)
+        except Exception:
+            return None
+
+    async def _disagg_settle(self, request_id: str, session_id: str,
+                             src: ReplicaHandle,
+                             prefix_key: str | None,
+                             ) -> tuple[ReplicaHandle, int, int]:
+        """Post-prefill half of a handoff: wait for the async park
+        (the D2H fetch lands on the source's offload thread), pick the
+        decode replica (radix prefix affinity applies WITHIN the
+        decode tier), and move the entry over the migration wire.
+        Unbounded by itself — the caller wraps the whole settle in ONE
+        ``migrate_timeout_s`` budget, so a hung park, a hung channel
+        or the ``router.handoff`` chaos hang all cost at most one
+        timeout before the fallback."""
+        if _fp.enabled:
+            # Chaos seam: the handoff settling — fire_ASYNC (event
+            # loop) so delay/hang rules yield instead of freezing
+            # every stream; `error` here is a handoff channel fault
+            # and must fall back to mixed placement with zero
+            # client-visible error frames.
+            await _fp.fire_async("router.handoff",
+                                 session_id=session_id,
+                                 replica=src.replica_id)
+        while True:
+            info = await asyncio.to_thread(self._safe_parked_info,
+                                           src, session_id)
+            if info is not None:
+                break
+            await asyncio.sleep(0.005)
+        kept, nbytes = info
+        dst, _ = self.policy.place(session_id, self.replicas,
+                                   {src.replica_id},
+                                   prefix_key=prefix_key,
+                                   roles=DECODE_ROLES)
+        if dst is None:
+            raise LLMServiceError("no decode replica for handoff",
+                                  category=ErrorCategory.CONNECTION,
+                                  recoverable=True)
+        # No pricing re-check here: the transfer was priced on the
+        # estimate BEFORE the prefill ran; with the prefill paid, the
+        # transfer is the cheap way to finish the job.
+        status = await asyncio.to_thread(self._migrate_session,
+                                         session_id, src, dst,
+                                         request_id)
+        if status != "ok":
+            raise LLMServiceError(f"handoff transfer {status}",
+                                  category=ErrorCategory.CONNECTION,
+                                  recoverable=True)
+        return dst, kept, nbytes
+
+    async def _disagg_handoff(self, request_id: str, session_id: str,
+                              messages: list[dict],
+                              params: GenerationParams,
+                              prefix_key: str | None,
+                              ) -> ReplicaHandle | None:
+        """The prefill→handoff→decode lifecycle, client-invisibly: run
+        a ``prefill_only`` sub-request on the prefill tier, then (one
+        ``migrate_timeout_s`` budget) wait for the parked entry and
+        migrate it to a decode replica, which is returned with the
+        session pinned to it — the caller's normal placement hits the
+        pin and the stream admits via the restore path. Any failure on
+        either side returns None: the caller places decode-local and
+        re-prefills, so the client sees no error frame, ever."""
+        src = self.policy.pick_tier(self.replicas, (ROLE_PREFILL,))
+        if src is None:
+            self._m_handoff_fallbacks.inc()
+            self.disagg.note_fallback()
+            return None
+        rid = f"{request_id}.prefill"
+        t0 = time.monotonic()
+        ok = False
+        failure = ""
+        pf_stats: dict[str, Any] = {}
+        self._handoff_streams[request_id] = (src, rid)
+        src.inflight.add(rid)
+        src.placements += 1
+        try:
+            async for ev in src.engine.generate(
+                    request_id=rid, session_id=session_id,
+                    messages=messages,
+                    params=_dc_replace(params, prefill_only=True)):
+                et = ev.get("type")
+                if et == "done":
+                    ok = True
+                    pf_stats = ev.get("stats") or {}
+                elif et in ("error", "cancelled"):
+                    failure = str(ev.get("error", et))
+        except asyncio.CancelledError:
+            src.engine.cancel(rid)
+            raise
+        except Exception as e:
+            failure = str(e)
+        finally:
+            src.inflight.discard(rid)
+            self._handoff_streams.pop(request_id, None)
+        if request_id in self._cancelled:
+            return None  # the caller's loop emits the cancelled frame
+        if ok:
+            st = pf_stats
+            if st.get("ttft_ms") and st.get("prefill_tokens"):
+                # The prefill tier's completions feed the SAME prefill
+                # EMA as decode-tier streams: prefill_only TTFT is the
+                # chunked prefill wall time, the honest throughput the
+                # handoff pricing needs.
+                self.kv_policy.note_prefill(
+                    int(st["prefill_tokens"]),
+                    float(st["ttft_ms"]) / 1000.0)
+            t_settle = time.monotonic()
+            try:
+                dst, kept, nbytes = await asyncio.wait_for(
+                    self._disagg_settle(request_id, session_id, src,
+                                        prefix_key),
+                    timeout=self.migrate_timeout_s)
+                dt_ms = (time.monotonic() - t_settle) * 1000.0
+                self._m_handoffs.inc()
+                self._m_handoff_ms.observe(dt_ms)
+                self.disagg.note_handoff(kept, nbytes)
+                if self._tracer.enabled:
+                    self._tracer.add_span(
+                        request_id, "handoff", t0, time.monotonic(),
+                        src=src.replica_id, dst=dst.replica_id,
+                        kept=kept, bytes=nbytes,
+                        settle_ms=round(dt_ms, 2))
+                self._events.emit(
+                    "router_disagg_handoff", severity="info",
+                    session=session_id, src=src.replica_id,
+                    dst=dst.replica_id, kept=kept, bytes=nbytes,
+                    settle_ms=round(dt_ms, 2))
+                return dst
+            except asyncio.TimeoutError:
+                failure = (f"handoff settle exceeded "
+                           f"{self.migrate_timeout_s}s")
+            except Exception as e:  # incl. FaultInjected from the seam
+                failure = str(e)
+        # ---------- fallback to mixed placement ----------
+        # The prefill leg died mid-chunk, the settle hung, or the
+        # transfer failed: the decode tier re-prefills the prompt —
+        # slower, never wrong, and the client sees nothing. A stale
+        # parked entry left on the prefill replica ages out by
+        # TTL/LRU; the pin (if the settle's place() set one) must not
+        # survive, or the next turn would chase KV that never arrived.
+        self._m_handoff_fallbacks.inc()
+        self.disagg.note_fallback()
+        self.affinity.drop(session_id)
+        if self._tracer.enabled:
+            self._tracer.add_span(request_id, "handoff", t0,
+                                  time.monotonic(),
+                                  src=src.replica_id, ok=False,
+                                  error=failure[:200])
+        self._events.emit("router_disagg_fallback", severity="warning",
+                          session=session_id, src=src.replica_id,
+                          error=failure[:200])
+        log.warning(f"[{request_id}] disagg handoff via "
+                    f"{src.replica_id} fell back to mixed placement: "
+                    f"{failure}")
+        return None
+
     async def generate(self, request_id: str, session_id: str,
                        messages: list[dict], params: GenerationParams,
                        ) -> AsyncGenerator[dict, None]:
@@ -658,7 +904,25 @@ class FleetRouter(EngineBase):
         pending_resume = False
         prefix_key = self._prefix_key(messages)
         failed_handle: ReplicaHandle | None = None
+        roles = self._decode_roles()
         try:
+            if roles is not None and self.migrate_enabled \
+                    and params.structured is None \
+                    and any(role_of(h) == ROLE_PREFILL
+                            and h.available()
+                            for h in self.replicas) \
+                    and self.disagg.wants_handoff(
+                        self._estimate_prompt_tokens(messages, params)):
+                # Disaggregated path: long prompt → prefill tier, KV
+                # over the wire, session pinned to the decode replica.
+                # Success or fallback, the loop below runs unchanged —
+                # on success the pin routes it to the decode replica
+                # where the restore path admits; on fallback it places
+                # decode-local and re-prefills (no error frame either
+                # way).
+                await self._disagg_handoff(request_id, session_id,
+                                           messages, params,
+                                           prefix_key)
             while True:
                 # A cancel can land while no replica owns the stream —
                 # between attempts, or while the generator is suspended
@@ -690,7 +954,8 @@ class FleetRouter(EngineBase):
                                             or 1.0),
                             reason="no_replica") from e
                 t_place = time.monotonic()
-                handle = self._place(session_id, excluded, prefix_key)
+                handle = self._place(session_id, excluded, prefix_key,
+                                     roles=roles)
                 if self._tracer.enabled:
                     self._tracer.add_span(
                         request_id, "place", t_place, time.monotonic(),
@@ -921,6 +1186,17 @@ class FleetRouter(EngineBase):
         # replica owns the stream at that instant) must still terminate
         # the retry loop.
         self._cancelled.add(request_id)
+        # A cancel during a disagg handoff's prefill leg: the client's
+        # request_id never reaches the prefill replica (the sub-request
+        # runs as "<id>.prefill"), so forward the cancel there — the
+        # handoff aborts, and the outer loop emits the cancelled frame.
+        handoff = self._handoff_streams.get(request_id)
+        if handoff is not None:
+            src, rid = handoff
+            try:
+                src.engine.cancel(rid)
+            except Exception:
+                pass
         route = self._routes.get(request_id)
         if route is not None:
             try:
@@ -962,6 +1238,7 @@ class FleetRouter(EngineBase):
             stats = self._safe(h, "get_stats", {}) or {}
             per_replica[h.replica_id] = {
                 "state": h.state, "draining": h.draining,
+                "role": role_of(h),
                 "inflight": len(h.inflight),
                 "waiting": stats.get("waiting", 0),
             }
@@ -1011,6 +1288,14 @@ class FleetRouter(EngineBase):
                 "enabled": self.migrate_enabled,
                 "timeout_s": self.migrate_timeout_s,
                 "policy": self.kv_policy.stats(),
+            },
+            # Disaggregated serving view (docs/ROUTER.md): per-role
+            # tier aggregates (queue depth and slot occupancy per
+            # tier — the elastic scaler's signals) plus the handoff
+            # controller's counters and learned wire-cost model.
+            "disagg": {
+                "tiers": tier_stats(self.replicas),
+                **self.disagg.stats(),
             },
             "counters": {
                 "placements": self._m_placements.value,
@@ -1116,22 +1401,41 @@ def build_fleet(cfg) -> FleetRouter:
     test/bench, or dp-style multi-engine on real hardware) plus one
     remote replica per ``ROUTER_BACKENDS`` URL (other FastTalk servers,
     reached through the existing remote.py client protocol)."""
+    from dataclasses import replace as dc_replace
+
     from fasttalk_tpu.engine.factory import build_engine
 
+    inproc_roles = parse_roles(getattr(cfg, "fleet_roles", ""),
+                               cfg.fleet_replicas, "FLEET_ROLES")
     handles: list[ReplicaHandle] = []
     for i in range(cfg.fleet_replicas):
-        engine = build_engine(cfg)
+        role = inproc_roles[i]
+        ecfg = cfg
+        if role == ROLE_PREFILL:
+            # A prefill-role replica is a batch machine, not a latency
+            # machine: deepen its admission queue (long prefills WAIT
+            # there, by design — the whole point is that the waiting
+            # happens away from decode streams). Slots stay as
+            # configured — chunked prefill occupies one slot per
+            # request and the engine rejects decode streams by role.
+            ecfg = dc_replace(cfg, sched_queue_bound=4
+                              * cfg.sched_queue_bound)
+        engine = build_engine(ecfg)
         # Component tagging: in-proc replicas share the process tracer,
         # so the replica id on each span is what keeps a stitched
         # trace's fragments attributable (observability/stitch.py).
         engine.set_trace_component(f"inproc-{i}")
         handles.append(ReplicaHandle(
-            f"inproc-{i}", engine,
+            f"inproc-{i}", engine, role=role,
             dead_probes=cfg.router_dead_probes))
-    for i, url in enumerate(u.strip() for u in
-                            cfg.router_backends.split(",") if u.strip()):
+    urls = [u.strip() for u in cfg.router_backends.split(",")
+            if u.strip()]
+    remote_roles = parse_roles(getattr(cfg, "router_backend_roles", ""),
+                               len(urls), "ROUTER_BACKEND_ROLES")
+    for i, url in enumerate(urls):
         handle = RemoteReplicaHandle(
             f"remote-{i}", url, cfg.model_name,
+            role=remote_roles[i],
             dead_probes=cfg.router_dead_probes,
             timeout_s=cfg.vllm_timeout,
             max_inflight=cfg.remote_max_inflight,
@@ -1147,4 +1451,6 @@ def build_fleet(cfg) -> FleetRouter:
         resume=cfg.router_resume,
         migrate=cfg.router_migrate,
         migrate_timeout_s=cfg.router_migrate_timeout_s,
-        prefix_affinity=cfg.router_prefix_affinity)
+        prefix_affinity=cfg.router_prefix_affinity,
+        disagg_prefill_min_tokens=getattr(
+            cfg, "disagg_prefill_min_tokens", 512))

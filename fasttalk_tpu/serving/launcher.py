@@ -171,8 +171,18 @@ class ServerLauncher:
         from fasttalk_tpu.router.elastic import ElasticScaler
         from fasttalk_tpu.router.replica import ReplicaHandle
 
-        def build_replica(replica_id: str) -> ReplicaHandle:
-            return ReplicaHandle(replica_id, build_engine(cfg),
+        def build_replica(replica_id: str,
+                          role: str = "mixed") -> ReplicaHandle:
+            # Role-split fleets (router/disagg.py): the scaler passes
+            # the starved tier's role; a new prefill replica gets the
+            # same deepened queue build_fleet gives the base tier.
+            ecfg = cfg
+            if role == "prefill":
+                from dataclasses import replace as dc_replace
+                ecfg = dc_replace(cfg, sched_queue_bound=4
+                                  * cfg.sched_queue_bound)
+            return ReplicaHandle(replica_id, build_engine(ecfg),
+                                 role=role,
                                  dead_probes=cfg.router_dead_probes)
 
         return ElasticScaler(

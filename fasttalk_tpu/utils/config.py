@@ -489,6 +489,27 @@ class Config:
     # Scaler control-loop cadence.
     fleet_scale_check_s: float = field(
         default_factory=lambda: _env_float("FLEET_SCALE_CHECK_S", 5.0))
+    # ---- Disaggregated prefill/decode serving (router/disagg.py,
+    # docs/ROUTER.md "Disaggregated prefill/decode") ----
+    # Per-replica roles for the in-process fleet, comma-separated,
+    # one of prefill|decode|mixed per FLEET_REPLICAS slot (e.g.
+    # "prefill,decode,decode"). Empty = every replica is "mixed"
+    # (today's behaviour). A prefill-role replica runs long-context
+    # chunked prefill with a deep queue and ZERO decode slots — it
+    # parks the finished KV and the router hands it to the decode
+    # tier over the /kv/parked migration wire.
+    fleet_roles: str = field(
+        default_factory=lambda: _env_str("FLEET_ROLES", ""))
+    # Same, for ROUTER_BACKENDS remote replicas (one role per URL).
+    router_backend_roles: str = field(
+        default_factory=lambda: _env_str("ROUTER_BACKEND_ROLES", ""))
+    # Prompt-length threshold (estimated tokens) above which a new
+    # stream takes the prefill-tier handoff path; shorter prompts
+    # place decode-local. Only meaningful when the fleet has a
+    # prefill-role replica.
+    disagg_prefill_min_tokens: int = field(
+        default_factory=lambda: _env_int("DISAGG_PREFILL_MIN_TOKENS",
+                                         512))
     # ---- Session KV host-offload tier (fasttalk_tpu/kvcache/,
     # docs/KVCACHE.md) ----
     # Host-RAM budget for parked session KV (MB). 0 disables the tier
@@ -994,6 +1015,49 @@ class Config:
             errs.append("fleet_scale_down_idle_s must be > 0")
         if self.fleet_scale_check_s <= 0:
             errs.append("fleet_scale_check_s must be > 0")
+        _role_values = ("prefill", "decode", "mixed")
+        _all_roles: list[str] = []
+        for spec, env, count, what in (
+                (self.fleet_roles, "FLEET_ROLES",
+                 self.fleet_replicas, "FLEET_REPLICAS"),
+                (self.router_backend_roles, "ROUTER_BACKEND_ROLES",
+                 len([u for u in self.router_backends.split(",")
+                      if u.strip()]), "ROUTER_BACKENDS"),
+        ):
+            if not spec.strip():
+                continue
+            roles = [r.strip().lower() for r in spec.split(",")]
+            bad = [r for r in roles if r not in _role_values]
+            if bad:
+                errs.append(f"{env} contains invalid role(s) "
+                            f"{bad!r} (each must be one of "
+                            f"prefill|decode|mixed)")
+                continue
+            if len(roles) != count:
+                errs.append(f"{env} lists {len(roles)} role(s) but "
+                            f"{what} defines {count} replica(s) — "
+                            "one role per replica, in order")
+                continue
+            _all_roles.extend(roles)
+        if _all_roles:
+            if not self.router_enabled:
+                errs.append("FLEET_ROLES/ROUTER_BACKEND_ROLES require "
+                            "ROUTER_ENABLED=true (replica roles are a "
+                            "router placement concept)")
+            if "prefill" in _all_roles and not self.router_migrate:
+                errs.append("a 'prefill' replica role requires "
+                            "ROUTER_MIGRATE=true (prefill replicas "
+                            "hand finished KV to the decode tier over "
+                            "the /kv/parked migration wire; without "
+                            "migration their output is unreachable)")
+            if "prefill" in _all_roles \
+                    and not any(r in ("decode", "mixed")
+                                for r in _all_roles):
+                errs.append("a fleet with 'prefill' roles needs at "
+                            "least one 'decode' or 'mixed' replica to "
+                            "run the decode side of the handoff")
+        if self.disagg_prefill_min_tokens < 1:
+            errs.append("disagg_prefill_min_tokens must be >= 1")
         if self.router_enabled:
             n_remote = len([u for u in self.router_backends.split(",")
                             if u.strip()])

@@ -80,6 +80,21 @@
 #                             HTTP channel, and the real-engine
 #                             drain -> migrate -> restore regression
 #                             (docs/ROUTER.md).
+#   ./run_tests.sh --disagg   disaggregated prefill/decode group
+#                             (docs/ROUTER.md "Disaggregated prefill/
+#                             decode"): the failpoint + router-span
+#                             lints (the router.handoff seam must be
+#                             chaos-injected and trace-asserted), role
+#                             parsing/placement/tier stats, the full
+#                             prefill->handoff->decode lifecycle on
+#                             real engines with greedy token parity vs
+#                             the mixed control, priced fallback to
+#                             mixed placement, per-tier elastic
+#                             scaling, prefill-death and hung-handoff
+#                             chaos, radix donation of imported
+#                             blocks, DISAGG_*/FLEET_ROLES config
+#                             validation, and a no-engine pricing
+#                             smoke.
 #   ./run_tests.sh --structured  structured-decoding group: the
 #                             schema→regex→DFA→token-FSM compiler
 #                             (tokenizer-boundary cases incl.
@@ -358,6 +373,45 @@ assert dst.put(out)
 assert dst.stats()["bytes"] == entry.nbytes
 print(f"migration smoke OK: {len(wire)} wire bytes, "
       f"{entry.nbytes} pool bytes accounted exactly")
+EOF
+    exit 0
+fi
+
+if [[ "${1:-}" == "--disagg" ]]; then
+    shift
+    # Disaggregated prefill/decode serving (ISSUE 19, docs/ROUTER.md
+    # "Disaggregated prefill/decode"): role vocabulary + placement,
+    # the full prefill->handoff->decode lifecycle on real engines with
+    # token parity vs the mixed control, pricing fallback, per-tier
+    # elastic scaling, both-sides chaos, and radix donation on import.
+    # Both lints first: the handoff failpoint must be chaos-injected
+    # and its span asserted by the fleet-trace suite.
+    "${PYENV[@]}" python scripts/check_failpoints.py
+    "${PYENV[@]}" python scripts/check_router_spans.py
+    "${PYENV[@]}" python -m pytest tests/test_disagg.py "$@"
+    echo "--- disagg pricing smoke (role parse + handoff threshold +"
+    echo "    wire-cost EMA, no engines) ---"
+    "${PYENV[@]}" python - <<'EOF'
+from fasttalk_tpu.kvcache.policy import RestorePolicy
+from fasttalk_tpu.router.disagg import DisaggController, parse_roles
+
+assert parse_roles("", 2) == ["mixed", "mixed"]
+assert parse_roles("prefill,decode", 2) == ["prefill", "decode"]
+pol = RestorePolicy(min_tokens=8)
+ctl = DisaggController(pol, prefill_min_tokens=64)
+pol.note_prefill(4096, 2.0)          # slow prefill ...
+pol.note_migrate(64 * 1024 * 1024, 0.01)  # ... fast wire
+assert ctl.wants_handoff(512), "long prompt must take the handoff"
+assert not ctl.wants_handoff(8), "short prompt stays decode-local"
+ctl.note_handoff(kept_tokens=512, nbytes=512 * 8192)
+assert ctl.bytes_per_token() == 8192.0
+slow = DisaggController(RestorePolicy(min_tokens=8),
+                        prefill_min_tokens=64)
+slow.kv_policy.note_migrate(1000, 10.0)   # ~100 B/s wire
+assert not slow.wants_handoff(512), \
+    "a priced-out wire must fall back to mixed placement"
+print("disagg pricing smoke OK: threshold + EMA pricing + learned "
+      f"bytes/token {ctl.bytes_per_token():.0f}")
 EOF
     exit 0
 fi

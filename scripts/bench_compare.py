@@ -25,6 +25,10 @@ improvement, a faster tok/s headline is a regression):
                                               regression below -5%
   multiturn / radix / chaos                   ms, lower is better,
                                               regression above +25%
+  disagg                                      ITL p99 gain ratio,
+                                              higher is better, below
+                                              -25% (tail-latency
+                                              derived, latency band)
   longctx / int4 / paged                      capacity ratios, higher
                                               is better, below -10%
   structured                                  overhead frac, must stay
@@ -68,6 +72,10 @@ _MODES: tuple[tuple, ...] = (
     ("multiturn",
      lambda m, u: m.startswith("multiturn"), "lower", 0.25),
     ("radix", lambda m, u: m.startswith("radix"), "lower", 0.25),
+    # Decode ITL p99 gain ratio (role-split over mixed): higher is
+    # better, and it is tail-latency derived so it gets the loose
+    # latency-class band, not the throughput one.
+    ("disagg", lambda m, u: m.startswith("disagg"), "higher", 0.25),
     ("longctx", lambda m, u: m.startswith("longctx"), "higher", 0.10),
     ("int4", lambda m, u: m.startswith("int4"), "higher", 0.10),
     ("paged", lambda m, u: m.startswith("paged"), "higher", 0.10),

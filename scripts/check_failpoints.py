@@ -31,7 +31,8 @@ FAILPOINTS = REPO / "fasttalk_tpu" / "resilience" / "failpoints.py"
 # exercised by at least one of them (router seams live in the fleet
 # fabric suite, everything else in the original chaos suite).
 CHAOS_TESTS = (REPO / "tests" / "test_chaos.py",
-               REPO / "tests" / "test_fleet_fabric.py")
+               REPO / "tests" / "test_fleet_fabric.py",
+               REPO / "tests" / "test_disagg.py")
 
 
 def catalog_names() -> set[str]:

@@ -43,6 +43,7 @@ SEAM_SPANS = {
     "router.probe": "probe",
     "router.migrate_send": "migrate_send",
     "router.migrate_recv": "migrate_recv",
+    "router.handoff": "handoff",
 }
 
 # Spans with no failpoint seam of their own but part of the router's

@@ -1,0 +1,750 @@
+"""Disaggregated prefill/decode serving (docs/ROUTER.md "Disaggregated
+prefill/decode", router/disagg.py): replica roles over the KV
+migration wire.
+
+Coverage per the PR's acceptance bar:
+
+- role vocabulary + role-filtered placement (a decode stream never
+  lands on a prefill replica; a pin pointing at one is ignored);
+- threshold routing: a prompt clearing DISAGG_PREFILL_MIN_TOKENS takes
+  the prefill→handoff→decode path as ONE client-invisible stream (the
+  prefill tier computes, the KV crosses the /kv/parked wire, the
+  decode tier streams — exactly one terminal event, zero error
+  frames); short prompts place decode-local;
+- pricing fallback: when the learned EMAs say the transfer costs more
+  than re-prefilling decode-side, the stream falls back to mixed
+  placement (no cliff);
+- chaos drills on the ``router.handoff`` failpoint
+  (scripts/check_failpoints.py counts this file): the prefill side
+  dying mid-chunk and a hung/failed settle both fall back with zero
+  client-visible error frames, and a hung handoff pays at most ONE
+  ROUTER_MIGRATE_TIMEOUT_S;
+- independent per-tier elastic scaling (prefill on aggregate queue
+  depth, decode on slot occupancy) with role preserved on scale-up
+  and the last replica of a tier never retired;
+- radix donation on ``/kv/parked`` import (real engines): a
+  migrated-in prefix enters the target's radix tree at restore;
+- the real-engine end-to-end: role-split fleet answers a long prompt
+  token-identical to a mixed control fleet.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from fasttalk_tpu.engine.engine import GenerationParams
+from fasttalk_tpu.resilience import failpoints as fp
+from fasttalk_tpu.router import ElasticScaler, FleetRouter, ReplicaHandle
+from fasttalk_tpu.router.disagg import (DECODE_ROLES, ROLE_DECODE,
+                                        ROLE_MIXED, ROLE_PREFILL,
+                                        DisaggController, parse_roles,
+                                        role_of, tier_stats)
+from fasttalk_tpu.router.policy import PlacementPolicy
+from fasttalk_tpu.utils.errors import ErrorCategory, LLMServiceError
+from tests.test_fleet_fabric import (GREEDY, PoolEngine, make_config,
+                                     make_entry)
+
+LONG_MSG = [{"role": "user", "content": "word " * 160}]   # ~200 est toks
+SHORT_MSG = [{"role": "user", "content": "hi"}]
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+# ---------------------------------------------------------------------
+# Fake speaking the disagg contract
+# ---------------------------------------------------------------------
+
+class DisaggEngine(PoolEngine):
+    """PoolEngine + the two engine-side pieces of disaggregation the
+    way TPUEngine implements them: a ``prefill_only`` request runs the
+    chunked prefill, parks the rows, and finishes ``prefill_parked``;
+    a prefill-role engine rejects decode streams outright."""
+
+    def __init__(self, prefill_tokens: int = 64,
+                 die_in_prefill: bool = False, **kw):
+        super().__init__(**kw)
+        self.prefill_tokens = prefill_tokens
+        self.die_in_prefill = die_in_prefill
+        self.prefill_requests: list[str] = []
+
+    async def generate(self, request_id, session_id, messages, params):
+        if getattr(params, "prefill_only", False):
+            self.prefill_requests.append(request_id)
+            self.requests_seen.append({
+                "request_id": request_id, "session_id": session_id,
+                "messages": messages, "params": params,
+            })
+            if self.dead:
+                raise LLMServiceError(
+                    "replica down", category=ErrorCategory.CONNECTION)
+            if self.die_in_prefill:
+                self.kill()
+                raise LLMServiceError(
+                    "replica died mid-chunk",
+                    category=ErrorCategory.CONNECTION)
+            self.pool.revive(session_id)
+            self.pool.put(make_entry(session_id,
+                                     n_tokens=self.prefill_tokens))
+            yield {"type": "done", "finish_reason": "prefill_parked",
+                   "stats": {"ttft_ms": 3.0,
+                             "prefill_tokens": self.prefill_tokens}}
+            return
+        if getattr(self, "role", "mixed") == "prefill":
+            raise LLMServiceError(
+                "replica role is 'prefill': decode streams are "
+                "rejected", category=ErrorCategory.VALIDATION,
+                recoverable=False)
+        async for ev in super().generate(request_id, session_id,
+                                         messages, params):
+            yield ev
+
+
+def make_disagg_fleet(roles=("prefill", "decode"), fast_wire=True,
+                      **router_kw):
+    engines = [DisaggEngine() for _ in roles]
+    handles = [ReplicaHandle(f"r{i}", e, role=role, dead_probes=2)
+               for i, (e, role) in enumerate(zip(engines, roles))]
+    kw = dict(probe_interval_s=0, failover_retries=2,
+              migrate_timeout_s=2.0, disagg_prefill_min_tokens=64)
+    kw.update(router_kw)
+    router = FleetRouter(handles, **kw)
+    router.start()
+    if fast_wire:
+        # Deterministic pricing: a fast learned wire makes the
+        # three-way policy choose "migrate" for any long prompt.
+        router.kv_policy.note_migrate(64 * 1024 * 1024, 0.01)
+    return router, engines, handles
+
+
+async def collect(router, rid, sid, messages, max_tokens=16, **params):
+    events = []
+    async for ev in router.generate(
+            rid, sid, messages,
+            GenerationParams(max_tokens=max_tokens, **GREEDY,
+                             **params)):
+        events.append(ev)
+    return events
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def assert_clean_stream(events):
+    """One terminal event, zero client-visible error/resumed frames —
+    the disagg machinery must be invisible however it went."""
+    assert events, "empty stream"
+    assert [e["type"] for e in events].count("done") == 1
+    assert events[-1]["type"] == "done"
+    assert not [e for e in events
+                if e["type"] in ("error", "resumed")], events
+
+
+# ---------------------------------------------------------------------
+# Role vocabulary + role-aware placement
+# ---------------------------------------------------------------------
+
+class TestRoles:
+    def test_parse_roles(self):
+        assert parse_roles("", 3) == ["mixed"] * 3
+        assert parse_roles("prefill, Decode,mixed", 3) == \
+            ["prefill", "decode", "mixed"]
+        with pytest.raises(ValueError, match="invalid replica role"):
+            parse_roles("prefill,banana", 2)
+        with pytest.raises(ValueError, match="one role per replica"):
+            parse_roles("prefill,decode", 3, "FLEET_ROLES")
+
+    def test_role_of_defaults_mixed(self):
+        class Bare:
+            pass
+        assert role_of(Bare()) == ROLE_MIXED
+
+    def test_place_filters_roles_and_ignores_prefill_pin(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            policy, affinity = router.policy, router.affinity
+            # role filter: only the decode replica is a candidate
+            h, affine = policy.place("s1", handles, set(),
+                                     roles=DECODE_ROLES)
+            assert h.replica_id == "r1" and not affine
+            # a pin pointing at the prefill replica must be ignored,
+            # never followed
+            affinity.set("s2", "r0")
+            h, affine = policy.place("s2", handles, set(),
+                                     roles=DECODE_ROLES)
+            assert h.replica_id == "r1" and not affine
+        finally:
+            router.shutdown()
+
+    def test_pick_tier_no_affinity_side_effects(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            h = PlacementPolicy.pick_tier(handles, (ROLE_PREFILL,))
+            assert h.replica_id == "r0"
+            assert router.affinity.get("anything") is None
+            assert PlacementPolicy.pick_tier(
+                handles, (ROLE_PREFILL,), exclude={"r0"}) is None
+        finally:
+            router.shutdown()
+
+    def test_prefill_engine_rejects_decode_stream(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            async def direct():
+                async for _ in engines[0].generate(
+                        "rX", "sX", SHORT_MSG,
+                        GenerationParams(max_tokens=4, **GREEDY)):
+                    pass
+            with pytest.raises(LLMServiceError, match="prefill"):
+                run(direct())
+        finally:
+            router.shutdown()
+
+    def test_tier_stats_aggregates_by_role(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            for h in handles:
+                h.probe_now()
+            tiers = tier_stats(handles)
+            assert set(tiers) == {"prefill", "decode"}
+            assert tiers["prefill"]["replicas"] == 1
+            assert tiers["decode"]["available"] == 1
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Threshold routing + the full handoff
+# ---------------------------------------------------------------------
+
+class TestHandoff:
+    def test_long_prompt_takes_prefill_handoff_decode_path(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            events = run(collect(router, "t1", "A", LONG_MSG))
+            assert_clean_stream(events)
+            assert "".join(e.get("text", "") for e in events
+                           if e["type"] == "token").strip()
+            # the prefill tier ran the prefill_only sub-request under
+            # a derived id — the client id never lands there
+            assert engines[0].prefill_requests == ["t1.prefill"]
+            # the KV crossed the wire: source pool gave the entry up,
+            # the decode pool holds it byte-whole
+            assert engines[0].pool.stats()["sessions"] == 0
+            entry = engines[1].pool.get("A")
+            assert entry is not None
+            assert entry.kept == engines[0].prefill_tokens
+            # the session ended pinned to the DECODE replica
+            assert router.affinity.get("A") == "r1"
+            # the decode stream itself ran on r1, not r0
+            assert all(r["params"].prefill_only is False
+                       for r in engines[1].requests_seen)
+            assert router.disagg.handoffs == 1
+            assert router.disagg.fallbacks == 0
+            # the wire-cost model learned from the completed handoff
+            assert router.disagg.bytes_per_token() == pytest.approx(
+                entry.nbytes / entry.kept)
+        finally:
+            router.shutdown()
+
+    def test_short_prompt_places_decode_local(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            events = run(collect(router, "t2", "B", SHORT_MSG))
+            assert_clean_stream(events)
+            assert engines[0].prefill_requests == []
+            assert engines[0].requests_seen == []
+            assert router.disagg.handoffs == 0
+        finally:
+            router.shutdown()
+
+    def test_mixed_fleet_never_consults_disagg(self):
+        router, engines, handles = make_disagg_fleet(
+            roles=("mixed", "mixed"))
+        try:
+            events = run(collect(router, "t3", "C", LONG_MSG))
+            assert_clean_stream(events)
+            assert engines[0].prefill_requests == []
+            assert engines[1].prefill_requests == []
+            assert router.disagg.handoffs == 0
+            assert router.disagg.fallbacks == 0
+        finally:
+            router.shutdown()
+
+    def test_cancel_mid_handoff_forwards_to_prefill_leg(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            # Freeze the settle so the cancel lands while the handoff
+            # owns the stream.
+            fp.activate("router.handoff=hang")
+
+            async def scenario():
+                agen = router.generate(
+                    "t4", "D", LONG_MSG,
+                    GenerationParams(max_tokens=8, **GREEDY))
+                task = asyncio.ensure_future(agen.__anext__())
+                await asyncio.sleep(0.1)
+                router.cancel("t4")
+                fp.clear()
+                first = await task
+                events = [first]
+                async for ev in agen:
+                    events.append(ev)
+                return events
+
+            events = run(scenario())
+            assert events[-1]["type"] == "cancelled"
+            assert not [e for e in events if e["type"] == "error"]
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Pricing fallback
+# ---------------------------------------------------------------------
+
+class TestPricingFallback:
+    def test_slow_wire_prices_out_the_handoff(self):
+        router, engines, handles = make_disagg_fleet(fast_wire=False)
+        try:
+            # Teach the policy a glacial wire: transferring anything
+            # costs more than re-prefilling it decode-side.
+            router.kv_policy.note_migrate(1000, 10.0)
+            assert not router.disagg.wants_handoff(200)
+            events = run(collect(router, "t5", "E", LONG_MSG))
+            assert_clean_stream(events)
+            assert engines[0].prefill_requests == []
+            assert router.disagg.handoffs == 0
+            # priced-out is the documented fallback, not an error:
+            # the stream served decode-local
+            assert router.affinity.get("E") == "r1"
+        finally:
+            router.shutdown()
+
+    def test_controller_threshold_and_ema(self):
+        router, _, _ = make_disagg_fleet()
+        try:
+            ctrl = DisaggController(router.kv_policy,
+                                    prefill_min_tokens=100)
+            assert not ctrl.wants_handoff(99)
+            assert ctrl.wants_handoff(5000)
+            ctrl.note_handoff(100, 819200)          # 8192 B/token
+            assert ctrl.bytes_per_token() == pytest.approx(8192.0)
+            ctrl.note_handoff(100, 819200)
+            assert ctrl.stats()["handoffs"] == 2
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Chaos drills (router.handoff; check_failpoints counts this file)
+# ---------------------------------------------------------------------
+
+class TestHandoffChaos:
+    def test_prefill_dies_mid_chunk_falls_back_clean(self):
+        router, engines, handles = make_disagg_fleet()
+        engines[0].die_in_prefill = True
+        try:
+            events = run(collect(router, "c1", "F", LONG_MSG))
+            # zero client-visible error frames: the decode tier
+            # re-prefilled the prompt and streamed normally
+            assert_clean_stream(events)
+            assert engines[0].prefill_requests == ["c1.prefill"]
+            assert router.disagg.handoffs == 0
+            assert router.disagg.fallbacks == 1
+            assert router.affinity.get("F") == "r1"
+        finally:
+            router.shutdown()
+
+    def test_handoff_error_fault_falls_back_clean(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            fp.activate("router.handoff=error")
+            events = run(collect(router, "c2", "G", LONG_MSG))
+            assert_clean_stream(events)
+            assert router.disagg.fallbacks == 1
+            assert router.disagg.handoffs == 0
+            # the prefill leg DID run; only the settle was injected —
+            # its parked entry stays behind and ages out by TTL/LRU
+            assert engines[0].prefill_requests == ["c2.prefill"]
+        finally:
+            router.shutdown()
+
+    def test_hung_handoff_pays_at_most_one_migrate_timeout(self):
+        router, engines, handles = make_disagg_fleet(
+            migrate_timeout_s=0.3)
+        try:
+            fp.activate("router.handoff=hang")
+            t0 = time.monotonic()
+            events = run(collect(router, "c3", "H", LONG_MSG))
+            elapsed = time.monotonic() - t0
+            assert_clean_stream(events)
+            # bounded by ONE ROUTER_MIGRATE_TIMEOUT_S (+ slack for the
+            # decode-side stream itself)
+            assert elapsed < 0.3 + 1.5, elapsed
+            assert router.disagg.fallbacks == 1
+        finally:
+            router.shutdown()
+
+    def test_no_decode_replica_available_falls_back_to_shed(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            engines[1].kill()
+            handles[1].probe_now()
+            handles[1].probe_now()  # dead_probes=2
+            with pytest.raises(Exception):
+                run(collect(router, "c4", "I", LONG_MSG))
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Independent per-tier elastic scaling
+# ---------------------------------------------------------------------
+
+def _stub_stats(engine, waiting=0, running=0, total=2):
+    engine.get_stats = lambda: {
+        "waiting": waiting, "running": running,
+        "slots": {"total_slots": total, "active": running,
+                  "pinned": 0, "resident_tokens": 0}}
+
+
+class TestElasticTiers:
+    def _scaler(self, router, roles_built, **kw):
+        def build(replica_id, role="mixed"):
+            roles_built.append((replica_id, role))
+            return ReplicaHandle(replica_id, DisaggEngine(), role=role,
+                                 dead_probes=2)
+        defaults = dict(min_replicas=1, max_replicas=5,
+                        up_queue_depth=4, down_idle_s=1.0)
+        defaults.update(kw)
+        return ElasticScaler(router, build, **defaults)
+
+    def test_prefill_queue_depth_scales_prefill_tier(self):
+        router, engines, handles = make_disagg_fleet()
+        built = []
+        try:
+            scaler = self._scaler(router, built)
+            _stub_stats(engines[0], waiting=10)
+            handles[0].probe_now()
+            decision = scaler.check_once()
+            assert decision["decision"] == "up"
+            assert built == [("elastic-1", "prefill")]
+            new = next(h for h in router.replicas
+                       if h.replica_id == "elastic-1")
+            assert role_of(new) == ROLE_PREFILL
+            assert new.engine.role == "prefill"
+        finally:
+            router.shutdown()
+
+    def test_decode_occupancy_scales_decode_tier(self):
+        router, engines, handles = make_disagg_fleet()
+        built = []
+        try:
+            scaler = self._scaler(router, built)
+            # decode slots saturated, but nobody QUEUED anywhere —
+            # the occupancy signal alone must trigger the scale-up
+            _stub_stats(engines[1], running=2, total=2)
+            handles[1].probe_now()
+            decision = scaler.check_once()
+            assert decision["decision"] == "up"
+            assert built == [("elastic-1", "decode")]
+            assert role_of(router.replicas[-1]) == ROLE_DECODE
+        finally:
+            router.shutdown()
+
+    def test_scale_down_never_empties_a_tier(self):
+        clock = [0.0]
+        engines = [DisaggEngine(), DisaggEngine()]
+        handles = [ReplicaHandle(f"r{i}", e, role=role, dead_probes=2)
+                   for i, (e, role) in enumerate(
+                       zip(engines, ("prefill", "decode")))]
+        router = FleetRouter(handles, probe_interval_s=0,
+                             migrate_timeout_s=2.0)
+        router.start()
+        built = []
+        try:
+            scaler = self._scaler(router, built,
+                                  clock=lambda: clock[0])
+            assert scaler.check_once()["decision"] == "hold"  # arm idle
+            clock[0] += 10.0
+            decision = scaler.check_once()
+            # both replicas are the last of their tier: hold, retire
+            # neither
+            assert decision["decision"] == "hold"
+            assert len(router.replicas) == 2
+        finally:
+            router.shutdown()
+
+    def test_one_arg_builder_back_compat_mixed_fleet(self):
+        router, engines, handles = make_disagg_fleet(
+            roles=("mixed", "mixed"))
+        built = []
+        try:
+            def build(replica_id):  # pre-roles builder shape
+                built.append(replica_id)
+                return ReplicaHandle(replica_id, DisaggEngine(),
+                                     dead_probes=2)
+            scaler = ElasticScaler(router, build, min_replicas=1,
+                                   max_replicas=4, up_queue_depth=4)
+            _stub_stats(engines[0], waiting=10)
+            handles[0].probe_now()
+            assert scaler.check_once()["decision"] == "up"
+            assert built == ["elastic-1"]
+            assert role_of(router.replicas[-1]) == ROLE_MIXED
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# /fleet + metrics surfacing
+# ---------------------------------------------------------------------
+
+class TestObservability:
+    def test_fleet_stats_carries_roles_tiers_and_handoffs(self):
+        router, engines, handles = make_disagg_fleet()
+        try:
+            run(collect(router, "o1", "J", LONG_MSG))
+            fs = router.fleet_stats()
+            roles = {r["replica_id"]: r["role"]
+                     for r in fs["replicas"]}
+            assert roles == {"r0": "prefill", "r1": "decode"}
+            d = fs["disagg"]
+            assert d["handoffs"] == 1 and d["fallbacks"] == 0
+            assert d["prefill_min_tokens"] == 64
+            assert set(d["tiers"]) == {"prefill", "decode"}
+            assert router.get_stats()["per_replica"]["r0"]["role"] \
+                == "prefill"
+        finally:
+            router.shutdown()
+
+    def test_handoff_metrics_prometheus_valid(self):
+        import importlib.util
+        import pathlib
+
+        from fasttalk_tpu.utils.metrics import get_metrics
+
+        router, engines, handles = make_disagg_fleet()
+        try:
+            run(collect(router, "o2", "K", LONG_MSG))
+            fp.activate("router.handoff=error")
+            run(collect(router, "o3", "K2", LONG_MSG))
+            fp.clear()
+            spec = importlib.util.spec_from_file_location(
+                "check_prometheus",
+                pathlib.Path(__file__).parent.parent / "scripts"
+                / "check_prometheus.py")
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            text = get_metrics().prometheus()
+            for name in ("router_disagg_handoffs_total",
+                         "router_disagg_handoff_ms",
+                         "router_disagg_fallback_total"):
+                assert name in text, name
+            problems = mod.validate(text)
+            assert not problems, problems
+        finally:
+            router.shutdown()
+
+    def test_handoff_span_in_stitched_trace(self):
+        from fasttalk_tpu.observability.trace import (get_tracer,
+                                                      mint_trace_id)
+
+        router, engines, handles = make_disagg_fleet()
+        try:
+            tr = get_tracer()
+            tid = mint_trace_id()
+            tr.start("o4", "L", trace_id=tid)
+            run(collect(router, "o4", "L", LONG_MSG))
+            names = [s.name for s in tr.get("o4").spans]
+            assert "handoff" in names
+            span = next(s for s in tr.get("o4").spans
+                        if s.name == "handoff")
+            assert span.attrs["src"] == "r0"
+            assert span.attrs["dst"] == "r1"
+            stitched = router.stitched_trace("o4")
+            assert stitched is not None
+            assert "handoff" in [s["name"] for s in stitched["spans"]]
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------
+
+class TestDisaggConfig:
+    def test_named_startup_errors(self):
+        with pytest.raises(ValueError, match="ROUTER_ENABLED"):
+            make_config(FLEET_ROLES="prefill,decode",
+                        FLEET_REPLICAS="2")
+        with pytest.raises(ValueError, match="ROUTER_MIGRATE"):
+            make_config(ROUTER_ENABLED="true", FLEET_REPLICAS="2",
+                        FLEET_ROLES="prefill,decode",
+                        ROUTER_MIGRATE="false")
+        with pytest.raises(ValueError,
+                           match="contains invalid role"):
+            make_config(ROUTER_ENABLED="true", FLEET_REPLICAS="2",
+                        FLEET_ROLES="prefill,banana")
+        with pytest.raises(ValueError, match="one role per replica"):
+            make_config(ROUTER_ENABLED="true", FLEET_REPLICAS="3",
+                        FLEET_ROLES="prefill,decode")
+        with pytest.raises(ValueError, match="decode"):
+            make_config(ROUTER_ENABLED="true", FLEET_REPLICAS="2",
+                        FLEET_ROLES="prefill,prefill")
+        with pytest.raises(ValueError,
+                           match="disagg_prefill_min_tokens"):
+            make_config(ROUTER_ENABLED="true",
+                        DISAGG_PREFILL_MIN_TOKENS="0")
+
+    def test_knobs_surface_in_config_show(self):
+        cfg = make_config(ROUTER_ENABLED="true", FLEET_REPLICAS="2",
+                          FLEET_ROLES="prefill,decode",
+                          DISAGG_PREFILL_MIN_TOKENS="128")
+        d = cfg.to_dict()
+        assert d["fleet_roles"] == "prefill,decode"
+        assert d["router_backend_roles"] == ""
+        assert d["disagg_prefill_min_tokens"] == 128
+
+    def test_all_mixed_defaults_stay_valid(self):
+        cfg = make_config(ROUTER_ENABLED="true", FLEET_REPLICAS="2")
+        assert cfg.fleet_roles == ""
+        assert cfg.disagg_prefill_min_tokens == 512
+
+
+# ---------------------------------------------------------------------
+# Real engines: role split end to end + radix donation on import
+# ---------------------------------------------------------------------
+
+REAL_MSG = [{"role": "user", "content":
+             "please summarize the following paragraph about paged "
+             "attention and prefix caches in terms a beginner could "
+             "follow without prior background in serving systems"}]
+
+
+def _real_engine(**kw):
+    from tests.test_fleet_fabric import _make_engine
+    return _make_engine(**kw)
+
+
+def _real_fleet(roles, **router_kw):
+    engines = [_real_engine() for _ in roles]
+    handles = [ReplicaHandle(f"r{i}", e, role=role)
+               for i, (e, role) in enumerate(zip(engines, roles))]
+    kw = dict(probe_interval_s=0, migrate_timeout_s=20.0,
+              disagg_prefill_min_tokens=64)
+    kw.update(router_kw)
+    router = FleetRouter(handles, **kw)
+    router.start()
+    router.kv_policy.note_migrate(64 * 1024 * 1024, 0.01)
+    return router, engines, handles
+
+
+def _collect_real(router, rid, sid, msgs, max_tokens=8):
+    async def go():
+        out = []
+        async for ev in router.generate(
+                rid, sid, msgs,
+                GenerationParams(max_tokens=max_tokens,
+                                 temperature=0.0, top_k=0,
+                                 top_p=1.0)):
+            out.append(ev)
+        return out
+    return asyncio.run(go())
+
+
+@pytest.mark.slow
+class TestRealEngineDisagg:
+    def test_handoff_token_parity_with_mixed_control(self):
+        # Control: the same prompt on an all-mixed fleet.
+        control, c_engines, _ = _real_fleet(("mixed", "mixed"))
+        try:
+            c_events = _collect_real(control, "p0", "CTRL", REAL_MSG)
+            assert c_events[-1]["type"] == "done"
+            control_text = "".join(e.get("text", "") for e in c_events
+                                   if e["type"] == "token")
+        finally:
+            control.shutdown()
+
+        router, engines, handles = _real_fleet(("prefill", "decode"))
+        try:
+            events = _collect_real(router, "p1", "REAL", REAL_MSG)
+            assert_clean_stream(events)
+            text = "".join(e.get("text", "") for e in events
+                           if e["type"] == "token")
+            # greedy sampling: the role-split stream must be
+            # token-identical to the mixed control
+            assert text == control_text
+            assert router.disagg.handoffs == 1, \
+                router.fleet_stats()["disagg"]
+            # the stream decoded on the decode replica via the restore
+            # path (not a re-prefill of the transcript)
+            assert engines[1].get_stats()["kv_host"]["restored_total"] \
+                >= 1
+            assert router.affinity.get("REAL") == "r1"
+        finally:
+            router.shutdown()
+
+    def test_import_marks_entry_and_restore_donates_to_radix(self):
+        # Engine A (paged+radix) parks a session's KV; engine B
+        # (paged+radix) imports it over the migration seam. The
+        # restore on B must (a) see the imported flag and (b) donate
+        # the migrated-in prefix into B's radix tree — a third session
+        # with the same prompt then aliases it.
+        radix_kw = dict(kv_layout="paged", kv_block_size=16,
+                        kv_radix=True)
+        a = _real_engine(**radix_kw)
+        b = _real_engine(**radix_kw)
+        try:
+            events = _collect_real_single(a, "r1", "S", REAL_MSG)
+            assert events[-1]["type"] == "done"
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline \
+                    and a._kv_pool.parked_len("S") == 0:
+                time.sleep(0.02)
+            entry = a.export_parked_kv("S")
+            assert entry is not None
+            assert getattr(entry, "imported", False) is False
+            assert b.import_parked_kv(entry)
+            imported = b._kv_pool.get("S")
+            assert imported is not None and imported.imported is True
+            inserted0 = b._kv_radix.stats()["inserted_blocks"]
+            reply = "".join(e.get("text", "") for e in events
+                            if e["type"] == "token")
+            msg2 = REAL_MSG + [
+                {"role": "assistant", "content": reply},
+                {"role": "user", "content": "and a short follow-up"}]
+            events2 = _collect_real_single(b, "r2", "S", msg2)
+            assert events2[-1]["type"] == "done"
+            assert b.get_stats()["kv_host"]["restored_total"] >= 1, \
+                "follow-up re-prefilled instead of restoring"
+            assert b._kv_radix.stats()["inserted_blocks"] > inserted0, \
+                "restore of an imported entry did not donate to radix"
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_prefill_only_rejects_structured(self):
+        with pytest.raises(ValueError, match="prefill_only"):
+            GenerationParams(max_tokens=4, prefill_only=True,
+                             structured={"type": "json_schema",
+                                         "schema": {"type": "object"}})
+
+
+def _collect_real_single(engine, rid, sid, msgs, max_tokens=8):
+    async def go():
+        out = []
+        async for ev in engine.generate(
+                rid, sid, msgs,
+                GenerationParams(max_tokens=max_tokens,
+                                 temperature=0.0, top_k=0,
+                                 top_p=1.0)):
+            out.append(ev)
+        return out
+    return asyncio.run(go())

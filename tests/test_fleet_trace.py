@@ -20,7 +20,7 @@ The tentpole invariants under test:
 
 scripts/check_router_spans.py statically asserts this file references
 every router span name: "place", "probe", "failover", "migrate_send",
-"migrate_recv", "resume".
+"migrate_recv", "resume", "handoff".
 """
 
 import asyncio
@@ -497,6 +497,29 @@ class TestRouterSpans:
             assert "migrate_send" in names
             assert "migrate_recv" in names
             assert names["migrate_send"].attrs["session_id"] == "s-mig"
+        finally:
+            router.shutdown()
+
+    async def test_disagg_handoff_records_handoff_span(self):
+        """The disagg prefill→decode handoff (router/disagg.py) is a
+        routing decision like place/migrate — its "handoff" span must
+        land in the same per-request timeline, attributed src→dst."""
+        from tests.test_disagg import LONG_MSG, make_disagg_fleet
+
+        router, engines, handles = make_disagg_fleet()
+        try:
+            tr = get_tracer()
+            tr.start("rid-h", "sess-h", trace_id=mint_trace_id())
+            events = []
+            async for ev in router.generate(
+                    "rid-h", "sess-h", LONG_MSG,
+                    GenerationParams(max_tokens=8, **GREEDY)):
+                events.append(ev)
+            assert events[-1]["type"] == "done"
+            spans = {s.name: s for s in tr.get("rid-h").spans}
+            assert "handoff" in spans
+            assert spans["handoff"].attrs["src"] == "r0"
+            assert spans["handoff"].attrs["dst"] == "r1"
         finally:
             router.shutdown()
 
